@@ -1,0 +1,92 @@
+"""DeepSpeed-TPU: a TPU-native training framework with the DeepSpeed API.
+
+Public surface parity with reference deepspeed/__init__.py: ``initialize()``,
+``add_config_arguments()``, ``init_distributed``, ``zero``, pipeline module
+types, ops. Internals are JAX/XLA/pjit/Pallas over a device mesh — no
+torch, no NCCL.
+"""
+from .version import __version__, __version_info__
+
+from .utils.distributed import init_distributed
+from .utils.logging import logger, log_dist
+from .runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+
+__git_hash__ = None
+__git_branch__ = None
+
+
+def initialize(args=None, model=None, optimizer=None, model_parameters=None,
+               training_data=None, lr_scheduler=None, mpu=None,
+               dist_init_required=None, collate_fn=None, config=None,
+               config_params=None):
+    """Initialize the DeepSpeed-TPU engine.
+
+    Mirrors reference deepspeed/__init__.py:52. Returns a tuple of
+    ``(engine, optimizer, training_dataloader, lr_scheduler)``.
+
+    ``model`` is a :class:`deepspeed_tpu.Model` (apply_fn + params pytree), a
+    flax module instance paired with params via ``model_parameters``, or a
+    :class:`deepspeed_tpu.pipe.PipelineModule` for pipeline parallelism.
+    """
+    from .runtime.engine import DeepSpeedEngine
+    from .runtime.pipe.module import PipelineModule
+    from .runtime.pipe.engine import PipelineEngine
+
+    assert model is not None, "deepspeed.initialize requires a model"
+
+    log_dist("DeepSpeedTPU info: version={}".format(__version__), ranks=[0])
+
+    if dist_init_required is None or dist_init_required:
+        init_distributed()
+
+    if config is None and config_params is not None:
+        config = config_params
+
+    if not isinstance(model, PipelineModule):
+        engine = DeepSpeedEngine(args=args,
+                                 model=model,
+                                 optimizer=optimizer,
+                                 model_parameters=model_parameters,
+                                 training_data=training_data,
+                                 lr_scheduler=lr_scheduler,
+                                 mpu=mpu,
+                                 dist_init_required=dist_init_required,
+                                 collate_fn=collate_fn,
+                                 config_params=config)
+    else:
+        assert mpu is None, "mpu must be None with pipeline parallelism"
+        engine = PipelineEngine(args=args,
+                                model=model,
+                                optimizer=optimizer,
+                                model_parameters=model_parameters,
+                                training_data=training_data,
+                                lr_scheduler=lr_scheduler,
+                                mpu=model.mpu(),
+                                dist_init_required=dist_init_required,
+                                collate_fn=collate_fn,
+                                config_params=config)
+
+    return_items = [engine, engine.optimizer, engine.training_dataloader,
+                    engine.lr_scheduler]
+    return tuple(return_items)
+
+
+def _add_core_arguments(parser):
+    """Add DeepSpeed args group (reference __init__.py:148)."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed (helper flag for user code, no "
+                            "impact on DeepSpeed backend)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="DeepSpeed json configuration file.")
+    group.add_argument("--deepspeed_mpi", default=False, action="store_true",
+                       help="Run via MPI; discover the job launch info from "
+                            "the MPI environment.")
+    return parser
+
+
+def add_config_arguments(parser):
+    """Update an argument parser to enable the DeepSpeed-TPU runtime
+    (reference __init__.py:199)."""
+    parser = _add_core_arguments(parser)
+    return parser

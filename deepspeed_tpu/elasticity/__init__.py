@@ -1,0 +1,10 @@
+from .elasticity import (compute_elastic_config, elasticity_enabled,
+                         ensure_immutable_elastic_config,
+                         get_candidate_batch_sizes, get_valid_gpus,
+                         get_best_candidates, _get_compatible_gpus_v01, HCN_LIST)
+from .config import (ElasticityConfig, ElasticityError, ElasticityConfigError,
+                     ElasticityIncompatibleWorldSize)
+from .constants import (ELASTICITY, ENABLED, DEEPSPEED_ELASTICITY_CONFIG,
+                        MINIMUM_DEEPSPEED_VERSION, LATEST_ELASTICITY_VERSION,
+                        IGNORE_NON_ELASTIC_BATCH_INFO,
+                        IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT)

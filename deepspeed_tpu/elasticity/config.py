@@ -1,0 +1,82 @@
+"""Elasticity config object (reference: deepspeed/elasticity/config.py)."""
+import json
+
+from .constants import (
+    ENABLED, ENABLED_DEFAULT, MAX_ACCEPTABLE_BATCH_SIZE,
+    MAX_ACCEPTABLE_BATCH_SIZE_DEFAULT, MICRO_BATCHES, MICRO_BATCHES_DEFAULT,
+    MIN_GPUS, MIN_GPUS_DEFAULT, MAX_GPUS, MAX_GPUS_DEFAULT, MIN_TIME,
+    MIN_TIME_DEFAULT, VERSION, VERSION_DEFAULT, PREFER_LARGER_BATCH,
+    PREFER_LARGER_BATCH_DEFAULT, IGNORE_NON_ELASTIC_BATCH_INFO,
+    IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT)
+
+
+class ElasticityError(Exception):
+    """Base exception for elasticity errors."""
+
+
+class ElasticityConfigError(ElasticityError):
+    """Bad elasticity configuration."""
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    """World size not in the valid device-count list for the elastic config."""
+
+
+class ElasticityConfig:
+    """Typed view of the ``"elasticity"`` config block.
+
+    When enabled, ``max_train_batch_size`` and ``micro_batch_sizes`` are
+    required; device-count bounds, min_time, version, and batch preference are
+    optional.
+    """
+
+    def __init__(self, param_dict):
+        self.enabled = param_dict.get(ENABLED, ENABLED_DEFAULT)
+        if self.enabled:
+            for required in (MAX_ACCEPTABLE_BATCH_SIZE, MICRO_BATCHES):
+                if required not in param_dict:
+                    raise ElasticityConfigError(
+                        "Elasticity config missing {}".format(required))
+            self.max_acceptable_batch_size = param_dict[MAX_ACCEPTABLE_BATCH_SIZE]
+            self.micro_batches = param_dict[MICRO_BATCHES]
+        else:
+            self.max_acceptable_batch_size = param_dict.get(
+                MAX_ACCEPTABLE_BATCH_SIZE, MAX_ACCEPTABLE_BATCH_SIZE_DEFAULT)
+            self.micro_batches = param_dict.get(MICRO_BATCHES, MICRO_BATCHES_DEFAULT)
+
+        if not isinstance(self.micro_batches, list):
+            raise ElasticityConfigError(
+                "micro_batch_sizes must be a list, got {}: {}".format(
+                    type(self.micro_batches), self.micro_batches))
+        if not all(isinstance(m, int) and m > 0 for m in self.micro_batches):
+            raise ElasticityConfigError(
+                "micro_batch_sizes must be positive integers, got {}".format(
+                    self.micro_batches))
+
+        self.min_gpus = param_dict.get(MIN_GPUS, MIN_GPUS_DEFAULT)
+        self.max_gpus = param_dict.get(MAX_GPUS, MAX_GPUS_DEFAULT)
+        if self.min_gpus < 1 or self.max_gpus < 1:
+            raise ElasticityConfigError(
+                "min/max device counts must be > 0, got min={} max={}".format(
+                    self.min_gpus, self.max_gpus))
+        if self.max_gpus < self.min_gpus:
+            raise ElasticityConfigError(
+                "min_gpus cannot exceed max_gpus, got min={} max={}".format(
+                    self.min_gpus, self.max_gpus))
+
+        self.min_time = param_dict.get(MIN_TIME, MIN_TIME_DEFAULT)
+        if self.min_time < 0:
+            raise ElasticityConfigError(
+                "min_time must be >= 0, got {}".format(self.min_time))
+
+        self.version = param_dict.get(VERSION, VERSION_DEFAULT)
+        self.prefer_larger_batch_size = param_dict.get(PREFER_LARGER_BATCH,
+                                                       PREFER_LARGER_BATCH_DEFAULT)
+        self.ignore_non_elastic_batch_info = param_dict.get(
+            IGNORE_NON_ELASTIC_BATCH_INFO, IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT)
+
+    def repr(self):
+        return self.__dict__
+
+    def __repr__(self):
+        return json.dumps(self.__dict__, sort_keys=True, indent=4)

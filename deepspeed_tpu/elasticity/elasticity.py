@@ -1,0 +1,206 @@
+"""Schedule-time elasticity: batch-size / device-count co-design.
+
+Reference behavior: deepspeed/elasticity/elasticity.py (v0.1 algorithm).
+Given acceptable micro-batch sizes and a max global batch, pick the global
+batch size divisible by the largest number of device counts, so the job can
+be scaled across that device-count list without changing convergence (the
+global batch decomposes as micro_batch * grad_accum * world_size).
+
+Pure math — identical on TPU; "gpus" in names kept for config parity, they
+mean accelerator chips here.
+"""
+import os
+import json
+import re
+from functools import reduce
+from math import gcd
+
+from .config import (ElasticityConfig, ElasticityConfigError, ElasticityError,
+                     ElasticityIncompatibleWorldSize)
+from .constants import (ELASTICITY, ENABLED, ENABLED_DEFAULT,
+                        LATEST_ELASTICITY_VERSION, MINIMUM_DEEPSPEED_VERSION,
+                        DEEPSPEED_ELASTICITY_CONFIG)
+from ..utils.logging import logger
+
+# Highly composite numbers: each has more divisors than any smaller positive
+# integer, which maximizes the number of compatible device counts per batch
+# size. Enough entries to cover ~720K global batch.
+HCN_LIST = [
+    1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840, 1260, 1680,
+    2520, 5040, 7560, 10080, 15120, 20160, 25200, 27720, 45360, 50400, 55440,
+    83160, 110880, 166320, 221760, 277200, 332640, 498960, 554400, 665280,
+    720720
+]
+
+
+def _lcm(values):
+    return reduce(lambda a, b: a * b // gcd(a, b), values)
+
+
+def get_candidate_batch_sizes(base_list, max_acceptable_batch_size):
+    """For each base, the largest base*HCN not exceeding the cap."""
+    candidates = set()
+    for base in base_list:
+        best = base
+        for hcn in HCN_LIST:
+            scaled = base * hcn
+            if scaled > max_acceptable_batch_size:
+                break
+            best = scaled
+        candidates.add(best)
+    return list(candidates)
+
+
+def get_valid_gpus(batch_size, micro_batches, min_valid_gpus, max_valid_gpus):
+    """All device counts w for which some micro-batch evenly tiles batch_size/w."""
+    valid = set()
+    for micro_batch in micro_batches:
+        if batch_size % micro_batch != 0:
+            continue
+        max_count = batch_size // micro_batch
+        # every divisor of max_count is a valid world size for this micro batch
+        divisors = [max_count] + [i for i in range(1, max_count // 2 + 1)
+                                  if max_count % i == 0]
+        for count in divisors:
+            if min_valid_gpus <= count <= max_valid_gpus:
+                valid.add(count)
+    return sorted(valid)
+
+
+def get_best_candidates(candidate_batch_sizes, micro_batches, min_gpus, max_gpus,
+                        prefer_larger):
+    """Pick the candidate with the most valid device counts (ties broken by
+    batch-size preference)."""
+    best_num_valid = 0
+    best_valid_gpus = None
+    best_batch_size = int(min(micro_batches))
+
+    for batch_size in candidate_batch_sizes:
+        valid_gpus = get_valid_gpus(batch_size, micro_batches, min_gpus, max_gpus)
+        better_tie = (len(valid_gpus) == best_num_valid and
+                      ((prefer_larger and batch_size > best_batch_size) or
+                       (not prefer_larger and batch_size < best_batch_size)))
+        if len(valid_gpus) > best_num_valid or better_tie:
+            best_num_valid = len(valid_gpus)
+            best_valid_gpus = valid_gpus
+            best_batch_size = batch_size
+    return best_batch_size, best_valid_gpus
+
+
+def _get_compatible_gpus_v01(micro_batches, max_acceptable_batch_size,
+                             min_gpus=None, max_gpus=None, prefer_larger=True):
+    """v0.1 heuristic: candidate bases are each micro-batch plus their LCM,
+    each scaled by the largest HCN fitting under the cap; the winner is the
+    candidate compatible with the most device counts in [min_gpus, max_gpus]."""
+    min_gpus = min_gpus or 1
+    max_gpus = max_gpus or int(max_acceptable_batch_size / min(micro_batches))
+
+    if not all(mb <= max_acceptable_batch_size for mb in micro_batches):
+        raise ValueError(
+            "All micro batches must be <= max_acceptable_batch_size={}".format(
+                max_acceptable_batch_size))
+
+    base_list = list(micro_batches) + [_lcm(micro_batches)]
+    candidates = get_candidate_batch_sizes(base_list, max_acceptable_batch_size)
+    return get_best_candidates(candidates, micro_batches, min_gpus, max_gpus,
+                               prefer_larger)
+
+
+def _parse_version(version_str):
+    matched = re.search(r"^(\d+)\.(\d+)(?:\.(\d+))?", version_str)
+    if matched is None:
+        raise ValueError(
+            "Expecting major.minor[.patch] version format, got {}".format(
+                version_str))
+    return (int(matched.group(1)), int(matched.group(2)),
+            int(matched.group(3) or 0))
+
+
+def _compatible_ds_version_check(target_version):
+    if _parse_version(target_version) < _parse_version(MINIMUM_DEEPSPEED_VERSION):
+        raise ElasticityError(
+            "Target version {} is below minimum {} supporting elasticity".format(
+                target_version, MINIMUM_DEEPSPEED_VERSION))
+    return True
+
+
+def elasticity_enabled(ds_config):
+    if ELASTICITY not in ds_config:
+        return False
+    return ds_config[ELASTICITY].get(ENABLED, ENABLED_DEFAULT)
+
+
+def ensure_immutable_elastic_config(runtime_elastic_config_dict):
+    """Verify the resource scheduler saw the same elastic config we run with."""
+    if DEEPSPEED_ELASTICITY_CONFIG not in os.environ:
+        logger.warning(
+            "DEEPSPEED_ELASTICITY_CONFIG env var not found; cannot guarantee "
+            "the resource scheduler will scale this job with compatible counts.")
+        return
+    scheduler_config = ElasticityConfig(
+        json.loads(os.environ[DEEPSPEED_ELASTICITY_CONFIG]))
+    runtime_config = ElasticityConfig(runtime_elastic_config_dict)
+    for field in ("max_acceptable_batch_size", "micro_batches", "version"):
+        sched_val = getattr(scheduler_config, field)
+        run_val = getattr(runtime_config, field)
+        if sched_val != run_val:
+            raise ElasticityConfigError(
+                "Elastic config {}={} seen by scheduler does not match runtime "
+                "{}={}".format(field, sched_val, field, run_val))
+
+
+def compute_elastic_config(ds_config, target_deepspeed_version, world_size=0):
+    """Compute (final_batch_size, valid_gpus[, micro_batch]) for an elastic job.
+
+    Deterministic for a given config; callable both from scheduling
+    infrastructure and from the runtime (DeepSpeedConfig calls this when the
+    elasticity block is enabled).
+    """
+    if not isinstance(ds_config, dict):
+        raise ValueError(
+            "Expected ds_config dict, got {}: {}".format(type(ds_config), ds_config))
+    if ELASTICITY not in ds_config:
+        raise ElasticityConfigError(
+            "'{}' is missing from config json".format(ELASTICITY))
+    elastic_config_dict = ds_config[ELASTICITY]
+    if not elastic_config_dict.get(ENABLED, ENABLED_DEFAULT):
+        raise ElasticityConfigError("Elasticity is disabled ('enabled': false)")
+
+    elastic_config = ElasticityConfig(elastic_config_dict)
+
+    if float(elastic_config.version) > LATEST_ELASTICITY_VERSION:
+        raise ElasticityConfigError(
+            "Elasticity version {} > latest supported {}".format(
+                elastic_config.version, LATEST_ELASTICITY_VERSION))
+    _compatible_ds_version_check(target_deepspeed_version)
+
+    if float(elastic_config.version) == 0.1:
+        final_batch_size, valid_gpus = _get_compatible_gpus_v01(
+            micro_batches=elastic_config.micro_batches,
+            max_acceptable_batch_size=elastic_config.max_acceptable_batch_size,
+            min_gpus=elastic_config.min_gpus,
+            max_gpus=elastic_config.max_gpus,
+            prefer_larger=elastic_config.prefer_larger_batch_size)
+        final_batch_size = int(final_batch_size)
+    else:
+        raise NotImplementedError(
+            "No elastic logic for version: {}".format(elastic_config.version))
+
+    if world_size > 0:
+        if world_size not in valid_gpus:
+            raise ElasticityIncompatibleWorldSize(
+                "World size ({}) not in valid device counts: {}".format(
+                    world_size, valid_gpus))
+        micro_batch_size = None
+        for mbsz in sorted(set(elastic_config.micro_batches), reverse=True):
+            if final_batch_size // world_size % mbsz == 0:
+                micro_batch_size = mbsz
+                break
+        if micro_batch_size is None:
+            raise ElasticityError(
+                "No divisible micro batch for world_size={}, batch={}, "
+                "micro_batches={}".format(world_size, final_batch_size,
+                                          elastic_config.micro_batches))
+        return final_batch_size, valid_gpus, micro_batch_size
+
+    return final_batch_size, valid_gpus

@@ -1,0 +1,3 @@
+from .topology import (ProcessTopology, PipeDataParallelTopology,
+                       PipeModelDataParallelTopology, MeshGrid, build_mesh,
+                       DATA_AXIS, MODEL_AXIS, PIPE_AXIS)

@@ -1,0 +1,50 @@
+"""Flops-profiler sub-config (reference: deepspeed/profiling/config.py)."""
+from ..runtime.config_utils import get_scalar_param
+
+FLOPS_PROFILER_FORMAT = """
+flops profiler should be enabled as:
+"flops_profiler": {
+  "enabled": true,
+  "profile_step": 1,
+  "module_depth": -1,
+  "top_modules": 3,
+  "detailed": true
+}
+"""
+
+FLOPS_PROFILER = "flops_profiler"
+
+FLOPS_PROFILER_ENABLED = "enabled"
+FLOPS_PROFILER_ENABLED_DEFAULT = False
+
+FLOPS_PROFILER_PROFILE_STEP = "profile_step"
+FLOPS_PROFILER_PROFILE_STEP_DEFAULT = 1
+
+FLOPS_PROFILER_MODULE_DEPTH = "module_depth"
+FLOPS_PROFILER_MODULE_DEPTH_DEFAULT = -1
+
+FLOPS_PROFILER_TOP_MODULES = "top_modules"
+FLOPS_PROFILER_TOP_MODULES_DEFAULT = 3
+
+FLOPS_PROFILER_DETAILED = "detailed"
+FLOPS_PROFILER_DETAILED_DEFAULT = True
+
+
+class DeepSpeedFlopsProfilerConfig(object):
+    def __init__(self, param_dict):
+        d = param_dict.get(FLOPS_PROFILER, {})
+        if not isinstance(d, dict):
+            d = {}
+        self.enabled = get_scalar_param(d, FLOPS_PROFILER_ENABLED,
+                                        FLOPS_PROFILER_ENABLED_DEFAULT)
+        self.profile_step = get_scalar_param(d, FLOPS_PROFILER_PROFILE_STEP,
+                                             FLOPS_PROFILER_PROFILE_STEP_DEFAULT)
+        self.module_depth = get_scalar_param(d, FLOPS_PROFILER_MODULE_DEPTH,
+                                             FLOPS_PROFILER_MODULE_DEPTH_DEFAULT)
+        self.top_modules = get_scalar_param(d, FLOPS_PROFILER_TOP_MODULES,
+                                            FLOPS_PROFILER_TOP_MODULES_DEFAULT)
+        self.detailed = get_scalar_param(d, FLOPS_PROFILER_DETAILED,
+                                         FLOPS_PROFILER_DETAILED_DEFAULT)
+
+    def repr(self):
+        return self.__dict__

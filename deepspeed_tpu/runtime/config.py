@@ -1,0 +1,628 @@
+"""``ds_config.json`` parser.
+
+Reference parity: deepspeed/runtime/config.py (DeepSpeedConfig at :519,
+batch-triple inference :679-725, sanity checks :750-787). The JSON surface is
+identical; ``world_size`` is the number of data-parallel shards of the device
+mesh rather than a torch process-group size.
+
+TPU-native additions (non-breaking): a ``bf16`` block (preferred on TPU —
+no loss scaler needed), accepted alongside the reference's ``fp16`` block.
+"""
+import json
+import logging
+
+from .constants import *
+from .config_utils import (get_scalar_param, dict_raise_error_on_duplicate_keys)
+from .zero.config import DeepSpeedZeroConfig
+from .zero.constants import (ZERO_OPTIMIZATION, ZERO_OPTIMIZATION_DISABLED,
+                             MAX_STAGE_ZERO_OPTIMIZATION)
+from .activation_checkpointing.config import DeepSpeedActivationCheckpointingConfig
+from ..profiling.config import DeepSpeedFlopsProfilerConfig
+from ..utils.logging import logger
+
+TENSOR_CORE_ALIGN_SIZE = 8
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+class ValidationMode:
+    WARN = "WARN"
+    IGNORE = "IGNORE"
+    FAIL = "FAIL"
+
+
+def get_amp_enabled(param_dict):
+    if AMP in param_dict:
+        return get_scalar_param(param_dict[AMP], AMP_ENABLED, AMP_ENABLED_DEFAULT)
+    return False
+
+
+def get_amp_params(param_dict):
+    if AMP in param_dict:
+        amp_params = dict(param_dict[AMP])
+        amp_params.pop(AMP_ENABLED, None)
+        return amp_params
+    return False
+
+
+def get_fp16_enabled(param_dict):
+    if FP16 in param_dict:
+        return get_scalar_param(param_dict[FP16], FP16_ENABLED, FP16_ENABLED_DEFAULT)
+    return False
+
+
+def get_bf16_enabled(param_dict):
+    if BF16 in param_dict:
+        return get_scalar_param(param_dict[BF16], BF16_ENABLED, BF16_ENABLED_DEFAULT)
+    return False
+
+
+def get_loss_scale(param_dict):
+    if get_fp16_enabled(param_dict):
+        return get_scalar_param(param_dict[FP16], FP16_LOSS_SCALE,
+                                FP16_LOSS_SCALE_DEFAULT)
+    return FP16_LOSS_SCALE_DEFAULT
+
+
+def get_initial_dynamic_scale(param_dict):
+    if get_fp16_enabled(param_dict):
+        initial_scale_power = get_scalar_param(param_dict[FP16],
+                                               FP16_INITIAL_SCALE_POWER,
+                                               FP16_INITIAL_SCALE_POWER_DEFAULT)
+    else:
+        initial_scale_power = FP16_INITIAL_SCALE_POWER_DEFAULT
+    return 2 ** initial_scale_power
+
+
+def get_dynamic_loss_scale_args(param_dict):
+    loss_scale_args = None
+    if get_fp16_enabled(param_dict):
+        fp16_dict = param_dict[FP16]
+        dynamic_keys = (FP16_INITIAL_SCALE_POWER, FP16_LOSS_SCALE_WINDOW,
+                        FP16_MIN_LOSS_SCALE, FP16_HYSTERESIS)
+        if any(key in fp16_dict for key in dynamic_keys):
+            init_scale = get_scalar_param(fp16_dict, FP16_INITIAL_SCALE_POWER,
+                                          FP16_INITIAL_SCALE_POWER_DEFAULT)
+            scale_window = get_scalar_param(fp16_dict, FP16_LOSS_SCALE_WINDOW,
+                                            FP16_LOSS_SCALE_WINDOW_DEFAULT)
+            delayed_shift = get_scalar_param(fp16_dict, FP16_HYSTERESIS,
+                                             FP16_HYSTERESIS_DEFAULT)
+            min_loss_scale = get_scalar_param(fp16_dict, FP16_MIN_LOSS_SCALE,
+                                              FP16_MIN_LOSS_SCALE_DEFAULT)
+            loss_scale_args = {
+                "init_scale": 2 ** init_scale,
+                "scale_window": scale_window,
+                "delayed_shift": delayed_shift,
+                "min_scale": min_loss_scale,
+            }
+    return loss_scale_args
+
+
+def get_gradient_accumulation_steps(param_dict):
+    return get_scalar_param(param_dict, GRADIENT_ACCUMULATION_STEPS,
+                            GRADIENT_ACCUMULATION_STEPS_DEFAULT)
+
+
+def get_sparse_gradients_enabled(param_dict):
+    return get_scalar_param(param_dict, SPARSE_GRADIENTS, SPARSE_GRADIENTS_DEFAULT)
+
+
+def get_zero_optimization(param_dict):
+    return get_scalar_param(param_dict, ZERO_OPTIMIZATION, ZERO_OPTIMIZATION_DISABLED)
+
+
+def get_allreduce_always_fp32(param_dict):
+    return get_scalar_param(param_dict, FP32_ALLREDUCE, FP32_ALLREDUCE_DEFAULT)
+
+
+def get_prescale_gradients(param_dict):
+    return get_scalar_param(param_dict, PRESCALE_GRADIENTS,
+                            PRESCALE_GRADIENTS_DEFAULT)
+
+
+def get_gradient_predivide_factor(param_dict):
+    return get_scalar_param(param_dict, GRADIENT_PREDIVIDE_FACTOR,
+                            GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
+
+
+def get_steps_per_print(param_dict):
+    return get_scalar_param(param_dict, STEPS_PER_PRINT, STEPS_PER_PRINT_DEFAULT)
+
+
+def get_disable_allgather(param_dict):
+    return get_scalar_param(param_dict, DISABLE_ALLGATHER, DISABLE_ALLGATHER_DEFAULT)
+
+
+def get_dump_state(param_dict):
+    return get_scalar_param(param_dict, DUMP_STATE, DUMP_STATE_DEFAULT)
+
+
+def get_gradient_clipping(param_dict):
+    return get_scalar_param(param_dict, GRADIENT_CLIPPING,
+                            GRADIENT_CLIPPING_DEFAULT)
+
+
+def get_sparse_attention(param_dict):
+    if SPARSE_ATTENTION not in param_dict:
+        return None
+    sparsity = param_dict[SPARSE_ATTENTION]
+    mode = get_scalar_param(sparsity, SPARSE_MODE, SPARSE_MODE_DEFAULT)
+    if mode == SPARSE_DENSE_MODE:
+        return get_sparse_dense_config(sparsity)
+    elif mode == SPARSE_FIXED_MODE:
+        return get_sparse_fixed_config(sparsity)
+    elif mode == SPARSE_VARIABLE_MODE:
+        return get_sparse_variable_config(sparsity)
+    elif mode == SPARSE_BIGBIRD_MODE:
+        return get_sparse_bigbird_config(sparsity)
+    elif mode == SPARSE_BSLONGFORMER_MODE:
+        return get_sparse_bslongformer_config(sparsity)
+    else:
+        raise NotImplementedError(
+            "Given sparsity mode, {}, has not been implemented yet!".format(mode))
+
+
+def get_sparse_dense_config(sparsity):
+    block = get_scalar_param(sparsity, SPARSE_BLOCK, SPARSE_BLOCK_DEFAULT)
+    return {SPARSE_MODE: SPARSE_DENSE_MODE, SPARSE_BLOCK: block}
+
+
+def get_sparse_fixed_config(sparsity):
+    return {
+        SPARSE_MODE: SPARSE_FIXED_MODE,
+        SPARSE_BLOCK:
+            get_scalar_param(sparsity, SPARSE_BLOCK, SPARSE_BLOCK_DEFAULT),
+        SPARSE_DIFFERENT_LAYOUT_PER_HEAD:
+            get_scalar_param(sparsity, SPARSE_DIFFERENT_LAYOUT_PER_HEAD,
+                             SPARSE_DIFFERENT_LAYOUT_PER_HEAD_DEFAULT),
+        SPARSE_NUM_LOCAL_BLOCKS:
+            get_scalar_param(sparsity, SPARSE_NUM_LOCAL_BLOCKS,
+                             SPARSE_NUM_LOCAL_BLOCKS_DEFAULT),
+        SPARSE_NUM_GLOBAL_BLOCKS:
+            get_scalar_param(sparsity, SPARSE_NUM_GLOBAL_BLOCKS,
+                             SPARSE_NUM_GLOBAL_BLOCKS_DEFAULT),
+        SPARSE_ATTENTION_TYPE:
+            get_scalar_param(sparsity, SPARSE_ATTENTION_TYPE,
+                             SPARSE_ATTENTION_TYPE_DEFAULT),
+        SPARSE_HORIZONTAL_GLOBAL_ATTENTION:
+            get_scalar_param(sparsity, SPARSE_HORIZONTAL_GLOBAL_ATTENTION,
+                             SPARSE_HORIZONTAL_GLOBAL_ATTENTION_DEFAULT),
+        SPARSE_NUM_DIFFERENT_GLOBAL_PATTERNS:
+            get_scalar_param(sparsity, SPARSE_NUM_DIFFERENT_GLOBAL_PATTERNS,
+                             SPARSE_NUM_DIFFERENT_GLOBAL_PATTERNS_DEFAULT),
+    }
+
+
+def get_sparse_variable_config(sparsity):
+    return {
+        SPARSE_MODE: SPARSE_VARIABLE_MODE,
+        SPARSE_BLOCK:
+            get_scalar_param(sparsity, SPARSE_BLOCK, SPARSE_BLOCK_DEFAULT),
+        SPARSE_DIFFERENT_LAYOUT_PER_HEAD:
+            get_scalar_param(sparsity, SPARSE_DIFFERENT_LAYOUT_PER_HEAD,
+                             SPARSE_DIFFERENT_LAYOUT_PER_HEAD_DEFAULT),
+        SPARSE_NUM_RANDOM_BLOCKS:
+            get_scalar_param(sparsity, SPARSE_NUM_RANDOM_BLOCKS,
+                             SPARSE_NUM_RANDOM_BLOCKS_DEFAULT),
+        SPARSE_LOCAL_WINDOW_BLOCKS:
+            get_scalar_param(sparsity, SPARSE_LOCAL_WINDOW_BLOCKS,
+                             SPARSE_LOCAL_WINDOW_BLOCKS_DEFAULT),
+        SPARSE_GLOBAL_BLOCK_INDICES:
+            get_scalar_param(sparsity, SPARSE_GLOBAL_BLOCK_INDICES,
+                             SPARSE_GLOBAL_BLOCK_INDICES_DEFAULT),
+        SPARSE_GLOBAL_BLOCK_END_INDICES:
+            get_scalar_param(sparsity, SPARSE_GLOBAL_BLOCK_END_INDICES,
+                             SPARSE_GLOBAL_BLOCK_END_INDICES_DEFAULT),
+        SPARSE_ATTENTION_TYPE:
+            get_scalar_param(sparsity, SPARSE_ATTENTION_TYPE,
+                             SPARSE_ATTENTION_TYPE_DEFAULT),
+        SPARSE_HORIZONTAL_GLOBAL_ATTENTION:
+            get_scalar_param(sparsity, SPARSE_HORIZONTAL_GLOBAL_ATTENTION,
+                             SPARSE_HORIZONTAL_GLOBAL_ATTENTION_DEFAULT),
+    }
+
+
+def get_sparse_bigbird_config(sparsity):
+    return {
+        SPARSE_MODE: SPARSE_BIGBIRD_MODE,
+        SPARSE_BLOCK:
+            get_scalar_param(sparsity, SPARSE_BLOCK, SPARSE_BLOCK_DEFAULT),
+        SPARSE_DIFFERENT_LAYOUT_PER_HEAD:
+            get_scalar_param(sparsity, SPARSE_DIFFERENT_LAYOUT_PER_HEAD,
+                             SPARSE_DIFFERENT_LAYOUT_PER_HEAD_DEFAULT),
+        SPARSE_NUM_RANDOM_BLOCKS:
+            get_scalar_param(sparsity, SPARSE_NUM_RANDOM_BLOCKS,
+                             SPARSE_NUM_RANDOM_BLOCKS_DEFAULT),
+        SPARSE_NUM_SLIDING_WINDOW_BLOCKS:
+            get_scalar_param(sparsity, SPARSE_NUM_SLIDING_WINDOW_BLOCKS,
+                             SPARSE_NUM_SLIDING_WINDOW_BLOCKS_DEFAULT),
+        SPARSE_NUM_GLOBAL_BLOCKS:
+            get_scalar_param(sparsity, SPARSE_NUM_GLOBAL_BLOCKS,
+                             SPARSE_NUM_GLOBAL_BLOCKS_DEFAULT),
+    }
+
+
+def get_sparse_bslongformer_config(sparsity):
+    return {
+        SPARSE_MODE: SPARSE_BSLONGFORMER_MODE,
+        SPARSE_BLOCK:
+            get_scalar_param(sparsity, SPARSE_BLOCK, SPARSE_BLOCK_DEFAULT),
+        SPARSE_DIFFERENT_LAYOUT_PER_HEAD:
+            get_scalar_param(sparsity, SPARSE_DIFFERENT_LAYOUT_PER_HEAD,
+                             SPARSE_DIFFERENT_LAYOUT_PER_HEAD_DEFAULT),
+        SPARSE_NUM_SLIDING_WINDOW_BLOCKS:
+            get_scalar_param(sparsity, SPARSE_NUM_SLIDING_WINDOW_BLOCKS,
+                             SPARSE_NUM_SLIDING_WINDOW_BLOCKS_DEFAULT),
+        SPARSE_GLOBAL_BLOCK_INDICES:
+            get_scalar_param(sparsity, SPARSE_GLOBAL_BLOCK_INDICES,
+                             SPARSE_GLOBAL_BLOCK_INDICES_DEFAULT),
+        SPARSE_GLOBAL_BLOCK_END_INDICES:
+            get_scalar_param(sparsity, SPARSE_GLOBAL_BLOCK_END_INDICES,
+                             SPARSE_GLOBAL_BLOCK_END_INDICES_DEFAULT),
+    }
+
+
+def get_optimizer_name(param_dict):
+    if OPTIMIZER in param_dict and TYPE in param_dict[OPTIMIZER]:
+        return param_dict[OPTIMIZER][TYPE]
+    return OPTIMIZER_TYPE_DEFAULT
+
+
+def get_optimizer_params(param_dict):
+    if get_optimizer_name(param_dict) is not None and \
+            OPTIMIZER_PARAMS in param_dict[OPTIMIZER]:
+        return param_dict[OPTIMIZER][OPTIMIZER_PARAMS]
+    return None
+
+
+def get_optimizer_gradient_clipping(param_dict):
+    optimizer_params = get_optimizer_params(param_dict)
+    if optimizer_params is not None and MAX_GRAD_NORM in optimizer_params:
+        return optimizer_params[MAX_GRAD_NORM]
+    return None
+
+
+def get_optimizer_legacy_fusion(param_dict):
+    if OPTIMIZER in param_dict and LEGACY_FUSION in param_dict[OPTIMIZER]:
+        return param_dict[OPTIMIZER][LEGACY_FUSION]
+    return LEGACY_FUSION_DEFAULT
+
+
+def get_zero_allow_untested_optimizer(param_dict):
+    return get_scalar_param(param_dict, ZERO_ALLOW_UNTESTED_OPTIMIZER,
+                            ZERO_ALLOW_UNTESTED_OPTIMIZER_DEFAULT)
+
+
+def get_scheduler_name(param_dict):
+    if SCHEDULER in param_dict and TYPE in param_dict[SCHEDULER]:
+        return param_dict[SCHEDULER][TYPE]
+    return SCHEDULER_TYPE_DEFAULT
+
+
+def get_scheduler_params(param_dict):
+    if get_scheduler_name(param_dict) is not None and \
+            SCHEDULER_PARAMS in param_dict[SCHEDULER]:
+        return param_dict[SCHEDULER][SCHEDULER_PARAMS]
+    return None
+
+
+def get_train_batch_size(param_dict):
+    return get_scalar_param(param_dict, TRAIN_BATCH_SIZE, TRAIN_BATCH_SIZE_DEFAULT)
+
+
+def get_train_micro_batch_size_per_gpu(param_dict):
+    return get_scalar_param(param_dict, TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+                            TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT)
+
+
+def get_wall_clock_breakdown(param_dict):
+    return get_scalar_param(param_dict, WALL_CLOCK_BREAKDOWN,
+                            WALL_CLOCK_BREAKDOWN_DEFAULT)
+
+
+def get_memory_breakdown(param_dict):
+    return get_scalar_param(param_dict, MEMORY_BREAKDOWN, MEMORY_BREAKDOWN_DEFAULT)
+
+
+def get_tensorboard_enabled(param_dict):
+    if TENSORBOARD in param_dict:
+        return get_scalar_param(param_dict[TENSORBOARD], TENSORBOARD_ENABLED,
+                                TENSORBOARD_ENABLED_DEFAULT)
+    return False
+
+
+def get_tensorboard_output_path(param_dict):
+    if get_tensorboard_enabled(param_dict):
+        return get_scalar_param(param_dict[TENSORBOARD], TENSORBOARD_OUTPUT_PATH,
+                                TENSORBOARD_OUTPUT_PATH_DEFAULT)
+    return TENSORBOARD_OUTPUT_PATH_DEFAULT
+
+
+def get_tensorboard_job_name(param_dict):
+    if get_tensorboard_enabled(param_dict):
+        return get_scalar_param(param_dict[TENSORBOARD], TENSORBOARD_JOB_NAME,
+                                TENSORBOARD_JOB_NAME_DEFAULT)
+    return TENSORBOARD_JOB_NAME_DEFAULT
+
+
+def get_checkpoint_params(param_dict):
+    return param_dict.get(CHECKPOINT, {})
+
+
+def get_checkpoint_tag_validation_mode(checkpoint_params):
+    tag_validation_mode = checkpoint_params.get(CHECKPOINT_TAG_VALIDATION,
+                                                CHECKPOINT_TAG_VALIDATION_DEFAULT)
+    tag_validation_mode = tag_validation_mode.upper()
+    if tag_validation_mode in (ValidationMode.WARN, ValidationMode.IGNORE,
+                               ValidationMode.FAIL):
+        return tag_validation_mode
+    raise DeepSpeedConfigError(
+        "Checkpoint config contains invalid tag_validation "
+        "value of {}, expecting one of {}".format(
+            tag_validation_mode,
+            [ValidationMode.WARN, ValidationMode.IGNORE, ValidationMode.FAIL]))
+
+
+def get_pld_enabled(param_dict):
+    if PROGRESSIVE_LAYER_DROP in param_dict:
+        return get_scalar_param(param_dict[PROGRESSIVE_LAYER_DROP], PLD_ENABLED,
+                                PLD_ENABLED_DEFAULT)
+    return False
+
+
+def get_pld_params(param_dict):
+    if PROGRESSIVE_LAYER_DROP in param_dict:
+        pld_params = dict(param_dict[PROGRESSIVE_LAYER_DROP])
+        pld_params.pop(PLD_ENABLED, None)
+        return pld_params
+    return False
+
+
+class DeepSpeedConfig(object):
+    """Typed view of a full ``ds_config`` dict (or json file path).
+
+    ``world_size`` is the data-parallel world size: for a mesh
+    (data, model, pipe) it is the size of the ``data`` axis — matching the
+    reference where world_size = total ranks / model-parallel size
+    (reference config.py:529-539).
+    """
+
+    def __init__(self, json_file, mpu=None, param_dict=None, mesh=None):
+        super(DeepSpeedConfig, self).__init__()
+
+        if param_dict is None:
+            with open(json_file, "r") as f:
+                self._param_dict = json.load(
+                    f, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+        else:
+            self._param_dict = param_dict
+
+        try:
+            import jax
+            self.global_rank = jax.process_index()
+            total_devices = jax.device_count()
+        except Exception:
+            self.global_rank = 0
+            total_devices = 1
+
+        if mesh is not None:
+            self.world_size = int(mesh.shape.get("data", 1))
+        elif mpu is not None:
+            self.world_size = total_devices // mpu.get_model_parallel_world_size()
+        else:
+            self.world_size = total_devices
+
+        # If elasticity is enabled, it overrides the batch config for the
+        # current world size and pins an immutable fingerprint.
+        self.elasticity_enabled = False
+        if self._param_dict.get("elasticity", {}).get("enabled", False):
+            self._configure_elasticity()
+
+        self._initialize_params(self._param_dict)
+        self._configure_train_batch_size()
+        self._do_sanity_check()
+
+    def _configure_elasticity(self):
+        from ..elasticity import (compute_elastic_config, elasticity_enabled,
+                                  ensure_immutable_elastic_config,
+                                  IGNORE_NON_ELASTIC_BATCH_INFO,
+                                  IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT,
+                                  ELASTICITY)
+        from ..version import __version__
+        self.elasticity_enabled = elasticity_enabled(self._param_dict)
+
+        elastic_dict = self._param_dict[ELASTICITY]
+        ignore_non_elastic_batch_info = elastic_dict.get(
+            IGNORE_NON_ELASTIC_BATCH_INFO, IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT)
+        if not ignore_non_elastic_batch_info:
+            batch_params = [TRAIN_BATCH_SIZE, TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+                            GRADIENT_ACCUMULATION_STEPS]
+            if any(p in self._param_dict for p in batch_params):
+                raise DeepSpeedConfigError(
+                    "One or more batch related parameters were found in your "
+                    "ds_config ({}). These parameters *will not be used* since "
+                    "elastic training is enabled, which takes control of these "
+                    "parameters. If you want to suppress this error set '{}': "
+                    "true in your elasticity config.".format(
+                        ", ".join(batch_params), IGNORE_NON_ELASTIC_BATCH_INFO))
+
+        ensure_immutable_elastic_config(elastic_dict)
+        final_batch_size, valid_gpus, micro_batch_size = compute_elastic_config(
+            ds_config=self._param_dict,
+            target_deepspeed_version=__version__,
+            world_size=self.world_size)
+        self.elastic_valid_world_sizes = valid_gpus
+        gradient_accu_steps = final_batch_size // (micro_batch_size *
+                                                   self.world_size)
+        self._param_dict[TRAIN_BATCH_SIZE] = final_batch_size
+        self._param_dict[TRAIN_MICRO_BATCH_SIZE_PER_GPU] = micro_batch_size
+        self._param_dict[GRADIENT_ACCUMULATION_STEPS] = gradient_accu_steps
+
+    def _initialize_params(self, param_dict):
+        self.train_batch_size = get_train_batch_size(param_dict)
+        self.train_micro_batch_size_per_gpu = \
+            get_train_micro_batch_size_per_gpu(param_dict)
+        self.gradient_accumulation_steps = get_gradient_accumulation_steps(param_dict)
+        self.steps_per_print = get_steps_per_print(param_dict)
+        self.dump_state = get_dump_state(param_dict)
+
+        self.disable_allgather = get_disable_allgather(param_dict)
+        self.allreduce_always_fp32 = get_allreduce_always_fp32(param_dict)
+        self.prescale_gradients = get_prescale_gradients(param_dict)
+        self.gradient_predivide_factor = get_gradient_predivide_factor(param_dict)
+        self.sparse_gradients_enabled = get_sparse_gradients_enabled(param_dict)
+
+        self.zero_config = DeepSpeedZeroConfig(param_dict)
+        self.zero_optimization_stage = self.zero_config.stage
+        self.zero_enabled = self.zero_optimization_stage > 0
+
+        self.activation_checkpointing_config = \
+            DeepSpeedActivationCheckpointingConfig(param_dict)
+        self.flops_profiler_config = DeepSpeedFlopsProfilerConfig(param_dict)
+
+        self.gradient_clipping = get_gradient_clipping(param_dict)
+        self.fp16_enabled = get_fp16_enabled(param_dict)
+        self.bf16_enabled = get_bf16_enabled(param_dict)
+        self.amp_enabled = get_amp_enabled(param_dict)
+        self.amp_params = get_amp_params(param_dict)
+        self.loss_scale = get_loss_scale(param_dict)
+        self.initial_dynamic_scale = get_initial_dynamic_scale(param_dict)
+        self.dynamic_loss_scale_args = get_dynamic_loss_scale_args(param_dict)
+
+        self.optimizer_name = get_optimizer_name(param_dict)
+        if self.optimizer_name is not None and \
+                self.optimizer_name.lower() in DEEPSPEED_OPTIMIZERS:
+            self.optimizer_name = self.optimizer_name.lower()
+        self.optimizer_params = get_optimizer_params(param_dict)
+        self.optimizer_legacy_fusion = get_optimizer_legacy_fusion(param_dict)
+
+        self.zero_allow_untested_optimizer = \
+            get_zero_allow_untested_optimizer(param_dict)
+
+        self.scheduler_name = get_scheduler_name(param_dict)
+        self.scheduler_params = get_scheduler_params(param_dict)
+
+        self.wall_clock_breakdown = get_wall_clock_breakdown(param_dict)
+        self.memory_breakdown = get_memory_breakdown(param_dict)
+        self.tensorboard_enabled = get_tensorboard_enabled(param_dict)
+        self.tensorboard_output_path = get_tensorboard_output_path(param_dict)
+        self.tensorboard_job_name = get_tensorboard_job_name(param_dict)
+
+        self.sparse_attention = get_sparse_attention(param_dict)
+
+        self.pld_enabled = get_pld_enabled(param_dict)
+        self.pld_params = get_pld_params(param_dict)
+
+        checkpoint_params = get_checkpoint_params(param_dict)
+        validation_mode = get_checkpoint_tag_validation_mode(checkpoint_params)
+        self.checkpoint_tag_validation_enabled = \
+            validation_mode != ValidationMode.IGNORE
+        self.checkpoint_tag_validation_fail = validation_mode == ValidationMode.FAIL
+
+    def _batch_assertion(self):
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+
+        assert train_batch > 0, \
+            "Train batch size: {} has to be greater than 0".format(train_batch)
+        assert micro_batch > 0, \
+            "Micro batch size per device: {} has to be greater than 0".format(
+                micro_batch)
+        assert grad_acc > 0, \
+            "Gradient accumulation steps: {} has to be greater than 0".format(
+                grad_acc)
+        assert train_batch == micro_batch * grad_acc * self.world_size, (
+            "Check batch related parameters. train_batch_size is not equal to "
+            "micro_batch_per_gpu * gradient_acc_step * world_size: "
+            "{} != {} * {} * {}".format(train_batch, micro_batch, grad_acc,
+                                        self.world_size))
+
+    def _set_batch_related_parameters(self):
+        """Infer the missing member(s) of the batch triple
+        (train_batch, micro_batch, grad_accum); any two determine the third."""
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+
+        if all(v is not None for v in (train_batch, micro_batch, grad_acc)):
+            return
+        elif train_batch is not None and micro_batch is not None:
+            self.gradient_accumulation_steps = \
+                train_batch // micro_batch // self.world_size
+        elif train_batch is not None and grad_acc is not None:
+            self.train_micro_batch_size_per_gpu = \
+                train_batch // self.world_size // grad_acc
+        elif micro_batch is not None and grad_acc is not None:
+            self.train_batch_size = micro_batch * grad_acc * self.world_size
+        elif train_batch is not None:
+            self.gradient_accumulation_steps = 1
+            self.train_micro_batch_size_per_gpu = train_batch // self.world_size
+        elif micro_batch is not None:
+            self.train_batch_size = micro_batch * self.world_size
+            self.gradient_accumulation_steps = 1
+        else:
+            raise AssertionError(
+                "Either train_batch_size or train_micro_batch_size_per_gpu "
+                "needs to be provided")
+
+    def _configure_train_batch_size(self):
+        self._set_batch_related_parameters()
+        self._batch_assertion()
+
+    def _do_sanity_check(self):
+        self._do_error_check()
+        self._do_warning_check()
+
+    def print(self, name):
+        logger.info("{}:".format(name))
+        for arg in sorted(vars(self)):
+            if arg != "_param_dict":
+                dots = "." * (29 - len(arg))
+                logger.info("  {} {} {}".format(arg, dots, getattr(self, arg)))
+        logger.info("  json = {}".format(
+            json.dumps(self._param_dict, sort_keys=True, indent=4,
+                       separators=(",", ":"))))
+
+    def _do_error_check(self):
+        assert self.train_micro_batch_size_per_gpu, \
+            "DeepSpeedConfig: {} is not defined".format(
+                TRAIN_MICRO_BATCH_SIZE_PER_GPU)
+        assert self.gradient_accumulation_steps, \
+            "DeepSpeedConfig: {} is not defined".format(GRADIENT_ACCUMULATION_STEPS)
+        if self.zero_enabled:
+            # Reference requires fp16 for ZeRO; bf16 is the TPU-native
+            # equivalent and is accepted as well.
+            assert self.fp16_enabled or self.bf16_enabled, \
+                "DeepSpeedConfig: ZeRO is only supported if fp16/bf16 is enabled"
+            assert self.zero_optimization_stage <= MAX_STAGE_ZERO_OPTIMIZATION, \
+                "DeepSpeedConfig: Maximum supported ZeRO stage is {}".format(
+                    MAX_STAGE_ZERO_OPTIMIZATION)
+
+    def _do_warning_check(self):
+        fp16_enabled = self.fp16_enabled or self.zero_enabled
+        vocabulary_size = self._param_dict.get(VOCABULARY_SIZE,
+                                               VOCABULARY_SIZE_DEFAULT)
+        if vocabulary_size and vocabulary_size % TENSOR_CORE_ALIGN_SIZE != 0:
+            logger.warning(
+                "DeepSpeedConfig: vocabulary size {} is not aligned to {}, may "
+                "impact MXU utilization.".format(vocabulary_size,
+                                                TENSOR_CORE_ALIGN_SIZE))
+        if self.optimizer_params is not None and \
+                MAX_GRAD_NORM in self.optimizer_params.keys() and \
+                self.optimizer_params[MAX_GRAD_NORM] > 0:
+            if fp16_enabled:
+                if self.global_rank == 0:
+                    logger.warning(
+                        "DeepSpeedConfig: In FP16 mode, DeepSpeed will pass "
+                        "{}:{} to FP16 wrapper".format(
+                            MAX_GRAD_NORM, self.optimizer_params[MAX_GRAD_NORM]))
+            else:
+                if self.global_rank == 0:
+                    logger.warning(
+                        "DeepSpeedConfig: In FP32 mode, DeepSpeed does not "
+                        "permit MAX_GRAD_NORM ({}) > 0, setting to zero".format(
+                            self.optimizer_params[MAX_GRAD_NORM]))
+                self.optimizer_params[MAX_GRAD_NORM] = 0.0

@@ -1,0 +1,106 @@
+"""ZeRO sub-config parser (reference: deepspeed/runtime/zero/config.py).
+
+On TPU, ZeRO stages are realized as GSPMD sharding of the train-state pytree
+over the mesh's ``data`` axis rather than via gradient hooks:
+  stage 1 -> optimizer state (+fp32 master) sharded,
+  stage 2 -> stage 1 + gradients reduce-scattered (psum_scatter),
+  stage 3 -> stage 2 + parameters sharded with per-use all-gather.
+Bucket-size/overlap knobs are accepted for surface parity; XLA's latency
+hiding scheduler replaces the manual stream machinery.
+"""
+from ..config_utils import get_scalar_param
+from .constants import *
+from ...utils.logging import logger
+
+
+class DeepSpeedZeroConfig(object):
+    def __init__(self, param_dict):
+        self.stage = None
+        self.contiguous_gradients = None
+        self.reduce_scatter = None
+        self.reduce_bucket_size = None
+        self.allgather_partitions = None
+        self.allgather_bucket_size = None
+        self.overlap_comm = None
+        self.cpu_offload = None
+        self.cpu_offload_params = None
+        self.cpu_offload_use_pin_memory = None
+        self.sub_group_size = None
+        self.max_live_parameters = None
+        self.max_reuse_distance = None
+        self.prefetch_bucket_size = None
+        self.param_persistence_threshold = None
+        self.gather_fp16_weights_on_model_save = None
+        self.elastic_checkpoint = None
+        self.load_from_fp32_weights = None
+
+        if ZERO_OPTIMIZATION in param_dict:
+            zero_config_dict = param_dict[ZERO_OPTIMIZATION]
+            if isinstance(zero_config_dict, bool):
+                zero_config_dict = self.read_zero_config_deprecated(param_dict)
+        else:
+            zero_config_dict = {}
+        self._initialize(zero_config_dict)
+
+    def read_zero_config_deprecated(self, param_dict):
+        zero_config_dict = {
+            ZERO_OPTIMIZATION_STAGE:
+                1 if param_dict[ZERO_OPTIMIZATION] else 0
+        }
+        if zero_config_dict[ZERO_OPTIMIZATION_STAGE] > 0:
+            zero_config_dict[ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE] = \
+                get_scalar_param(param_dict,
+                                 ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEPRECATED,
+                                 ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEFAULT)
+        logger.warning(
+            "DeepSpeedConfig: this format of ZeRO optimization setup is deprecated."
+            " Please use the following format: {}".format(ZERO_FORMAT))
+        return zero_config_dict
+
+    def _initialize(self, zero_config_dict):
+        g = lambda key, default: get_scalar_param(zero_config_dict, key, default)
+        self.stage = g(ZERO_OPTIMIZATION_STAGE, ZERO_OPTIMIZATION_STAGE_DEFAULT)
+        self.contiguous_gradients = g(ZERO_OPTIMIZATION_CONTIGUOUS_GRADIENTS,
+                                      ZERO_OPTIMIZATION_CONTIGUOUS_GRADIENTS_DEFAULT)
+        self.reduce_bucket_size = g(ZERO_OPTIMIZATION_REDUCE_BUCKET_SIZE,
+                                    ZERO_OPTIMIZATION_REDUCE_BUCKET_SIZE_DEFAULT)
+        self.reduce_scatter = g(ZERO_OPTIMIZATION_REDUCE_SCATTER,
+                                ZERO_OPTIMIZATION_REDUCE_SCATTER_DEFAULT)
+        self.overlap_comm = g(ZERO_OPTIMIZATION_OVERLAP_COMM,
+                              ZERO_OPTIMIZATION_OVERLAP_COMM_DEFAULT)
+        self.allgather_partitions = g(ZERO_OPTIMIZATION_ALLGATHER_PARTITIONS,
+                                      ZERO_OPTIMIZATION_ALLGATHER_PARTITIONS_DEFAULT)
+        self.allgather_bucket_size = g(ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE,
+                                       ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEFAULT)
+        self.cpu_offload = g(ZERO_OPTIMIZATION_CPU_OFFLOAD,
+                             ZERO_OPTIMIZATION_CPU_OFFLOAD_DEFAULT)
+        self.cpu_offload_params = g(ZERO_OPTIMIZATION_CPU_OFFLOAD_PARAMS,
+                                    ZERO_OPTIMIZATION_CPU_OFFLOAD_PARAMS_DEFAULT)
+        self.cpu_offload_use_pin_memory = g(
+            ZERO_OPTIMIZATION_CPU_OFFLOAD_USE_PIN_MEMORY,
+            ZERO_OPTIMIZATION_CPU_OFFLOAD_USE_PIN_MEMORY_DEFAULT)
+        self.sub_group_size = g(ZERO_OPTIMIZATION_SUB_GROUP_SIZE,
+                                ZERO_OPTIMIZATION_SUB_GROUP_SIZE_DEFAULT)
+        self.max_live_parameters = g(ZERO_OPTIMIZATION_MAX_LIVE_PARAMETERS,
+                                     ZERO_OPTIMIZATION_MAX_LIVE_PARAMETERS_DEFAULT)
+        self.max_reuse_distance = g(ZERO_OPTIMIZATION_MAX_REUSE_DISTANCE,
+                                    ZERO_OPTIMIZATION_MAX_REUSE_DISTANCE_DEFAULT)
+        self.prefetch_bucket_size = g(ZERO_OPTIMIZATION_PREFETCH_BUCKET_SIZE,
+                                      ZERO_OPTIMIZATION_PREFETCH_BUCKET_SIZE_DEFAULT)
+        self.param_persistence_threshold = g(
+            ZERO_OPTIMIZATION_PARAM_PERSISTENCE_THRESHOLD,
+            ZERO_OPTIMIZATION_PARAM_PERSISTENCE_THRESHOLD_DEFAULT)
+        self.gather_fp16_weights_on_model_save = g(
+            ZERO_OPTIMIZATION_GATHER_FP16_WEIGHTS_ON_MODEL_SAVE,
+            ZERO_OPTIMIZATION_GATHER_FP16_WEIGHTS_ON_MODEL_SAVE_DEFAULT)
+        self.elastic_checkpoint = g(ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT,
+                                    ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT_DEFAULT)
+        self.load_from_fp32_weights = g(ZERO_OPTIMIZATION_LOAD_FROM_FP32_WEIGHTS,
+                                        ZERO_OPTIMIZATION_LOAD_FROM_FP32_WEIGHTS_DEFAULT)
+
+    def repr(self):
+        return self.__dict__
+
+    def __repr__(self):
+        import json
+        return json.dumps(self.__dict__, indent=4, sort_keys=True)

@@ -1,0 +1,92 @@
+"""Multi-host rendezvous.
+
+Reference parity: deepspeed/utils/distributed.py (init_distributed :12,
+mpi_discovery :54). On TPU the NCCL/MPI process-group dance is replaced by
+``jax.distributed.initialize``; single-process runs (including CPU test
+meshes) skip initialization entirely.
+"""
+import os
+
+from .logging import logger
+
+_initialized = False
+
+
+def is_initialized():
+    return _initialized
+
+
+def init_distributed(dist_backend=None, auto_mpi_discovery=True,
+                     distributed_port=29500, verbose=True,
+                     coordinator_address=None, num_processes=None,
+                     process_id=None):
+    """Initialize multi-host JAX. No-op when running single-process.
+
+    ``dist_backend`` is accepted for API parity and ignored (the backend is
+    always XLA collectives over ICI/DCN).
+    """
+    global _initialized
+    if _initialized:
+        return
+    import jax
+
+    env = os.environ
+    # Respect explicit args first, then the launcher env surface
+    # (MASTER_ADDR/PORT, RANK, WORLD_SIZE — same names as the reference), then
+    # cloud TPU auto-detection inside jax.distributed.
+    if coordinator_address is None and "MASTER_ADDR" in env:
+        port = env.get("MASTER_PORT", str(distributed_port))
+        coordinator_address = "{}:{}".format(env["MASTER_ADDR"], port)
+    if num_processes is None and "WORLD_SIZE" in env:
+        num_processes = int(env["WORLD_SIZE"])
+    if process_id is None and "RANK" in env:
+        process_id = int(env["RANK"])
+
+    if auto_mpi_discovery and num_processes is None and _in_mpi_env():
+        coordinator_address, num_processes, process_id = _mpi_discovery(
+            distributed_port, coordinator_address)
+
+    if num_processes is None or num_processes <= 1:
+        if verbose:
+            logger.info("Single-process run; skipping jax.distributed init")
+        _initialized = True
+        return
+
+    if verbose:
+        logger.info(
+            "Initializing jax.distributed: coordinator={}, nprocs={}, "
+            "process_id={}".format(coordinator_address, num_processes, process_id))
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+
+
+def _in_mpi_env():
+    return any(v in os.environ for v in
+               ("OMPI_COMM_WORLD_SIZE", "PMI_SIZE", "SLURM_NTASKS"))
+
+
+def _mpi_discovery(distributed_port, coordinator_address):
+    """Discover world info from MPI-ish env vars (reference mpi_discovery)."""
+    env = os.environ
+    if "OMPI_COMM_WORLD_SIZE" in env:
+        world_size = int(env["OMPI_COMM_WORLD_SIZE"])
+        rank = int(env["OMPI_COMM_WORLD_RANK"])
+    elif "SLURM_NTASKS" in env:
+        world_size = int(env["SLURM_NTASKS"])
+        rank = int(env["SLURM_PROCID"])
+    else:
+        world_size = int(env.get("PMI_SIZE", 1))
+        rank = int(env.get("PMI_RANK", 0))
+    if coordinator_address is None:
+        try:
+            from mpi4py import MPI
+            comm = MPI.COMM_WORLD
+            import socket
+            master = comm.bcast(socket.gethostname() if rank == 0 else None,
+                                root=0)
+            coordinator_address = "{}:{}".format(master, distributed_port)
+        except ImportError:
+            coordinator_address = "127.0.0.1:{}".format(distributed_port)
+    return coordinator_address, world_size, rank

@@ -1,0 +1,42 @@
+"""Logging utilities.
+
+Reference parity: deepspeed/utils/logging.py (logger + log_dist). On TPU the
+"rank" is the JAX process index.
+"""
+import logging
+import sys
+import functools
+
+
+@functools.lru_cache(None)
+def _create_logger(name="DeepSpeedTPU", level=logging.INFO):
+    logger_ = logging.getLogger(name)
+    logger_.setLevel(level)
+    logger_.propagate = False
+    if not logger_.handlers:
+        handler = logging.StreamHandler(stream=sys.stdout)
+        handler.setLevel(level)
+        formatter = logging.Formatter(
+            "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s")
+        handler.setFormatter(formatter)
+        logger_.addHandler(handler)
+    return logger_
+
+
+logger = _create_logger()
+
+
+def _process_index():
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message, ranks=None, level=logging.INFO):
+    """Log only on the listed process ranks (``None`` or ``[-1]`` = all)."""
+    rank = _process_index()
+    should_log = ranks is None or len(ranks) == 0 or (-1 in ranks) or (rank in ranks)
+    if should_log:
+        logger.log(level, "[Rank {}] {}".format(rank, message))

@@ -1,0 +1,155 @@
+"""Wall-clock and throughput timers.
+
+Reference parity: deepspeed/utils/timer.py (SynchronizedWallClockTimer :19,
+ThroughputTimer :97). On TPU, synchronization uses
+``jax.block_until_ready``-style barriers via ``jax.effects_barrier`` /
+device sync instead of ``torch.cuda.synchronize``.
+"""
+import time
+
+from .logging import logger
+
+
+def _device_synchronize():
+    try:
+        import jax
+        # Block until all pending device work is done (closest analogue of a
+        # CUDA sync); cheap when nothing is in flight.
+        (jax.device_put(0.0) + 0).block_until_ready()
+    except Exception:
+        pass
+
+
+class SynchronizedWallClockTimer:
+    """Named timers whose start/stop sync outstanding device work."""
+
+    class Timer:
+        def __init__(self, name):
+            self.name_ = name
+            self.elapsed_ = 0.0
+            self.started_ = False
+            self.start_time = time.time()
+
+        def start(self):
+            assert not self.started_, "timer has already been started"
+            _device_synchronize()
+            self.start_time = time.time()
+            self.started_ = True
+
+        def stop(self, reset=False):
+            assert self.started_, "timer is not started"
+            _device_synchronize()
+            if reset:
+                self.elapsed_ = time.time() - self.start_time
+            else:
+                self.elapsed_ += time.time() - self.start_time
+            self.started_ = False
+
+        def reset(self):
+            self.elapsed_ = 0.0
+            self.started_ = False
+
+        def elapsed(self, reset=True):
+            started_ = self.started_
+            if self.started_:
+                self.stop()
+            elapsed_ = self.elapsed_
+            if reset:
+                self.reset()
+            if started_:
+                self.start()
+            return elapsed_
+
+    def __init__(self):
+        self.timers = {}
+
+    def __call__(self, name):
+        if name not in self.timers:
+            self.timers[name] = self.Timer(name)
+        return self.timers[name]
+
+    @staticmethod
+    def memory_usage():
+        try:
+            import jax
+            stats = jax.local_devices()[0].memory_stats() or {}
+            alloc = stats.get("bytes_in_use", 0) / (1024 ** 3)
+            peak = stats.get("peak_bytes_in_use", 0) / (1024 ** 3)
+            return "mem (GB) | allocated: {:.2f} | peak: {:.2f}".format(alloc, peak)
+        except Exception:
+            return "mem (GB) | unavailable"
+
+    def log(self, names, normalizer=1.0, reset=True, memory_breakdown=False):
+        assert normalizer > 0.0
+        string = "time (ms)"
+        for name in names:
+            if name in self.timers:
+                elapsed_time = self.timers[name].elapsed(reset=reset) * 1000.0
+                elapsed_time /= normalizer
+                string += " | {}: {:.2f}".format(name, elapsed_time)
+        if memory_breakdown:
+            string += " | " + self.memory_usage()
+        logger.info(string)
+
+
+class ThroughputTimer:
+    """Samples/sec tracker around train steps (reference timer.py:97)."""
+
+    def __init__(self, batch_size, num_workers, start_step=2,
+                 steps_per_output=50, monitor_memory=False, logging_fn=None):
+        self.start_time = 0
+        self.end_time = 0
+        self.started = False
+        self.batch_size = batch_size if batch_size else 1
+        self.num_workers = num_workers
+        self.start_step = start_step
+        self.epoch_count = 0
+        self.local_step_count = 0
+        self.total_step_count = 0
+        self.total_elapsed_time = 0
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn or logger.info
+        self.initialized = False
+
+    def update_epoch_count(self):
+        self.epoch_count += 1
+        self.local_step_count = 0
+
+    def _init_timer(self):
+        self.initialized = True
+
+    def start(self):
+        self._init_timer()
+        self.started = True
+        if self.total_step_count >= self.start_step:
+            _device_synchronize()
+            self.start_time = time.time()
+
+    def stop(self, report_speed=True):
+        if not self.started:
+            return
+        self.started = False
+        self.total_step_count += 1
+        self.local_step_count += 1
+        if self.total_step_count > self.start_step:
+            _device_synchronize()
+            self.end_time = time.time()
+            duration = self.end_time - self.start_time
+            self.total_elapsed_time += duration
+            if self.local_step_count % self.steps_per_output == 0:
+                if report_speed:
+                    self.logging(
+                        "{}/{}, SamplesPerSec={}".format(
+                            self.epoch_count, self.local_step_count,
+                            self.avg_samples_per_sec()))
+                if self.monitor_memory:
+                    self.logging(SynchronizedWallClockTimer.memory_usage())
+
+    def avg_samples_per_sec(self):
+        if self.total_step_count > self.start_step:
+            samples_per_step = self.batch_size * self.num_workers
+            total_step_offset = self.total_step_count - self.start_step
+            avg_time_per_step = self.total_elapsed_time / total_step_offset
+            return samples_per_step / avg_time_per_step
+        return float("-inf")

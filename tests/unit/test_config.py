@@ -1,0 +1,195 @@
+"""Batch-triple inference and config validation.
+
+Mirrors reference tests/unit/test_config.py + test_ds_config.py semantics,
+with world_size = the 8-device CPU mesh data axis.
+"""
+import json
+import pytest
+
+import jax
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+WORLD = None  # resolved lazily (8 on the CPU test mesh)
+
+
+def world():
+    return jax.device_count()
+
+
+def base_dict(**kwargs):
+    d = {"fp16": {"enabled": False}}
+    d.update(kwargs)
+    return d
+
+
+def test_only_train_batch():
+    cfg = DeepSpeedConfig(None, param_dict=base_dict(train_batch_size=world() * 4))
+    assert cfg.train_batch_size == world() * 4
+    assert cfg.train_micro_batch_size_per_gpu == 4
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_only_micro_batch():
+    cfg = DeepSpeedConfig(None,
+                          param_dict=base_dict(train_micro_batch_size_per_gpu=2))
+    assert cfg.train_batch_size == 2 * world()
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_train_and_micro():
+    cfg = DeepSpeedConfig(None, param_dict=base_dict(
+        train_batch_size=world() * 8, train_micro_batch_size_per_gpu=2))
+    assert cfg.gradient_accumulation_steps == 4
+
+
+def test_train_and_grad_acc():
+    cfg = DeepSpeedConfig(None, param_dict=base_dict(
+        train_batch_size=world() * 8, gradient_accumulation_steps=2))
+    assert cfg.train_micro_batch_size_per_gpu == 4
+
+
+def test_micro_and_grad_acc():
+    cfg = DeepSpeedConfig(None, param_dict=base_dict(
+        train_micro_batch_size_per_gpu=3, gradient_accumulation_steps=5))
+    assert cfg.train_batch_size == 3 * 5 * world()
+
+
+def test_all_three_consistent():
+    cfg = DeepSpeedConfig(None, param_dict=base_dict(
+        train_batch_size=world() * 6,
+        train_micro_batch_size_per_gpu=3,
+        gradient_accumulation_steps=2))
+    assert cfg.train_batch_size == world() * 6
+
+
+def test_all_three_inconsistent():
+    with pytest.raises(AssertionError):
+        DeepSpeedConfig(None, param_dict=base_dict(
+            train_batch_size=world() * 100,
+            train_micro_batch_size_per_gpu=3,
+            gradient_accumulation_steps=2))
+
+
+def test_none_given():
+    with pytest.raises(AssertionError):
+        DeepSpeedConfig(None, param_dict=base_dict())
+
+
+def test_only_grad_accum_given():
+    with pytest.raises(AssertionError):
+        DeepSpeedConfig(None, param_dict=base_dict(gradient_accumulation_steps=4))
+
+
+def test_config_from_file(tmp_config_file):
+    path = tmp_config_file({"train_batch_size": world() * 2,
+                            "fp16": {"enabled": True, "loss_scale": 128}})
+    cfg = DeepSpeedConfig(path)
+    assert cfg.fp16_enabled
+    assert cfg.loss_scale == 128
+
+
+def test_config_duplicate_key(tmp_path):
+    path = tmp_path / "dup.json"
+    path.write_text('{"train_batch_size": 8, "train_batch_size": 16}')
+    with pytest.raises(ValueError):
+        DeepSpeedConfig(str(path))
+
+
+def test_zero_requires_mixed_precision():
+    with pytest.raises(AssertionError):
+        DeepSpeedConfig(None, param_dict={
+            "train_batch_size": world(),
+            "zero_optimization": {"stage": 2},
+        })
+
+
+def test_zero_config_parsing():
+    cfg = DeepSpeedConfig(None, param_dict={
+        "train_batch_size": world(),
+        "fp16": {"enabled": True},
+        "zero_optimization": {
+            "stage": 3,
+            "overlap_comm": True,
+            "cpu_offload": True,
+            "stage3_max_live_parameters": 500,
+            "stage3_param_persistence_threshold": 42,
+        },
+    })
+    assert cfg.zero_enabled
+    assert cfg.zero_optimization_stage == 3
+    assert cfg.zero_config.overlap_comm is True
+    assert cfg.zero_config.cpu_offload is True
+    assert cfg.zero_config.max_live_parameters == 500
+    assert cfg.zero_config.param_persistence_threshold == 42
+
+
+def test_zero_deprecated_bool_format():
+    cfg = DeepSpeedConfig(None, param_dict={
+        "train_batch_size": world(),
+        "fp16": {"enabled": True},
+        "zero_optimization": True,
+    })
+    assert cfg.zero_optimization_stage == 1
+
+
+def test_bf16_block():
+    cfg = DeepSpeedConfig(None, param_dict={
+        "train_batch_size": world(),
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+    })
+    assert cfg.bf16_enabled
+    assert cfg.zero_enabled
+
+
+def test_dynamic_loss_scale_args():
+    cfg = DeepSpeedConfig(None, param_dict={
+        "train_batch_size": world(),
+        "fp16": {"enabled": True, "initial_scale_power": 16,
+                 "loss_scale_window": 500, "hysteresis": 2,
+                 "min_loss_scale": 1},
+    })
+    args = cfg.dynamic_loss_scale_args
+    assert args["init_scale"] == 2 ** 16
+    assert args["scale_window"] == 500
+    assert args["delayed_shift"] == 2
+    assert args["min_scale"] == 1
+
+
+def test_scheduler_optimizer_parsing():
+    cfg = DeepSpeedConfig(None, param_dict={
+        "train_batch_size": world(),
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_num_steps": 10}},
+    })
+    assert cfg.optimizer_name == "adam"
+    assert cfg.optimizer_params["lr"] == 1e-3
+    assert cfg.scheduler_name == "WarmupLR"
+    assert cfg.scheduler_params["warmup_num_steps"] == 10
+
+
+def test_sparse_attention_fixed_mode():
+    cfg = DeepSpeedConfig(None, param_dict={
+        "train_batch_size": world(),
+        "sparse_attention": {"mode": "fixed", "block": 32,
+                             "num_local_blocks": 8},
+    })
+    sa = cfg.sparse_attention
+    assert sa["mode"] == "fixed"
+    assert sa["block"] == 32
+    assert sa["num_local_blocks"] == 8
+    # defaults fill in
+    assert sa["num_global_blocks"] == 1
+
+
+def test_checkpoint_tag_validation_modes():
+    for mode, enabled, fail in [("Warn", True, False), ("Ignore", False, False),
+                                ("Fail", True, True)]:
+        cfg = DeepSpeedConfig(None, param_dict={
+            "train_batch_size": world(),
+            "checkpoint": {"tag_validation": mode},
+        })
+        assert cfg.checkpoint_tag_validation_enabled == enabled
+        assert cfg.checkpoint_tag_validation_fail == fail

@@ -1,0 +1,135 @@
+"""Elasticity solver tests (mirrors reference tests/unit/test_elastic.py)."""
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu import elasticity
+from deepspeed_tpu.version import __version__ as ds_version
+
+
+def base_config():
+    return {
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 10000,
+            "micro_batch_sizes": [8, 12, 16, 17],
+            "min_gpus": 32,
+            "max_gpus": 1500,
+            "min_time": 20,
+            "version": 0.1,
+        }
+    }
+
+
+def test_basic_10k():
+    ds_config = base_config()
+    final_batch_size, valid_gpus = elasticity.compute_elastic_config(
+        ds_config=ds_config, target_deepspeed_version=ds_version)
+
+    for gpu_num in valid_gpus:
+        assert final_batch_size % gpu_num == 0
+        batch_per_gpu = final_batch_size // gpu_num
+        assert any(batch_per_gpu % mb == 0
+                   for mb in ds_config["elasticity"]["micro_batch_sizes"])
+
+    assert len(valid_gpus) == 23
+    assert final_batch_size == 9792
+
+
+def test_disabled():
+    ds_config = base_config()
+    ds_config["elasticity"]["enabled"] = False
+    with pytest.raises(elasticity.ElasticityError):
+        elasticity.compute_elastic_config(ds_config=ds_config,
+                                          target_deepspeed_version=ds_version)
+
+
+def test_valid_world_size():
+    final_batch_size, valid_gpus, mbsize = elasticity.compute_elastic_config(
+        ds_config=base_config(), target_deepspeed_version=ds_version,
+        world_size=64)
+    assert mbsize == 17
+
+
+def test_invalid_world_size():
+    with pytest.raises(elasticity.ElasticityIncompatibleWorldSize):
+        elasticity.compute_elastic_config(ds_config=base_config(),
+                                          target_deepspeed_version=ds_version,
+                                          world_size=128)
+
+
+def test_future_elastic_version():
+    ds_config = base_config()
+    ds_config["elasticity"]["version"] = "0.2"
+    with pytest.raises(elasticity.ElasticityError):
+        elasticity.compute_elastic_config(ds_config=ds_config,
+                                          target_deepspeed_version=ds_version)
+
+
+def test_missing_max_batch():
+    ds_config = base_config()
+    del ds_config["elasticity"]["max_train_batch_size"]
+    with pytest.raises(elasticity.ElasticityConfigError):
+        elasticity.compute_elastic_config(ds_config=ds_config,
+                                          target_deepspeed_version=ds_version)
+
+
+def test_missing_micro_batch():
+    ds_config = base_config()
+    del ds_config["elasticity"]["micro_batch_sizes"]
+    with pytest.raises(elasticity.ElasticityConfigError):
+        elasticity.compute_elastic_config(ds_config=ds_config,
+                                          target_deepspeed_version=ds_version)
+
+
+def test_empty_config():
+    with pytest.raises(elasticity.ElasticityConfigError):
+        elasticity.compute_elastic_config(ds_config={"elasticity": {}},
+                                          target_deepspeed_version=ds_version)
+
+
+def test_proper_mbsz():
+    ds_config = base_config()
+    ds_config["elasticity"]["max_train_batch_size"] = 32
+    ds_config["elasticity"]["micro_batch_sizes"] = [1, 2, 3, 7]
+    ds_config["elasticity"]["min_gpus"] = 1
+    final_batch_size, valid_gpus, mbsize = elasticity.compute_elastic_config(
+        ds_config=ds_config, target_deepspeed_version=ds_version, world_size=7)
+    assert mbsize == 3
+
+
+def test_non_elastic_batch_params_w_override(tmp_config_file):
+    """Batch params + elasticity coexist only with ignore_non_elastic_batch_info."""
+    import jax
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    world = jax.device_count()
+    ds_config = {
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 4,
+            "micro_batch_sizes": [1, 2, 4],
+            "min_gpus": 1,
+            "max_gpus": 4,
+            "version": 0.1,
+            "ignore_non_elastic_batch_info": True,
+        },
+    }
+    # world=8 is not a valid gpu count for max batch 4 -> incompatible
+    if world == 8:
+        with pytest.raises(elasticity.ElasticityIncompatibleWorldSize):
+            DeepSpeedConfig(None, param_dict=ds_config)
+
+
+def test_non_elastic_batch_params_conflict():
+    from deepspeed_tpu.runtime.config import (DeepSpeedConfig,
+                                              DeepSpeedConfigError)
+    ds_config = {
+        "train_batch_size": 8,
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 1000,
+            "micro_batch_sizes": [1, 2, 4],
+            "version": 0.1,
+        },
+    }
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig(None, param_dict=ds_config)

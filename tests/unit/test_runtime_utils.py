@@ -1,0 +1,92 @@
+"""Partition math + norm helpers (mirrors reference test_runtime_utils.py)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime import utils as ds_utils
+
+
+def check_partition(weights, num_parts, eps=1e-3):
+    parts = ds_utils.partition_balanced(weights, num_parts, eps)
+    assert len(parts) == num_parts + 1
+    assert parts[0] == 0
+    assert parts[-1] == len(weights)
+    for p in range(1, len(parts)):
+        assert parts[p] >= parts[p - 1]
+    # near-optimal bottleneck: heaviest chunk within (1+eps) of best possible
+    chunk_weights = [sum(weights[parts[p]:parts[p + 1]])
+                     for p in range(num_parts)]
+    assert max(chunk_weights) <= (1 + 2 * eps) * _optimal_bottleneck(
+        weights, num_parts) + 1e-9
+
+
+def _optimal_bottleneck(weights, num_parts):
+    best = sum(weights)
+    # brute force over all boundary placements for small cases
+    n = len(weights)
+    import itertools
+    for cuts in itertools.combinations(range(1, n), num_parts - 1):
+        bounds = (0,) + cuts + (n,)
+        bottleneck = max(sum(weights[bounds[i]:bounds[i + 1]])
+                         for i in range(num_parts))
+        best = min(best, bottleneck)
+    return best
+
+
+def test_partition_uniform():
+    parts = ds_utils.partition_uniform(10, 5)
+    assert parts == [0, 2, 4, 6, 8, 10]
+    parts = ds_utils.partition_uniform(10, 3)
+    assert parts[0] == 0 and parts[-1] == 10 and len(parts) == 4
+    # fewer items than parts
+    parts = ds_utils.partition_uniform(2, 4)
+    assert parts == [0, 1, 2, 2, 2]
+
+
+def test_partition_balanced_uniform_weights():
+    check_partition([1] * 8, 4)
+
+
+def test_partition_balanced_skewed():
+    check_partition([1, 1, 1, 1, 10], 2)
+    check_partition([10, 1, 1, 1, 1], 2)
+    check_partition([1, 5, 1, 5, 1, 5], 3)
+
+
+def test_partition_balanced_more_parts_than_items():
+    parts = ds_utils.partition_balanced([5, 5], 4)
+    assert parts[0] == 0 and parts[-1] == 2
+
+
+def test_grad_norm():
+    grads = {"a": jnp.ones((3, 4)), "b": jnp.full((2,), 2.0)}
+    norm = ds_utils.get_grad_norm(grads)
+    expected = np.sqrt(12 * 1.0 + 2 * 4.0)
+    np.testing.assert_allclose(float(norm), expected, rtol=1e-6)
+
+
+def test_clip_grad_norm():
+    grads = {"w": jnp.full((4,), 10.0)}
+    clipped, total = ds_utils.clip_grad_norm_(grads, max_norm=1.0)
+    np.testing.assert_allclose(float(ds_utils.get_grad_norm(clipped)), 1.0,
+                               rtol=1e-4)
+    # under the cap -> untouched
+    grads = {"w": jnp.full((4,), 0.01)}
+    clipped, _ = ds_utils.clip_grad_norm_(grads, max_norm=1.0)
+    np.testing.assert_allclose(np.asarray(clipped["w"]),
+                               np.asarray(grads["w"]))
+
+
+def test_check_overflow():
+    ok = {"a": jnp.ones(4)}
+    bad = {"a": jnp.array([1.0, float("inf")])}
+    nan = {"a": jnp.array([1.0, float("nan")])}
+    assert not bool(ds_utils.CheckOverflow.has_overflow(ok))
+    assert bool(ds_utils.CheckOverflow.has_overflow(bad))
+    assert bool(ds_utils.CheckOverflow.has_overflow(nan))
+
+
+def test_call_to_str():
+    assert ds_utils.call_to_str("foo") == "foo()"
+    assert ds_utils.call_to_str("foo", 1, 2) == "foo(1, 2)"
+    assert ds_utils.call_to_str("foo", 1, b=2) == "foo(1, b=2)"

@@ -1,0 +1,121 @@
+"""Topology/mesh math (mirrors reference tests/unit/test_topology.py)."""
+import pytest
+import jax
+
+from deepspeed_tpu.parallel.topology import (
+    ProcessTopology as Topo, PipeDataParallelTopology,
+    PipeModelDataParallelTopology, MeshGrid, build_mesh, _prime_factors)
+
+
+def test_topology_2d():
+    topo = Topo(axes=["row", "col"], dims=[2, 2])
+    assert topo.world_size() == 4
+    assert topo.get_rank(row=0, col=0) == 0
+    assert topo.get_rank(row=0, col=1) == 1
+    assert topo.get_rank(row=1, col=0) == 2
+    assert topo.get_rank(row=1, col=1) == 3
+    assert topo.get_axis_list(axis="row", idx=0) == [0, 1]
+    assert topo.get_axis_list(axis="row", idx=1) == [2, 3]
+    assert topo.get_axis_list(axis="col", idx=0) == [0, 2]
+    assert topo.get_axis_list(axis="col", idx=1) == [1, 3]
+
+
+def test_topology_dims():
+    topo = Topo(axes=["a", "b", "c"], dims=[2, 3, 4])
+    assert topo.world_size() == 24
+    assert topo.get_dim("a") == 2
+    assert topo.get_dim("b") == 3
+    assert topo.get_dim("c") == 4
+
+
+def test_topology_match():
+    topo = Topo(axes=["pipe", "data", "model"], dims=[2, 2, 2])
+    assert topo.filter_match(pipe=0, data=1) == [2, 3]
+
+
+def test_topology_rank_repr():
+    topo = Topo(axes=["a", "b"], dims=[2, 2])
+    assert topo.get_rank_repr(rank=0) == "a_00-b_00"
+    assert topo.get_rank_repr(rank=1) == "a_00-b_01"
+    assert topo.get_rank_repr(rank=2) == "a_01-b_00"
+    assert topo.get_rank_repr(rank=3) == "a_01-b_01"
+    assert topo.get_rank_repr(rank=3, inner_sep="+") == "a+01-b+01"
+
+    topo = Topo(axes=["pipe", "data"], dims=[2, 2])
+    for r in range(4):
+        assert topo.get_rank_repr(rank=r) == ""
+
+
+def test_topology_3d():
+    topo = Topo(axes=["a", "b", "c"], dims=[2, 2, 2])
+    assert topo.get_rank(a=0, b=0, c=0) == 0
+    assert topo.get_rank(a=0, b=0, c=1) == 1
+    assert topo.get_rank(a=0, b=1, c=0) == 2
+    assert topo.get_rank(a=1, b=0, c=0) == 4
+    assert topo.get_axis_list("a", 0) == [0, 1, 2, 3]
+    assert topo.get_coord(rank=5) == topo.ProcessCoord(a=1, b=0, c=1)
+
+
+def test_topology_comm_list():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    assert topo.world_size() == 8
+    # pipe groups: ranks that differ only in pipe coordinate
+    pipe_list = topo.get_axis_comm_lists("pipe")
+    for group in pipe_list:
+        assert len(group) == 2
+        coords = [topo.get_coord(r) for r in group]
+        assert coords[0].data == coords[1].data
+        assert coords[0].model == coords[1].model
+    data_list = topo.get_axis_comm_lists("data")
+    assert len(data_list) == 4
+    model_list = topo.get_axis_comm_lists("model")
+    assert len(model_list) == 4
+    # bogus axis
+    assert topo.get_axis_comm_lists("bogus") == []
+
+
+def test_primes():
+    assert _prime_factors(12) == [2, 2, 3]
+    assert _prime_factors(97) == [97]
+    assert _prime_factors(8) == [2, 2, 2]
+    with pytest.raises(ValueError):
+        _prime_factors(0)
+
+
+def test_build_mesh_2d():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=4)
+    mesh = build_mesh(topo)
+    assert mesh.shape["pipe"] == 2
+    assert mesh.shape["data"] == 4
+
+
+def test_build_mesh_default_data_axis():
+    mesh = build_mesh()
+    assert mesh.shape["data"] == jax.device_count()
+
+
+def test_grid_pipe_data():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=4)
+    grid = MeshGrid(topology=topo, process_rank=0)
+    assert grid.get_pipe_parallel_world_size() == 2
+    assert grid.get_data_parallel_world_size() == 4
+    assert grid.get_model_parallel_world_size() == 1
+    assert grid.is_first_stage()
+
+
+def test_stage_to_global():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+    grid = MeshGrid(topology=topo, process_rank=0)
+    assert grid.stage_to_global(stage_id=0, data=0) == 0
+    assert grid.stage_to_global(stage_id=0, data=1) == 1
+    assert grid.stage_to_global(stage_id=1, data=0) == 2
+    assert grid.stage_to_global(stage_id=1, data=1) == 3
+
+
+def test_mesh_grid_3d():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    grid = MeshGrid(topology=topo, process_rank=0)
+    assert grid.get_model_parallel_world_size() == 2
+    assert grid.mesh.shape["model"] == 2
+    assert grid.mesh.shape["pipe"] == 2
+    assert grid.mesh.shape["data"] == 2

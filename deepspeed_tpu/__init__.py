@@ -29,8 +29,12 @@ def initialize(args=None, model=None, optimizer=None, model_parameters=None,
     :class:`deepspeed_tpu.pipe.PipelineModule` for pipeline parallelism.
     """
     from .runtime.engine import DeepSpeedEngine
-    from .runtime.pipe.module import PipelineModule
-    from .runtime.pipe.engine import PipelineEngine
+    try:
+        from .runtime.pipe.module import PipelineModule
+        from .runtime.pipe.engine import PipelineEngine
+    except ImportError:  # pipeline stack not built yet
+        PipelineModule = ()
+        PipelineEngine = None
 
     assert model is not None, "deepspeed.initialize requires a model"
 

@@ -1,0 +1,49 @@
+"""Plain SGD (with momentum) — the torch.optim passthrough equivalent."""
+import jax
+import jax.numpy as jnp
+
+
+class SGD:
+    name = "sgd"
+    supports_zero = True
+
+    def __init__(self, lr=1e-3, momentum=0.0, weight_decay=0.0, **kwargs):
+        self.lr = lr
+        self.momentum = momentum
+        self.weight_decay = weight_decay
+        self.betas = (momentum, 0.0)
+
+    def init_state(self, params):
+        zeros = lambda p: jnp.zeros(p.shape, dtype=jnp.float32)
+        return {
+            "step": jnp.zeros((), dtype=jnp.int32),
+            "exp_avg": jax.tree_util.tree_map(zeros, params),
+            "exp_avg_sq": jax.tree_util.tree_map(zeros, params),
+        }
+
+    def hyperparams(self):
+        return {
+            "lr": float(self.lr),
+            "beta1": float(self.momentum),
+            "beta2": 0.0,
+            "eps": 0.0,
+            "weight_decay": float(self.weight_decay),
+        }
+
+    def update(self, grads, state, params, lr, beta1, beta2, eps, weight_decay):
+        step = state["step"] + 1
+
+        def leaf(p, g, m):
+            g = g.astype(jnp.float32) + weight_decay * p.astype(jnp.float32)
+            m_new = beta1 * m + g
+            p_new = p.astype(jnp.float32) - lr * m_new
+            return p_new.astype(p.dtype), m_new
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_m = treedef.flatten_up_to(state["exp_avg"])
+        out = [leaf(p, g, m) for p, g, m in zip(flat_p, flat_g, flat_m)]
+        new_params = jax.tree_util.tree_unflatten(treedef, [o[0] for o in out])
+        new_m = jax.tree_util.tree_unflatten(treedef, [o[1] for o in out])
+        return new_params, {"step": step, "exp_avg": new_m,
+                            "exp_avg_sq": state["exp_avg_sq"]}

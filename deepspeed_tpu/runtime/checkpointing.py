@@ -1,0 +1,68 @@
+"""Checkpoint serialization helpers.
+
+Reference parity: engine.py:1343-1685 file-layout semantics — ``latest``
+pointer, ``<dir>/<tag>/mp_rank_XX_model_states.pt`` model file, separate
+``zero_pp_rank_N_mp_rank_XX_optim_states.pt`` optimizer shards, client-state
+round trip. Tensors are stored as numpy inside a pickled dict; sharded
+``jax.Array``s are gathered to host first (orbax-style async sharded
+checkpointing can replace the transport without changing this layout).
+"""
+import os
+import pickle
+
+import numpy as np
+
+import jax
+
+
+def tree_to_numpy(tree):
+    def to_np(x):
+        if isinstance(x, jax.Array):
+            if hasattr(x, "is_fully_replicated") and not x.is_fully_addressable:
+                from jax.experimental import multihost_utils
+                return np.asarray(multihost_utils.process_allgather(x))
+            return np.asarray(x)
+        return x
+    return jax.tree_util.tree_map(to_np, tree)
+
+
+def save_state_dict(path, state_dict):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(tree_to_numpy(state_dict), f, protocol=4)
+
+
+def load_state_dict(path):
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def model_ckpt_name(checkpoints_path, tag, mp_rank=0):
+    return os.path.join(checkpoints_path, str(tag),
+                        "mp_rank_{:02d}_model_states.pt".format(mp_rank))
+
+
+def zero_ckpt_name(checkpoints_path, tag, dp_rank=0, mp_rank=0):
+    return os.path.join(
+        checkpoints_path, str(tag),
+        "zero_pp_rank_{}_mp_rank_{:02d}_optim_states.pt".format(dp_rank, mp_rank))
+
+
+def layer_ckpt_name(checkpoints_path, tag, layer_id, model_rank=0):
+    return os.path.join(
+        checkpoints_path, str(tag),
+        "layer_{:02d}-model_{:02d}-model_states.pt".format(layer_id, model_rank))
+
+
+def save_latest(save_dir, tag):
+    os.makedirs(save_dir, exist_ok=True)
+    with open(os.path.join(save_dir, "latest"), "w") as f:
+        f.write(str(tag))
+
+
+def read_latest(load_dir):
+    latest_path = os.path.join(load_dir, "latest")
+    if os.path.isfile(latest_path):
+        with open(latest_path, "r") as f:
+            return f.read().strip()
+    return None

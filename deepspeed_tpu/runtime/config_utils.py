@@ -13,12 +13,6 @@ def get_scalar_param(param_dict, param_name, param_default_value):
     return param_dict.get(param_name, param_default_value)
 
 
-def get_list_param(param_dict, param_name, param_default_value):
-    if param_dict is None:
-        return param_default_value
-    return param_dict.get(param_name, param_default_value)
-
-
 def dict_raise_error_on_duplicate_keys(ordered_pairs):
     """``json.load(..., object_pairs_hook=...)`` hook that rejects duplicate keys."""
     d = dict(ordered_pairs)
@@ -30,7 +24,3 @@ def dict_raise_error_on_duplicate_keys(ordered_pairs):
         raise ValueError("Duplicate keys in DeepSpeed config: {}".format(duplicates))
     return d
 
-
-def load_config_json(path):
-    with open(path, "r") as f:
-        return json.load(f, object_pairs_hook=dict_raise_error_on_duplicate_keys)

@@ -1,0 +1,129 @@
+"""Static and dynamic loss scaling as pure functions of a small state pytree.
+
+Reference parity: deepspeed/runtime/fp16/loss_scaler.py (LossScaler :56,
+DynamicLossScaler :79). The reference mutates Python attributes per step; here
+the scaler state lives inside the jitted train step and is updated
+branchlessly with ``jnp.where`` so an overflow-skip step compiles to the same
+program as a normal step (SURVEY §7 "hard parts").
+
+Semantics preserved:
+  * overflow: if hysteresis exhausted, scale = max(scale/2, min_scale) and
+    the hysteresis window restarts on the next overflow; else hysteresis -= 1
+  * ``scale_window`` consecutive clean steps: scale *= 2 and hysteresis
+    resets to ``delayed_shift``
+"""
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+INITIAL_LOSS_SCALE = "init_scale"
+SCALE_WINDOW = "scale_window"
+DELAYED_SHIFT = "delayed_shift"
+MIN_LOSS_SCALE = "min_scale"
+
+
+class LossScalerState(NamedTuple):
+    cur_scale: jnp.ndarray        # f32 scalar
+    cur_hysteresis: jnp.ndarray   # i32 scalar
+    last_overflow_iter: jnp.ndarray  # i32 scalar
+    cur_iter: jnp.ndarray         # i32 scalar
+    dynamic: bool                 # static python flag (baked into the jit)
+    scale_factor: float
+    scale_window: int
+    delayed_shift: int
+    min_scale: float
+
+
+# Register so that only the four counters are traced leaves; the config
+# fields ride along as static aux data (a plain NamedTuple would trace them
+# and break `if not state.dynamic` under jit).
+jax.tree_util.register_pytree_node(
+    LossScalerState,
+    lambda s: ((s.cur_scale, s.cur_hysteresis, s.last_overflow_iter,
+                s.cur_iter),
+               (s.dynamic, s.scale_factor, s.scale_window, s.delayed_shift,
+                s.min_scale)),
+    lambda aux, children: LossScalerState(*children, *aux))
+
+
+def create_loss_scaler(static_loss_scale=None, init_scale=2 ** 32,
+                       scale_factor=2.0, scale_window=1000, min_scale=1.0,
+                       delayed_shift=1):
+    """Build initial scaler state. ``static_loss_scale`` > 0 disables dynamics."""
+    dynamic = static_loss_scale is None or static_loss_scale == 0
+    scale = float(init_scale if dynamic else static_loss_scale)
+    return LossScalerState(
+        cur_scale=jnp.asarray(scale, dtype=jnp.float32),
+        cur_hysteresis=jnp.asarray(delayed_shift, dtype=jnp.int32),
+        last_overflow_iter=jnp.asarray(-1, dtype=jnp.int32),
+        cur_iter=jnp.asarray(0, dtype=jnp.int32),
+        dynamic=dynamic,
+        scale_factor=float(scale_factor),
+        scale_window=int(scale_window),
+        delayed_shift=int(delayed_shift),
+        min_scale=float(min_scale),
+    )
+
+
+def loss_scaler_from_config(config):
+    """Build from a DeepSpeedConfig's fp16 block."""
+    if not getattr(config, "fp16_enabled", False):
+        return create_loss_scaler(static_loss_scale=1.0)
+    if config.loss_scale and config.loss_scale > 0:
+        return create_loss_scaler(static_loss_scale=config.loss_scale)
+    args = config.dynamic_loss_scale_args or {}
+    return create_loss_scaler(
+        static_loss_scale=None,
+        init_scale=args.get(INITIAL_LOSS_SCALE, config.initial_dynamic_scale),
+        scale_window=args.get(SCALE_WINDOW, 1000),
+        min_scale=args.get(MIN_LOSS_SCALE, 1.0),
+        delayed_shift=args.get(DELAYED_SHIFT, 1),
+    )
+
+
+def update_scale(state: LossScalerState, has_overflow) -> LossScalerState:
+    """One scaler step; ``has_overflow`` is a traced bool. Branchless."""
+    if not state.dynamic:
+        return state._replace(cur_iter=state.cur_iter + 1)
+
+    has_overflow = jnp.asarray(has_overflow)
+
+    # Overflow path: drop scale only when hysteresis is (or would be) spent.
+    hysteresis_spent = jnp.logical_or(state.delayed_shift == 1,
+                                      state.cur_hysteresis <= 1)
+    dropped_scale = jnp.maximum(state.cur_scale / state.scale_factor,
+                                state.min_scale)
+    overflow_scale = jnp.where(hysteresis_spent, dropped_scale, state.cur_scale)
+    overflow_hysteresis = jnp.where(hysteresis_spent, state.cur_hysteresis,
+                                    state.cur_hysteresis - 1)
+
+    # Clean path: grow scale every scale_window clean steps.
+    window_elapsed = (state.cur_iter - state.last_overflow_iter) % \
+        state.scale_window == 0
+    grown_scale = jnp.where(window_elapsed,
+                            state.cur_scale * state.scale_factor,
+                            state.cur_scale)
+    grown_hysteresis = jnp.where(window_elapsed,
+                                 jnp.asarray(state.delayed_shift,
+                                             dtype=jnp.int32),
+                                 state.cur_hysteresis)
+
+    return state._replace(
+        cur_scale=jnp.where(has_overflow, overflow_scale, grown_scale),
+        cur_hysteresis=jnp.where(has_overflow, overflow_hysteresis,
+                                 grown_hysteresis),
+        last_overflow_iter=jnp.where(has_overflow, state.cur_iter,
+                                     state.last_overflow_iter),
+        cur_iter=state.cur_iter + 1,
+    )
+
+
+# Convenience views matching the reference's attribute names.
+def loss_scale(state: LossScalerState):
+    return state.cur_scale
+
+
+def backward_scale(loss, state: LossScalerState):
+    """Scale a loss before differentiation (reference backward(scaled_loss))."""
+    return loss * state.cur_scale

@@ -1,0 +1,111 @@
+"""Model container: the nn.Module-equivalent handed to ``initialize()``.
+
+The reference wraps a ``torch.nn.Module`` whose ``forward(*inputs)`` returns
+the loss (engine.py:886-929). Here a model is a pure apply function plus a
+params pytree. Flax modules are adapted automatically.
+"""
+import inspect
+
+
+class Model:
+    """(apply_fn, params) pair.
+
+    ``apply_fn(params, *inputs)`` must return the scalar loss (training
+    convention, as the reference's ``module(*inputs)``), or a tuple whose
+    first element is the loss. If the function accepts an ``rng`` keyword the
+    engine threads a fresh PRNG key per micro-step (dropout etc.); if it
+    accepts ``train`` the engine passes the current mode.
+
+    ``partition_spec_fn(path, shape) -> PartitionSpec|None`` may be provided
+    for tensor-parallel parameter layouts.
+    """
+
+    def __init__(self, apply_fn, params, partition_spec_fn=None, name=None):
+        self.apply_fn = apply_fn
+        self.params = params
+        self.partition_spec_fn = partition_spec_fn
+        self.name = name or getattr(apply_fn, "__name__", "model")
+        sig_params = _signature_params(apply_fn)
+        self.accepts_rng = "rng" in sig_params or "rngs" in sig_params
+        self.rng_kwarg = "rngs" if "rngs" in sig_params else "rng"
+        # Mode kwarg: either train=bool or the flax-common deterministic=bool.
+        if "train" in sig_params:
+            self.mode_kwarg = "train"
+        elif "deterministic" in sig_params:
+            self.mode_kwarg = "deterministic"
+        else:
+            self.mode_kwarg = None
+        self.accepts_kwargs = any(
+            p.kind == inspect.Parameter.VAR_KEYWORD for p in sig_params.values())
+
+    def mode_kwargs(self, train):
+        if self.mode_kwarg == "train":
+            return {"train": train}
+        if self.mode_kwarg == "deterministic":
+            return {"deterministic": not train}
+        return {}
+
+    def rng_kwargs(self, rng):
+        if not self.accepts_rng:
+            return {}
+        if self.rng_kwarg == "rngs":
+            return {"rngs": {"dropout": rng}}
+        return {"rng": rng}
+
+
+def _signature_params(fn):
+    try:
+        return inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return {}
+
+
+def as_model(model, model_parameters=None):
+    """Coerce user input to a :class:`Model`.
+
+    Accepts: a Model; a flax linen Module (+ params/variables in
+    ``model_parameters``); or a bare callable (+ params).
+    """
+    if isinstance(model, Model):
+        return model
+
+    try:
+        from flax import linen as nn
+        is_flax = isinstance(model, nn.Module)
+    except ImportError:
+        is_flax = False
+
+    if is_flax:
+        assert model_parameters is not None, \
+            "flax modules require model_parameters (params or variables dict)"
+        variables = model_parameters
+        if not (isinstance(variables, dict) and "params" in variables):
+            variables = {"params": model_parameters}
+
+        def apply_fn(params, *inputs, **kwargs):
+            vs = dict(variables)
+            vs["params"] = params
+            return model.apply(vs, *inputs, **kwargs)
+
+        sig = _signature_params(model.__call__)
+        m = Model(apply_fn, variables["params"],
+                  name=type(model).__name__)
+        m.accepts_rng = True  # flax apply always takes rngs
+        m.rng_kwarg = "rngs"
+        if "train" in sig:
+            m.mode_kwarg = "train"
+        elif "deterministic" in sig:
+            m.mode_kwarg = "deterministic"
+        else:
+            m.mode_kwarg = None
+        return m
+
+    if callable(model):
+        params = model_parameters
+        if params is None:
+            params = getattr(model, "params", None)
+        assert params is not None, \
+            "callable models require model_parameters (a params pytree)"
+        return Model(model, params)
+
+    raise TypeError("Cannot interpret model of type {}".format(type(model)))

@@ -1,0 +1,64 @@
+"""Tiny model fixtures (mirrors reference tests/unit/simple_model.py)."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.model import Model
+
+
+def make_simple_model(hidden_dim, nlayers=2, seed=0):
+    """Two-layer MLP; apply(params, x, y) -> MSE loss (the reference's
+    SimpleModel + CrossEntropyLoss analogue, returning loss from forward)."""
+    rng = np.random.RandomState(seed)
+    params = {}
+    for i in range(nlayers):
+        params["layer_{}".format(i)] = {
+            "w": jnp.asarray(rng.randn(hidden_dim, hidden_dim) * 0.1,
+                             dtype=jnp.float32),
+            "b": jnp.zeros((hidden_dim,), dtype=jnp.float32),
+        }
+
+    def apply_fn(params, x, y):
+        h = x
+        for i in range(nlayers):
+            layer = params["layer_{}".format(i)]
+            h = h @ layer["w"].astype(h.dtype) + layer["b"].astype(h.dtype)
+            if i < nlayers - 1:
+                h = jax.nn.relu(h)
+        return jnp.mean((h - y) ** 2)
+
+    return Model(apply_fn, params, name="SimpleModel")
+
+
+class SimpleDataset:
+    """Random (x, y) regression pairs with a learnable linear target."""
+
+    def __init__(self, total_samples, hidden_dim, seed=0, dtype=np.float32):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randn(total_samples, hidden_dim).astype(dtype)
+        w_true = rng.randn(hidden_dim, hidden_dim).astype(dtype) * 0.1
+        self.y = (self.x @ w_true).astype(dtype)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+
+def random_dataloader(model=None, total_samples=64, hidden_dim=8, device=None,
+                      dtype=np.float32):
+    dataset = SimpleDataset(total_samples, hidden_dim, dtype=dtype)
+    return dataset
+
+
+def base_config(world, micro_batch=4, gas=1, **overrides):
+    cfg = {
+        "train_micro_batch_size_per_gpu": micro_batch,
+        "gradient_accumulation_steps": gas,
+        "steps_per_print": 1000,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+    }
+    cfg.update(overrides)
+    return cfg

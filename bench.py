@@ -1,0 +1,105 @@
+"""Benchmark: GPT-2 (125M) causal-LM pretraining throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline = measured MFU / 0.45 — the repo's north-star target
+(BASELINE.json: Megatron-GPT2 ZeRO-2 at >=45% MFU).
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+# Peak bf16 TFLOPS per chip by device kind.
+PEAK_TFLOPS = {
+    "TPU v2": 22.5, "TPU v3": 61.0, "TPU v4": 137.5,  # bf16 per chip
+    "TPU v5 lite": 197.0, "TPU v5e": 197.0, "TPU v5": 229.5,
+    "TPU v5p": 229.5, "TPU v6 lite": 459.0, "TPU v6e": 459.0,
+    "cpu": 0.1,
+}
+
+
+def peak_for(device):
+    kind = getattr(device, "device_kind", "cpu")
+    for name, tf in PEAK_TFLOPS.items():
+        if kind.lower().startswith(name.lower()):
+            return tf * 1e12
+    return 0.1e12
+
+
+def main():
+    import jax
+    import deepspeed_tpu as deepspeed
+    from deepspeed_tpu.models import gpt2
+
+    on_tpu = jax.default_backend() == "tpu"
+    seq = 1024 if on_tpu else 128
+    micro_batch = 8 if on_tpu else 2
+    steps = 20 if on_tpu else 3
+    warmup = 3 if on_tpu else 1
+
+    if on_tpu:
+        cfg = gpt2.config_for("gpt2_small", max_seq_len=seq, remat=True)
+    else:
+        cfg = gpt2.GPT2Config(vocab_size=512, max_seq_len=seq, n_layers=2,
+                              n_heads=4, d_model=128,
+                              use_flash_attention=False, remat=False)
+    model = gpt2.make_gpt2_model(config=cfg)
+    n_params = gpt2.num_params(cfg)
+
+    ds_config = {
+        "train_micro_batch_size_per_gpu": micro_batch,
+        "gradient_accumulation_steps": 1,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = deepspeed.initialize(model=model, config_params=ds_config)
+
+    rng = np.random.RandomState(0)
+    global_batch = micro_batch * engine.dp_world_size
+    ids = rng.randint(0, cfg.vocab_size, size=(1, global_batch, seq)) \
+        .astype(np.int32)
+    batch = (ids, ids.copy())
+
+    # compile + warmup
+    for _ in range(warmup):
+        loss = engine.train_batch(batch=batch)
+    jax.block_until_ready(engine.state["params"]["wte"])
+
+    t0 = time.time()
+    for _ in range(steps):
+        loss = engine.train_batch(batch=batch)
+    jax.block_until_ready(engine.state["params"]["wte"])
+    dt = time.time() - t0
+
+    tokens_per_step = global_batch * seq
+    tokens_per_sec = tokens_per_step * steps / dt
+    # flops/token: 6N for the dense path + 12*L*d*s for attention scores/ctx
+    flops_per_token = 6.0 * n_params + 12.0 * cfg.n_layers * cfg.d_model * seq
+    achieved = tokens_per_sec * flops_per_token / jax.device_count()
+    mfu = achieved / peak_for(jax.devices()[0])
+
+    print(json.dumps({
+        "metric": "gpt2_125m_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec / jax.device_count(), 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "loss": round(float(loss), 4),
+            "seq_len": seq,
+            "global_batch": global_batch,
+            "params": n_params,
+            "device": getattr(jax.devices()[0], "device_kind", "cpu"),
+            "backend": jax.default_backend(),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

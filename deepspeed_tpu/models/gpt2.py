@@ -1,0 +1,218 @@
+"""Megatron-style GPT-2 — the flagship pretraining model family.
+
+Reference parity: the DeepSpeedExamples Megatron-GPT2 workload (BASELINE
+configs 2/4/5; reference tests/model/Megatron_GPT2). TPU-first design:
+
+  * pure-functional transformer over a params pytree; one jitted step;
+  * Megatron tensor parallelism expressed as PartitionSpecs on the ``model``
+    mesh axis (QKV/MLP-in column-parallel, proj/MLP-out row-parallel,
+    vocab-parallel embedding) — XLA inserts the TP collectives that
+    Megatron's ColumnParallelLinear/RowParallelLinear do by hand;
+  * activation checkpointing via jax.checkpoint per block;
+  * attention routed through ops.transformer (Pallas flash attention on TPU,
+    reference csrc/transformer fused kernels).
+
+Model size table matches GPT-2 family: 125M/350M/760M/1.5B (gpt2_small..xl).
+"""
+import math
+from dataclasses import dataclass, field
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.topology import MODEL_AXIS
+
+
+@dataclass
+class GPT2Config:
+    vocab_size: int = 50304        # 50257 padded to a multiple of 128
+    max_seq_len: int = 1024
+    n_layers: int = 12
+    n_heads: int = 12
+    d_model: int = 768
+    dropout: float = 0.0
+    remat: bool = True             # activation checkpointing per block
+    use_flash_attention: bool = True
+    dtype: object = jnp.float32    # param dtype at init (engine recasts)
+
+    @property
+    def d_head(self):
+        return self.d_model // self.n_heads
+
+
+SIZES = {
+    "gpt2_small": dict(n_layers=12, n_heads=12, d_model=768),      # 125M
+    "gpt2_medium": dict(n_layers=24, n_heads=16, d_model=1024),    # 350M
+    "gpt2_large": dict(n_layers=36, n_heads=20, d_model=1280),     # 760M
+    "gpt2_xl": dict(n_layers=48, n_heads=25, d_model=1600),        # 1.5B
+}
+
+
+def config_for(name, **overrides):
+    base = dict(SIZES[name])
+    base.update(overrides)
+    return GPT2Config(**base)
+
+
+def init_params(config, seed=0):
+    """Megatron-style init: normal(0, 0.02), output projections scaled by
+    1/sqrt(2*n_layers)."""
+    rng = np.random.RandomState(seed)
+    std = 0.02
+    proj_std = std / math.sqrt(2.0 * config.n_layers)
+    d, v, s = config.d_model, config.vocab_size, config.max_seq_len
+    norm = lambda *shape, sd=std: jnp.asarray(
+        rng.randn(*shape) * sd, dtype=config.dtype)
+    zeros = lambda *shape: jnp.zeros(shape, dtype=config.dtype)
+    ones = lambda *shape: jnp.ones(shape, dtype=config.dtype)
+
+    blocks = []
+    for _ in range(config.n_layers):
+        blocks.append({
+            "ln1": {"scale": ones(d), "bias": zeros(d)},
+            "attn": {
+                "qkv_kernel": norm(d, 3 * d),
+                "qkv_bias": zeros(3 * d),
+                "proj_kernel": norm(d, d, sd=proj_std),
+                "proj_bias": zeros(d),
+            },
+            "ln2": {"scale": ones(d), "bias": zeros(d)},
+            "mlp": {
+                "fc_kernel": norm(d, 4 * d),
+                "fc_bias": zeros(4 * d),
+                "proj_kernel": norm(4 * d, d, sd=proj_std),
+                "proj_bias": zeros(d),
+            },
+        })
+    return {
+        "wte": norm(v, d),
+        "wpe": norm(s, d, sd=std / 2),
+        "blocks": blocks,
+        "ln_f": {"scale": ones(d), "bias": zeros(d)},
+    }
+
+
+def partition_spec_fn(path, shape):
+    """Megatron TP layout on the ``model`` mesh axis."""
+    if path.endswith("wte"):
+        return P(MODEL_AXIS, None)               # vocab-parallel embedding
+    if "qkv_kernel" in path or "fc_kernel" in path:
+        return P(None, MODEL_AXIS)               # column parallel
+    if "qkv_bias" in path or "fc_bias" in path:
+        return P(MODEL_AXIS)
+    if "attn" in path and "proj_kernel" in path:
+        return P(MODEL_AXIS, None)               # row parallel
+    if "mlp" in path and "proj_kernel" in path:
+        return P(MODEL_AXIS, None)
+    return None                                   # replicated (LN, wpe, biases)
+
+
+def _layer_norm(x, scale, bias, eps=1e-5):
+    from ..ops.transformer.fused_ops import fused_layer_norm
+    return fused_layer_norm(x, scale, bias, eps)
+
+
+def _attention(x, block, config, rng, train):
+    b, s, d = x.shape
+    h, dh = config.n_heads, config.d_head
+    qkv = x @ block["qkv_kernel"].astype(x.dtype) + \
+        block["qkv_bias"].astype(x.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    reshape = lambda t: t.reshape(b, s, h, dh)
+    q, k, v = reshape(q), reshape(k), reshape(v)
+
+    from ..ops.transformer.attention import causal_attention
+    ctx = causal_attention(q, k, v, use_flash=config.use_flash_attention)
+    ctx = ctx.reshape(b, s, d)
+    out = ctx @ block["proj_kernel"].astype(x.dtype) + \
+        block["proj_bias"].astype(x.dtype)
+    if train and config.dropout > 0.0 and rng is not None:
+        keep = jax.random.bernoulli(rng, 1.0 - config.dropout, out.shape)
+        out = jnp.where(keep, out / (1.0 - config.dropout), 0.0)
+    return out
+
+
+def _mlp(x, block, config, rng, train):
+    from ..ops.transformer.fused_ops import fused_bias_gelu
+    h = fused_bias_gelu(x @ block["fc_kernel"].astype(x.dtype),
+                        block["fc_bias"].astype(x.dtype))
+    out = h @ block["proj_kernel"].astype(x.dtype) + \
+        block["proj_bias"].astype(x.dtype)
+    if train and config.dropout > 0.0 and rng is not None:
+        keep = jax.random.bernoulli(rng, 1.0 - config.dropout, out.shape)
+        out = jnp.where(keep, out / (1.0 - config.dropout), 0.0)
+    return out
+
+
+def _block(x, block_params, config, rng, train):
+    r1, r2 = (None, None) if rng is None else jax.random.split(rng)
+    ln1 = _layer_norm(x, block_params["ln1"]["scale"],
+                      block_params["ln1"]["bias"])
+    x = x + _attention(ln1, block_params["attn"], config, r1, train)
+    ln2 = _layer_norm(x, block_params["ln2"]["scale"],
+                      block_params["ln2"]["bias"])
+    x = x + _mlp(ln2, block_params["mlp"], config, r2, train)
+    return x
+
+
+def forward_hidden(params, input_ids, config, rng=None, train=False):
+    """Embedding + transformer stack -> final hidden states."""
+    b, s = input_ids.shape
+    compute_dtype = params["ln_f"]["scale"].dtype
+    x = jnp.take(params["wte"], input_ids, axis=0).astype(compute_dtype) + \
+        params["wpe"][:s].astype(compute_dtype)
+
+    block_fn = partial(_block, config=config, train=train)
+    if config.remat:
+        block_fn = jax.checkpoint(block_fn,
+                                  policy=jax.checkpoint_policies.nothing_saveable)
+
+    rngs = (jax.random.split(rng, config.n_layers)
+            if rng is not None else [None] * config.n_layers)
+    for i, bp in enumerate(params["blocks"]):
+        x = block_fn(x, bp, rng=rngs[i])
+    x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    return x
+
+
+def lm_loss(params, input_ids, labels, config, rng=None, train=True):
+    """Causal LM cross-entropy (mean over tokens). ``labels`` may equal
+    ``input_ids`` (shift happens internally); -100 positions are masked."""
+    hidden = forward_hidden(params, input_ids, config, rng=rng, train=train)
+    logits = hidden @ params["wte"].astype(hidden.dtype).T  # tied embedding
+
+    shift_logits = logits[:, :-1].astype(jnp.float32)
+    shift_labels = labels[:, 1:]
+    mask = (shift_labels != -100).astype(jnp.float32)
+    safe_labels = jnp.where(shift_labels == -100, 0, shift_labels)
+    logp = jax.nn.log_softmax(shift_logits, axis=-1)
+    token_ll = jnp.take_along_axis(logp, safe_labels[..., None],
+                                   axis=-1)[..., 0]
+    return -(token_ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def make_gpt2_model(config=None, size="gpt2_small", seed=0, **overrides):
+    """Build a :class:`deepspeed_tpu.runtime.model.Model` for the engine."""
+    from ..runtime.model import Model
+    if config is None:
+        config = config_for(size, **overrides)
+    params = init_params(config, seed=seed)
+
+    def apply_fn(params, input_ids, labels, rng=None, train=True):
+        return lm_loss(params, input_ids, labels, config, rng=rng, train=train)
+
+    model = Model(apply_fn, params, partition_spec_fn=partition_spec_fn,
+                  name="gpt2")
+    model.config = config
+    return model
+
+
+def num_params(config):
+    d, v, s, L = (config.d_model, config.vocab_size, config.max_seq_len,
+                  config.n_layers)
+    per_block = 12 * d * d + 13 * d
+    return v * d + s * d + L * per_block + 2 * d

@@ -1,0 +1,126 @@
+"""GPT-2 as a PipelineModule — the Megatron-GPT2 3D-parallel workload
+(BASELINE config 5: PP x TP x ZeRO-DP).
+
+Reference parity: DeepSpeedExamples Megatron GPT2PipelineModel + reference
+pipe/module.py usage. The embedding is a TiedLayerSpec shared with the
+output head (tied-weight gradients sum automatically through autodiff,
+replacing the reference's tied-comm groups, pipe/module.py:405-474).
+"""
+import jax
+import jax.numpy as jnp
+
+from ..runtime.pipe import PipelineModule, LayerSpec, TiedLayerSpec
+from .gpt2 import GPT2Config, _block, config_for
+
+
+class EmbeddingLayer:
+    """wte + wpe lookup; pre-pipeline (hoisted, tied key 'embed')."""
+
+    def __init__(self, config):
+        self.config = config
+
+    @staticmethod
+    def partition_spec_fn(path, shape):
+        # Tied embeddings stay replicated over model for now: a
+        # vocab-parallel tied table (grad = scatter-add + psum over pipe)
+        # trips an XLA-CPU bf16 miscompile in the pipeline loop transpose;
+        # the body QKV/MLP tensors carry the TP win. Revisit on real TPU.
+        return None
+
+    def init(self, rng):
+        cfg = self.config
+        k1, k2 = jax.random.split(rng)
+        return {
+            "wte": jax.random.normal(k1, (cfg.vocab_size, cfg.d_model),
+                                     dtype=cfg.dtype) * 0.02,
+            "wpe": jax.random.normal(k2, (cfg.max_seq_len, cfg.d_model),
+                                     dtype=cfg.dtype) * 0.01,
+        }
+
+    def apply(self, params, input_ids):
+        s = input_ids.shape[-1]
+        compute_dtype = params["wpe"].dtype
+        return (jnp.take(params["wte"], input_ids, axis=0) +
+                params["wpe"][:s]).astype(compute_dtype)
+
+
+class GPT2BlockLayer:
+    """One transformer block; the homogeneous pipelined body."""
+
+    def __init__(self, config):
+        self.config = config
+
+    @staticmethod
+    def partition_spec_fn(path, shape):
+        """Megatron TP layout for one block (same rules as gpt2.py, applied
+        to the per-layer param tree rooted at the block)."""
+        from .gpt2 import partition_spec_fn as gpt2_spec
+        return gpt2_spec("blocks/0/" + path, shape)
+
+    def init(self, rng):
+        from .gpt2 import init_params
+        one = GPT2Config(vocab_size=8, max_seq_len=8,
+                         n_layers=1, n_heads=self.config.n_heads,
+                         d_model=self.config.d_model, dtype=self.config.dtype,
+                         use_flash_attention=self.config.use_flash_attention)
+        return init_params(one, seed=int(jax.random.randint(
+            rng, (), 0, 2 ** 31 - 1)))["blocks"][0]
+
+    def apply(self, params, x, rng=None):
+        return _block(x, params, self.config, rng=rng, train=True)
+
+
+class FinalNorm:
+    """Final layernorm; post-pipeline."""
+
+    def __init__(self, config):
+        self.config = config
+
+    def init(self, rng):
+        d = self.config.d_model
+        return {"scale": jnp.ones((d,), self.config.dtype),
+                "bias": jnp.zeros((d,), self.config.dtype)}
+
+    def apply(self, params, x):
+        from ..ops.transformer.fused_ops import fused_layer_norm
+        return fused_layer_norm(x, params["scale"], params["bias"])
+
+
+def _head_forward(tied_params, hidden):
+    """Tied output head: logits = h @ wte^T."""
+    return hidden @ tied_params["wte"].astype(hidden.dtype).T
+
+
+def lm_loss_fn(logits, labels):
+    shift_logits = logits[:, :-1].astype(jnp.float32)
+    shift_labels = labels[:, 1:]
+    mask = (shift_labels != -100).astype(jnp.float32)
+    safe = jnp.where(shift_labels == -100, 0, shift_labels)
+    logp = jax.nn.log_softmax(shift_logits, axis=-1)
+    ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def make_gpt2_pipeline(config=None, size="gpt2_small", num_stages=2,
+                       num_dp=None, num_mp=None, topology=None,
+                       activation_checkpoint_interval=1, **overrides):
+    if config is None:
+        config = config_for(size, **overrides)
+    assert config.n_layers % num_stages == 0, \
+        "num_stages ({}) must evenly divide n_layers ({})".format(
+            num_stages, config.n_layers)
+
+    layers = [TiedLayerSpec("embed", EmbeddingLayer, config,
+                            forward_fn=None)]
+    layers += [LayerSpec(GPT2BlockLayer, config)
+               for _ in range(config.n_layers)]
+    layers += [LayerSpec(FinalNorm, config),
+               TiedLayerSpec("embed", EmbeddingLayer, config,
+                             forward_fn=_head_forward)]
+
+    net = PipelineModule(
+        layers=layers, num_stages=num_stages, topology=topology,
+        loss_fn=lm_loss_fn, num_dp=num_dp, num_mp=num_mp,
+        activation_checkpoint_interval=activation_checkpoint_interval)
+    net.config = config
+    return net

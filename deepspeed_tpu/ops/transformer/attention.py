@@ -1,0 +1,35 @@
+"""Attention dispatch: Pallas flash kernel on TPU, jnp reference elsewhere."""
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def reference_causal_attention(q, k, v, sm_scale=None):
+    """Plain XLA attention, (b, s, h, d) layout; numerically the spec for the
+    flash kernel (mirrors reference tests test_cuda_forward's python BERT)."""
+    b, s, h, d = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
+    qf = q.astype(jnp.float32) * scale
+    scores = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
+    mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+    scores = jnp.where(mask[None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return ctx.astype(q.dtype)
+
+
+def causal_attention(q, k, v, use_flash=True, sm_scale=None, interpret=None):
+    """(b, s, h, d) in, (b, s, h, d) out."""
+    if interpret is None:
+        interpret = False
+    backend_ok = jax.default_backend() == "tpu" or interpret
+    if use_flash and backend_ok:
+        from .flash_attention import flash_attention
+        b, s, h, d = q.shape
+        fold = lambda t: t.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+        unfold = lambda t: t.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+        out = flash_attention(fold(q), fold(k), fold(v), sm_scale, True,
+                              512, interpret)
+        return unfold(out)
+    return reference_causal_attention(q, k, v, sm_scale)

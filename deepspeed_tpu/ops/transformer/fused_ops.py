@@ -1,0 +1,42 @@
+"""Fused elementwise transformer ops.
+
+Reference parity: csrc/transformer/normalize_kernels.cu (layernorm fwd/bwd),
+gelu_kernels.cu (fused bias-gelu), dropout_kernels.cu (fused
+bias-dropout-residual). On TPU these are written as jnp compositions that XLA
+fuses into the surrounding matmuls — the hand-rolled CUDA kernels exist to
+get exactly this fusion, which the XLA compiler performs natively (the ops
+below compile to single fused loops; no HBM round-trips between bias, act,
+dropout, residual).
+"""
+import jax
+import jax.numpy as jnp
+
+
+def fused_layer_norm(x, scale, bias, eps=1e-5):
+    """LayerNorm over the last dim; stats in fp32 for bf16/fp16 inputs
+    (reference normalize_kernels.cu fwd)."""
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32) +
+            bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def fused_bias_gelu(x, bias):
+    """x + bias then tanh-approx GeLU (reference gelu_kernels.cu, which uses
+    the same tanh approximation)."""
+    y = (x + bias.astype(x.dtype)).astype(jnp.float32)
+    out = 0.5 * y * (1.0 + jnp.tanh(0.7978845608028654 *
+                                    (y + 0.044715 * y * y * y)))
+    return out.astype(x.dtype)
+
+
+def fused_bias_dropout_residual(x, bias, residual, rate, rng, train=True):
+    """(x + bias) -> dropout -> + residual, one fused loop
+    (reference dropout_kernels.cu bias-dropout-residual)."""
+    y = x + bias.astype(x.dtype)
+    if train and rate > 0.0 and rng is not None:
+        keep = jax.random.bernoulli(rng, 1.0 - rate, y.shape)
+        y = jnp.where(keep, y / (1.0 - rate), jnp.zeros_like(y))
+    return y + residual

@@ -1,0 +1,419 @@
+"""PipelineModule: model-as-layer-list for pipeline parallelism.
+
+Reference parity: deepspeed/runtime/pipe/module.py (LayerSpec :23,
+TiedLayerSpec :71, PipelineModule :85, partitioning :348-403). TPU-first
+redesign of the execution model:
+
+  * Layers are functional: an object with ``init(rng) -> params`` and
+    ``apply(params, x) -> x`` (class LayerSpec defers construction exactly
+    like the reference, so layer lists describe models larger than host
+    memory — only shapes are materialized before sharding).
+  * The *pipelined body* must be stage-stackable: after partitioning, every
+    stage holds the same number of structurally-identical layers, so stage
+    parameters stack into arrays with a leading ``pipe`` dimension sharded
+    over the pipe mesh axis. This is what lets ONE jitted program express
+    all stages (SPMD), with ``ppermute`` moving activations between
+    neighbors — the reference's per-process layer build (:197-249) and
+    broadcast-pair p2p (p2p.py) collapse into dataflow.
+  * Non-stackable head/tail layers (embedding, final norm/head) are
+    "hoisted": computed outside the pipe loop, replicated across the pipe
+    axis (sharded over data/model as usual). Tied layers (TiedLayerSpec,
+    e.g. tied embedding+head) are naturally hoisted — parameter tying is
+    just reusing the same array, and the tied-grad reduction
+    (reference :405-474) falls out of autodiff.
+"""
+import re
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...parallel.topology import (PipeDataParallelTopology,
+                                  PipeModelDataParallelTopology, MeshGrid,
+                                  PIPE_AXIS)
+from ...utils.logging import logger
+from ..utils import partition_balanced, partition_uniform
+
+
+class LayerSpec:
+    """Defers layer construction (reference :23-68). ``typename`` is a class
+    or factory; building yields the layer object (with init/apply)."""
+
+    def __init__(self, typename, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+        if not callable(typename):
+            raise RuntimeError("LayerSpec requires a callable type/factory")
+
+    def build(self, log=False):
+        if log:
+            logger.info("building {}".format(repr(self)))
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+    def __repr__(self):
+        from ..utils import call_to_str
+        return call_to_str(getattr(self.typename, "__name__",
+                                   str(self.typename)),
+                           *self.module_args, **self.module_kwargs)
+
+
+class TiedLayerSpec(LayerSpec):
+    """A layer whose parameters are shared with every other TiedLayerSpec of
+    the same ``key`` (reference :71-82)."""
+
+    def __init__(self, key, typename, *module_args, forward_fn=None,
+                 tied_weight_attr="wte", **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+        self.tied_weight_attr = tied_weight_attr
+
+
+class Layer:
+    """Adapter making a (init_fn, apply_fn) pair a pipeline layer."""
+
+    def __init__(self, init_fn, apply_fn, name="layer"):
+        self._init = init_fn
+        self._apply = apply_fn
+        self.name = name
+
+    def init(self, rng):
+        return self._init(rng)
+
+    def apply(self, params, x, **kwargs):
+        return self._apply(params, x, **kwargs)
+
+
+class PipelineModule:
+    """Partition a layer list across pipeline stages (reference :85).
+
+    Args follow the reference: ``layers`` (list of LayerSpec/layer objects),
+    ``num_stages`` or ``topology``, ``loss_fn``, ``partition_method``
+    ('uniform' | 'parameters' | 'type:regex'),
+    ``activation_checkpoint_interval``, ``seed_layers``.
+    """
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seed_layers=False, base_seed=1234, partition_method="parameters",
+                 activation_checkpoint_interval=0, num_dp=None, num_mp=None):
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self.seed_layers = seed_layers
+        self.base_seed = base_seed
+
+        if topology is None:
+            assert num_stages is not None, \
+                "must provide num_stages or topology"
+            n_dev = jax.device_count()
+            if num_dp is None and num_mp is None:
+                assert n_dev % num_stages == 0
+                num_dp, num_mp = n_dev // num_stages, 1
+            num_dp = num_dp or 1
+            num_mp = num_mp or 1
+            if num_mp > 1:
+                topology = PipeModelDataParallelTopology(
+                    num_pp=num_stages, num_mp=num_mp, num_dp=num_dp)
+            else:
+                topology = PipeDataParallelTopology(num_pp=num_stages,
+                                                    num_dp=num_dp)
+        self._topo = topology
+        self.num_stages = topology.get_dim(PIPE_AXIS)
+        self._grid = MeshGrid(topology=topology)
+
+        # Build every layer spec (deferred construction keeps this cheap).
+        self._layer_specs = list(layers)
+        self._build_layers()
+        self._partition_layers()
+        self._init_params()
+
+    def mpu(self):
+        return self._grid
+
+    @property
+    def topology(self):
+        return self._topo
+
+    # ------------------------------------------------------------------ build
+    def _build_layers(self):
+        self.layers = []
+        self.tied_keys = {}
+        for i, spec in enumerate(self._layer_specs):
+            if isinstance(spec, TiedLayerSpec):
+                if spec.key not in self.tied_keys:
+                    self.tied_keys[spec.key] = spec.build()
+                self.layers.append(("tied", spec.key, spec))
+            elif isinstance(spec, LayerSpec):
+                self.layers.append(("layer", None, spec.build()))
+            elif hasattr(spec, "init") and hasattr(spec, "apply"):
+                self.layers.append(("layer", None, spec))
+            elif callable(spec):
+                # stateless function layer
+                self.layers.append(("fn", None, spec))
+            else:
+                raise TypeError("Unsupported layer spec: {}".format(spec))
+
+    def _layer_weight(self, entry):
+        """Estimated parameter count, used by partition_method='parameters'
+        (reference partition by trainable parameters :378-403). Uses
+        eval_shape — no parameter memory is materialized."""
+        kind, _, layer = entry
+        if kind != "layer":
+            return 0
+        try:
+            shapes = jax.eval_shape(layer.init, jax.random.PRNGKey(0))
+            return sum(int(np.prod(p.shape))
+                       for p in jax.tree_util.tree_leaves(shapes))
+        except Exception:
+            return 1
+
+    def _partition_layers(self):
+        """Decide the pipelined body vs hoisted head/tail.
+
+        The body is the maximal run of structurally identical 'layer'
+        entries; with 'type:regex' the body is the layers whose class name
+        matches. Body length must divide evenly into stages.
+        """
+        method = self.partition_method.lower()
+        entries = self.layers
+        n = len(entries)
+
+        if method.startswith("type:"):
+            pattern = method[len("type:"):]
+            body_mask = [
+                kind == "layer" and
+                re.search(pattern, type(layer).__name__, re.IGNORECASE)
+                is not None
+                for kind, _, layer in entries]
+        else:
+            # body = longest run of same-class plain layers
+            body_mask = [False] * n
+            best_start, best_len = 0, 0
+            i = 0
+            while i < n:
+                kind, _, layer = entries[i]
+                if kind != "layer":
+                    i += 1
+                    continue
+                j = i
+                while (j < n and entries[j][0] == "layer" and
+                       type(entries[j][2]) is type(layer)):
+                    j += 1
+                if j - i > best_len:
+                    best_start, best_len = i, j - i
+                i = j
+            for i in range(best_start, best_start + best_len):
+                body_mask[i] = True
+
+        body_idx = [i for i, m in enumerate(body_mask) if m]
+        assert body_idx, "no pipelineable body found in layer list"
+        assert body_idx == list(range(body_idx[0], body_idx[-1] + 1)), \
+            "pipelined body must be contiguous"
+        n_body = len(body_idx)
+        assert n_body % self.num_stages == 0, \
+            "pipelined body of {} layers must divide num_stages={} (pad with " \
+            "identity layers or change partitioning)".format(n_body,
+                                                             self.num_stages)
+        self.body_start = body_idx[0]
+        self.body_end = body_idx[-1] + 1
+        self.layers_per_stage = n_body // self.num_stages
+        self.pre_layers = entries[:self.body_start]
+        self.body_layers = entries[self.body_start:self.body_end]
+        self.post_layers = entries[self.body_end:]
+
+        # parts[i] = first body-layer of stage i (reference partition
+        # bookkeeping; contiguous equal split since the body is homogeneous —
+        # partition_balanced reduces to uniform for equal weights)
+        if self.partition_method == "parameters":
+            weights = [self._layer_weight(e) for e in self.body_layers]
+            self.parts = partition_balanced(weights, self.num_stages)
+        else:
+            self.parts = partition_uniform(len(self.body_layers),
+                                           self.num_stages)
+
+    def _init_params(self):
+        """Init: tied + pre/post params as plain trees; body params stacked
+        with a leading (num_stages, layers_per_stage) prefix."""
+        key = jax.random.PRNGKey(self.base_seed)
+
+        self.tied_params = {}
+        for tkey, layer in self.tied_keys.items():
+            key, sub = jax.random.split(key)
+            self.tied_params[tkey] = layer.init(sub)
+
+        def init_entry(entry, sub):
+            kind, tkey, layer = entry
+            if kind == "tied":
+                return None  # shared, lives in tied_params
+            if kind == "fn":
+                return None
+            return layer.init(sub)
+
+        self.pre_params = []
+        for e in self.pre_layers:
+            key, sub = jax.random.split(key)
+            self.pre_params.append(init_entry(e, sub))
+        self.post_params = []
+        for e in self.post_layers:
+            key, sub = jax.random.split(key)
+            self.post_params.append(init_entry(e, sub))
+
+        body_param_list = []
+        for i, e in enumerate(self.body_layers):
+            if self.seed_layers:
+                sub = jax.random.PRNGKey(self.base_seed + i)
+            else:
+                key, sub = jax.random.split(key)
+            body_param_list.append(init_entry(e, sub))
+        # stack: (num_stages, layers_per_stage, *param_shape)
+        stacked = jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack(leaves).reshape(
+                (self.num_stages, self.layers_per_stage) + leaves[0].shape),
+            *body_param_list)
+        self.body_params = stacked
+
+        self.params = {
+            "tied": self.tied_params,
+            "pre": self.pre_params,
+            "post": self.post_params,
+            "body": self.body_params,
+        }
+
+    # ----------------------------------------------------------------- apply
+    def apply_pre(self, params, x, **kwargs):
+        """Run hoisted head layers (e.g. embedding)."""
+        for entry, p in zip(self.pre_layers, params["pre"]):
+            x = self._apply_entry(entry, p, params, x, **kwargs)
+        return x
+
+    def apply_post(self, params, x, **kwargs):
+        for entry, p in zip(self.post_layers, params["post"]):
+            x = self._apply_entry(entry, p, params, x, **kwargs)
+        return x
+
+    def _apply_entry(self, entry, p, params, x, **kwargs):
+        kind, tkey, layer = entry
+        if kind == "tied":
+            spec = layer  # the TiedLayerSpec
+            tied_layer = self.tied_keys[tkey]
+            if spec.forward_fn is not None:
+                return spec.forward_fn(params["tied"][tkey], x)
+            return tied_layer.apply(params["tied"][tkey], x)
+        if kind == "fn":
+            return layer(x)
+        return layer.apply(p, x)
+
+    def _body_accepts_rng(self):
+        import inspect
+        proto_layer = self.body_layers[0][2]
+        try:
+            return "rng" in inspect.signature(proto_layer.apply).parameters
+        except (TypeError, ValueError):
+            return False
+
+    def apply_body_stage(self, stage_params, x, rng=None):
+        """Apply one stage's layers_per_stage body layers; ``stage_params``
+        has leading dim layers_per_stage. lax.scan keeps every stage the same
+        program regardless of depth; ``activation_checkpoint_interval`` N
+        remats every N layers (reference forward :292-346)."""
+        proto_layer = self.body_layers[0][2]
+        L = self.layers_per_stage
+        interval = self.activation_checkpoint_interval
+        thread_rng = rng is not None and self._body_accepts_rng()
+
+        def one(carry, layer_params):
+            x, i = carry
+            kwargs = {}
+            if thread_rng:
+                kwargs["rng"] = jax.random.fold_in(rng, i)
+            return (proto_layer.apply(layer_params, x, **kwargs), i + 1), None
+
+        if interval and interval > 0 and L % max(interval, 1) == 0 and \
+                interval < L:
+            # group layers into chunks of `interval`; remat each chunk
+            grouped = jax.tree_util.tree_map(
+                lambda t: t.reshape((L // interval, interval) + t.shape[1:]),
+                stage_params)
+
+            def chunk(carry, chunk_params):
+                x, i = carry
+                def inner(x):
+                    (y, j), _ = jax.lax.scan(one, (x, i), chunk_params)
+                    return y
+                y = jax.checkpoint(inner)(x)
+                return (y, i + interval), None
+
+            (x, _), _ = jax.lax.scan(chunk, (x, jnp.asarray(0)), grouped)
+            return x
+
+        if interval:
+            def one_remat(carry, layer_params):
+                x, i = carry
+                kwargs = {}
+                if thread_rng:
+                    kwargs["rng"] = jax.random.fold_in(rng, i)
+                apply = jax.checkpoint(
+                    lambda p, x: proto_layer.apply(p, x, **kwargs))
+                return (apply(layer_params, x), i + 1), None
+            (x, _), _ = jax.lax.scan(one_remat, (x, jnp.asarray(0)),
+                                     stage_params)
+            return x
+
+        (x, _), _ = jax.lax.scan(one, (x, jnp.asarray(0)), stage_params)
+        return x
+
+    def apply_sequential(self, params, x, **kwargs):
+        """Reference semantics of forward(): run everything in order
+        (used for correctness tests and single-stage fallback)."""
+        x = self.apply_pre(params, x, **kwargs)
+        for s in range(self.num_stages):
+            x = self.apply_body_stage(
+                jax.tree_util.tree_map(lambda t: t[s], params["body"]), x)
+        x = self.apply_post(params, x, **kwargs)
+        return x
+
+    def partition_spec_fn(self, path, shape):
+        """Tensor-parallel PartitionSpec for a param at ``path`` in the
+        module's params tree. Delegates to the owning layer's
+        ``partition_spec_fn(inner_path, inner_shape)`` when it defines one;
+        body paths get the ``pipe`` axis prepended on the (stage, layer)
+        stack dims."""
+        from jax.sharding import PartitionSpec as P
+        from ...parallel.topology import PIPE_AXIS
+
+        parts = path.split("/", 1)
+        head, rest = parts[0], (parts[1] if len(parts) > 1 else "")
+        if head == "body":
+            proto = self.body_layers[0][2]
+            inner = getattr(proto, "partition_spec_fn", None)
+            inner_spec = inner(rest, shape[2:]) if inner else None
+            if inner_spec is None:
+                inner_spec = [None] * (len(shape) - 2)
+            return P(PIPE_AXIS, None, *inner_spec)
+        if head == "tied":
+            key, _, rest2 = rest.partition("/")
+            layer = self.tied_keys.get(key)
+            inner = getattr(layer, "partition_spec_fn", None)
+            return inner(rest2, shape) if inner else None
+        if head in ("pre", "post"):
+            idx, _, rest2 = rest.partition("/")
+            try:
+                entries = self.pre_layers if head == "pre" else self.post_layers
+                layer = entries[int(idx)][2]
+            except (ValueError, IndexError):
+                return None
+            inner = getattr(layer, "partition_spec_fn", None)
+            return inner(rest2, shape) if inner else None
+        return None
+
+    def describe(self):
+        return {
+            "num_stages": self.num_stages,
+            "layers_per_stage": self.layers_per_stage,
+            "pre": len(self.pre_layers),
+            "post": len(self.post_layers),
+            "parts": self.parts,
+            "tied": list(self.tied_keys),
+        }

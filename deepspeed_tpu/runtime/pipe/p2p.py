@@ -1,0 +1,28 @@
+"""Stage-to-stage activation transfer.
+
+Reference parity: deepspeed/runtime/pipe/p2p.py — there, send/recv between
+adjacent stages is a dist.broadcast inside cached 2-rank groups (an NCCL-era
+workaround). On TPU the transfer is a ``lax.ppermute`` over the ``pipe``
+mesh axis *inside* the jitted program, riding ICI; these helpers build the
+permutation lists.
+"""
+import jax
+
+
+def forward_perm(num_stages):
+    """stage i -> stage i+1 (activations flowing down the pipe)."""
+    return [(i, i + 1) for i in range(num_stages - 1)]
+
+
+def backward_perm(num_stages):
+    """stage i -> stage i-1 (gradients flowing back)."""
+    return [(i + 1, i) for i in range(num_stages - 1)]
+
+
+def send_forward(x, num_stages, axis_name="pipe"):
+    """ppermute x one stage forward; the first stage receives zeros."""
+    return jax.lax.ppermute(x, axis_name, forward_perm(num_stages))
+
+
+def send_backward(x, num_stages, axis_name="pipe"):
+    return jax.lax.ppermute(x, axis_name, backward_perm(num_stages))

@@ -1,0 +1,257 @@
+"""Declarative pipeline instruction schedules.
+
+Reference parity: deepspeed/runtime/pipe/schedule.py (PipeSchedule ABC :6,
+TrainSchedule :182, InferenceSchedule :129, instruction vocabulary
+:336-474). The schedule layer is backend-agnostic logic: a generator of
+per-step instruction lists per stage. On TPU the fused shard_map executor
+(pipe/engine.py) realizes the same fill/steady/drain dataflow inside one
+XLA program; these classes remain the spec (and drive tests + the
+future manual-backward executor).
+"""
+from ..utils import call_to_str
+
+
+class PipeInstruction:
+    """A single step directive for one pipeline stage."""
+
+    def __init__(self, **kwargs):
+        self.name = self.__class__.__name__
+        self.kwargs = kwargs
+        for key, val in kwargs.items():
+            setattr(self, key, val)
+
+    def __repr__(self):
+        return call_to_str(self.name, **self.kwargs)
+
+    def __eq__(self, other):
+        return (self.__class__ == other.__class__ and
+                self.kwargs == other.kwargs)
+
+
+class OptimizerStep(PipeInstruction):
+    """Apply the optimizer (all stages, end of batch)."""
+
+
+class ReduceGrads(PipeInstruction):
+    """Data-parallel gradient reduction."""
+
+
+class ReduceTiedGrads(PipeInstruction):
+    """Reduce gradients of tied modules across owning stages."""
+
+
+class BufferOpInstruction(PipeInstruction):
+    def __init__(self, buffer_id, **kwargs):
+        super().__init__(buffer_id=buffer_id, **kwargs)
+
+
+class LoadMicroBatch(BufferOpInstruction):
+    pass
+
+
+class ForwardPass(BufferOpInstruction):
+    pass
+
+
+class BackwardPass(BufferOpInstruction):
+    pass
+
+
+class SendActivation(BufferOpInstruction):
+    pass
+
+
+class RecvActivation(BufferOpInstruction):
+    pass
+
+
+class SendGrad(BufferOpInstruction):
+    pass
+
+
+class RecvGrad(BufferOpInstruction):
+    pass
+
+
+class PipeSchedule:
+    """Yields, per engine step, the list of instructions for this stage
+    (reference :6-126)."""
+
+    def __init__(self, micro_batches, stages, stage_id):
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = self.stage_id - 1
+        self.next_stage = self.stage_id + 1
+
+    def steps(self):
+        raise NotImplementedError
+
+    def num_pipe_buffers(self):
+        return self.micro_batches
+
+    @property
+    def stage(self):
+        return self.stage_id
+
+    @property
+    def num_stages(self):
+        return self.stages
+
+    @property
+    def num_micro_batches(self):
+        return self.micro_batches
+
+    @property
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self):
+        return self.stage_id == self.stages - 1
+
+    def _valid_micro_batch(self, micro_batch_id):
+        return 0 <= micro_batch_id < self.micro_batches
+
+    def _valid_stage(self, stage_id):
+        return 0 <= stage_id < self.stages
+
+    def __iter__(self):
+        self.it = iter(self.steps())
+        return self.it
+
+    def __next__(self):
+        return next(self.it)
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only fill-drain (reference :129): M + S - 1 steps, two
+    alternating buffers."""
+
+    def steps(self):
+        total_steps = self.micro_batches + self.stages - 1
+        for step_id in range(total_steps):
+            micro_batch_id = step_id - self.stage_id
+            cmds = []
+            buf = step_id % 2
+            if self._valid_micro_batch(micro_batch_id):
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(buf))
+                else:
+                    cmds.append(RecvActivation(buf))
+                cmds.append(ForwardPass(buf))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buf))
+            yield cmds
+
+    def num_pipe_buffers(self):
+        return 2
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B-interleaved fill-drain training schedule (reference :182).
+
+    2*(M + S - 1) half-steps; stages alternate forward/backward phases with
+    even/odd staggering so a stage's forward of microbatch m and backward of
+    microbatch m-(S-stage) interleave in steady state. Ends with
+    ReduceTiedGrads, ReduceGrads, OptimizerStep.
+    """
+
+    def steps(self):
+        prev_micro_batch_id = -1
+        total_steps = 2 * (self.micro_batches + self.stages - 1)
+        for step_id in range(total_steps):
+            micro_batch_id, is_forward = self._step_to_micro_batch(step_id)
+            cmds = []
+
+            # Alternate send/recv with the neighbor touched by this phase.
+            if self._valid_micro_batch(prev_micro_batch_id):
+                if is_forward:
+                    # previous phase was a backward: its grad goes upstream
+                    if not self.is_first_stage:
+                        cmds.append(SendGrad(
+                            self._buffer_idx(prev_micro_batch_id)))
+                else:
+                    if not self.is_last_stage:
+                        cmds.append(SendActivation(
+                            self._buffer_idx(prev_micro_batch_id)))
+            if self._valid_micro_batch(micro_batch_id):
+                if is_forward:
+                    if self.is_first_stage:
+                        cmds.append(LoadMicroBatch(
+                            self._buffer_idx(micro_batch_id)))
+                    else:
+                        cmds.append(RecvActivation(
+                            self._buffer_idx(micro_batch_id)))
+                    cmds.append(ForwardPass(self._buffer_idx(micro_batch_id)))
+                else:
+                    if not self.is_last_stage:
+                        cmds.append(RecvGrad(self._buffer_idx(micro_batch_id)))
+                    cmds.append(BackwardPass(self._buffer_idx(micro_batch_id)))
+
+            if step_id == total_steps - 1:
+                cmds.append(ReduceTiedGrads())
+                cmds.append(ReduceGrads())
+                cmds.append(OptimizerStep())
+
+            prev_micro_batch_id = micro_batch_id
+            yield cmds
+
+    def _step_to_micro_batch(self, step_id):
+        """Map a half-step to (micro_batch_id, is_forward) with the even/odd
+        stage staggering of the reference (:249-289)."""
+        def _is_even(x):
+            return x % 2 == 0
+
+        if _is_even(step_id) and _is_even(self.stage_id):
+            micro_batch_id = self._even_step_forward_id(step_id)
+            is_forward = True
+        elif not _is_even(step_id) and not _is_even(self.stage_id):
+            micro_batch_id = self._odd_step_forward_id(step_id)
+            is_forward = True
+        elif _is_even(step_id) and not _is_even(self.stage_id):
+            micro_batch_id = self._even_step_backward_id(step_id)
+            is_forward = False
+        else:
+            micro_batch_id = self._odd_step_backward_id(step_id)
+            is_forward = False
+        return micro_batch_id, is_forward
+
+    def _even_step_forward_id(self, step_id):
+        base = step_id // 2
+        return base - self.stage_id // 2
+
+    def _odd_step_forward_id(self, step_id):
+        base = (step_id - 1) // 2
+        return base - self.stage_id // 2
+
+    def _even_step_backward_id(self, step_id):
+        base = step_id // 2
+        return base - self.stages + (self.stage_id + 1) // 2
+
+    def _odd_step_backward_id(self, step_id):
+        base = ((step_id - 1) // 2) - self.stages + 1
+        return base + (self.stage_id + 1) // 2
+
+    def _buffer_idx(self, micro_batch_id):
+        assert self._valid_micro_batch(micro_batch_id)
+        return micro_batch_id % self.num_pipe_buffers()
+
+    def num_pipe_buffers(self):
+        """min(S - stage + 1, M) buffers (reference :243-247)."""
+        buffers = min(self.stages - self.stage_id + 1, self.micro_batches)
+        return max(2, buffers)
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Degenerate single-stage schedule (reference :476)."""
+
+    def steps(self):
+        for step_id in range(self.micro_batches):
+            cmds = [LoadMicroBatch(0), ForwardPass(0), BackwardPass(0)]
+            if step_id == self.micro_batches - 1:
+                cmds.extend([ReduceGrads(), OptimizerStep()])
+            yield cmds
+
+    def num_pipe_buffers(self):
+        return 1

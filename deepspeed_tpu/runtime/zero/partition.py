@@ -50,11 +50,23 @@ class ZeroShardingPlan:
         return self._named(P())
 
     def _tp_spec(self, path, shape):
-        if self.model_spec_fn is not None:
-            spec = self.model_spec_fn(path, shape)
-            if spec is not None:
-                return spec
-        return None
+        if self.model_spec_fn is None:
+            return None
+        spec = self.model_spec_fn(path, shape)
+        if spec is None:
+            return None
+        # Drop axes the mesh doesn't carry (e.g. TP layouts on a DP-only
+        # mesh): the param is simply replicated along those dims.
+        cleaned = []
+        for entry in spec:
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            if entry is None or all(ax in self.mesh.shape for ax in axes):
+                cleaned.append(entry)
+            else:
+                cleaned.append(None)
+        if all(c is None for c in cleaned):
+            return None
+        return P(*cleaned)
 
     def _zero_spec(self, path, shape, threshold):
         """Combine any TP spec with data-axis sharding of a free dimension."""

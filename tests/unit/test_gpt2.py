@@ -1,0 +1,107 @@
+"""GPT-2 model family: forward, training, TP sharding specs."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu as deepspeed
+from deepspeed_tpu.models import gpt2
+
+TINY = dict(vocab_size=256, max_seq_len=64, n_layers=2, n_heads=2,
+            d_model=64, use_flash_attention=False, remat=False)
+
+
+def tiny_model(seed=0, **over):
+    cfg = {**TINY, **over}
+    return gpt2.make_gpt2_model(config=gpt2.GPT2Config(**cfg), seed=seed)
+
+
+def make_batch(b, s, vocab, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, vocab, size=(b, s)).astype(np.int32)
+    return ids, ids.copy()
+
+
+def test_forward_loss_near_uniform():
+    model = tiny_model()
+    ids, labels = make_batch(4, 64, 256)
+    loss = model.apply_fn(model.params, ids, labels, train=False)
+    # random init -> loss ~ log(vocab)
+    assert abs(float(loss) - np.log(256)) < 1.0
+
+
+def test_gpt2_trains_with_engine():
+    model = tiny_model()
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 1000,
+    }
+    engine, _, _, _ = deepspeed.initialize(model=model, config_params=cfg)
+    # fixed batch -> loss must drop fast (memorization)
+    ids, labels = make_batch(16, 64, 256)
+    losses = []
+    for _ in range(10):
+        loss = engine(ids, labels)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.5, losses
+
+
+def test_partition_specs():
+    fn = gpt2.partition_spec_fn
+    assert fn("wte", (256, 64)) == P("model", None)
+    assert fn("blocks/0/attn/qkv_kernel", (64, 192)) == P(None, "model")
+    assert fn("blocks/0/attn/proj_kernel", (64, 64)) == P("model", None)
+    assert fn("blocks/0/mlp/fc_kernel", (64, 256)) == P(None, "model")
+    assert fn("blocks/0/mlp/proj_kernel", (256, 64)) == P("model", None)
+    assert fn("blocks/0/ln1/scale", (64,)) is None
+    assert fn("wpe", (64, 64)) is None
+
+
+def test_tp_mesh_matches_dp_only():
+    """2-way TP x 4-way DP must produce the same loss trajectory as 8-way DP."""
+    from deepspeed_tpu.parallel.topology import (PipeModelDataParallelTopology,
+                                                 MeshGrid)
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 1000,
+    }
+    ids, labels = make_batch(8, 64, 256)
+
+    e_dp, _, _, _ = deepspeed.initialize(model=tiny_model(seed=1),
+                                         config_params=dict(cfg))
+    topo = PipeModelDataParallelTopology(num_pp=1, num_mp=2, num_dp=4)
+    grid = MeshGrid(topology=topo, process_rank=0)
+    cfg_tp = dict(cfg)
+    cfg_tp["train_micro_batch_size_per_gpu"] = 4  # dp=4 now: 4*... batch 16?
+    e_tp, _, _, _ = deepspeed.initialize(model=tiny_model(seed=1),
+                                         config_params=cfg_tp, mpu=grid)
+    assert e_tp.dp_world_size == 4
+    assert e_tp.mp_world_size == 2
+
+    l_dp, l_tp = [], []
+    for _ in range(3):
+        loss = e_dp(ids, labels); e_dp.backward(loss); e_dp.step()
+        l_dp.append(float(loss))
+        loss = e_tp(ids, labels); e_tp.backward(loss); e_tp.step()
+        l_tp.append(float(loss))
+    np.testing.assert_allclose(l_dp, l_tp, rtol=2e-2, atol=2e-2)
+
+    # TP params actually sharded over the model axis
+    qkv = e_tp.state["params"]["blocks"][0]["attn"]["qkv_kernel"]
+    assert "model" in str(qkv.sharding.spec)
+
+
+def test_num_params_formula():
+    cfg = gpt2.config_for("gpt2_small")
+    n = gpt2.num_params(cfg)
+    assert 120e6 < n < 170e6  # 125M class (padded vocab)

@@ -1,0 +1,68 @@
+"""GPT-2 pipeline (3D-parallel smoke): PP x DP training on the CPU mesh."""
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu as deepspeed
+from deepspeed_tpu.models import gpt2, gpt2_pipe
+
+TINY = dict(vocab_size=128, max_seq_len=32, n_layers=4, n_heads=2,
+            d_model=32, use_flash_attention=False, remat=False)
+
+
+def make_net(num_stages=2, num_dp=4, num_mp=None):
+    cfg = gpt2.GPT2Config(**TINY)
+    return gpt2_pipe.make_gpt2_pipeline(config=cfg, num_stages=num_stages,
+                                        num_dp=num_dp, num_mp=num_mp,
+                                        activation_checkpoint_interval=0)
+
+
+def batches(M, b, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, 128, size=(M, b, 32)).astype(np.int32)
+    return ids, ids.copy()
+
+
+def cfg(gas):
+    return {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": gas,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+        "optimizer": {"type": "Adam", "params": {"lr": 3e-3}},
+        "steps_per_print": 1000,
+    }
+
+
+def test_gpt2_pipeline_trains():
+    net = make_net(num_stages=2, num_dp=4)
+    engine, _, _, _ = deepspeed.initialize(model=net, config_params=cfg(2))
+    x, y = batches(2, 8)
+    losses = [float(engine.train_batch(batch=(x, y))) for _ in range(8)]
+    assert losses[-1] < losses[0] - 0.3, losses
+    # tied embed params sharded/replicated sanely + body on pipe axis
+    body_w = engine.state["params"]["body"]["attn"]["qkv_kernel"]
+    assert "pipe" in str(body_w.sharding.spec)
+
+
+def test_gpt2_pipeline_3d():
+    """PP=2 x DP=2 x TP=2 mesh: full 3D parallel one-step smoke."""
+    net = make_net(num_stages=2, num_dp=2, num_mp=2)
+    engine, _, _, _ = deepspeed.initialize(model=net, config_params=cfg(2))
+    assert dict(engine.mesh.shape) == {"pipe": 2, "data": 2, "model": 2}
+    x, y = batches(2, 4)
+    l0 = float(engine.train_batch(batch=(x, y)))
+    l1 = float(engine.train_batch(batch=(x, y)))
+    assert np.isfinite(l0) and np.isfinite(l1)
+    assert l1 < l0
+
+
+def test_gpt2_pipeline_matches_sequential():
+    """Pipeline loss == sequential eval loss on the same params/batch."""
+    net = make_net(num_stages=2, num_dp=4)
+    engine, _, _, _ = deepspeed.initialize(model=net, config_params=cfg(2))
+    x, y = batches(2, 8, seed=3)
+    ev = float(engine.eval_batch(batch=(x, y)))
+    tr = float(engine.train_batch(batch=(x, y)))
+    assert tr == pytest.approx(ev, rel=5e-2, abs=5e-3)

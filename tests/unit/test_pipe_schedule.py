@@ -1,0 +1,97 @@
+"""Pipeline schedule logic (mirrors reference test_pipe_schedule.py)."""
+import pytest
+
+from deepspeed_tpu.runtime.pipe import schedule as sch
+
+
+def _cmds_of(sched):
+    return [step for step in sched.steps()]
+
+
+def test_inference_schedule_basics():
+    s = sch.InferenceSchedule(micro_batches=4, stages=2, stage_id=0)
+    steps = _cmds_of(s)
+    assert len(steps) == 4 + 2 - 1
+    # first stage loads, never recvs activations
+    for cmds in steps:
+        assert not any(isinstance(c, sch.RecvActivation) for c in cmds)
+    loads = [c for cmds in steps for c in cmds
+             if isinstance(c, sch.LoadMicroBatch)]
+    assert len(loads) == 4
+
+
+def test_inference_schedule_last_stage():
+    s = sch.InferenceSchedule(micro_batches=4, stages=2, stage_id=1)
+    steps = _cmds_of(s)
+    recvs = [c for cmds in steps for c in cmds
+             if isinstance(c, sch.RecvActivation)]
+    fwds = [c for cmds in steps for c in cmds
+            if isinstance(c, sch.ForwardPass)]
+    sends = [c for cmds in steps for c in cmds
+             if isinstance(c, sch.SendActivation)]
+    assert len(recvs) == 4 and len(fwds) == 4 and len(sends) == 0
+
+
+@pytest.mark.parametrize("micro_batches,stages", [(4, 2), (8, 4), (3, 3),
+                                                  (1, 2)])
+def test_train_schedule_counts(micro_batches, stages):
+    for stage_id in range(stages):
+        s = sch.TrainSchedule(micro_batches=micro_batches, stages=stages,
+                              stage_id=stage_id)
+        steps = _cmds_of(s)
+        assert len(steps) == 2 * (micro_batches + stages - 1)
+        fwds = [c for cmds in steps for c in cmds
+                if isinstance(c, sch.ForwardPass)]
+        bwds = [c for cmds in steps for c in cmds
+                if isinstance(c, sch.BackwardPass)]
+        assert len(fwds) == micro_batches
+        assert len(bwds) == micro_batches
+        # exactly one optimizer step at the very end
+        opts = [c for cmds in steps for c in cmds
+                if isinstance(c, sch.OptimizerStep)]
+        assert len(opts) == 1
+        assert any(isinstance(c, sch.OptimizerStep) for c in steps[-1])
+
+
+def test_train_schedule_fwd_before_bwd():
+    """Each microbatch's forward precedes its backward on every stage."""
+    for stage_id in range(4):
+        s = sch.TrainSchedule(micro_batches=8, stages=4, stage_id=stage_id)
+        seen_fwd = {}
+        for t, cmds in enumerate(s.steps()):
+            for c in cmds:
+                if isinstance(c, sch.ForwardPass):
+                    seen_fwd.setdefault(c.buffer_id, t)
+                if isinstance(c, sch.BackwardPass):
+                    assert c.buffer_id in seen_fwd
+
+
+def test_train_schedule_buffer_count():
+    s = sch.TrainSchedule(micro_batches=8, stages=4, stage_id=0)
+    assert s.num_pipe_buffers() == min(4 - 0 + 1, 8)
+    s = sch.TrainSchedule(micro_batches=1, stages=4, stage_id=0)
+    assert s.num_pipe_buffers() == 2
+
+
+def test_send_recv_pairing():
+    """Stage i's SendActivation count equals stage i+1's RecvActivation."""
+    M, S = 6, 3
+    sends = []
+    recvs = []
+    for sid in range(S):
+        s = sch.TrainSchedule(micro_batches=M, stages=S, stage_id=sid)
+        cmds = [c for step in s.steps() for c in step]
+        sends.append(len([c for c in cmds
+                          if isinstance(c, sch.SendActivation)]))
+        recvs.append(len([c for c in cmds
+                          if isinstance(c, sch.RecvActivation)]))
+    assert sends[:-1] == recvs[1:]
+    assert sends[-1] == 0 and recvs[0] == 0
+
+
+def test_instruction_repr_and_eq():
+    a = sch.ForwardPass(3)
+    b = sch.ForwardPass(3)
+    c = sch.ForwardPass(4)
+    assert a == b and a != c
+    assert "ForwardPass" in repr(a) and "3" in repr(a)

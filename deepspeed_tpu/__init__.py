@@ -10,6 +10,7 @@ from .version import __version__, __version_info__
 from .utils.distributed import init_distributed
 from .utils.logging import logger, log_dist
 from .runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+from .runtime.activation_checkpointing import checkpointing
 
 __git_hash__ = None
 __git_branch__ = None

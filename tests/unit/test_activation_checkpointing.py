@@ -1,0 +1,138 @@
+"""Activation checkpointing tests (reference
+tests/unit/test_activation_checkpointing.py): gradients through the
+checkpointed function must equal gradients through the plain function, with
+and without partition/cpu options; RNG tracker semantics."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as deepspeed
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing as ckpt
+
+
+def _reset_options():
+    ckpt.PARTITION_ACTIVATIONS = False
+    ckpt.CPU_CHECKPOINT = False
+    ckpt.CONTIGUOUS_CHECKPOINTING = False
+    ckpt.SYNCHRONIZE = False
+    ckpt.PROFILE_TIME = False
+
+
+@pytest.fixture(autouse=True)
+def reset_options():
+    _reset_options()
+    yield
+    _reset_options()
+
+
+def _mlp(w1, w2, x):
+    h = jnp.tanh(x @ w1)
+    return jnp.sum(jnp.tanh(h @ w2) ** 2)
+
+
+def _rand_weights(seed=0, d=16):
+    rng = np.random.RandomState(seed)
+    w1 = jnp.asarray(rng.randn(d, 4 * d), jnp.float32)
+    w2 = jnp.asarray(rng.randn(4 * d, d), jnp.float32)
+    x = jnp.asarray(rng.randn(8, d), jnp.float32)
+    return w1, w2, x
+
+
+def test_checkpoint_matches_plain_grads():
+    w1, w2, x = _rand_weights()
+
+    def loss_plain(w1, w2):
+        return _mlp(w1, w2, x)
+
+    def loss_ckpt(w1, w2):
+        return ckpt.checkpoint(_mlp, w1, w2, x)
+
+    g_plain = jax.grad(loss_plain, argnums=(0, 1))(w1, w2)
+    g_ckpt = jax.grad(loss_ckpt, argnums=(0, 1))(w1, w2)
+    for a, b in zip(g_plain, g_ckpt):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_checkpoint_inside_jit():
+    w1, w2, x = _rand_weights(1)
+
+    @jax.jit
+    def loss(w1, w2):
+        return ckpt.checkpoint(_mlp, w1, w2, x)
+
+    g = jax.grad(loss)(w1, w2)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_partition_activations_grads_match():
+    ckpt.configure(partition_activations=True)
+    w1, w2, x = _rand_weights(2)
+
+    def loss_ckpt(w1, w2):
+        return ckpt.checkpoint(_mlp, w1, w2, x)
+
+    g_ckpt = jax.grad(loss_ckpt, argnums=(0, 1))(w1, w2)
+    g_plain = jax.grad(lambda a, b: _mlp(a, b, x), argnums=(0, 1))(w1, w2)
+    for a, b in zip(g_plain, g_ckpt):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_configure_from_ds_config(tmp_config_file):
+    path = tmp_config_file({
+        "train_batch_size": 8,
+        "activation_checkpointing": {
+            "partition_activations": True,
+            "cpu_checkpointing": False,
+            "number_checkpoints": 4,
+            "profile": False,
+        },
+    })
+    ckpt.configure(deepspeed_config=path)
+    assert ckpt.is_configured()
+    assert ckpt.PARTITION_ACTIVATIONS is True
+    assert ckpt.num_layers == 4
+
+
+def test_contiguous_requires_partition():
+    with pytest.raises(ValueError):
+        ckpt.configure(partition_activations=False,
+                       contiguous_checkpointing=True, num_checkpoints=2)
+
+
+def test_checkpoint_wrapper_decorator():
+    w1, w2, x = _rand_weights(3)
+    wrapped = ckpt.checkpoint_wrapper(_mlp)
+    np.testing.assert_allclose(np.asarray(wrapped(w1, w2, x)),
+                               np.asarray(_mlp(w1, w2, x)), rtol=1e-6)
+
+
+def test_rng_tracker_fork_advances():
+    ckpt.model_parallel_cuda_manual_seed(123, tp_rank=0)
+    tracker = ckpt.get_cuda_rng_tracker()
+    with tracker.fork() as k1:
+        a = jax.random.normal(k1, (4,))
+    with tracker.fork() as k2:
+        b = jax.random.normal(k2, (4,))
+    assert not np.allclose(np.asarray(a), np.asarray(b))
+
+
+def test_rng_tracker_tp_ranks_differ():
+    ckpt.model_parallel_cuda_manual_seed(7, tp_rank=0)
+    s0 = ckpt.get_cuda_rng_tracker().get_states()["model-parallel-rng"]
+    ckpt.model_parallel_cuda_manual_seed(7, tp_rank=1)
+    s1 = ckpt.get_cuda_rng_tracker().get_states()["model-parallel-rng"]
+    assert not np.array_equal(np.asarray(s0), np.asarray(s1))
+
+
+def test_rng_tracker_duplicate_seed_raises():
+    tracker = ckpt.RNGStatesTracker()
+    tracker.add("a", 1)
+    with pytest.raises(Exception):
+        tracker.add("b", 1)
+    with pytest.raises(Exception):
+        tracker.add("a", 2)
+
+
+def test_public_api_reachable():
+    assert deepspeed.checkpointing.checkpoint is ckpt.checkpoint

@@ -58,36 +58,47 @@ def config_for(name, **overrides):
     return GPT2Config(**base)
 
 
+def init_block_params(config, rng):
+    """One transformer block, Megatron init: normal(0, 0.02) with the
+    residual output projections scaled by 1/sqrt(2*n_layers) — n_layers is
+    the FULL model depth (also used by the pipeline's per-layer init)."""
+    std = 0.02
+    proj_std = std / math.sqrt(2.0 * config.n_layers)
+    d = config.d_model
+    norm = lambda *shape, sd=std: jnp.asarray(
+        rng.randn(*shape) * sd, dtype=config.dtype)
+    zeros = lambda *shape: jnp.zeros(shape, dtype=config.dtype)
+    ones = lambda *shape: jnp.ones(shape, dtype=config.dtype)
+    return {
+        "ln1": {"scale": ones(d), "bias": zeros(d)},
+        "attn": {
+            "qkv_kernel": norm(d, 3 * d),
+            "qkv_bias": zeros(3 * d),
+            "proj_kernel": norm(d, d, sd=proj_std),
+            "proj_bias": zeros(d),
+        },
+        "ln2": {"scale": ones(d), "bias": zeros(d)},
+        "mlp": {
+            "fc_kernel": norm(d, 4 * d),
+            "fc_bias": zeros(4 * d),
+            "proj_kernel": norm(4 * d, d, sd=proj_std),
+            "proj_bias": zeros(d),
+        },
+    }
+
+
 def init_params(config, seed=0):
     """Megatron-style init: normal(0, 0.02), output projections scaled by
     1/sqrt(2*n_layers)."""
     rng = np.random.RandomState(seed)
     std = 0.02
-    proj_std = std / math.sqrt(2.0 * config.n_layers)
     d, v, s = config.d_model, config.vocab_size, config.max_seq_len
     norm = lambda *shape, sd=std: jnp.asarray(
         rng.randn(*shape) * sd, dtype=config.dtype)
     zeros = lambda *shape: jnp.zeros(shape, dtype=config.dtype)
     ones = lambda *shape: jnp.ones(shape, dtype=config.dtype)
 
-    blocks = []
-    for _ in range(config.n_layers):
-        blocks.append({
-            "ln1": {"scale": ones(d), "bias": zeros(d)},
-            "attn": {
-                "qkv_kernel": norm(d, 3 * d),
-                "qkv_bias": zeros(3 * d),
-                "proj_kernel": norm(d, d, sd=proj_std),
-                "proj_bias": zeros(d),
-            },
-            "ln2": {"scale": ones(d), "bias": zeros(d)},
-            "mlp": {
-                "fc_kernel": norm(d, 4 * d),
-                "fc_bias": zeros(4 * d),
-                "proj_kernel": norm(4 * d, d, sd=proj_std),
-                "proj_bias": zeros(d),
-            },
-        })
+    blocks = [init_block_params(config, rng) for _ in range(config.n_layers)]
     return {
         "wte": norm(v, d),
         "wpe": norm(s, d, sd=std / 2),
@@ -179,12 +190,10 @@ def forward_hidden(params, input_ids, config, rng=None, train=False):
     return x
 
 
-def lm_loss(params, input_ids, labels, config, rng=None, train=True):
-    """Causal LM cross-entropy (mean over tokens). ``labels`` may equal
-    ``input_ids`` (shift happens internally); -100 positions are masked."""
-    hidden = forward_hidden(params, input_ids, config, rng=rng, train=train)
-    logits = hidden @ params["wte"].astype(hidden.dtype).T  # tied embedding
-
+def causal_lm_cross_entropy(logits, labels):
+    """Shifted masked CE shared by the dense and pipeline GPT-2 paths.
+    ``labels`` may equal ``input_ids`` (shift happens internally); -100
+    positions are masked."""
     shift_logits = logits[:, :-1].astype(jnp.float32)
     shift_labels = labels[:, 1:]
     mask = (shift_labels != -100).astype(jnp.float32)
@@ -193,6 +202,13 @@ def lm_loss(params, input_ids, labels, config, rng=None, train=True):
     token_ll = jnp.take_along_axis(logp, safe_labels[..., None],
                                    axis=-1)[..., 0]
     return -(token_ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def lm_loss(params, input_ids, labels, config, rng=None, train=True):
+    """Causal LM cross-entropy (mean over tokens)."""
+    hidden = forward_hidden(params, input_ids, config, rng=rng, train=train)
+    logits = hidden @ params["wte"].astype(hidden.dtype).T  # tied embedding
+    return causal_lm_cross_entropy(logits, labels)
 
 
 def make_gpt2_model(config=None, size="gpt2_small", seed=0, **overrides):

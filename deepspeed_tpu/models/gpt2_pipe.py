@@ -58,13 +58,12 @@ class GPT2BlockLayer:
         return gpt2_spec("blocks/0/" + path, shape)
 
     def init(self, rng):
-        from .gpt2 import init_params
-        one = GPT2Config(vocab_size=8, max_seq_len=8,
-                         n_layers=1, n_heads=self.config.n_heads,
-                         d_model=self.config.d_model, dtype=self.config.dtype,
-                         use_flash_attention=self.config.use_flash_attention)
-        return init_params(one, seed=int(jax.random.randint(
-            rng, (), 0, 2 ** 31 - 1)))["blocks"][0]
+        import numpy as np
+        from .gpt2 import init_block_params
+        # Full-depth config so the residual projections get the Megatron
+        # 1/sqrt(2*n_layers) depth scaling (init parity with gpt2.init_params).
+        seed = int(jax.random.randint(rng, (), 0, 2 ** 31 - 1))
+        return init_block_params(self.config, np.random.RandomState(seed))
 
     def apply(self, params, x, rng=None):
         return _block(x, params, self.config, rng=rng, train=True)
@@ -92,13 +91,8 @@ def _head_forward(tied_params, hidden):
 
 
 def lm_loss_fn(logits, labels):
-    shift_logits = logits[:, :-1].astype(jnp.float32)
-    shift_labels = labels[:, 1:]
-    mask = (shift_labels != -100).astype(jnp.float32)
-    safe = jnp.where(shift_labels == -100, 0, shift_labels)
-    logp = jax.nn.log_softmax(shift_logits, axis=-1)
-    ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
-    return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    from .gpt2 import causal_lm_cross_entropy
+    return causal_lm_cross_entropy(logits, labels)
 
 
 def make_gpt2_pipeline(config=None, size="gpt2_small", num_stages=2,

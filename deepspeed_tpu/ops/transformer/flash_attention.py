@@ -4,13 +4,16 @@ Reference parity: csrc/transformer/softmax_kernels.cu +
 strided_batch_gemm.h + transform_kernels.cu — the reference's fused
 attention pipeline (QK^T, masked softmax, ·V as batched cublas + custom
 kernels). On TPU this becomes one Pallas kernel with online softmax
-(FlashAttention-style): scores never touch HBM, the MXU sees (Bq, d)·(d, S)
-and (Bq, S)·(S, d) matmuls per block, and causal blocks are skipped.
+(FlashAttention-style): scores never touch HBM, the MXU sees (Bq, d)·(d, Bk)
+and (Bq, Bk)·(Bk, d) matmuls per block pair, and k-blocks strictly above the
+causal diagonal are skipped (the inner loop's trip count shrinks with the
+query-block index, ~2x less MXU work for causal).
 
 Layout: K/V for one (batch, head) live in VMEM whole (fine to ~8K sequence
-at d_head<=128: 8K*128*4B*2 = 8 MB), the query axis is blocked via the grid.
-Backward follows the standard flash decomposition (dq from a per-q-block
-pass; dk/dv accumulated in VMEM scratch across the sequential TPU grid).
+at d_head<=128: 8K*128*4B*2 = 8 MB); the query axis is blocked via the grid
+and the key axis by an in-kernel fori_loop over VMEM slices. Backward
+follows the standard flash decomposition (dq accumulated across the k loop;
+dk/dv accumulated in VMEM scratch across the sequential TPU grid).
 """
 import functools
 
@@ -20,35 +23,76 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
 
 
+def _pad_kv(k, v, block_k):
+    """Zero-pad K/V on the sequence axis to a block_k multiple; padded keys
+    are masked out in-kernel via ``k_pos < seq_len``."""
+    s = k.shape[1]
+    pad = (-s) % block_k
+    if pad:
+        widths = ((0, 0), (0, pad), (0, 0))
+        k = jnp.pad(k, widths)
+        v = jnp.pad(v, widths)
+    return k, v
+
+
+def _num_visible(qi, block_q, block_k, num_k_blocks, causal):
+    """How many k blocks the q block `qi` attends to (trip count of the
+    inner loop). Causal: ceil((qi+1)*block_q / block_k), clamped."""
+    if not causal:
+        return num_k_blocks
+    visible = ((qi + 1) * block_q + block_k - 1) // block_k
+    return jnp.minimum(visible, num_k_blocks)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, block_q,
-                causal):
+                block_k, num_k_blocks, causal, seq_len):
     qi = pl.program_id(1)
-    q = q_ref[:].astype(jnp.float32) * sm_scale          # (Bq, d)
-    k = k_ref[:].astype(jnp.float32)                     # (S, d)
-    v = v_ref[:].astype(jnp.float32)                     # (S, d)
-    s = k.shape[0]
+    q = q_ref[0].astype(jnp.float32) * sm_scale          # (Bq, d)
+    d = q.shape[-1]
 
-    scores = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # (Bq, S)
-    if causal:
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, 0)
-        k_pos = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
-        scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
 
-    m = jnp.max(scores, axis=-1, keepdims=True)
-    p = jnp.exp(scores - m)
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ()))) / l
-    o_ref[:] = o.astype(o_ref.dtype)
-    lse_ref[:] = m + jnp.log(l)                          # (Bq, 1)
+    def body(ki, carry):
+        acc, m, l = carry
+        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s_blk = jax.lax.dot_general(q, k_blk,
+                                    (((1,), (1,)), ((), ())))  # (Bq, Bk)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s_blk.shape, 1)
+        mask = k_pos < seq_len          # zero-padded k tail
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_pos)
+        s_blk = jnp.where(mask, s_blk, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s_blk, axis=-1, keepdims=True))
+        p = jnp.exp(s_blk - m_new)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jax.lax.dot_general(p, v_blk,
+                                               (((1,), (0,)), ((), ())))
+        return acc, m_new, l
+
+    acc = jnp.zeros((block_q, d), jnp.float32)
+    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+    visible = _num_visible(qi, block_q, block_k, num_k_blocks, causal)
+    acc, m, l = jax.lax.fori_loop(0, visible, body, (acc, m, l))
+
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)                     # (Bq, 1)
 
 
 def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                 dq_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, sm_scale, block_q,
-                causal, num_q_blocks, seq_len):
+                block_k, num_k_blocks, causal, num_q_blocks, seq_len):
+    # seq_len masks BOTH the padded q tail (rows summed into dk/dv) and the
+    # padded k tail (columns of the score block).
     qi = pl.program_id(1)
 
     @pl.when(qi == 0)
@@ -56,50 +100,70 @@ def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    q = q_ref[:].astype(jnp.float32)                     # (Bq, d)
-    k = k_ref[:].astype(jnp.float32)                     # (S, d)
-    v = v_ref[:].astype(jnp.float32)
-    o = o_ref[:].astype(jnp.float32)
-    do = do_ref[:].astype(jnp.float32)
-    lse = lse_ref[:]                                     # (Bq, 1)
+    q = q_ref[0].astype(jnp.float32)                     # (Bq, d)
+    o = o_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]                                     # (Bq, 1)
+    d = q.shape[-1]
 
-    scores = jax.lax.dot_general(q * sm_scale, k,
-                                 (((1,), (1,)), ((), ())))  # (Bq, S)
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, scores.shape, 0)
-    if causal:
-        k_pos = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
-        scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
-
-    p = jnp.exp(scores - lse)                            # (Bq, S)
+        jnp.int32, (block_q, block_k), 0)
     # Rows past the true sequence end (padded tail of the last q block) carry
     # undefined q/do/lse; unlike the forward (whose padded outputs are simply
     # discarded), dk/dv SUM over q rows — mask them out.
-    p = jnp.where(q_pos < seq_len, p, 0.0)
-    do = jnp.where(q_pos[:, :1] < seq_len, do, 0.0)
-    dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())))
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))
+    row_valid = q_pos[:, :1] < seq_len
+    do = jnp.where(row_valid, do, 0.0)
     delta = jnp.sum(do * o, axis=-1, keepdims=True)      # (Bq, 1)
-    ds = p * (dp - delta) * sm_scale                     # (Bq, S)
-    dq_ref[:] = jax.lax.dot_general(
-        ds, k, (((1,), (0,)), ((), ()))).astype(dq_ref.dtype)
-    dk_acc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())))
+    qs = q * sm_scale
+
+    def body(ki, dq):
+        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s_blk = jax.lax.dot_general(qs, k_blk,
+                                    (((1,), (1,)), ((), ())))  # (Bq, Bk)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s_blk.shape, 1)
+        mask = k_pos < seq_len
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_pos)
+        s_blk = jnp.where(mask, s_blk, NEG_INF)
+        p = jnp.exp(s_blk - lse)                          # (Bq, Bk)
+        p = jnp.where(jnp.logical_and(row_valid, mask), p, 0.0)
+        dv_upd = jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())))
+        dv_acc[pl.ds(ki * block_k, block_k), :] = \
+            dv_acc[pl.ds(ki * block_k, block_k), :] + dv_upd
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())))
+        ds = p * (dp - delta) * sm_scale                  # (Bq, Bk)
+        dk_upd = jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())))
+        dk_acc[pl.ds(ki * block_k, block_k), :] = \
+            dk_acc[pl.ds(ki * block_k, block_k), :] + dk_upd
+        return dq + jax.lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())))
+
+    visible = _num_visible(qi, block_q, block_k, num_k_blocks, causal)
+    dq = jax.lax.fori_loop(0, visible, body, jnp.zeros((block_q, d),
+                                                       jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
 
     @pl.when(qi == num_q_blocks - 1)
     def _flush():
-        dk_ref[:] = dk_acc[:].astype(dk_ref.dtype)
-        dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _fwd(q, k, v, sm_scale, causal, block_q, interpret):
+def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     bh, s, d = q.shape
     block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    k, v = _pad_kv(k, v, block_k)
+    s_p = k.shape[1]
+    num_k_blocks = s_p // block_k
     grid = (bh, pl.cdiv(s, block_q))
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0))
-    kv_spec = pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0))
+    kv_spec = pl.BlockSpec((1, s_p, d), lambda b, i: (b, 0, 0))
     out, lse = pl.pallas_call(
-        functools.partial(_squeeze_wrap(_fwd_kernel, n_in=3, n_out=2),
-                          sm_scale=sm_scale, block_q=block_q, causal=causal),
+        functools.partial(_fwd_kernel, sm_scale=sm_scale, block_q=block_q,
+                          block_k=block_k, num_k_blocks=num_k_blocks,
+                          causal=causal, seq_len=s),
         grid=grid,
         in_specs=[q_spec, kv_spec, kv_spec],
         out_specs=(q_spec,
@@ -111,57 +175,34 @@ def _fwd(q, k, v, sm_scale, causal, block_q, interpret):
     return out, lse
 
 
-def _bwd(q, k, v, o, do, lse, sm_scale, causal, block_q, interpret):
+def _bwd(q, k, v, o, do, lse, sm_scale, causal, block_q, block_k, interpret):
     bh, s, d = q.shape
     block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    k, v = _pad_kv(k, v, block_k)
+    s_p = k.shape[1]
+    num_k_blocks = s_p // block_k
     num_q_blocks = pl.cdiv(s, block_q)
     grid = (bh, num_q_blocks)
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0))
-    kv_spec = pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0))
+    kv_spec = pl.BlockSpec((1, s_p, d), lambda b, i: (b, 0, 0))
     lse_spec = pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0))
     dq, dk, dv = pl.pallas_call(
-        functools.partial(_squeeze_wrap(_bwd_kernel, n_in=6, n_out=3),
-                          sm_scale=sm_scale, block_q=block_q, causal=causal,
-                          num_q_blocks=num_q_blocks, seq_len=s),
+        functools.partial(_bwd_kernel, sm_scale=sm_scale, block_q=block_q,
+                          block_k=block_k, num_k_blocks=num_k_blocks,
+                          causal=causal, num_q_blocks=num_q_blocks,
+                          seq_len=s),
         grid=grid,
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, q_spec, lse_spec],
         out_specs=(q_spec, kv_spec, kv_spec),
         out_shape=(jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-                   jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-                   jax.ShapeDtypeStruct((bh, s, d), q.dtype)),
-        scratch_shapes=[pltpu.VMEM((s, d), jnp.float32),
-                        pltpu.VMEM((s, d), jnp.float32)],
+                   jax.ShapeDtypeStruct((bh, s_p, d), q.dtype),
+                   jax.ShapeDtypeStruct((bh, s_p, d), q.dtype)),
+        scratch_shapes=[pltpu.VMEM((s_p, d), jnp.float32),
+                        pltpu.VMEM((s_p, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, o, do, lse)
-    return dq, dk, dv
-
-
-def _squeeze_wrap(kernel, n_in, n_out):
-    """Adapt kernels written for (rows, d) refs to (1, rows, d) blocks."""
-    class _View:
-        def __init__(self, ref):
-            self._ref = ref
-
-        def __getitem__(self, idx):
-            val = self._ref[...]
-            return val[0] if val.ndim >= 2 else val
-
-        def __setitem__(self, idx, value):
-            self._ref[...] = value[None] if value.ndim >= 1 else value
-
-        @property
-        def dtype(self):
-            return self._ref.dtype
-
-        def __iadd__(self, other):  # pragma: no cover - not used on views
-            raise NotImplementedError
-
-    def wrapped(*refs, **kwargs):
-        views = [_View(r) for r in refs[:n_in + n_out]]
-        scratch = refs[n_in + n_out:]
-        kernel(*views, *scratch, **kwargs)
-
-    return wrapped
+    return dq, dk[:, :s], dv[:, :s]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -174,7 +215,8 @@ def flash_attention(q, k, v, sm_scale=None, causal=True,
 
 def _flash_fwd(q, k, v, sm_scale, causal, block_q, interpret):
     scale = sm_scale if sm_scale is not None else 1.0 / (q.shape[-1] ** 0.5)
-    out, lse = _fwd(q, k, v, scale, causal, block_q, interpret)
+    out, lse = _fwd(q, k, v, scale, causal, block_q, DEFAULT_BLOCK_K,
+                    interpret)
     return out, (q, k, v, out, lse)
 
 
@@ -186,7 +228,8 @@ def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, interpret):
 def _flash_bwd_rule(sm_scale, causal, block_q, interpret, res, do):
     q, k, v, out, lse = res
     scale = sm_scale if sm_scale is not None else 1.0 / (q.shape[-1] ** 0.5)
-    dq, dk, dv = _bwd(q, k, v, out, do, lse, scale, causal, block_q, interpret)
+    dq, dk, dv = _bwd(q, k, v, out, do, lse, scale, causal, block_q,
+                      DEFAULT_BLOCK_K, interpret)
     return dq, dk, dv
 
 

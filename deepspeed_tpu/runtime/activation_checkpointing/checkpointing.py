@@ -164,9 +164,17 @@ def checkpoint(function, *args):
     policy = _offload_policy() if CPU_CHECKPOINT else \
         jax.checkpoint_policies.nothing_saveable
 
-    if PARTITION_ACTIVATIONS:
+    if PARTITION_ACTIVATIONS or CPU_CHECKPOINT:
         def fn(*a):
-            a = _shard_over_model_axis(a)
+            if CPU_CHECKPOINT:
+                # Tag the residuals so the offload policy can match them
+                # (save_and_offload_only_these_names keys on checkpoint_name).
+                from jax.ad_checkpoint import checkpoint_name
+                a = jax.tree_util.tree_map(
+                    lambda x: checkpoint_name(x, "checkpointed")
+                    if hasattr(x, "ndim") else x, a)
+            if PARTITION_ACTIVATIONS:
+                a = _shard_over_model_axis(a)
             return function(*a)
     else:
         fn = function

@@ -119,52 +119,53 @@ class PipelineEngine(DeepSpeedEngine):
                     if t.dtype == jnp.float32 and compute_dtype != jnp.float32
                     else t, dict(other_params))
 
-                def embed(m):
-                    m_c = jnp.clip(m, 0, M - 1)
-                    x_m = jax.tree_util.tree_map(
-                        lambda t: jax.lax.dynamic_index_in_dim(
-                            t, m_c, axis=0, keepdims=False), inputs)
-                    return module.apply_pre(params_all, x_m)
+                # Hoist the embedding out of the pipe loop: all M microbatch
+                # embeddings are computed once up front (the loop runs
+                # M+S-1 steps, and its grad transpose would re-run whatever
+                # sits inside per step).
+                embeds = jax.lax.map(
+                    lambda x_m: module.apply_pre(params_all, x_m), inputs)
 
-                def loss_of(y, m):
+                def body(t, carry):
+                    recv, ys = carry
+                    m = t - stage
                     m_c = jnp.clip(m, 0, M - 1)
-                    lbl = jax.tree_util.tree_map(
-                        lambda t: jax.lax.dynamic_index_in_dim(
-                            t, m_c, axis=0, keepdims=False), labels)
+                    x_first = jax.tree_util.tree_map(
+                        lambda e: jax.lax.dynamic_index_in_dim(
+                            e, m_c, axis=0, keepdims=False), embeds)
+                    x = jnp.where(stage == 0, x_first, recv)
+                    step_rng = jax.random.fold_in(rng, t * num_stages + stage)
+                    y = module.apply_body_stage(local_body, x, rng=step_rng)
+                    # last stage stores y for microbatch m when valid; the
+                    # output head + loss run ONCE over the M collected
+                    # outputs after the loop, not per pipeline step.
+                    is_last = stage == num_stages - 1
+                    valid = jnp.logical_and(m >= 0, m < M)
+                    write = jnp.logical_and(is_last, valid)
+                    prev = jax.lax.dynamic_index_in_dim(
+                        ys, m_c, axis=0, keepdims=False)
+                    ys = jax.lax.dynamic_update_index_in_dim(
+                        ys, jnp.where(write, y, prev), m_c, axis=0)
+                    recv_next = p2p.send_forward(y, num_stages, PIPE_AXIS)
+                    return (recv_next, ys)
+
+                x0 = jax.tree_util.tree_map(lambda e: e[0], embeds)
+                recv0 = jnp.zeros_like(x0)
+                ys0 = jnp.zeros((M,) + x0.shape, x0.dtype)
+                _, ys = jax.lax.fori_loop(0, total_steps, body, (recv0, ys0))
+
+                def loss_of(args):
+                    y, lbl = args
                     out = module.apply_post(params_all, y)
                     if module.loss_fn is not None:
                         return module.loss_fn(out, lbl)
                     return jnp.mean(out)
 
-                def body(t, carry):
-                    recv, losses = carry
-                    m = t - stage
-                    x_first = embed(m)
-                    x = jnp.where(stage == 0, x_first, recv)
-                    step_rng = jax.random.fold_in(rng, t * num_stages + stage)
-                    y = module.apply_body_stage(local_body, x, rng=step_rng)
-                    # last stage consumes y for microbatch m when valid
-                    loss_m = loss_of(y, m)
-                    is_last = stage == num_stages - 1
-                    valid = jnp.logical_and(m >= 0, m < M)
-                    write = jnp.logical_and(is_last, valid)
-                    m_c = jnp.clip(m, 0, M - 1)
-                    losses = jax.lax.dynamic_update_index_in_dim(
-                        losses,
-                        jnp.where(write, loss_m,
-                                  jax.lax.dynamic_index_in_dim(
-                                      losses, m_c, axis=0, keepdims=False)),
-                        m_c, axis=0)
-                    recv_next = p2p.send_forward(y, num_stages, PIPE_AXIS)
-                    return (recv_next, losses)
-
-                x0 = embed(jnp.asarray(0))
-                recv0 = jnp.zeros_like(x0)
-                losses0 = jnp.zeros((M,), dtype=jnp.float32)
-                _, losses = jax.lax.fori_loop(0, total_steps, body,
-                                              (recv0, losses0))
-                # broadcast last stage's losses to every pipe rank
-                # (reference _aggregate_total_loss)
+                losses = jax.lax.map(loss_of, (ys, labels)) \
+                    .astype(jnp.float32)
+                # broadcast last stage's losses to every pipe rank; the mask
+                # also zeroes the garbage ys on non-last ranks out of the
+                # gradient (reference _aggregate_total_loss)
                 is_last = (jax.lax.axis_index(PIPE_AXIS) ==
                            num_stages - 1).astype(losses.dtype)
                 losses = jax.lax.psum(losses * is_last, PIPE_AXIS)
@@ -197,7 +198,6 @@ class PipelineEngine(DeepSpeedEngine):
         through the pipe loop, then the shared apply-step."""
         pipeline_losses = self._pipeline_forward_fn()
         apply_step = self._apply_step_fn()
-        gas = self.micro_batches
         plan = self.zero_plan
 
         def fused(state, stacked_batch, rng, hyper):

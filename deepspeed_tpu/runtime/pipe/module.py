@@ -293,17 +293,33 @@ class PipelineModule:
             x = self._apply_entry(entry, p, params, x, **kwargs)
         return x
 
+    @staticmethod
+    def _call_accepting(fn, p, x, **kwargs):
+        """Call ``fn(p, x)`` forwarding only the kwargs its signature takes
+        (so e.g. ``rng`` reaches an embedding-dropout layer but a
+        plain layer is not broken by it)."""
+        import inspect
+        if kwargs:
+            try:
+                accepted = inspect.signature(fn).parameters
+                kwargs = {k: v for k, v in kwargs.items() if k in accepted}
+            except (TypeError, ValueError):
+                kwargs = {}
+        return fn(p, x, **kwargs)
+
     def _apply_entry(self, entry, p, params, x, **kwargs):
         kind, tkey, layer = entry
         if kind == "tied":
             spec = layer  # the TiedLayerSpec
             tied_layer = self.tied_keys[tkey]
             if spec.forward_fn is not None:
-                return spec.forward_fn(params["tied"][tkey], x)
-            return tied_layer.apply(params["tied"][tkey], x)
+                return self._call_accepting(spec.forward_fn,
+                                            params["tied"][tkey], x, **kwargs)
+            return self._call_accepting(tied_layer.apply,
+                                        params["tied"][tkey], x, **kwargs)
         if kind == "fn":
             return layer(x)
-        return layer.apply(p, x)
+        return self._call_accepting(layer.apply, p, x, **kwargs)
 
     def _body_accepts_rng(self):
         import inspect
@@ -330,8 +346,17 @@ class PipelineModule:
                 kwargs["rng"] = jax.random.fold_in(rng, i)
             return (proto_layer.apply(layer_params, x, **kwargs), i + 1), None
 
-        if interval and interval > 0 and L % max(interval, 1) == 0 and \
-                interval < L:
+        # Clamp interval to the stage depth (interval >= L == remat the whole
+        # stage as one chunk); non-divisor intervals fall back to per-layer
+        # remat with a warning rather than silently changing memory behavior.
+        interval = min(interval, L) if interval and interval > 0 else interval
+        if interval and interval > 0 and L % interval != 0:
+            from ...utils.logging import logger
+            logger.warning(
+                "activation_checkpoint_interval={} does not divide "
+                "layers_per_stage={}; falling back to per-layer "
+                "checkpointing".format(interval, L))
+        if interval and interval > 0 and L % interval == 0:
             # group layers into chunks of `interval`; remat each chunk
             grouped = jax.tree_util.tree_map(
                 lambda t: t.reshape((L // interval, interval) + t.shape[1:]),

@@ -59,6 +59,30 @@ def test_flash_uneven_seq_blocks():
                                rtol=1e-4, atol=1e-4)
 
 
+def test_flash_ragged_k_tail_grads():
+    # seq with no nice divisor (2*prime): exercises the zero-padded k tail
+    # masking in BOTH kernels (fwd scores and bwd dk/dv slicing)
+    b, s, h, d = 1, 202, 2, 32
+    q, k, v = rand_qkv(b, s, h, d, seed=11)
+
+    def loss_flash(q, k, v):
+        out = causal_attention(q, k, v, use_flash=True, interpret=True)
+        return jnp.sum(out * jnp.sin(out))
+
+    def loss_ref(q, k, v):
+        out = reference_causal_attention(q, k, v)
+        return jnp.sum(out * jnp.sin(out))
+
+    np.testing.assert_allclose(np.asarray(loss_flash(q, k, v)),
+                               np.asarray(loss_ref(q, k, v)),
+                               rtol=1e-4, atol=1e-4)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-3, atol=2e-3)
+
+
 def test_non_causal_mode():
     b, s, h, d = 1, 128, 2, 32
     q, k, v = rand_qkv(b, s, h, d, seed=7)

@@ -1,0 +1,307 @@
+"""Pallas block-sparse flash attention driven by a SparsityConfig layout.
+
+Reference parity: deepspeed/ops/sparse_attention/matmul.py (Triton SDD/DSD
+block-sparse matmuls), softmax.py (block-sparse softmax) and
+csrc/sparse_attention/utils.cpp (sdd_segment load balancing). The
+reference composes three Triton ops (QK^T -> masked softmax -> .V) that
+materialize block-sparse score tensors in HBM; on TPU the whole pipeline
+is one Pallas kernel with online softmax, so scores never leave VMEM and
+the layout's "which blocks exist" metadata becomes a trace-time static
+index list driving the inner loop (the analogue of sdd_segment's lut).
+
+The layout is a numpy (num_heads, nb, nb) 0/1 matrix from
+sparsity_config.py. Per (head, q-block) we precompute the active
+k-block indices (and the transpose for the dk/dv pass) as scalar-prefetch
+arrays; the kernel fori_loops over exactly the active blocks, so FLOPs
+and HBM traffic scale with layout density, not seq^2.
+
+Masks (key-padding and attention) and relative position bias are folded
+into additive f32 biases; they participate in forward/recompute but do
+not receive gradients (the reference trains neither).
+"""
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def build_block_index(layout):
+    """Per (head, q-block) active k-block index lists, padded to the max
+    row population. Returns (counts[H, nb], indices[H, nb, max_n])."""
+    layout = np.asarray(layout)
+    heads, nbq, nbk = layout.shape
+    counts = layout.sum(axis=-1).astype(np.int32)
+    max_n = max(int(counts.max()), 1)
+    indices = np.zeros((heads, nbq, max_n), dtype=np.int32)
+    for h in range(heads):
+        for qi in range(nbq):
+            active = np.nonzero(layout[h, qi])[0]
+            indices[h, qi, :len(active)] = active
+    return counts, indices
+
+
+def _attn_fwd_kernel(nact_ref, idx_ref, q_ref, k_ref, v_ref, kpm_ref,
+                     bias_ref, o_ref, lse_ref, *, sm_scale, block, causal,
+                     has_kpm, has_bias):
+    h = pl.program_id(1)
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale          # (B, d)
+    d = q.shape[-1]
+    q_pos = qi * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
+    k_iota = jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+
+    def body(j, carry):
+        acc, m, l = carry
+        ki = idx_ref[h, qi, j]
+        k_blk = k_ref[0, 0, pl.ds(ki * block, block), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(ki * block, block), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())))
+        if has_kpm:
+            s = s + kpm_ref[0, pl.ds(ki * block, block)][None, :]
+        if has_bias:
+            s = s + bias_ref[:, pl.ds(ki * block, block)]
+        if causal:
+            s = jnp.where(q_pos >= ki * block + k_iota, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        # Rows where every score so far is masked (m_new still NEG_INF)
+        # must not resolve exp(NEG_INF - NEG_INF) to 1.
+        p = jnp.where(m_new <= NEG_INF, 0.0, jnp.exp(s - m_new))
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jax.lax.dot_general(p, v_blk,
+                                               (((1,), (0,)), ((), ())))
+        return acc, m_new, l
+
+    init = (jnp.zeros((block, d), jnp.float32),
+            jnp.full((block, 1), NEG_INF, jnp.float32),
+            jnp.zeros((block, 1), jnp.float32))
+    acc, m, l = jax.lax.fori_loop(0, nact_ref[h, qi], body, init)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0, 0] = jnp.where(l == 0.0, NEG_INF, m + jnp.log(l_safe))
+
+
+def _attn_dq_kernel(nact_ref, idx_ref, q_ref, k_ref, v_ref, kpm_ref, bias_ref,
+                    do_ref, lse_ref, delta_ref, dq_ref, *, sm_scale, block,
+                    causal, has_kpm, has_bias):
+    h = pl.program_id(1)
+    qi = pl.program_id(2)
+    qs = q_ref[0, 0].astype(jnp.float32) * sm_scale
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+    d = qs.shape[-1]
+    q_pos = qi * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
+    k_iota = jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+
+    def body(j, dq):
+        ki = idx_ref[h, qi, j]
+        k_blk = k_ref[0, 0, pl.ds(ki * block, block), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(ki * block, block), :].astype(jnp.float32)
+        s = jax.lax.dot_general(qs, k_blk, (((1,), (1,)), ((), ())))
+        if has_kpm:
+            s = s + kpm_ref[0, pl.ds(ki * block, block)][None, :]
+        if has_bias:
+            s = s + bias_ref[:, pl.ds(ki * block, block)]
+        if causal:
+            s = jnp.where(q_pos >= ki * block + k_iota, s, NEG_INF)
+        # Rows with no surviving score (lse == NEG_INF) contribute nothing.
+        p = jnp.where(lse <= NEG_INF, 0.0, jnp.exp(s - lse))
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())))
+        ds = p * (dp - delta) * sm_scale
+        return dq + jax.lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())))
+
+    dq = jax.lax.fori_loop(0, nact_ref[h, qi], body,
+                           jnp.zeros((block, d), jnp.float32))
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _attn_dkdv_kernel(nact_ref, idx_ref, q_ref, k_ref, v_ref, kpm_ref,
+                      bias_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *,
+                      sm_scale, block, causal, has_kpm, has_bias):
+    h = pl.program_id(1)
+    ki = pl.program_id(2)
+    k_blk = k_ref[0, 0].astype(jnp.float32)                  # (B, d)
+    v_blk = v_ref[0, 0].astype(jnp.float32)
+    d = k_blk.shape[-1]
+    k_pos = ki * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+    q_iota = jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
+    if has_kpm:
+        kpm_cols = kpm_ref[0, pl.ds(ki * block, block)][None, :]
+
+    def body(j, carry):
+        dk, dv = carry
+        qi = idx_ref[h, ki, j]
+        q_blk = q_ref[0, 0, pl.ds(qi * block, block), :].astype(jnp.float32)
+        do_blk = do_ref[0, 0, pl.ds(qi * block, block), :].astype(jnp.float32)
+        lse_blk = lse_ref[0, 0, pl.ds(qi * block, block), :]
+        delta_blk = delta_ref[0, 0, pl.ds(qi * block, block), :]
+        qs = q_blk * sm_scale
+        s = jax.lax.dot_general(qs, k_blk, (((1,), (1,)), ((), ())))
+        if has_kpm:
+            s = s + kpm_cols
+        if has_bias:
+            s = s + bias_ref[pl.ds(qi * block, block), pl.ds(ki * block,
+                                                             block)]
+        if causal:
+            s = jnp.where(qi * block + q_iota >= k_pos, s, NEG_INF)
+        p = jnp.where(lse_blk <= NEG_INF, 0.0, jnp.exp(s - lse_blk))
+        dv = dv + jax.lax.dot_general(p, do_blk, (((0,), (0,)), ((), ())))
+        dp = jax.lax.dot_general(do_blk, v_blk, (((1,), (1,)), ((), ())))
+        ds = p * (dp - delta_blk) * sm_scale
+        dk = dk + jax.lax.dot_general(ds, q_blk, (((0,), (0,)), ((), ())))
+        return dk, dv
+
+    init = (jnp.zeros((block, d), jnp.float32),
+            jnp.zeros((block, d), jnp.float32))
+    dk, dv = jax.lax.fori_loop(0, nact_ref[h, ki], body, init)
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
+                                has_kpm=False, has_bias=False,
+                                interpret=False):
+    """Build a jittable ``attn(q, k, v, kpm, bias) -> out`` for a fixed
+    layout.
+
+    q/k/v: (batch, heads, seq, d_head); seq must equal
+    ``layout.shape[1] * block``. ``kpm`` is an additive (batch, seq) f32
+    key bias, ``bias`` an additive (seq, seq) f32 score bias (attn mask +
+    relative position embedding); pass None for each unless the matching
+    ``has_*`` flag is set. Gradients flow to q/k/v only.
+    """
+    layout = np.asarray(layout)
+    heads, nb, _ = layout.shape
+    seq = nb * block
+    nact_f, idx_f = build_block_index(layout)
+    nact_b, idx_b = build_block_index(layout.transpose(0, 2, 1))
+
+    def _specs(batch_d):
+        blk = pl.BlockSpec((1, 1, block, batch_d),
+                           lambda b, h, i, *_: (b, h, i, 0))
+        full = pl.BlockSpec((1, 1, seq, batch_d),
+                            lambda b, h, i, *_: (b, h, 0, 0))
+        col = pl.BlockSpec((1, 1, block, 1), lambda b, h, i, *_: (b, h, i, 0))
+        fcol = pl.BlockSpec((1, 1, seq, 1), lambda b, h, i, *_: (b, h, 0, 0))
+        kpm = pl.BlockSpec((1, seq), lambda b, h, i, *_: (b, 0))
+        bias = pl.BlockSpec((block, seq), lambda b, h, i, *_: (i, 0))
+        fbias = pl.BlockSpec((seq, seq), lambda b, h, i, *_: (0, 0))
+        return blk, full, col, fcol, kpm, bias, fbias
+
+    def _mask_ops(kpm, bias):
+        ops = []
+        if has_kpm:
+            ops.append(jnp.asarray(kpm, jnp.float32))
+        if has_bias:
+            ops.append(jnp.asarray(bias, jnp.float32))
+        return ops
+
+    def _fwd(q, k, v, kpm, bias):
+        batch, h, s, d = q.shape
+        assert h == heads and s == seq, (q.shape, layout.shape, block)
+        scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
+        blk, full, col, fcol, kpm_s, bias_s, _ = _specs(d)
+        in_specs = [blk, full, full] + ([kpm_s] if has_kpm else []) + \
+                   ([bias_s] if has_bias else [])
+        ops = [q, k, v] + _mask_ops(kpm, bias)
+        kernel = functools.partial(
+            _kernel_shim, _attn_fwd_kernel, has_kpm, has_bias,
+            sm_scale=scale, block=block, causal=causal)
+        out, lse = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(batch, heads, nb),
+                in_specs=in_specs,
+                out_specs=(blk, col)),
+            out_shape=(jax.ShapeDtypeStruct(q.shape, q.dtype),
+                       jax.ShapeDtypeStruct((batch, h, s, 1), jnp.float32)),
+            interpret=interpret,
+        )(jnp.asarray(nact_f), jnp.asarray(idx_f), *ops)
+        return out, lse
+
+    def _bwd(q, k, v, kpm, bias, out, lse, do):
+        batch, h, s, d = q.shape
+        scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
+        delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1, keepdims=True)
+        blk, full, col, fcol, kpm_s, bias_s, fbias_s = _specs(d)
+
+        mask_specs = ([kpm_s] if has_kpm else []) + \
+                     ([bias_s] if has_bias else [])
+        mask_ops = _mask_ops(kpm, bias)
+        dq_kernel = functools.partial(
+            _kernel_shim, _attn_dq_kernel, has_kpm, has_bias,
+            sm_scale=scale, block=block, causal=causal)
+        dq = pl.pallas_call(
+            dq_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(batch, heads, nb),
+                in_specs=[blk, full, full] + mask_specs + [blk, col, col],
+                out_specs=blk),
+            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+            interpret=interpret,
+        )(jnp.asarray(nact_f), jnp.asarray(idx_f), q, k, v, *mask_ops, do,
+          lse, delta)
+
+        # dk/dv pass walks the transposed layout: full-bias block rows are
+        # indexed dynamically, so the bias is passed whole.
+        mask_specs_t = ([kpm_s] if has_kpm else []) + \
+                       ([fbias_s] if has_bias else [])
+        dkdv_kernel = functools.partial(
+            _kernel_shim, _attn_dkdv_kernel, has_kpm, has_bias,
+            sm_scale=scale, block=block, causal=causal)
+        dk, dv = pl.pallas_call(
+            dkdv_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(batch, heads, nb),
+                in_specs=[full, blk, blk] + mask_specs_t +
+                         [full, fcol, fcol],
+                out_specs=(blk, blk)),
+            out_shape=(jax.ShapeDtypeStruct(k.shape, k.dtype),
+                       jax.ShapeDtypeStruct(v.shape, v.dtype)),
+            interpret=interpret,
+        )(jnp.asarray(nact_b), jnp.asarray(idx_b), q, k, v, *mask_ops, do,
+          lse, delta)
+        return dq, dk, dv
+
+    @jax.custom_vjp
+    def attn(q, k, v, kpm=None, bias=None):
+        out, _ = _fwd(q, k, v, kpm, bias)
+        return out
+
+    def fwd_rule(q, k, v, kpm=None, bias=None):
+        out, lse = _fwd(q, k, v, kpm, bias)
+        return out, (q, k, v, kpm, bias, out, lse)
+
+    def bwd_rule(res, do):
+        q, k, v, kpm, bias, out, lse = res
+        dq, dk, dv = _bwd(q, k, v, kpm, bias, out, lse, do)
+        dkpm = jnp.zeros_like(kpm) if kpm is not None else None
+        dbias = jnp.zeros_like(bias) if bias is not None else None
+        return dq, dk, dv, dkpm, dbias
+
+    attn.defvjp(fwd_rule, bwd_rule)
+    return attn
+
+
+def _kernel_shim(kernel, has_kpm, has_bias, nact_ref, idx_ref, *refs,
+                 **params):
+    """Re-inserts None placeholders for absent mask operands so each kernel
+    keeps one signature."""
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    rest = refs[3:]
+    kpm_ref = rest.pop(0) if has_kpm else None
+    bias_ref = rest.pop(0) if has_bias else None
+    kernel(nact_ref, idx_ref, q_ref, k_ref, v_ref, kpm_ref, bias_ref, *rest,
+           has_kpm=has_kpm, has_bias=has_bias, **params)

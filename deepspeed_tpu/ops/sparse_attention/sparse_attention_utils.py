@@ -1,0 +1,92 @@
+"""Helpers for adapting models to block-sparse attention.
+
+Reference parity: deepspeed/ops/sparse_attention/sparse_attention_utils.py
+(SparseAttentionUtils: extend_position_embedding:19,
+update_tokenizer_model_max_length:67, replace_model_self_attention_with_
+sparse_self_attention:84, pad_to_block_size:126, unpad_sequence_output:180).
+Functional versions over arrays/pytrees instead of in-place torch module
+surgery.
+"""
+import jax.numpy as jnp
+
+
+class SparseAttentionUtils:
+    """Utilities for integrating sparse attention into transformer models."""
+
+    @staticmethod
+    def extend_position_embedding(weights, max_position,
+                                  num_reserved_positions=0):
+        """Tile position-embedding ``weights`` (orig_pos, emb) up to
+        ``max_position`` rows (reference :19 — bert tiles whole table,
+        roberta preserves its 2 reserved rows via
+        ``num_reserved_positions=2``)."""
+        reserved = weights[:num_reserved_positions]
+        body = weights[num_reserved_positions:]
+        original = body.shape[0]
+        if max_position <= original:
+            raise ValueError(
+                f"new max position {max_position} must exceed the original "
+                f"{original}")
+        multiples = -(-max_position // original)  # ceil: cover every position
+        extended = jnp.concatenate([body] * multiples, axis=0)[:max_position]
+        return jnp.concatenate([reserved, extended], axis=0)
+
+    @staticmethod
+    def update_tokenizer_model_max_length(tokenizer, max_position):
+        """Raise a HF tokenizer's max length (reference :67)."""
+        tokenizer.model_max_length = max_position
+        if hasattr(tokenizer, "init_kwargs"):
+            tokenizer.init_kwargs["model_max_length"] = max_position
+        return tokenizer
+
+    @staticmethod
+    def pad_to_block_size(block_size, input_ids=None, attention_mask=None,
+                          token_type_ids=None, position_ids=None,
+                          inputs_embeds=None, pad_token_id=0,
+                          model_embeddings=None):
+        """Right-pad sequence inputs to a multiple of ``block_size``
+        (reference :126). Returns ``(pad_len, padded tensors...)`` in the
+        argument order; absent inputs come back as None. Padding positions
+        get ``pad_token_id`` / mask 0 / type 0, and position ids continue
+        counting. ``inputs_embeds`` are padded with the embedding of
+        ``pad_token_id`` when ``model_embeddings`` (a (vocab, emb) table)
+        is given, else zeros."""
+        ref = input_ids if input_ids is not None else inputs_embeds
+        assert ref is not None, "need input_ids or inputs_embeds"
+        seq_len = ref.shape[1]
+        pad_len = (block_size - seq_len % block_size) % block_size
+
+        def pad_2d(x, value):
+            return None if x is None else jnp.pad(
+                x, ((0, 0), (0, pad_len)), constant_values=value)
+
+        if pad_len:
+            input_ids = pad_2d(input_ids, pad_token_id)
+            attention_mask = pad_2d(attention_mask, 0)
+            token_type_ids = pad_2d(token_type_ids, 0)
+            if position_ids is not None:
+                tail = position_ids[:, -1:] + jnp.arange(
+                    1, pad_len + 1, dtype=position_ids.dtype)[None, :]
+                position_ids = jnp.concatenate([position_ids, tail], axis=1)
+            if inputs_embeds is not None:
+                if model_embeddings is not None:
+                    fill = jnp.broadcast_to(
+                        model_embeddings[pad_token_id],
+                        (inputs_embeds.shape[0], pad_len,
+                         inputs_embeds.shape[2]))
+                else:
+                    fill = jnp.zeros((inputs_embeds.shape[0], pad_len,
+                                      inputs_embeds.shape[2]),
+                                     inputs_embeds.dtype)
+                inputs_embeds = jnp.concatenate([inputs_embeds, fill],
+                                                axis=1)
+        return (pad_len, input_ids, attention_mask, token_type_ids,
+                position_ids, inputs_embeds)
+
+    @staticmethod
+    def unpad_sequence_output(pad_len, sequence_output):
+        """Drop the padded tail added by :meth:`pad_to_block_size`
+        (reference :180)."""
+        if pad_len:
+            sequence_output = sequence_output[:, :-pad_len]
+        return sequence_output

@@ -1,0 +1,133 @@
+"""SparseSelfAttention: layout-driven sparse attention module.
+
+Reference parity: deepspeed/ops/sparse_attention/sparse_self_attention.py:14
+(SparseSelfAttention nn.Module composing Triton MatMul sdd/dsd + Softmax)
+and bert_sparse_self_attention.py:10. Here the three Triton ops collapse
+into one Pallas kernel (block_sparse_attention.py); the module keeps the
+reference call signature ``(query, key, value, rpe, key_padding_mask,
+attn_mask)`` with 'add'/'mul' mask modes, caches one compiled kernel per
+(seq_len, mask-arity) instead of the reference's per-seq-len Triton op
+cache (sparse_self_attention.py:68), and slices the master layout for
+shorter sequences (sparse_self_attention.py:52).
+"""
+import numpy as np
+
+import jax.numpy as jnp
+
+from .sparsity_config import SparsityConfig
+from .block_sparse_attention import make_block_sparse_attention, NEG_INF
+
+
+class SparseSelfAttention:
+    """Applies block-sparse self attention per a :class:`SparsityConfig`.
+
+    q/k/v: (batch, heads, seq, d_head). ``rpe`` is an additive
+    (seq, seq) relative position bias; ``key_padding_mask`` is
+    (batch, seq); ``attn_mask`` is (seq, seq). 'mul' masks are 0/1
+    keep-masks, 'add' masks are additive biases (both as in the
+    reference).
+    """
+
+    def __init__(self, sparsity_config=None, key_padding_mask_mode="add",
+                 attn_mask_mode="mul", max_seq_length=2048, causal=False,
+                 interpret=None):
+        self.sparsity_config = sparsity_config or SparsityConfig(num_heads=4)
+        if key_padding_mask_mode not in ("add", "mul"):
+            raise ValueError("key_padding_mask_mode must be 'add' or 'mul'")
+        if attn_mask_mode not in ("add", "mul"):
+            raise ValueError("attn_mask_mode must be 'add' or 'mul'")
+        self.key_padding_mask_mode = key_padding_mask_mode
+        self.attn_mask_mode = attn_mask_mode
+        self.max_seq_length = max_seq_length
+        self.causal = causal
+        self.interpret = interpret
+        self.master_layout = self.sparsity_config.make_layout(max_seq_length)
+        self._kernels = {}
+
+    def get_layout(self, seq_len):
+        block = self.sparsity_config.block
+        if seq_len % block != 0:
+            raise ValueError(
+                f"Sequence length {seq_len} must be divisible by block "
+                f"{block}!")
+        nb = seq_len // block
+        return self.master_layout[:, :nb, :nb]
+
+    def _kernel(self, seq_len, has_kpm, has_bias):
+        key = (seq_len, has_kpm, has_bias)
+        if key not in self._kernels:
+            interpret = self.interpret
+            if interpret is None:
+                import jax
+                interpret = jax.default_backend() == "cpu"
+            self._kernels[key] = make_block_sparse_attention(
+                self.get_layout(seq_len), self.sparsity_config.block,
+                causal=self.causal, has_kpm=has_kpm, has_bias=has_bias,
+                interpret=interpret)
+        return self._kernels[key]
+
+    def __call__(self, query, key, value, rpe=None, key_padding_mask=None,
+                 attn_mask=None):
+        assert query.ndim == 4, "q/k/v must be (batch, heads, seq, d_head)"
+        seq_len = query.shape[2]
+
+        kpm = None
+        if key_padding_mask is not None:
+            kpm = jnp.asarray(key_padding_mask, jnp.float32)
+            if self.key_padding_mask_mode == "mul":
+                kpm = jnp.where(kpm != 0, 0.0, NEG_INF)
+
+        bias = None
+        if attn_mask is not None:
+            am = jnp.asarray(attn_mask, jnp.float32)
+            if self.attn_mask_mode == "mul":
+                am = jnp.where(am != 0, 0.0, NEG_INF)
+            bias = am
+        if rpe is not None:
+            rpe = jnp.asarray(rpe, jnp.float32)
+            bias = rpe if bias is None else bias + rpe
+
+        attn = self._kernel(seq_len, kpm is not None, bias is not None)
+        args = [query, key, value]
+        if kpm is not None or bias is not None:
+            args.append(kpm)
+            args.append(bias)
+        return attn(*args)
+
+    forward = __call__
+
+
+class BertSparseSelfAttention:
+    """BERT-style QKV projection around SparseSelfAttention
+    (reference bert_sparse_self_attention.py:10). Functional: weights are
+    passed per call as a dict {q,k,v: {kernel,bias}}."""
+
+    def __init__(self, num_attention_heads, hidden_size,
+                 sparsity_config=None, max_seq_length=2048):
+        if hidden_size % num_attention_heads != 0:
+            raise ValueError(
+                f"hidden size {hidden_size} is not a multiple of "
+                f"num_attention_heads {num_attention_heads}")
+        self.num_attention_heads = num_attention_heads
+        self.attention_head_size = hidden_size // num_attention_heads
+        self.sparse_self_attention = SparseSelfAttention(
+            sparsity_config or SparsityConfig(num_heads=num_attention_heads),
+            max_seq_length=max_seq_length)
+
+    def transpose_for_scores(self, x):
+        b, s, _ = x.shape
+        x = x.reshape(b, s, self.num_attention_heads,
+                      self.attention_head_size)
+        return x.transpose(0, 2, 1, 3)
+
+    def __call__(self, params, hidden_states, attention_mask=None):
+        q = hidden_states @ params["query"]["kernel"] + \
+            params["query"]["bias"]
+        k = hidden_states @ params["key"]["kernel"] + params["key"]["bias"]
+        v = hidden_states @ params["value"]["kernel"] + \
+            params["value"]["bias"]
+        ql, kl, vl = map(self.transpose_for_scores, (q, k, v))
+        ctx = self.sparse_self_attention(ql, kl, vl,
+                                         key_padding_mask=attention_mask)
+        b, h, s, d = ctx.shape
+        return ctx.transpose(0, 2, 1, 3).reshape(b, s, h * d)

@@ -112,8 +112,13 @@ def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
     # undefined q/do/lse; unlike the forward (whose padded outputs are simply
     # discarded), dk/dv SUM over q rows — mask them out.
     row_valid = q_pos[:, :1] < seq_len
+    # q/o/do on padded rows are undefined (may be NaN); they enter dk/dv
+    # through row reductions (ds.T@q, p.T@do, delta) where 0 * NaN = NaN,
+    # so every padded row is zeroed at the source.
+    q = jnp.where(row_valid, q, 0.0)
     do = jnp.where(row_valid, do, 0.0)
-    delta = jnp.sum(do * o, axis=-1, keepdims=True)      # (Bq, 1)
+    delta = jnp.where(row_valid,
+                      jnp.sum(do * o, axis=-1, keepdims=True), 0.0)
     qs = q * sm_scale
 
     def body(ki, dq):
@@ -205,31 +210,34 @@ def _bwd(q, k, v, o, do, lse, sm_scale, causal, block_q, block_k, interpret):
     return dq, dk[:, :s], dv[:, :s]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, sm_scale=None, causal=True,
-                    block_q=DEFAULT_BLOCK_Q, interpret=False):
+                    block_q=DEFAULT_BLOCK_Q, interpret=False,
+                    block_k=DEFAULT_BLOCK_K):
     """q/k/v: (batch_heads, seq, d_head) -> (batch_heads, seq, d_head)."""
-    out, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, interpret)
+    out, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, interpret,
+                        block_k)
     return out
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, block_q, interpret):
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, interpret, block_k):
     scale = sm_scale if sm_scale is not None else 1.0 / (q.shape[-1] ** 0.5)
-    out, lse = _fwd(q, k, v, scale, causal, block_q, DEFAULT_BLOCK_K,
-                    interpret)
+    out, lse = _fwd(q, k, v, scale, causal, block_q, block_k, interpret)
     return out, (q, k, v, out, lse)
 
 
-def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, interpret):
-    out, res = _flash_fwd(q, k, v, sm_scale, causal, block_q, interpret)
+def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, interpret,
+                    block_k=DEFAULT_BLOCK_K):
+    out, res = _flash_fwd(q, k, v, sm_scale, causal, block_q, interpret,
+                          block_k)
     return out, res
 
 
-def _flash_bwd_rule(sm_scale, causal, block_q, interpret, res, do):
+def _flash_bwd_rule(sm_scale, causal, block_q, interpret, block_k, res, do):
     q, k, v, out, lse = res
     scale = sm_scale if sm_scale is not None else 1.0 / (q.shape[-1] ** 0.5)
     dq, dk, dv = _bwd(q, k, v, out, do, lse, scale, causal, block_q,
-                      DEFAULT_BLOCK_K, interpret)
+                      block_k, interpret)
     return dq, dk, dv
 
 

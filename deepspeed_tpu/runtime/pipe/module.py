@@ -301,8 +301,10 @@ class PipelineModule:
         import inspect
         if kwargs:
             try:
-                accepted = inspect.signature(fn).parameters
-                kwargs = {k: v for k, v in kwargs.items() if k in accepted}
+                params = inspect.signature(fn).parameters
+                if not any(q.kind == inspect.Parameter.VAR_KEYWORD
+                           for q in params.values()):
+                    kwargs = {k: v for k, v in kwargs.items() if k in params}
             except (TypeError, ValueError):
                 kwargs = {}
         return fn(p, x, **kwargs)

@@ -60,13 +60,16 @@ def test_flash_uneven_seq_blocks():
 
 
 def test_flash_ragged_k_tail_grads():
-    # seq with no nice divisor (2*prime): exercises the zero-padded k tail
+    # seq with no nice divisor (2*prime) AND block_k < seq so K is truly
+    # zero-padded (202 -> 4 blocks of 64): exercises the padded-tail
     # masking in BOTH kernels (fwd scores and bwd dk/dv slicing)
     b, s, h, d = 1, 202, 2, 32
     q, k, v = rand_qkv(b, s, h, d, seed=11)
+    fold = lambda t: t.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
     def loss_flash(q, k, v):
-        out = causal_attention(q, k, v, use_flash=True, interpret=True)
+        out = flash_attention(fold(q), fold(k), fold(v), None, True, 64,
+                              True, 64)
         return jnp.sum(out * jnp.sin(out))
 
     def loss_ref(q, k, v):

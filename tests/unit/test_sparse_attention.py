@@ -1,0 +1,284 @@
+"""Sparse attention tests (mirrors reference tests/unit/test_sparse_attention.py
+— triton ops vs dense reference — plus layout-shape checks)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.sparse_attention import (
+    SparsityConfig, DenseSparsityConfig, FixedSparsityConfig,
+    VariableSparsityConfig, BigBirdSparsityConfig,
+    BSLongformerSparsityConfig, make_block_sparse_attention,
+    build_block_index, SparseSelfAttention, SparseAttentionUtils)
+
+
+# --- layout generators ------------------------------------------------------
+
+def test_dense_layout_all_ones():
+    cfg = DenseSparsityConfig(num_heads=2, block=16)
+    layout = cfg.make_layout(64)
+    assert layout.shape == (2, 4, 4)
+    assert layout.sum() == 2 * 16
+
+
+def test_layout_requires_divisible_seq():
+    with pytest.raises(ValueError):
+        DenseSparsityConfig(num_heads=1, block=16).make_layout(50)
+
+
+def test_fixed_bidirectional_layout():
+    cfg = FixedSparsityConfig(num_heads=2, block=16, num_local_blocks=4,
+                              num_global_blocks=1)
+    layout = cfg.make_layout(16 * 8)
+    # local: dense 4x4 windows on the diagonal
+    assert (layout[0, :4, :4] == 1).all()
+    assert (layout[0, 4:, 4:] == 1).all()
+    # global: last block of each window is a full column
+    assert (layout[0, :, 3] == 1).all()
+    assert (layout[0, :, 7] == 1).all()
+    # off-window, non-global blocks stay empty
+    assert layout[0, 0, 4] == 0
+    assert layout[0, 5, 1] == 0
+    # heads share one layout by default
+    assert (layout[0] == layout[1]).all()
+
+
+def test_fixed_unidirectional_layout():
+    cfg = FixedSparsityConfig(num_heads=1, block=16, num_local_blocks=4,
+                              num_global_blocks=1,
+                              attention="unidirectional")
+    layout = cfg.make_layout(16 * 8)
+    # strictly-upper blocks never attended
+    assert np.triu(layout[0], 1).sum() == 0
+    # lower-tri local window + global col visible only from rows below it
+    assert layout[0, 2, 1] == 1
+    assert layout[0, 1, 2] == 0
+    assert layout[0, 7, 3] == 1  # global col from a later row
+    assert layout[0, 2, 3] == 0  # global col not visible from above
+
+
+def test_fixed_different_patterns_per_head():
+    cfg = FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=4,
+                              num_global_blocks=1,
+                              different_layout_per_head=True,
+                              num_different_global_patterns=4)
+    layout = cfg.make_layout(16 * 8)
+    # head h uses global column (3 - h) within each window
+    for h in range(4):
+        assert (layout[h, :, 3 - h] == 1).all()
+    assert not (layout[0] == layout[1]).all()
+
+
+def test_variable_layout():
+    cfg = VariableSparsityConfig(num_heads=1, block=16, num_random_blocks=0,
+                                 local_window_blocks=[2, 4],
+                                 global_block_indices=[0])
+    layout = cfg.make_layout(16 * 10)
+    assert (layout[0, :2, :2] == 1).all()     # first window: 2 blocks
+    assert (layout[0, 2:6, 2:6] == 1).all()   # second window: 4 blocks
+    assert (layout[0, 6:10, 6:10] == 1).all()  # last width repeats
+    assert (layout[0, :, 0] == 1).all()       # global col 0
+    assert layout[0, 1, 3] == 0
+
+
+def test_variable_global_ranges():
+    cfg = VariableSparsityConfig(num_heads=1, block=16,
+                                 global_block_indices=[0, 4],
+                                 global_block_end_indices=[2, 5],
+                                 horizontal_global_attention=True)
+    layout = cfg.make_layout(16 * 8)
+    for c in (0, 1, 4):
+        assert (layout[0, :, c] == 1).all()
+        assert (layout[0, c, :] == 1).all()
+
+
+def test_bigbird_layout():
+    cfg = BigBirdSparsityConfig(num_heads=1, block=16, num_random_blocks=1,
+                                num_sliding_window_blocks=3,
+                                num_global_blocks=1, seed=0)
+    layout = cfg.make_layout(16 * 8)
+    nb = 8
+    rows = np.arange(nb)
+    window = np.abs(rows[:, None] - rows[None, :]) <= 1
+    assert (layout[0][window] == 1).all()
+    assert (layout[0, 0, :] == 1).all()
+    assert (layout[0, :, 0] == 1).all()
+    # every row has >= 1 random block beyond structure (may overlap)
+    assert (layout[0].sum(-1) >= window.sum(-1)).all()
+
+
+def test_bslongformer_layout():
+    cfg = BSLongformerSparsityConfig(num_heads=1, block=16,
+                                     num_sliding_window_blocks=3,
+                                     global_block_indices=[0])
+    layout = cfg.make_layout(16 * 8)
+    assert (layout[0, 0, :] == 1).all()
+    assert (layout[0, :, 0] == 1).all()
+    assert layout[0, 4, 3] == 1 and layout[0, 4, 5] == 1
+    assert layout[0, 4, 6] == 0
+
+
+def test_build_block_index():
+    layout = np.array([[[1, 0, 1], [0, 1, 0], [1, 1, 1]]])
+    counts, idx = build_block_index(layout)
+    assert counts.tolist() == [[2, 1, 3]]
+    assert idx[0, 0, :2].tolist() == [0, 2]
+    assert idx[0, 2].tolist() == [0, 1, 2]
+
+
+# --- kernel vs dense reference ---------------------------------------------
+
+def _dense_reference(q, k, v, layout, block, causal=False, kpm=None,
+                     bias=None):
+    """Plain-jnp attention with the block layout expanded to an element
+    mask."""
+    mask = np.kron(np.asarray(layout), np.ones((block, block))) > 0
+    s = q.shape[2]
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if kpm is not None:
+        scores = scores + kpm[:, None, None, :]
+    if bias is not None:
+        scores = scores + bias[None, None]
+    if causal:
+        cm = np.tril(np.ones((s, s), bool))
+        mask = mask & cm[None]
+    scores = jnp.where(mask[None], scores, -1e30)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_kernel_matches_dense(causal):
+    block, nb, heads, batch, d = 16, 4, 2, 2, 32
+    seq = block * nb
+    cfg = FixedSparsityConfig(num_heads=heads, block=block,
+                              num_local_blocks=2, num_global_blocks=1,
+                              attention="unidirectional" if causal
+                              else "bidirectional")
+    layout = cfg.make_layout(seq)
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(batch, heads, seq, d), jnp.float32)
+               for _ in range(3))
+    attn = make_block_sparse_attention(layout, block, causal=causal,
+                                       interpret=True)
+    out = attn(q, k, v)
+    ref = _dense_reference(q, k, v, layout, block, causal=causal)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_kernel_gradients_match_dense():
+    block, nb, heads, batch, d = 16, 4, 1, 1, 16
+    seq = block * nb
+    layout = BSLongformerSparsityConfig(
+        num_heads=heads, block=block,
+        num_sliding_window_blocks=3).make_layout(seq)
+    rng = np.random.RandomState(1)
+    q, k, v = (jnp.asarray(rng.randn(batch, heads, seq, d), jnp.float32)
+               for _ in range(3))
+    attn = make_block_sparse_attention(layout, block, interpret=True)
+
+    def loss_sparse(q, k, v):
+        return (attn(q, k, v) ** 2).sum()
+
+    def loss_dense(q, k, v):
+        return (_dense_reference(q, k, v, layout, block) ** 2).sum()
+
+    g_sparse = jax.grad(loss_sparse, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gs, gd in zip(g_sparse, g_dense):
+        np.testing.assert_allclose(gs, gd, atol=1e-4, rtol=1e-4)
+
+
+def test_kernel_causal_fully_masked_row():
+    # A q block whose only active k block sits strictly above the causal
+    # diagonal: every score is masked, output must be 0 with 0 gradients
+    # (not exp(NEG_INF - NEG_INF) = 1 garbage).
+    block, d = 16, 16
+    layout = np.array([[[0, 1], [1, 1]]])  # q block 0 sees only k block 1
+    rng = np.random.RandomState(5)
+    q, k, v = (jnp.asarray(rng.randn(1, 1, 32, d), jnp.float32)
+               for _ in range(3))
+    attn = make_block_sparse_attention(layout, block, causal=True,
+                                       interpret=True)
+    out = attn(q, k, v)
+    assert not np.isnan(np.asarray(out)).any()
+    np.testing.assert_allclose(out[0, 0, :block], 0.0, atol=1e-6)
+    grads = jax.grad(lambda *a: (attn(*a) ** 2).sum(), argnums=(0, 1, 2))(
+        q, k, v)
+    for g in grads:
+        assert not np.isnan(np.asarray(g)).any()
+    np.testing.assert_allclose(grads[0][0, 0, :block], 0.0, atol=1e-6)
+
+
+def test_kernel_with_masks():
+    block, nb, heads, batch, d = 16, 2, 1, 2, 16
+    seq = block * nb
+    layout = DenseSparsityConfig(num_heads=heads,
+                                 block=block).make_layout(seq)
+    rng = np.random.RandomState(2)
+    q, k, v = (jnp.asarray(rng.randn(batch, heads, seq, d), jnp.float32)
+               for _ in range(3))
+    kpm = jnp.asarray(rng.randn(batch, seq), jnp.float32)
+    bias = jnp.asarray(rng.randn(seq, seq), jnp.float32)
+    attn = make_block_sparse_attention(layout, block, has_kpm=True,
+                                       has_bias=True, interpret=True)
+    out = attn(q, k, v, kpm, bias)
+    ref = _dense_reference(q, k, v, layout, block, kpm=kpm, bias=bias)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+# --- module API -------------------------------------------------------------
+
+def test_sparse_self_attention_module():
+    heads, block, seq, d = 2, 16, 64, 16
+    cfg = FixedSparsityConfig(num_heads=heads, block=block,
+                              num_local_blocks=2)
+    module = SparseSelfAttention(cfg, max_seq_length=128, interpret=True)
+    rng = np.random.RandomState(3)
+    q, k, v = (jnp.asarray(rng.randn(1, heads, seq, d), jnp.float32)
+               for _ in range(3))
+    out = module(q, k, v)
+    assert out.shape == q.shape
+    layout = cfg.make_layout(seq)
+    ref = _dense_reference(q, k, v, layout, block)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_sparse_self_attention_mul_key_padding():
+    heads, block, seq, d = 1, 16, 32, 16
+    module = SparseSelfAttention(DenseSparsityConfig(num_heads=heads,
+                                                     block=block),
+                                 key_padding_mask_mode="mul",
+                                 max_seq_length=64, interpret=True)
+    rng = np.random.RandomState(4)
+    q, k, v = (jnp.asarray(rng.randn(2, heads, seq, d), jnp.float32)
+               for _ in range(3))
+    keep = jnp.asarray(rng.rand(2, seq) > 0.3, jnp.float32)
+    out = module(q, k, v, key_padding_mask=keep)
+    kpm_bias = jnp.where(keep != 0, 0.0, -1e30)
+    layout = np.ones((heads, seq // block, seq // block))
+    ref = _dense_reference(q, k, v, layout, block, kpm=kpm_bias)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+# --- utils ------------------------------------------------------------------
+
+def test_pad_to_block_size_roundtrip():
+    ids = jnp.arange(2 * 30).reshape(2, 30)
+    mask = jnp.ones((2, 30), jnp.int32)
+    pad_len, p_ids, p_mask, _, _, _ = SparseAttentionUtils.pad_to_block_size(
+        block_size=16, input_ids=ids, attention_mask=mask, pad_token_id=7)
+    assert pad_len == 2 and p_ids.shape == (2, 32)
+    assert (p_ids[:, 30:] == 7).all() and (p_mask[:, 30:] == 0).all()
+    out = SparseAttentionUtils.unpad_sequence_output(
+        pad_len, p_ids[:, :, None])
+    assert out.shape == (2, 30, 1)
+
+
+def test_extend_position_embedding():
+    w = jnp.arange(8.0).reshape(4, 2)
+    ext = SparseAttentionUtils.extend_position_embedding(w, 8)
+    assert ext.shape == (8, 2)
+    np.testing.assert_allclose(ext[4:], w)

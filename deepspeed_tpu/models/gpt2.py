@@ -38,6 +38,11 @@ class GPT2Config:
     remat: bool = True             # activation checkpointing per block
     use_flash_attention: bool = True
     dtype: object = jnp.float32    # param dtype at init (engine recasts)
+    # Sequence/context parallelism: "ring" | "ulysses" | None. When set,
+    # attention runs via shard_map over sp_mesh's ``sequence`` axis
+    # (parallel/ring_attention.py) so activations shard over sequence.
+    sequence_parallel: object = None
+    sp_mesh: object = None
 
     @property
     def d_head(self):
@@ -136,8 +141,18 @@ def _attention(x, block, config, rng, train):
     reshape = lambda t: t.reshape(b, s, h, dh)
     q, k, v = reshape(q), reshape(k), reshape(v)
 
-    from ..ops.transformer.attention import causal_attention
-    ctx = causal_attention(q, k, v, use_flash=config.use_flash_attention)
+    if config.sequence_parallel:
+        import functools
+        from ..parallel.ring_attention import sequence_parallel_attention
+        from ..ops.transformer.attention import causal_attention
+        attn_fn = functools.partial(causal_attention,
+                                    use_flash=config.use_flash_attention)
+        ctx = sequence_parallel_attention(q, k, v, config.sp_mesh,
+                                          impl=config.sequence_parallel,
+                                          attn_fn=attn_fn)
+    else:
+        from ..ops.transformer.attention import causal_attention
+        ctx = causal_attention(q, k, v, use_flash=config.use_flash_attention)
     ctx = ctx.reshape(b, s, d)
     out = ctx @ block["proj_kernel"].astype(x.dtype) + \
         block["proj_bias"].astype(x.dtype)

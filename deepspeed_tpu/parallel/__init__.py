@@ -1,3 +1,5 @@
 from .topology import (ProcessTopology, PipeDataParallelTopology,
                        PipeModelDataParallelTopology, MeshGrid, build_mesh,
-                       DATA_AXIS, MODEL_AXIS, PIPE_AXIS)
+                       DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQUENCE_AXIS)
+from .ring_attention import (ring_attention, ulysses_attention,
+                             sequence_parallel_attention)

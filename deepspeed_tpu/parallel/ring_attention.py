@@ -1,0 +1,211 @@
+"""Sequence/context parallelism: ring attention and all-to-all (Ulysses-
+style) attention over a mesh ``sequence`` axis.
+
+The reference's long-sequence story at this version is block-sparse
+attention only (deepspeed/ops/sparse_attention/, SURVEY §5) — there is no
+ring attention or context parallelism in it. On TPU, sequence parallelism is
+a first-class axis: activations are sharded over ``sequence`` and the
+attention exchange rides ICI via ``ppermute`` (ring) or ``all_to_all``
+(head/sequence transpose), exactly the collectives XLA schedules best.
+
+Two interchangeable strategies, both exact (not approximations):
+
+* ``ring_attention`` — K/V blocks rotate around the ring while each device
+  accumulates online-softmax partial results for its resident Q shard.
+  Communication per step is the K/V shard (2·S/n·D per head), fully
+  overlappable with the per-block attention matmuls. Memory is O(S/n) per
+  device, so sequence length scales linearly with the ring size.
+* ``ulysses_attention`` — all_to_all re-shards from sequence-sharded to
+  head-sharded, runs dense (flash) attention on full sequences for a subset
+  of heads, and all_to_alls back. Cheaper at moderate S (two collectives
+  total), requires heads % ring_size == 0.
+
+Both are differentiable: the forward is a ``lax.scan``/``all_to_all``
+composition whose transpose XLA derives (ppermute's transpose is the inverse
+permutation), with ``jax.checkpoint`` on the ring body so the backward
+recomputes per-step attention instead of storing n_steps of residuals.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .topology import DATA_AXIS, SEQUENCE_AXIS
+from ..ops.transformer.attention import NEG_INF
+
+
+def _chunk_attention(q, k, v, bias_mask, sm_scale, m, l, o):
+    """One online-softmax accumulation step.
+
+    q: [B, H, Sq, D]; k/v: [B, H, Sk, D]; bias_mask: broadcastable to
+    [B, H, Sq, Sk] boolean (True = attend); running stats m/l: [B, H, Sq, 1],
+    o: [B, H, Sq, D]. Returns updated (m, l, o).
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    s = jnp.where(bias_mask, s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    # Fully-masked rows: every score is NEG_INF, so exp(0)=1 would leak mass
+    # through padded/causally-hidden chunks — this `where` is the guard.
+    p = jnp.where(bias_mask, p, 0.0)
+    correction = jnp.exp(m - m_new)
+    l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+    o_new = o * correction + pv
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, axis_name=SEQUENCE_AXIS, causal=True,
+                   sm_scale=None):
+    """Exact ring attention. Call inside ``shard_map``/``pjit`` with the
+    sequence dimension mapped over ``axis_name``.
+
+    q/k/v: [batch, seq_local, heads, d_head] (the local sequence shard).
+    Returns [batch, seq_local, heads, d_head].
+
+    Equivalent communication structure to the reference's pipeline p2p ring
+    (deepspeed/runtime/pipe/p2p.py) but expressed as ``lax.ppermute`` inside
+    jit so XLA overlaps the K/V rotation with the attention matmuls.
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
+
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)  # [B,H,S,D]
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+
+    q_pos = idx * s_local + jnp.arange(s_local)
+
+    m0 = jnp.full((b, h, s_local, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_local, 1), jnp.float32)
+    o0 = jnp.zeros((b, h, s_local, d), jnp.float32)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def attend(step, m, l, o, k_cur, v_cur):
+        # After `step` rotations each device holds the shard originally
+        # resident `step` ranks behind it on the ring.
+        kv_idx = (idx - step) % n
+        k_pos = kv_idx * s_local + jnp.arange(s_local)
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+        else:
+            mask = jnp.ones((s_local, s_local), bool)
+        return _chunk_attention(qt, k_cur, v_cur, mask[None, None], scale,
+                                m, l, o)
+
+    def body(carry, step):
+        m, l, o, k_cur, v_cur = carry
+        m, l, o = attend(step, m, l, o, k_cur, v_cur)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (m, l, o, k_nxt, v_nxt), None
+
+    if n > 1:
+        body = jax.checkpoint(body, prevent_cse=False)
+        # n-1 rotated steps; the final resident chunk needs no rotation, so
+        # the ring carries exactly n-1 K/V hops (no dead trailing permute).
+        (m, l, o, k_last, v_last), _ = lax.scan(
+            body, (m0, l0, o0, kt, vt), jnp.arange(n - 1))
+    else:
+        m, l, o, k_last, v_last = m0, l0, o0, kt, vt
+    m, l, o = attend(n - 1, m, l, o, k_last, v_last)
+    out = o / jnp.maximum(l, 1e-30)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name=SEQUENCE_AXIS, causal=True,
+                      sm_scale=None, attn_fn=None):
+    """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism.
+
+    Re-shards [B, S/n, H, D] -> [B, S, H/n, D] with one ``all_to_all``,
+    runs dense attention over the full sequence for the local head subset
+    (``attn_fn``, e.g. the Pallas flash kernel via
+    ops.transformer.attention.causal_attention), and transposes back.
+    Requires heads % ring_size == 0.
+    """
+    n = lax.psum(1, axis_name)
+    b, s_local, h, d = q.shape
+    if h % n:
+        raise ValueError(
+            "ulysses attention needs heads ({}) divisible by the sequence "
+            "axis size ({})".format(h, n))
+
+    def fwd_a2a(x):   # [B, S/n, H, D] -> [B, S, H/n, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def bwd_a2a(x):   # [B, S, H/n, D] -> [B, S/n, H, D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = fwd_a2a(q), fwd_a2a(k), fwd_a2a(v)
+    if attn_fn is None:
+        attn_fn = functools.partial(_dense_attention, causal=causal,
+                                    sm_scale=sm_scale)
+    out = attn_fn(qh, kh, vh)
+    return bwd_a2a(out)
+
+
+def _dense_attention(q, k, v, causal=True, sm_scale=None):
+    """Plain jnp attention over [B, S, H, D]; the non-causal-capable twin of
+    ops.transformer.attention.reference_causal_attention (swap in the Pallas
+    flash kernel via attn_fn= for long S on real TPUs)."""
+    d = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        n = q.shape[1]
+        mask = jnp.tril(jnp.ones((n, n), bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(p.dtype),
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+# Back-compat alias used by tests as the numerics spec.
+_dense_reference_attention = _dense_attention
+
+
+def sequence_parallel_attention(q, k, v, mesh, impl="ring",
+                                axis_name=SEQUENCE_AXIS, causal=True,
+                                sm_scale=None, attn_fn=None):
+    """Top-level entry: q/k/v are global [B, S, H, D] arrays; shards the
+    sequence dim over ``axis_name`` of ``mesh`` and runs the chosen exact
+    sequence-parallel attention.
+
+    The batch dim stays sharded over ``data`` when the mesh carries that
+    axis, so DP×SP composes without an implicit batch all-gather."""
+    try:
+        from jax import shard_map
+    except ImportError:          # older jax
+        from jax.experimental.shard_map import shard_map
+
+    if impl == "ring":
+        fn = functools.partial(ring_attention, axis_name=axis_name,
+                               causal=causal, sm_scale=sm_scale)
+    elif impl in ("ulysses", "all_to_all", "alltoall"):
+        fn = functools.partial(ulysses_attention, axis_name=axis_name,
+                               causal=causal, sm_scale=sm_scale,
+                               attn_fn=attn_fn)
+    else:
+        raise ValueError("unknown sequence-parallel impl: %r" % (impl,))
+
+    batch_axis = DATA_AXIS if mesh.shape.get(DATA_AXIS, 1) > 1 else None
+    spec = P(batch_axis, axis_name, None, None)
+    kwargs = dict(mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    try:
+        sharded = shard_map(fn, check_vma=False, **kwargs)
+    except TypeError:            # older jax spells it check_rep
+        sharded = shard_map(fn, check_rep=False, **kwargs)
+    # jit so the eager path (e.g. under an outer jax.checkpoint, where
+    # remat-of-shard_map can't evaluate eagerly) always compiles; under an
+    # outer jit this inlines for free.
+    return jax.jit(sharded)(q, k, v)

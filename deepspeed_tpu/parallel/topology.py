@@ -15,6 +15,7 @@ import numpy as np
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 PIPE_AXIS = "pipe"
+SEQUENCE_AXIS = "sequence"
 
 
 def _prime_factors(N):
@@ -136,7 +137,8 @@ class PipeModelDataParallelTopology(ProcessTopology):
                          dims=[num_pp, num_dp, num_mp])
 
 
-def build_mesh(topology=None, data=None, model=None, pipe=None, devices=None):
+def build_mesh(topology=None, data=None, model=None, pipe=None, devices=None,
+               sequence=None):
     """Build a ``jax.sharding.Mesh`` realizing a named-axis topology.
 
     Axis order follows the topology (outermost first); on real hardware
@@ -151,7 +153,8 @@ def build_mesh(topology=None, data=None, model=None, pipe=None, devices=None):
         dims = [topology.get_dim(a) for a in axes]
     else:
         axes, dims = [], []
-        for name, size in ((PIPE_AXIS, pipe), (DATA_AXIS, data), (MODEL_AXIS, model)):
+        for name, size in ((PIPE_AXIS, pipe), (DATA_AXIS, data),
+                           (SEQUENCE_AXIS, sequence), (MODEL_AXIS, model)):
             if size is not None and size > 1:
                 axes.append(name)
                 dims.append(size)

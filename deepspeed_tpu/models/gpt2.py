@@ -141,17 +141,23 @@ def _attention(x, block, config, rng, train):
     reshape = lambda t: t.reshape(b, s, h, dh)
     q, k, v = reshape(q), reshape(k), reshape(v)
 
+    from ..ops.transformer.attention import (causal_attention,
+                                             causal_attention_fn)
     if config.sequence_parallel:
-        import functools
         from ..parallel.ring_attention import sequence_parallel_attention
-        from ..ops.transformer.attention import causal_attention
-        attn_fn = functools.partial(causal_attention,
-                                    use_flash=config.use_flash_attention)
-        ctx = sequence_parallel_attention(q, k, v, config.sp_mesh,
-                                          impl=config.sequence_parallel,
-                                          attn_fn=attn_fn)
+        if config.sp_mesh is None or not hasattr(config.sp_mesh, "shape"):
+            raise ValueError(
+                "GPT2Config.sequence_parallel={!r} requires sp_mesh to be "
+                "the engine's global jax.sharding.Mesh carrying a "
+                "'sequence' axis (e.g. build_mesh(data=2, sequence=4))"
+                .format(config.sequence_parallel))
+        # attn_fn feeds the ulysses impl's local kernel (flash-capable);
+        # the ring impl uses its own online-softmax accumulation and
+        # ignores it (use_flash_attention is a no-op under "ring").
+        ctx = sequence_parallel_attention(
+            q, k, v, config.sp_mesh, impl=config.sequence_parallel,
+            attn_fn=causal_attention_fn(config.use_flash_attention))
     else:
-        from ..ops.transformer.attention import causal_attention
         ctx = causal_attention(q, k, v, use_flash=config.use_flash_attention)
     ctx = ctx.reshape(b, s, d)
     out = ctx @ block["proj_kernel"].astype(x.dtype) + \

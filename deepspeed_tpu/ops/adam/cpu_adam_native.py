@@ -1,0 +1,95 @@
+"""JAX bridge to the native host SIMD Adam (csrc/cpu_adam.cpp).
+
+Reference parity: deepspeed/ops/adam/cpu_adam.py + csrc/adam/cpu_adam.cpp —
+the ZeRO-Offload optimizer step that runs on host cores while the
+accelerator holds only compute-dtype params. Under JAX the jitted train
+step reaches the host through ``jax.pure_callback``: the callback receives
+the fp32 master shard + grads as numpy arrays, runs the in-place C++ SIMD
+kernel, and returns the updated (p, m, v). XLA overlaps the per-leaf
+callbacks with whatever device work remains, which is this design's
+equivalent of the reference's overlapping H2D copy streams
+(cpu_adam.cpp:35-55).
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+_lib = None
+
+
+def _get_lib():
+    global _lib
+    if _lib is None:
+        from ..op_builder.cpu_adam import CPUAdamBuilder
+        _lib = CPUAdamBuilder().load()
+    return _lib
+
+
+def _ptr(a):
+    return a.ctypes.data
+
+
+def adam_step_host(p, g, m, v, lr, beta1, beta2, eps, weight_decay,
+                   bc1, bc2, adam_w_mode):
+    """In-place-style host step over contiguous fp32 numpy arrays.
+
+    Returns fresh (p, m, v) arrays (copies — pure_callback inputs must not
+    be mutated).
+    """
+    lib = _get_lib()
+    p = np.ascontiguousarray(p, dtype=np.float32).copy()
+    m = np.ascontiguousarray(m, dtype=np.float32).copy()
+    v = np.ascontiguousarray(v, dtype=np.float32).copy()
+    g = np.ascontiguousarray(g, dtype=np.float32)
+    lib.ds_cpu_adam_step(_ptr(p), _ptr(g), _ptr(m), _ptr(v), p.size,
+                         float(lr), float(beta1), float(beta2), float(eps),
+                         float(weight_decay), float(bc1), float(bc2),
+                         int(adam_w_mode))
+    return p, m, v
+
+
+def native_adam_update(grads, state, params, lr, beta1, beta2, eps,
+                       weight_decay, bias_correction=True, adam_w_mode=True):
+    """Drop-in for ops.adam.fused_adam.adam_update running the moment/param
+    math on host cores via the C++ kernel. Same state layout
+    ({step, exp_avg, exp_avg_sq}) and return signature."""
+    _get_lib()  # fail fast (caller falls back to the XLA path)
+
+    step = state["step"] + 1
+    stepf = step.astype(jnp.float32)
+    if bias_correction:
+        bc1 = 1.0 - jnp.power(beta1, stepf)
+        bc2 = 1.0 - jnp.power(beta2, stepf)
+    else:
+        bc1 = bc2 = jnp.float32(1.0)
+
+    wmode = 1 if adam_w_mode else 0
+
+    def callback(p, g, m, v, lr, b1, b2, eps_, wd, bc1_, bc2_):
+        return adam_step_host(p, g, m, v, lr, b1, b2, eps_, wd, bc1_, bc2_,
+                              wmode)
+
+    def leaf(p, g, m, v):
+        shapes = (
+            jax.ShapeDtypeStruct(p.shape, jnp.float32),
+            jax.ShapeDtypeStruct(m.shape, jnp.float32),
+            jax.ShapeDtypeStruct(v.shape, jnp.float32),
+        )
+        p32 = p.astype(jnp.float32)
+        g32 = g.astype(jnp.float32)
+        p_new, m_new, v_new = jax.pure_callback(
+            callback, shapes, p32, g32, m, v, lr, beta1, beta2, eps,
+            weight_decay, bc1, bc2, vmap_method="sequential")
+        return p_new.astype(p.dtype), m_new, v_new
+
+    flat_p, treedef = jax.tree_util.tree_flatten(params)
+    flat_g = treedef.flatten_up_to(grads)
+    flat_m = treedef.flatten_up_to(state["exp_avg"])
+    flat_v = treedef.flatten_up_to(state["exp_avg_sq"])
+    out = [leaf(p, g, m, v) for p, g, m, v in
+           zip(flat_p, flat_g, flat_m, flat_v)]
+    new_params = jax.tree_util.tree_unflatten(treedef, [o[0] for o in out])
+    new_m = jax.tree_util.tree_unflatten(treedef, [o[1] for o in out])
+    new_v = jax.tree_util.tree_unflatten(treedef, [o[2] for o in out])
+    return new_params, {"step": step, "exp_avg": new_m, "exp_avg_sq": new_v}

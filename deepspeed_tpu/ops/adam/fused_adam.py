@@ -147,8 +147,15 @@ class DeepSpeedCPUAdam(FusedAdam):
                     grads, state, params, lr, beta1, beta2, eps, weight_decay,
                     bias_correction=self.bias_correction,
                     adam_w_mode=self.adam_w_mode)
-            except Exception:
+            except Exception as e:
                 if self.use_native:
                     raise
+                if not getattr(self, "_warned_fallback", False):
+                    self._warned_fallback = True
+                    from ...utils.logging import logger
+                    logger.warning(
+                        "DeepSpeedCPUAdam: native host kernel unavailable "
+                        "(%s: %s); falling back to the XLA path",
+                        type(e).__name__, e)
         return super().update(grads, state, params, lr, beta1, beta2, eps,
                               weight_decay)

@@ -1,0 +1,23 @@
+"""Native-op registry (reference op_builder/__init__.py ALL_OPS :14-24).
+
+Pallas/XLA ops need no build step; this registry covers the host-side C++
+ops plus availability metadata for the Pallas kernels so ``ds_report`` can
+print one compatibility matrix for everything.
+"""
+from .builder import OpBuilder, cache_dir
+from .cpu_adam import CPUAdamBuilder
+
+ALL_OPS = {
+    CPUAdamBuilder.NAME: CPUAdamBuilder,
+}
+
+
+# Pallas/XLA ops: no build, availability = backend probe. Listed so the
+# env report mirrors the reference's full op table.
+PALLAS_OPS = {
+    "flash_attention": "deepspeed_tpu.ops.transformer.flash_attention",
+    "fused_adam": "deepspeed_tpu.ops.adam.pallas_adam",
+    "block_sparse_attention":
+        "deepspeed_tpu.ops.sparse_attention.block_sparse_attention",
+    "fused_ops": "deepspeed_tpu.ops.transformer.fused_ops",
+}

@@ -1,0 +1,123 @@
+"""JIT build system for native (C++) host ops.
+
+Reference parity: op_builder/builder.py (OpBuilder ABC :81 — sources(),
+include_paths(), is_compatible(), JIT compile on first .load()). The
+reference compiles CUDA extensions against torch; here ops are host-side
+C++ shared libraries (the TPU compute path is Pallas/XLA and needs no
+build step) compiled with the system toolchain and loaded through ctypes.
+Compatibility probing checks the host toolchain instead of CUDA archs.
+
+Build artifacts are content-hashed into ``~/.cache/deepspeed_tpu/`` (or
+``$DEEPSPEED_TPU_CACHE``) so rebuilds happen only when sources change —
+the reference's "JIT load" behavior (op_builder/builder.py:123+).
+"""
+import ctypes
+import hashlib
+import os
+import shutil
+import subprocess
+import threading
+
+from ...utils.logging import logger
+
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+CSRC_DIR = os.path.join(REPO_ROOT, "csrc")
+
+_build_lock = threading.Lock()
+
+
+def cache_dir():
+    base = os.environ.get("DEEPSPEED_TPU_CACHE")
+    if not base:
+        base = os.path.join(os.path.expanduser("~"), ".cache",
+                            "deepspeed_tpu")
+    os.makedirs(base, exist_ok=True)
+    return base
+
+
+class OpBuilder:
+    """One native op: named sources, compatibility probe, JIT build+load."""
+
+    NAME = None
+
+    def sources(self):
+        """Absolute paths of C++ sources."""
+        raise NotImplementedError
+
+    def include_paths(self):
+        return [os.path.join(CSRC_DIR, "includes")]
+
+    def extra_cflags(self):
+        flags = ["-O3", "-std=c++17", "-fPIC", "-shared", "-fopenmp"]
+        if self._supports_march_native():
+            flags.append("-march=native")
+        return flags
+
+    def compiler(self):
+        return os.environ.get("CXX", "g++")
+
+    def is_compatible(self):
+        """Whether this op can build/run here (reference is_compatible())."""
+        ok = shutil.which(self.compiler()) is not None
+        if not ok:
+            logger.warning("op %s: no C++ compiler found", self.NAME)
+        return ok and all(os.path.exists(s) for s in self.sources())
+
+    def _supports_march_native(self):
+        probe = getattr(OpBuilder, "_march_native_ok", None)
+        if probe is None:
+            probe = subprocess.run(
+                [self.compiler(), "-march=native", "-E", "-x", "c++",
+                 "-", "-o", os.devnull],
+                input=b"", stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL).returncode == 0
+            OpBuilder._march_native_ok = probe
+        return probe
+
+    def _hash(self):
+        h = hashlib.sha256()
+        for s in sorted(self.sources()):
+            with open(s, "rb") as f:
+                h.update(f.read())
+        h.update(" ".join(self.extra_cflags()).encode())
+        return h.hexdigest()[:16]
+
+    def so_path(self):
+        return os.path.join(cache_dir(),
+                            "{}_{}.so".format(self.NAME, self._hash()))
+
+    def build(self):
+        out = self.so_path()
+        if os.path.exists(out):
+            return out
+        with _build_lock:
+            if os.path.exists(out):
+                return out
+            cmd = [self.compiler()] + self.extra_cflags()
+            for inc in self.include_paths():
+                if os.path.isdir(inc):
+                    cmd += ["-I", inc]
+            # pid-unique tmp: _build_lock is per-process, so concurrent
+            # processes sharing the cache must not collide on one tmp path.
+            tmp = "{}.tmp.{}".format(out, os.getpid())
+            cmd += list(self.sources()) + ["-o", tmp]
+            logger.info("Building op %s: %s", self.NAME, " ".join(cmd))
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    "build of op {} failed:\n{}".format(self.NAME,
+                                                        proc.stderr))
+            os.replace(tmp, out)
+        return out
+
+    def load(self):
+        """Build if needed and return the loaded ctypes library."""
+        cached = getattr(self, "_lib", None)
+        if cached is not None:
+            return cached
+        if not self.is_compatible():
+            raise RuntimeError(
+                "op {} is not compatible on this host".format(self.NAME))
+        self._lib = ctypes.CDLL(self.build())
+        return self._lib

@@ -1,0 +1,32 @@
+"""Builder for the host SIMD Adam op (reference op_builder/cpu_adam.py)."""
+import ctypes
+import os
+
+from .builder import OpBuilder, CSRC_DIR
+
+
+class CPUAdamBuilder(OpBuilder):
+    NAME = "cpu_adam"
+
+    def sources(self):
+        return [os.path.join(CSRC_DIR, "cpu_adam.cpp")]
+
+    def load(self):
+        lib = super().load()
+        lib.ds_cpu_adam_step.restype = None
+        lib.ds_cpu_adam_step.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_int,
+        ]
+        lib.ds_cpu_adam_step_bf16_copy.restype = None
+        lib.ds_cpu_adam_step_bf16_copy.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_int,
+        ]
+        lib.ds_cpu_adam_num_threads.restype = ctypes.c_int
+        lib.ds_cpu_adam_num_threads.argtypes = []
+        return lib

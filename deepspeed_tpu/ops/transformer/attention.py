@@ -1,4 +1,5 @@
 """Attention dispatch: Pallas flash kernel on TPU, jnp reference elsewhere."""
+import functools as _functools
 import jax
 import jax.numpy as jnp
 
@@ -33,3 +34,11 @@ def causal_attention(q, k, v, use_flash=True, sm_scale=None, interpret=None):
                               512, interpret)
         return unfold(out)
     return reference_causal_attention(q, k, v, sm_scale)
+
+
+@_functools.lru_cache(maxsize=None)
+def causal_attention_fn(use_flash=True):
+    """Hashable, cached (q, k, v) -> ctx callable — the form
+    sequence_parallel_attention's jit cache needs (a fresh partial per call
+    would miss that cache every time)."""
+    return _functools.partial(causal_attention, use_flash=use_flash)

@@ -128,6 +128,10 @@ def ulysses_attention(q, k, v, axis_name=SEQUENCE_AXIS, causal=True,
     (``attn_fn``, e.g. the Pallas flash kernel via
     ops.transformer.attention.causal_attention), and transposes back.
     Requires heads % ring_size == 0.
+
+    When ``attn_fn`` is given it OWNS masking and scaling: ``causal`` and
+    ``sm_scale`` only configure the built-in dense fallback and are ignored
+    otherwise (pass a partial carrying your own settings).
     """
     n = lax.psum(1, axis_name)
     b, s_local, h, d = q.shape
@@ -174,15 +178,8 @@ def _dense_attention(q, k, v, causal=True, sm_scale=None):
 _dense_reference_attention = _dense_attention
 
 
-def sequence_parallel_attention(q, k, v, mesh, impl="ring",
-                                axis_name=SEQUENCE_AXIS, causal=True,
-                                sm_scale=None, attn_fn=None):
-    """Top-level entry: q/k/v are global [B, S, H, D] arrays; shards the
-    sequence dim over ``axis_name`` of ``mesh`` and runs the chosen exact
-    sequence-parallel attention.
-
-    The batch dim stays sharded over ``data`` when the mesh carries that
-    axis, so DP×SP composes without an implicit batch all-gather."""
+@functools.lru_cache(maxsize=64)
+def _make_sharded(mesh, impl, axis_name, causal, sm_scale, attn_fn):
     try:
         from jax import shard_map
     except ImportError:          # older jax
@@ -208,4 +205,22 @@ def sequence_parallel_attention(q, k, v, mesh, impl="ring",
     # jit so the eager path (e.g. under an outer jax.checkpoint, where
     # remat-of-shard_map can't evaluate eagerly) always compiles; under an
     # outer jit this inlines for free.
-    return jax.jit(sharded)(q, k, v)
+    return jax.jit(sharded)
+
+
+def sequence_parallel_attention(q, k, v, mesh, impl="ring",
+                                axis_name=SEQUENCE_AXIS, causal=True,
+                                sm_scale=None, attn_fn=None):
+    """Top-level entry: q/k/v are global [B, S, H, D] arrays; shards the
+    sequence dim over ``axis_name`` of ``mesh`` and runs the chosen exact
+    sequence-parallel attention.
+
+    The batch dim stays sharded over ``data`` when the mesh carries that
+    axis, so DP×SP composes without an implicit batch all-gather.
+    ``attn_fn`` applies to the ulysses impl only (the local dense kernel;
+    it owns masking/scaling — see :func:`ulysses_attention`). The jitted
+    wrapper is cached per (mesh, impl, options), so eager callers don't
+    re-trace per call; ``attn_fn`` must therefore be hashable (a named
+    function or functools.partial of one, not a fresh lambda per call)."""
+    return _make_sharded(mesh, impl, axis_name, causal, sm_scale,
+                         attn_fn)(q, k, v)

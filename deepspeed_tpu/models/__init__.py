@@ -1,0 +1,4 @@
+from . import gpt2
+from . import bert
+from .gpt2 import make_gpt2_model
+from .bert import make_bert_model, make_bert_squad_model

@@ -1,0 +1,263 @@
+"""BERT model family built on the fused DeepSpeedTransformerLayer.
+
+Reference parity: the DeepSpeedExamples BERT pretraining / BingBertSquad
+workloads (BASELINE config 3: BERT-large ZeRO-2 + FusedAdam/LAMB; reference
+tests/model/BingBertSquad) and the nvidia-bert integration the fused kernel
+was built for (docs/_posts/2020-05-28-fastest-bert-training.md). The encoder
+stack is a scan over DeepSpeedTransformerLayer params
+(ops/transformer/transformer.py), so the same fused layer the kernel tests
+cover is what the model trains with.
+
+Heads: masked-LM + next-sentence prediction (pretraining loss) and a SQuAD
+span head (``make_bert_squad_model``).
+"""
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.transformer.transformer import (DeepSpeedTransformerConfig,
+                                           init_transformer_params,
+                                           transformer_layer_forward)
+from ..ops.transformer.fused_ops import fused_layer_norm
+from ..parallel.topology import MODEL_AXIS
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30528        # 30522 padded to a multiple of 64
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    n_layers: int = 12
+    n_heads: int = 12
+    d_model: int = 768
+    d_intermediate: int = 3072
+    dropout: float = 0.1
+    attn_dropout: float = 0.1
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    pre_layer_norm: bool = True    # reference's deepspeed bert uses pre-LN
+    remat: bool = True
+    dtype: object = jnp.float32
+
+
+SIZES = {
+    "bert_base": dict(n_layers=12, n_heads=12, d_model=768,
+                      d_intermediate=3072),
+    "bert_large": dict(n_layers=24, n_heads=16, d_model=1024,
+                       d_intermediate=4096),
+}
+
+
+def config_for(name, **overrides):
+    base = dict(SIZES[name])
+    base.update(overrides)
+    return BertConfig(**base)
+
+
+def _layer_config(config):
+    return DeepSpeedTransformerConfig(
+        hidden_size=config.d_model,
+        intermediate_size=config.d_intermediate,
+        heads=config.n_heads,
+        attn_dropout_ratio=config.attn_dropout,
+        hidden_dropout_ratio=config.dropout,
+        num_hidden_layers=config.n_layers,
+        initializer_range=config.initializer_range,
+        layer_norm_eps=config.layer_norm_eps,
+        pre_layer_norm=config.pre_layer_norm,
+        fp16=config.dtype == jnp.bfloat16)
+
+
+def init_params(config, seed=0):
+    rng = np.random.RandomState(seed)
+    d, v = config.d_model, config.vocab_size
+    std = config.initializer_range
+    norm = lambda *shape, sd=std: jnp.asarray(rng.randn(*shape) * sd,
+                                              dtype=config.dtype)
+    zeros = lambda *shape: jnp.zeros(shape, dtype=config.dtype)
+    ones = lambda *shape: jnp.ones(shape, dtype=config.dtype)
+    layer_cfg = _layer_config(config)
+    layers = [init_transformer_params(layer_cfg, seed=seed + 1 + i)
+              for i in range(config.n_layers)]
+    # Stack per-layer params so the encoder is one lax.scan (static layer
+    # count, single compiled block body — the TPU-idiomatic deep stack).
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *layers)
+    return {
+        "embeddings": {
+            "word": norm(v, d),
+            "position": norm(config.max_seq_len, d),
+            "token_type": norm(config.type_vocab_size, d),
+            "ln_scale": ones(d),
+            "ln_bias": zeros(d),
+        },
+        "layers": stacked,
+        "pooler": {"kernel": norm(d, d), "bias": zeros(d)},
+        "mlm": {
+            "transform_kernel": norm(d, d),
+            "transform_bias": zeros(d),
+            "ln_scale": ones(d),
+            "ln_bias": zeros(d),
+            "output_bias": zeros(v),
+        },
+        "nsp": {"kernel": norm(d, 2), "bias": zeros(2)},
+    }
+
+
+def partition_spec_fn(path, shape):
+    """Megatron TP layout: QKV/intermediate column-parallel, output
+    projections row-parallel, vocab-parallel embedding."""
+    if path.endswith("word") or path.endswith("output_bias"):
+        return P(MODEL_AXIS, None) if len(shape) == 2 else P(MODEL_AXIS)
+    if "attn_qkvw" in path or "inter_w" in path:
+        return P(None, MODEL_AXIS)
+    if "attn_qkvb" in path or "inter_b" in path:
+        return P(MODEL_AXIS)
+    if "attn_ow" in path or "output_w" in path:
+        return P(MODEL_AXIS, None)
+    return None
+
+
+def encode(params, input_ids, token_type_ids=None, attention_mask=None,
+           config=None, rng=None, train=False):
+    """Embeddings + encoder stack -> (b, s, d) hidden states."""
+    emb = params["embeddings"]
+    b, s = input_ids.shape
+    x = emb["word"][input_ids]
+    x = x + emb["position"][None, :s]
+    if token_type_ids is None:
+        token_type_ids = jnp.zeros_like(input_ids)
+    x = x + emb["token_type"][token_type_ids]
+    x = fused_layer_norm(x, emb["ln_scale"], emb["ln_bias"],
+                         config.layer_norm_eps)
+    x = x.astype(config.dtype)
+
+    layer_cfg = _layer_config(config)
+    n = config.n_layers
+    keys = (jax.random.split(rng, n) if rng is not None
+            else jnp.zeros((n, 2), dtype=jnp.uint32))
+
+    def block(carry, layer):
+        layer_params, key = layer
+        layer_rng = key if rng is not None else None
+        out = transformer_layer_forward(layer_params, carry, attention_mask,
+                                        layer_cfg, layer_rng, train)
+        return out, None
+
+    body = jax.checkpoint(block) if config.remat else block
+    x, _ = jax.lax.scan(body, x, (params["layers"], keys))
+    return x
+
+
+def pool(params, hidden):
+    """[CLS] -> tanh dense (pooler)."""
+    first = hidden[:, 0]
+    return jnp.tanh(first @ params["pooler"]["kernel"]
+                    + params["pooler"]["bias"])
+
+
+def mlm_logits(params, hidden, config):
+    h = hidden @ params["mlm"]["transform_kernel"] + \
+        params["mlm"]["transform_bias"]
+    h = jax.nn.gelu(h, approximate=True)
+    h = fused_layer_norm(h, params["mlm"]["ln_scale"], params["mlm"]["ln_bias"],
+                         config.layer_norm_eps)
+    word = params["embeddings"]["word"].astype(h.dtype)
+    return h @ word.T + params["mlm"]["output_bias"].astype(h.dtype)
+
+
+def pretrain_loss(params, input_ids, token_type_ids, attention_mask,
+                  mlm_labels, nsp_labels, config, rng=None, train=True):
+    """Masked-LM CE (over -100-masked labels) + NSP CE."""
+    hidden = encode(params, input_ids, token_type_ids, attention_mask,
+                    config, rng, train)
+    logits = mlm_logits(params, hidden, config).astype(jnp.float32)
+    mask = (mlm_labels != -100).astype(jnp.float32)
+    safe = jnp.where(mlm_labels == -100, 0, mlm_labels)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    token_ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    mlm_loss = -(token_ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+    pooled = pool(params, hidden)
+    nsp = (pooled @ params["nsp"]["kernel"].astype(pooled.dtype)
+           + params["nsp"]["bias"].astype(pooled.dtype)).astype(jnp.float32)
+    nsp_ll = jnp.take_along_axis(jax.nn.log_softmax(nsp, axis=-1),
+                                 nsp_labels[:, None], axis=-1)[:, 0]
+    return mlm_loss - nsp_ll.mean()
+
+
+def squad_loss(params, input_ids, token_type_ids, attention_mask,
+               start_positions, end_positions, config, rng=None, train=True):
+    """SQuAD span-extraction loss (BingBertSquad e2e workload)."""
+    hidden = encode(params, input_ids, token_type_ids, attention_mask,
+                    config, rng, train)
+    logits = (hidden @ params["squad"]["kernel"].astype(hidden.dtype)
+              + params["squad"]["bias"].astype(hidden.dtype))
+    logits = logits.astype(jnp.float32)
+    start_logits, end_logits = logits[..., 0], logits[..., 1]
+
+    def ce(lg, pos):
+        ll = jnp.take_along_axis(jax.nn.log_softmax(lg, axis=-1),
+                                 pos[:, None], axis=-1)[:, 0]
+        return -ll.mean()
+
+    return 0.5 * (ce(start_logits, start_positions)
+                  + ce(end_logits, end_positions))
+
+
+def make_bert_model(config=None, size="bert_base", seed=0, **overrides):
+    """Pretraining (MLM+NSP) Model for the engine."""
+    from ..runtime.model import Model
+    if config is None:
+        config = config_for(size, **overrides)
+    params = init_params(config, seed=seed)
+
+    def apply_fn(params, input_ids, token_type_ids, attention_mask,
+                 mlm_labels, nsp_labels, rng=None, train=True):
+        return pretrain_loss(params, input_ids, token_type_ids,
+                             attention_mask, mlm_labels, nsp_labels, config,
+                             rng=rng, train=train)
+
+    model = Model(apply_fn, params, partition_spec_fn=partition_spec_fn,
+                  name="bert")
+    model.config = config
+    return model
+
+
+def make_bert_squad_model(config=None, size="bert_base", seed=0, **overrides):
+    """Span-extraction fine-tuning Model (BingBertSquad)."""
+    from ..runtime.model import Model
+    if config is None:
+        config = config_for(size, **overrides)
+    params = init_params(config, seed=seed)
+    rng = np.random.RandomState(seed + 977)
+    params["squad"] = {
+        "kernel": jnp.asarray(rng.randn(config.d_model, 2)
+                              * config.initializer_range, dtype=config.dtype),
+        "bias": jnp.zeros((2,), dtype=config.dtype),
+    }
+
+    def apply_fn(params, input_ids, token_type_ids, attention_mask,
+                 start_positions, end_positions, rng=None, train=True):
+        return squad_loss(params, input_ids, token_type_ids, attention_mask,
+                          start_positions, end_positions, config, rng=rng,
+                          train=train)
+
+    model = Model(apply_fn, params, partition_spec_fn=partition_spec_fn,
+                  name="bert_squad")
+    model.config = config
+    return model
+
+
+def num_params(config):
+    d, v, di = config.d_model, config.vocab_size, config.d_intermediate
+    per_layer = 4 * d * d + 2 * d * di + 9 * d + di
+    return (v * d + config.max_seq_len * d + config.type_vocab_size * d
+            + 2 * d + config.n_layers * per_layer
+            + (d * d + d)                       # pooler
+            + (d * d + d + 2 * d + v)           # mlm head
+            + (2 * d + 2))                      # nsp head

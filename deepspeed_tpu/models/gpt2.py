@@ -152,11 +152,14 @@ def _attention(x, block, config, rng, train):
                 "'sequence' axis (e.g. build_mesh(data=2, sequence=4))"
                 .format(config.sequence_parallel))
         # attn_fn feeds the ulysses impl's local kernel (flash-capable);
-        # the ring impl uses its own online-softmax accumulation and
-        # ignores it (use_flash_attention is a no-op under "ring").
+        # the ring impl uses its own online-softmax accumulation, so pass
+        # None there to keep _make_sharded's jit cache key stable across
+        # use_flash_attention values.
+        attn_fn = (causal_attention_fn(config.use_flash_attention)
+                   if config.sequence_parallel == "ulysses" else None)
         ctx = sequence_parallel_attention(
             q, k, v, config.sp_mesh, impl=config.sequence_parallel,
-            attn_fn=causal_attention_fn(config.use_flash_attention))
+            attn_fn=attn_fn)
     else:
         ctx = causal_attention(q, k, v, use_flash=config.use_flash_attention)
     ctx = ctx.reshape(b, s, d)

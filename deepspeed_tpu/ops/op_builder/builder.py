@@ -14,6 +14,7 @@ the reference's "JIT load" behavior (op_builder/builder.py:123+).
 import ctypes
 import hashlib
 import os
+import platform
 import shutil
 import subprocess
 import threading
@@ -40,6 +41,8 @@ class OpBuilder:
     """One native op: named sources, compatibility probe, JIT build+load."""
 
     NAME = None
+    _flag_probe_cache = {}
+    _compiler_id_cache = {}
 
     def sources(self):
         """Absolute paths of C++ sources."""
@@ -49,8 +52,10 @@ class OpBuilder:
         return [os.path.join(CSRC_DIR, "includes")]
 
     def extra_cflags(self):
-        flags = ["-O3", "-std=c++17", "-fPIC", "-shared", "-fopenmp"]
-        if self._supports_march_native():
+        flags = ["-O3", "-std=c++17", "-fPIC", "-shared"]
+        if self._supports_flag("-fopenmp"):
+            flags.append("-fopenmp")
+        if self._supports_flag("-march=native"):
             flags.append("-march=native")
         return flags
 
@@ -64,16 +69,28 @@ class OpBuilder:
             logger.warning("op %s: no C++ compiler found", self.NAME)
         return ok and all(os.path.exists(s) for s in self.sources())
 
-    def _supports_march_native(self):
-        probe = getattr(OpBuilder, "_march_native_ok", None)
-        if probe is None:
-            probe = subprocess.run(
-                [self.compiler(), "-march=native", "-E", "-x", "c++",
+    def _supports_flag(self, flag):
+        cache = OpBuilder._flag_probe_cache
+        key = (self.compiler(), flag)
+        if key not in cache:
+            cache[key] = subprocess.run(
+                [self.compiler(), flag, "-E", "-x", "c++",
                  "-", "-o", os.devnull],
                 input=b"", stdout=subprocess.DEVNULL,
                 stderr=subprocess.DEVNULL).returncode == 0
-            OpBuilder._march_native_ok = probe
-        return probe
+        return cache[key]
+
+    def _compiler_identity(self):
+        """Compiler version + target triple: -march=native resolves against
+        the build host, so a shared cache must key on both."""
+        cache = OpBuilder._compiler_id_cache
+        cc = self.compiler()
+        if cc not in cache:
+            out = subprocess.run([cc, "--version", "-dumpmachine"],
+                                 capture_output=True, text=True)
+            cache[cc] = out.stdout.strip() + platform.machine() + \
+                platform.node()
+        return cache[cc]
 
     def _hash(self):
         h = hashlib.sha256()
@@ -81,6 +98,7 @@ class OpBuilder:
             with open(s, "rb") as f:
                 h.update(f.read())
         h.update(" ".join(self.extra_cflags()).encode())
+        h.update(self._compiler_identity().encode())
         return h.hexdigest()[:16]
 
     def so_path(self):

@@ -1,0 +1,7 @@
+from .transformer import (DeepSpeedTransformerConfig,
+                          DeepSpeedTransformerLayer,
+                          init_transformer_params,
+                          transformer_layer_forward)
+from .attention import causal_attention, reference_causal_attention
+from .fused_ops import (fused_layer_norm, fused_bias_gelu,
+                        fused_bias_dropout_residual)

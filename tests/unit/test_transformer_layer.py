@@ -1,0 +1,182 @@
+"""Fused transformer layer + BERT model tests.
+
+Mirrors reference tests/unit/test_cuda_forward.py / test_cuda_backward.py:
+the fused layer is checked against a plain python/jnp BERT layer reference,
+and the BERT model trains end-to-end through the engine.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
+                                           DeepSpeedTransformerLayer)
+from deepspeed_tpu.ops.transformer.transformer import init_transformer_params
+from deepspeed_tpu.models import bert
+
+
+def small_config(**overrides):
+    kw = dict(batch_size=2, hidden_size=64, heads=4, intermediate_size=256,
+              attn_dropout_ratio=0.0, hidden_dropout_ratio=0.0,
+              num_hidden_layers=2, initializer_range=0.02, seed=7,
+              pre_layer_norm=True)
+    kw.update(overrides)
+    return DeepSpeedTransformerConfig(**kw)
+
+
+def reference_layer(params, x, mask, config):
+    """Unfused jnp encoder layer — the numerics spec (mirrors the python
+    BERT layer of reference test_cuda_forward.py)."""
+    def ln(t, w, b):
+        mu = t.mean(-1, keepdims=True)
+        var = ((t - mu) ** 2).mean(-1, keepdims=True)
+        return (t - mu) / jnp.sqrt(var + config.layer_norm_eps) * w + b
+
+    b_, s, d = x.shape
+    h = config.heads
+    attn_in = ln(x, params["attn_nw"], params["attn_nb"]) \
+        if config.pre_layer_norm else x
+    qkv = attn_in @ params["attn_qkvw"] + params["attn_qkvb"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    sh = lambda t: t.reshape(b_, s, h, d // h)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", sh(q), sh(k)) / np.sqrt(d // h)
+    if mask is not None:
+        keep = mask.astype(jnp.float32)
+        scores = scores + ((1.0 - keep) * -1e9)[:, None, None, :]
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, sh(v)).reshape(b_, s, d)
+    x = x + ctx @ params["attn_ow"] + params["attn_ob"]
+    if not config.pre_layer_norm:
+        x = ln(x, params["attn_nw"], params["attn_nb"])
+    ffn_in = ln(x, params["norm_w"], params["norm_b"]) \
+        if config.pre_layer_norm else x
+    inter = jax.nn.gelu(ffn_in @ params["inter_w"] + params["inter_b"],
+                        approximate=True)
+    x = x + inter @ params["output_w"] + params["output_b"]
+    if not config.pre_layer_norm:
+        x = ln(x, params["norm_w"], params["norm_b"])
+    return x
+
+
+@pytest.mark.parametrize("pre_ln", [True, False])
+def test_forward_matches_reference(pre_ln):
+    config = small_config(pre_layer_norm=pre_ln)
+    layer = DeepSpeedTransformerLayer(config)
+    params = layer.init_params()
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 16, 64),
+                    dtype=jnp.float32)
+    mask = jnp.asarray(np.random.RandomState(1).rand(2, 16) > 0.2,
+                       dtype=jnp.int32)
+    out = layer(params, x, mask, train=False)
+    ref = reference_layer(params, x, mask, config)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("flag", ["gelu_checkpoint", "attn_dropout_checkpoint",
+                                  "normalize_invertible"])
+def test_checkpoint_flags_preserve_grads(flag):
+    base = small_config()
+    opt = small_config(**{flag: True})
+    layer = DeepSpeedTransformerLayer(base)
+    params = layer.init_params()
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 8, 64),
+                    dtype=jnp.float32)
+
+    def loss(cfg):
+        lyr = DeepSpeedTransformerLayer(cfg)
+        return lambda p: (lyr(p, x, None, train=False) ** 2).mean()
+
+    g_base = jax.grad(loss(base))(params)
+    g_opt = jax.grad(loss(opt))(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                atol=1e-5), g_base, g_opt)
+
+
+def test_initial_weight_loading():
+    config = small_config()
+    d, di = config.hidden_size, config.intermediate_size
+    rs = np.random.RandomState(3)
+    # torch-layout (out, in) weights as module_inject hands them over
+    weights = [rs.randn(d, d) for _ in range(4)] + [rs.randn(d)] + \
+              [rs.randn(di, d), rs.randn(d, di)] + [rs.randn(d)]
+    biases = [rs.randn(d) for _ in range(5)] + [rs.randn(di)] + \
+             [rs.randn(d), rs.randn(d)]
+    layer = DeepSpeedTransformerLayer(config, initial_weights=weights,
+                                      initial_biases=biases)
+    params = layer.init_params()
+    np.testing.assert_allclose(np.asarray(params["attn_qkvw"][:, :d]),
+                               weights[0].T, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(params["inter_w"]), weights[5].T,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(params["attn_qkvb"][d:2 * d]),
+                               biases[1], atol=1e-6)
+
+
+def test_layer_id_assignment():
+    DeepSpeedTransformerLayer.layer_count = 0
+    config = small_config()
+    layers = [DeepSpeedTransformerLayer(config) for _ in range(3)]
+    assert [l.config.layer_id for l in layers] == [0, 1, 2]
+
+
+def _bert_batch(rs, config, batch=4, seq=32):
+    ids = rs.randint(0, config.vocab_size, size=(batch, seq))
+    types = rs.randint(0, 2, size=(batch, seq))
+    mask = np.ones((batch, seq), dtype=np.int32)
+    mlm_labels = np.where(rs.rand(batch, seq) < 0.15, ids, -100)
+    nsp = rs.randint(0, 2, size=(batch,))
+    return (jnp.asarray(ids), jnp.asarray(types), jnp.asarray(mask),
+            jnp.asarray(mlm_labels), jnp.asarray(nsp))
+
+
+def test_bert_pretrain_engine_convergence():
+    config_dict = {
+        "train_batch_size": 8,
+        "steps_per_print": 10,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+        "bf16": {"enabled": True},
+    }
+    model = bert.make_bert_model(size="bert_base", n_layers=2, d_model=64,
+                                 n_heads=4, d_intermediate=128,
+                                 vocab_size=128, max_seq_len=64,
+                                 dropout=0.0, attn_dropout=0.0)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config_params=config_dict)
+    rs = np.random.RandomState(0)
+    batch = _bert_batch(rs, model.config, batch=8)
+    losses = []
+    for _ in range(8):
+        loss = engine(*batch)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_bert_squad_loss_runs():
+    model = bert.make_bert_squad_model(size="bert_base", n_layers=2,
+                                       d_model=64, n_heads=4,
+                                       d_intermediate=128, vocab_size=128,
+                                       max_seq_len=64)
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, 128, size=(2, 32)))
+    types = jnp.zeros_like(ids)
+    mask = jnp.ones_like(ids)
+    start = jnp.asarray(rs.randint(0, 32, size=(2,)))
+    end = jnp.asarray(rs.randint(0, 32, size=(2,)))
+    loss = model.apply_fn(model.params, ids, types, mask, start, end,
+                          train=False)
+    assert np.isfinite(float(loss))
+
+
+def test_bert_num_params_matches():
+    config = bert.config_for("bert_base", vocab_size=128, max_seq_len=64,
+                             n_layers=2, d_model=64, n_heads=4,
+                             d_intermediate=128)
+    params = bert.init_params(config)
+    from deepspeed_tpu.runtime.utils import count_parameters
+    assert count_parameters(params) == bert.num_params(config)

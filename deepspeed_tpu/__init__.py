@@ -11,6 +11,7 @@ from .utils.distributed import init_distributed
 from .utils.logging import logger, log_dist
 from .runtime.config import DeepSpeedConfig, DeepSpeedConfigError
 from .runtime.activation_checkpointing import checkpointing
+from . import zero
 
 __git_hash__ = None
 __git_branch__ = None

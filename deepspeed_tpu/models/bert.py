@@ -110,15 +110,17 @@ def init_params(config, seed=0):
 
 def partition_spec_fn(path, shape):
     """Megatron TP layout: QKV/intermediate column-parallel, output
-    projections row-parallel, vocab-parallel embedding."""
+    projections row-parallel, vocab-parallel embedding. Encoder params are
+    stacked with a leading (n_layers,) dim (init_params), so layer specs
+    carry a leading None."""
     if path.endswith("word") or path.endswith("output_bias"):
         return P(MODEL_AXIS, None) if len(shape) == 2 else P(MODEL_AXIS)
     if "attn_qkvw" in path or "inter_w" in path:
-        return P(None, MODEL_AXIS)
+        return P(None, None, MODEL_AXIS)
     if "attn_qkvb" in path or "inter_b" in path:
-        return P(MODEL_AXIS)
+        return P(None, MODEL_AXIS)
     if "attn_ow" in path or "output_w" in path:
-        return P(MODEL_AXIS, None)
+        return P(None, MODEL_AXIS, None)
     return None
 
 

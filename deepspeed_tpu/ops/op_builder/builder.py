@@ -86,9 +86,12 @@ class OpBuilder:
         cache = OpBuilder._compiler_id_cache
         cc = self.compiler()
         if cc not in cache:
-            out = subprocess.run([cc, "--version", "-dumpmachine"],
-                                 capture_output=True, text=True)
-            cache[cc] = out.stdout.strip() + platform.machine() + \
+            probes = []
+            for flag in ("--version", "-dumpmachine"):
+                out = subprocess.run([cc, flag], capture_output=True,
+                                     text=True)
+                probes.append(out.stdout.strip())
+            cache[cc] = "\n".join(probes) + platform.machine() + \
                 platform.node()
         return cache[cc]
 

@@ -1,0 +1,2 @@
+from .compressed import (CompressedBackend, compressed_allreduce_local,
+                         pack_signs, unpack_signs)

@@ -1,0 +1,157 @@
+"""Compressed (1-bit) collectives: sign-pack allreduce with error feedback.
+
+Reference parity: deepspeed/runtime/comm/nccl.py:43-178 (NcclBackend.
+compressed_allreduce) and its MPI twin (comm/mpi.py). The reference's
+2-phase algorithm is kept exactly; the transport changes:
+
+  * cupy ``packbits`` -> a jnp bit-pack (uint8 dot with power-of-two
+    weights) that XLA vectorizes on-device;
+  * ``torch.distributed.all_to_all_single`` / ``all_gather`` ->
+    ``jax.lax.all_to_all`` / ``all_gather`` inside ``shard_map`` over the
+    ``data`` mesh axis, so the exchange rides ICI and XLA overlaps it;
+  * CUDA stream juggling disappears (XLA schedules).
+
+Phase 1 (worker): add worker error feedback, take one scale
+``||x||/sqrt(n)``, pack sign bits, update the worker error, all_to_all the
+sign chunks (+ all_gather scales).
+Phase 2 (server): each rank decompresses & averages its chunk across
+workers, adds server error feedback, re-compresses with a fresh scale,
+updates server error, all_gathers the result to everyone.
+
+Compression ratio is 32x on the wire minus two scalar scales per buffer —
+the reference's "6.6x end-to-end at 40 Gb Ethernet" regime corresponds to
+DCN-limited pods here.
+"""
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ...parallel.topology import DATA_AXIS
+
+_BIT_WEIGHTS = 2 ** np.arange(8, dtype=np.uint8)
+
+
+def pack_signs(x):
+    """Pack sign bits of ``x`` (size divisible by 8) into uint8, 8 lanes per
+    byte (cupy packbits equivalent, compression/cupy.py:20)."""
+    bits = (x >= 0).astype(jnp.uint8).reshape(-1, 8)
+    return (bits * jnp.asarray(_BIT_WEIGHTS)).sum(axis=-1).astype(jnp.uint8)
+
+
+def unpack_signs(packed, scale):
+    """uint8 bytes -> ±scale floats."""
+    bits = (packed[:, None] >> jnp.arange(8, dtype=jnp.uint8)) & 1
+    return scale * (2.0 * bits.astype(jnp.float32) - 1.0).reshape(-1)
+
+
+def _compress(x):
+    """One buffer -> (packed signs, scalar scale, error residual)."""
+    n = x.size
+    scale = jnp.linalg.norm(x) / jnp.sqrt(float(n))
+    packed = pack_signs(x)
+    decompressed = scale * jnp.where(x >= 0, 1.0, -1.0)
+    return packed, scale, x - decompressed
+
+
+def compressed_allreduce_local(x, worker_error, server_error, axis_name,
+                               world_size):
+    """The per-device body: call inside shard_map/pmap over ``axis_name``.
+
+    ``x``: this device's local buffer (flat fp32, size divisible by
+    8*world_size). Returns (averaged buffer, new worker_error, new
+    server_error) — errors have the same shapes as the inputs
+    (server_error is 1/world_size of the buffer).
+    """
+    n = x.size
+    chunk = n // world_size
+
+    # ---- phase 1: worker compression + exchange
+    corrected = x + worker_error
+    packed, scale, new_worker_error = _compress(corrected)
+    # rows: chunk destined to each server rank
+    packed_rows = packed.reshape(world_size, chunk // 8)
+    recv = jax.lax.all_to_all(packed_rows, axis_name, split_axis=0,
+                              concat_axis=0, tiled=False)
+    scales = jax.lax.all_gather(scale, axis_name)
+
+    # ---- phase 2: server decompress, average, re-compress, broadcast
+    # recv[w] = my chunk's sign bytes from worker w
+    per_worker = jax.vmap(unpack_signs)(recv, scales)      # (world, chunk)
+    server_chunk = per_worker.mean(axis=0) + server_error
+    server_packed, server_scale, new_server_error = _compress(server_chunk)
+
+    gathered = jax.lax.all_gather(server_packed, axis_name)  # (world, chunk/8)
+    gathered_scales = jax.lax.all_gather(server_scale, axis_name)
+    result = jax.vmap(unpack_signs)(gathered, gathered_scales).reshape(-1)
+    return result, new_worker_error, new_server_error
+
+
+class CompressedBackend:
+    """NcclBackend/MpiBackend equivalent over a JAX mesh.
+
+    ``compressed_allreduce(per_rank_values, worker_error, server_error)``
+    takes the *stacked* per-rank buffers — shape (world, n) sharded or
+    shardable over the ``data`` axis — and returns (averaged (world, n),
+    new worker errors, new server errors). Error state is carried by the
+    caller, as the reference keeps it on the optimizer (onebit/adam.py).
+    """
+
+    def __init__(self, mesh, axis=DATA_AXIS):
+        self.mesh = mesh
+        self.axis = axis
+        self.world_size = int(mesh.shape[axis])
+        self._jit_cache = {}  # per-instance: padded size -> jitted exchange
+
+    def padded_size(self, n):
+        mult = 8 * self.world_size
+        return ((n + mult - 1) // mult) * mult
+
+    def _build(self, n):
+        if n in self._jit_cache:
+            return self._jit_cache[n]
+        world = self.world_size
+        axis = self.axis
+
+        @jax.jit
+        def run(values, worker_error, server_error):
+            body = functools.partial(compressed_allreduce_local,
+                                     axis_name=axis, world_size=world)
+
+            # shard_map splits the leading (world,) dim: each device sees
+            # its own (1, n) row; drop/re-add the axis inside.
+            def per_device(v, we, se):
+                out, nwe, nse = body(v[0], we[0], se[0])
+                return out[None], nwe[None], nse[None]
+
+            sharded = shard_map(
+                per_device, mesh=self.mesh,
+                in_specs=(P(axis), P(axis), P(axis)),
+                out_specs=(P(axis), P(axis), P(axis)))
+            return sharded(values, worker_error, server_error)
+
+        self._jit_cache[n] = run
+        return run
+
+    def compressed_allreduce(self, values, worker_error=None,
+                             server_error=None):
+        world = self.world_size
+        n = values.shape[-1]
+        padded = self.padded_size(n)
+        if padded != n:
+            values = jnp.pad(values, ((0, 0), (0, padded - n)))
+        if worker_error is None:
+            worker_error = jnp.zeros((world, padded), dtype=jnp.float32)
+        if server_error is None:
+            server_error = jnp.zeros((world, padded // world),
+                                     dtype=jnp.float32)
+        out, we, se = self._build(padded)(values.astype(jnp.float32),
+                                          worker_error, server_error)
+        return out[:, :n], we, se

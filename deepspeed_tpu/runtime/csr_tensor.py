@@ -1,0 +1,74 @@
+"""CSR (compressed sparse row) gradient representation.
+
+Reference parity: deepspeed/runtime/csr_tensor.py (CSRTensor) + the sparse
+embedding-gradient allreduce in engine.py:1285-1341, which all_gathers CSR
+values/indices (with per-rank size equalization) instead of all-reducing a
+mostly-zero dense [vocab, hidden] gradient.
+
+TPU context: under GSPMD the embedding gradient's reduction is inserted by
+XLA, and the idiomatic bandwidth fix is vocab-sharding the embedding on the
+``model`` axis (models/gpt2.py partition_spec_fn) so no rank ever owns the
+dense [vocab, hidden] grad. The CSR form remains useful at the *host*
+boundary — sparse checkpoint deltas, grad inspection, CPU-offloaded
+embedding updates — and this class keeps the reference's exact API:
+``from_dense / to_dense / sparse_size / add``, plus ``all_gather_concat``
+reproducing the size-equalized gather semantics for host-side use.
+"""
+import numpy as np
+
+import jax.numpy as jnp
+
+
+class CSRTensor:
+    """Row-sparse matrix: only rows with any nonzero are stored."""
+
+    def __init__(self, indices, values, dense_size):
+        self.indices = jnp.asarray(indices, dtype=jnp.int32)
+        self.values = jnp.asarray(values)
+        self.dense_size = tuple(dense_size)
+
+    @staticmethod
+    def from_dense(dense):
+        """Keep rows with any nonzero entry (reference from_dense)."""
+        d = np.asarray(dense)
+        row_nnz = np.abs(d).sum(axis=tuple(range(1, d.ndim))) != 0
+        indices = np.nonzero(row_nnz)[0].astype(np.int32)
+        return CSRTensor(indices, d[indices], d.shape)
+
+    def to_dense(self):
+        dense = jnp.zeros(self.dense_size, dtype=self.values.dtype)
+        if self.indices.size == 0:
+            return dense
+        return dense.at[self.indices].set(self.values)
+
+    def sparse_size(self):
+        """(stored elements, total elements) — reference returns the ratio's
+        ingredients for logging."""
+        total = int(np.prod(self.dense_size))
+        stored = int(self.values.size)
+        return stored, total
+
+    def add(self, other):
+        """Elementwise add of two CSR tensors over the same dense shape."""
+        assert self.dense_size == other.dense_size
+        dense = self.to_dense() + other.to_dense()
+        return CSRTensor.from_dense(dense)
+
+    def __repr__(self):
+        stored, total = self.sparse_size()
+        return "CSRTensor(dense_size={}, stored={}/{})".format(
+            self.dense_size, stored, total)
+
+
+def all_gather_concat(csr_list):
+    """Combine per-rank CSR shards into the summed dense gradient —
+    the semantic result of the reference's sparse_allreduce_bucket
+    (engine.py:1309-1336: all_gather values+indices padded to the max
+    per-rank size, then scatter-add). Host-side equivalent for offloaded
+    embedding updates."""
+    assert csr_list
+    dense = csr_list[0].to_dense()
+    for csr in csr_list[1:]:
+        if csr.indices.size:
+            dense = dense.at[csr.indices].add(csr.values)
+    return dense

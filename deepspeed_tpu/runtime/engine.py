@@ -198,6 +198,12 @@ class DeepSpeedEngine:
             self.optimizer = SGD(**params)
         else:
             raise ValueError("Unknown optimizer: {}".format(name))
+        if self.zero_optimization() and \
+                not getattr(self.optimizer, "supports_zero", True):
+            # reference zero/utils.py is_zero_supported_optimizer
+            raise ValueError(
+                "{} is not compatible with ZeRO (zero_optimization.stage "
+                ">= 1)".format(type(self.optimizer).__name__))
         log_dist("Using DeepSpeed optimizer: {}".format(name), ranks=[0])
 
     def _configure_lr_scheduler(self, client_lr_scheduler):
@@ -245,10 +251,13 @@ class DeepSpeedEngine:
 
         opt_target = master if self.mixed_precision else compute_params
         opt_state = self.optimizer.init_state(opt_target)
-        # all per-param moments/buffers live with the master shards
+        # all per-param moments/buffers live with the master shards; state
+        # shapes may differ from param shapes (e.g. OnebitAdam's flat error
+        # buffers), so shardings come from each subtree's own leaves
         opt_state = {
             key: val if key == "step" else jax.tree_util.tree_map(
-                lambda m, s: jax.device_put(m, s), val, master_sh)
+                lambda m, s: jax.device_put(m, s), val,
+                plan.tree_shardings(val, "master"))
             for key, val in opt_state.items()
         }
         acc_grads = jax.tree_util.tree_map(
@@ -785,14 +794,14 @@ class DeepSpeedEngine:
                 lambda p: jnp.asarray(p, jnp.float32), self.state["params"])
 
         if load_optimizer_states and sd.get("optimizer") is not None:
-            master_sh = plan.tree_shardings(
-                self.get_master_params(), "master")
             opt = sd["optimizer"]
+            # shardings from each subtree's own leaf shapes (error buffers
+            # etc. are not param-shaped)
             self.state["opt"] = {
                 key: jnp.asarray(val) if key == "step" else
                 jax.tree_util.tree_map(
                     lambda x, s: jax.device_put(jnp.asarray(x, jnp.float32), s),
-                    val, master_sh)
+                    val, plan.tree_shardings(val, "master"))
                 for key, val in opt.items()
             }
 

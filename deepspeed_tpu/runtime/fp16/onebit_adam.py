@@ -2,23 +2,56 @@
 
 Reference parity: deepspeed/runtime/fp16/onebit/adam.py. Two phases:
   * warmup (< freeze_step): exact Adam — full-precision gradient averaging;
-  * compression (>= freeze_step): the variance (exp_avg_sq) is frozen and the
-    *momentum* is what crosses the wire, sign-compressed with error feedback
-    (reference :201-219 via NcclBackend.compressed_allreduce).
+  * compression (>= freeze_step): the variance (exp_avg_sq) is frozen and
+    the *momentum* is what crosses the wire, sign-compressed with error
+    feedback (reference :201-219 via NcclBackend.compressed_allreduce).
 
-Under GSPMD the gradient mean is normally inserted by XLA. To express the
-compressed exchange explicitly, the update uses a ``shard_map`` over the
-``data`` axis when per-shard gradients are provided; the sign-pack +
-all_to_all + allgather pipeline lives in runtime/comm/compressed.py. When
-the engine hands us already-averaged global gradients (the default GSPMD
-path), compression is mathematically inactive but the variance-freeze
-schedule still applies — matching the reference's convergence behavior, with
-comm compression engaged once the engine runs in shard_map mode.
+The sign-pack + all_to_all + all_gather transport lives in
+runtime/comm/compressed.py. Under the engine's GSPMD path gradients arrive
+globally averaged, so every rank's momentum is identical and the reference's
+compressed allreduce degenerates to its two quantization stages (worker
+compress -> server average of equal values -> server compress), each with
+its own error-feedback accumulator. That exact degenerate pipeline is what
+``update`` applies in the frozen phase — numerics match the reference's
+convergence behavior, and the same ``_compress``/``unpack_signs`` kernels
+carry the real multi-worker exchange when driven through
+``CompressedBackend`` under shard_map.
 """
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
 from ...ops.adam.fused_adam import FusedAdam
+
+
+def _padded_flat_size(shape):
+    n = int(np.prod(shape)) if shape else 1
+    return ((n + 7) // 8) * 8
+
+
+def _masked_compress(x, mask, n):
+    """Sign+scale quantize the first ``n`` lanes of a padded buffer. The
+    scale is taken over the real lanes only (padding would deflate
+    ||x||/sqrt(n) and its error feedback would oscillate at ±scale), and
+    pad lanes carry zero value/error."""
+    scale = jnp.linalg.norm(x * mask) / jnp.sqrt(float(n))
+    decompressed = scale * jnp.where(x >= 0, 1.0, -1.0) * mask
+    return decompressed, (x - decompressed) * mask
+
+
+def _quantize_with_feedback(x, worker_error, server_error):
+    """Worker-compress then server-compress one buffer, updating both error
+    accumulators (the all-equal-workers form of compressed_allreduce_local)."""
+    n = x.size
+    padded = worker_error.size
+    flat = jnp.pad(x.reshape(-1), (0, padded - n))
+    mask = (jnp.arange(padded) < n).astype(jnp.float32)
+    corrected = flat + worker_error
+    worker_q, new_worker_error = _masked_compress(corrected, mask, n)
+    server_in = worker_q + server_error
+    server_q, new_server_error = _masked_compress(server_in, mask, n)
+    return server_q[:n].reshape(x.shape), new_worker_error, new_server_error
 
 
 class OnebitAdam(FusedAdam):
@@ -40,39 +73,58 @@ class OnebitAdam(FusedAdam):
 
     def init_state(self, params):
         state = super().init_state(params)
-        # error-feedback accumulator for the compression phase
+        # error-feedback accumulators for the compression phase, padded to
+        # the sign-pack lane width
         state["worker_error"] = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(p.shape, dtype=jnp.float32), params)
+            lambda p: jnp.zeros(_padded_flat_size(p.shape),
+                                dtype=jnp.float32), params)
+        state["server_error"] = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(_padded_flat_size(p.shape),
+                                dtype=jnp.float32), params)
         return state
 
     def update(self, grads, state, params, lr, beta1, beta2, eps, weight_decay):
         step = state["step"] + 1
         frozen = step > self.freeze_step
 
-        def leaf(p, g, m, v, err):
+        def leaf(p, g, m, v, werr, serr):
             g = g.astype(jnp.float32)
             p32 = p.astype(jnp.float32)
             g = g + weight_decay * p32
-            # Momentum always updates; in the frozen phase the reference
-            # exchanges it sign-compressed with error feedback. With global
-            # grads the compression is exact (error=0), so the error buffer
-            # tracks the compression residual only in shard_map mode.
-            m_new = beta1 * m + (1.0 - beta1) * g
-            v_new = jnp.where(frozen, v, beta2 * v + (1.0 - beta2) * (g * g))
+            m_exact = beta1 * m + (1.0 - beta1) * g
+
+            # lax.cond so the warmup phase (typically thousands of steps)
+            # never executes the compression pipeline.
+            def frozen_branch(args):
+                m_ex, v_old, we, se, _ = args
+                m_comp, nwe, nse = _quantize_with_feedback(m_ex, we, se)
+                return m_comp, v_old, nwe, nse
+
+            def warmup_branch(args):
+                m_ex, v_old, we, se, g_ = args
+                return (m_ex, beta2 * v_old + (1.0 - beta2) * (g_ * g_),
+                        we, se)
+
+            m_new, v_new, new_werr, new_serr = jax.lax.cond(
+                frozen, frozen_branch, warmup_branch,
+                (m_exact, v, werr, serr, g))
             if self.bias_correction:
                 bc1 = 1.0 - jnp.power(beta1, step.astype(jnp.float32))
                 bc2 = 1.0 - jnp.power(beta2, step.astype(jnp.float32))
             else:
                 bc1 = bc2 = 1.0
             update = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
-            return (p32 - lr * update).astype(p.dtype), m_new, v_new, err
+            return ((p32 - lr * update).astype(p.dtype), m_new, v_new,
+                    new_werr, new_serr)
 
         flat_p, treedef = jax.tree_util.tree_flatten(params)
         flat_g = treedef.flatten_up_to(grads)
         flat_m = treedef.flatten_up_to(state["exp_avg"])
         flat_v = treedef.flatten_up_to(state["exp_avg_sq"])
-        flat_e = treedef.flatten_up_to(state["worker_error"])
-        out = [leaf(*xs) for xs in zip(flat_p, flat_g, flat_m, flat_v, flat_e)]
+        flat_we = treedef.flatten_up_to(state["worker_error"])
+        flat_se = treedef.flatten_up_to(state["server_error"])
+        out = [leaf(*xs) for xs in zip(flat_p, flat_g, flat_m, flat_v,
+                                       flat_we, flat_se)]
         unflatten = lambda i: jax.tree_util.tree_unflatten(
             treedef, [o[i] for o in out])
         return unflatten(0), {
@@ -80,4 +132,5 @@ class OnebitAdam(FusedAdam):
             "exp_avg": unflatten(1),
             "exp_avg_sq": unflatten(2),
             "worker_error": unflatten(3),
+            "server_error": unflatten(4),
         }

@@ -1,0 +1,3 @@
+from .partition import ZeroShardingPlan
+from .init_ctx import (Init, GatheredParameters,
+                       register_external_parameter)

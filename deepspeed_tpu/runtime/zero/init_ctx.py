@@ -1,0 +1,183 @@
+"""zero.Init / GatheredParameters: construct-time parameter partitioning.
+
+Reference parity: deepspeed/runtime/zero/partition_parameters.py — ``Init``
+(:226) monkey-patches ``nn.Module.__init__`` so every parameter is
+partitioned the moment it is created (1/N slice per rank, optionally on
+CPU), and ``GatheredParameters`` (:852) temporarily all-gathers full values
+for user access.
+
+TPU re-founding: a parameter is a ``jax.Array`` whose NamedSharding IS the
+partitioning, so "convert at construction" means device_put-ing each leaf
+with the stage-3 plan's sharding as the model object is built — host RAM
+briefly holds each full leaf (as the reference's CPU-side init does) but
+device HBM only ever holds the 1/N shard. The patch point is
+:class:`runtime.model.Model` (our nn.Module equivalent): inside ``with
+zero.Init(mesh=...)``, every Model constructed gets ``params`` sharded and
+tagged ``ds_sharded=True``. No ds_id/ds_status state machine survives —
+AVAILABLE/NOT_AVAILABLE/INFLIGHT (:110) was eager-mode bookkeeping; under
+jit, gather/release is XLA's schedule.
+
+``remote_device="cpu"`` keeps the shard on host memory (ZeRO-Offload
+params, reference :341-346) via jax.device_put to the host platform;
+``pin_memory`` is accepted for parity (host arrays are already DMA-able).
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...parallel.topology import DATA_AXIS, build_mesh
+from ...utils.logging import logger
+from .partition import ZeroShardingPlan
+
+
+def _threshold_from_config(ds_config):
+    if ds_config is None:
+        return 100000
+    if isinstance(ds_config, dict):
+        zero_cfg = ds_config.get("zero_optimization", {})
+        return zero_cfg.get("param_persistence_threshold", 100000)
+    return getattr(ds_config, "zero_param_persistence_threshold", 100000)
+
+
+class Init:
+    """Context manager: Models constructed inside get stage-3-sharded params.
+
+    ``with zero.Init(mesh=mesh): model = make_gpt2_model(...)`` — every
+    parameter leaf is placed with the ZeRO-3 plan's NamedSharding at
+    construction (reference partition_parameters.py:226's post-init hook).
+    """
+
+    _active = None
+
+    def __init__(self, module=None, data_parallel_group=None, mesh=None,
+                 mem_efficient_linear=True, remote_device=None,
+                 pin_memory=False, config=None, enabled=True, dtype=None,
+                 param_persistence_threshold=None):
+        self.enabled = enabled
+        self.mesh = mesh if mesh is not None else build_mesh()
+        self.remote_device = remote_device
+        self.pin_memory = pin_memory
+        self.dtype = dtype
+        threshold = (param_persistence_threshold
+                     if param_persistence_threshold is not None
+                     else _threshold_from_config(config))
+        self.plan = ZeroShardingPlan(self.mesh, stage=3,
+                                     param_persistence_threshold=threshold)
+        self._saved_init = None
+
+    # -- tree sharding -------------------------------------------------------
+    def shard_tree(self, tree, spec_fn=None):
+        """device_put every leaf with its stage-3 sharding. ``spec_fn``
+        optionally provides TP PartitionSpecs (Model.partition_spec_fn)."""
+        plan = self.plan
+        if spec_fn is not None:
+            plan = ZeroShardingPlan(self.mesh, stage=3,
+                                    param_persistence_threshold=plan.persist_threshold,
+                                    model_spec_fn=spec_fn)
+
+        def place(path, leaf):
+            arr = leaf
+            if self.dtype is not None and hasattr(arr, "astype"):
+                arr = arr.astype(self.dtype)
+            if self.remote_device == "cpu":
+                # ZeRO-Offload params: shard stays in host memory. The
+                # engine streams it to HBM per use (cpu_offload path).
+                cpus = jax.devices("cpu")
+                return jax.device_put(arr, cpus[0])
+            sharding = plan.param_sharding(path, np.shape(arr))
+            return jax.device_put(arr, sharding)
+
+        from .partition import _path_str
+        return jax.tree_util.tree_map_with_path(
+            lambda kp, leaf: place(_path_str(kp), leaf), tree)
+
+    # -- Model construction hook ---------------------------------------------
+    def __enter__(self):
+        if not self.enabled:
+            return self
+        from ..model import Model
+        Init._active = self
+        self._saved_init = Model.__init__
+        ctx = self
+
+        def patched_init(model_self, apply_fn, params, partition_spec_fn=None,
+                         name=None):
+            ctx._saved_init(model_self, apply_fn, params,
+                            partition_spec_fn=partition_spec_fn, name=name)
+            model_self.params = ctx.shard_tree(model_self.params,
+                                               spec_fn=partition_spec_fn)
+            model_self.ds_sharded = True
+
+        Model.__init__ = patched_init
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if not self.enabled:
+            return False
+        from ..model import Model
+        Model.__init__ = self._saved_init
+        Init._active = None
+        return False
+
+
+class GatheredParameters:
+    """Temporarily materialize full (replicated) parameter values.
+
+    Reference partition_parameters.py:852: inside the context every listed
+    param is all-gathered; if ``modifier_rank`` is set, rank's modifications
+    are re-partitioned + broadcast on exit. Here: ``with
+    GatheredParameters(model) as full:`` yields a mutable dict of full
+    numpy arrays; on exit (when ``modifier_rank`` is not None) the —
+    possibly modified — values are re-sharded back into ``model.params``.
+    Under SPMD every process runs the same modification, which subsumes the
+    reference's broadcast-from-modifier semantics.
+    """
+
+    def __init__(self, target, modifier_rank=None, fwd_module=None,
+                 enabled=True):
+        self.enabled = enabled
+        self.modifier_rank = modifier_rank
+        self._model = None
+        if hasattr(target, "params") and hasattr(target, "apply_fn"):
+            self._model = target
+            self.params = target.params
+        else:
+            self.params = target
+        self._full = None
+
+    def __enter__(self):
+        if not self.enabled:
+            return self.params
+        self._full = jax.tree_util.tree_map(
+            lambda leaf: np.array(leaf), self.params)  # writable copies
+        return self._full
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if not self.enabled or exc_type is not None:
+            return False
+        if self.modifier_rank is None:
+            return False
+        shardings = jax.tree_util.tree_map(
+            lambda leaf: getattr(leaf, "sharding", None), self.params)
+        resharded = jax.tree_util.tree_map(
+            lambda new, s: (jax.device_put(jnp.asarray(new), s)
+                            if s is not None else jnp.asarray(new)),
+            self._full, shardings)
+        if self._model is not None:
+            self._model.params = resharded
+        else:
+            # in-place dict update so callers holding the tree see it
+            if isinstance(self.params, dict):
+                self.params.clear()
+                self.params.update(resharded)
+        return False
+
+
+def register_external_parameter(module, parameter):
+    """API parity no-op (reference partition_parameters.py:45). The
+    reference needs explicit registration when a module uses another
+    module's weights so the coordinator knows to gather them; under XLA's
+    dataflow any leaf referenced by the traced apply_fn is gathered where
+    used — there is no hook machinery to inform."""
+    logger.debug("register_external_parameter: no-op under SPMD/XLA")

@@ -1,0 +1,8 @@
+"""deepspeed_tpu.zero: ZeRO public namespace (reference deepspeed/zero).
+
+``zero.Init`` partitions parameters at model construction;
+``zero.GatheredParameters`` temporarily materializes full values;
+``zero.ZeroShardingPlan`` is the GSPMD sharding plan behind the stages.
+"""
+from .runtime.zero import (Init, GatheredParameters,
+                           register_external_parameter, ZeroShardingPlan)

@@ -1,0 +1,43 @@
+"""CSRTensor tests (reference tests/unit/test_csr.py)."""
+import numpy as np
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.csr_tensor import CSRTensor, all_gather_concat
+
+
+def _sparse_dense(rs, rows=32, cols=8, active=5):
+    dense = np.zeros((rows, cols), dtype=np.float32)
+    idx = rs.choice(rows, size=active, replace=False)
+    dense[idx] = rs.randn(active, cols)
+    return dense
+
+
+def test_from_dense_roundtrip():
+    rs = np.random.RandomState(0)
+    dense = _sparse_dense(rs)
+    csr = CSRTensor.from_dense(dense)
+    stored, total = csr.sparse_size()
+    assert stored == 5 * 8 and total == 32 * 8
+    np.testing.assert_allclose(np.asarray(csr.to_dense()), dense)
+
+
+def test_empty():
+    csr = CSRTensor.from_dense(np.zeros((16, 4), dtype=np.float32))
+    assert csr.sparse_size()[0] == 0
+    np.testing.assert_allclose(np.asarray(csr.to_dense()), 0.0)
+
+
+def test_add():
+    rs = np.random.RandomState(1)
+    a, b = _sparse_dense(rs), _sparse_dense(rs)
+    out = CSRTensor.from_dense(a).add(CSRTensor.from_dense(b))
+    np.testing.assert_allclose(np.asarray(out.to_dense()), a + b, atol=1e-6)
+
+
+def test_all_gather_concat_sums_ranks():
+    rs = np.random.RandomState(2)
+    shards = [_sparse_dense(rs) for _ in range(4)]
+    csrs = [CSRTensor.from_dense(s) for s in shards]
+    out = all_gather_concat(csrs)
+    np.testing.assert_allclose(np.asarray(out), sum(shards), atol=1e-6)

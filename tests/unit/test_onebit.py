@@ -1,0 +1,138 @@
+"""Compressed (1-bit) collectives + 1-bit Adam tests.
+
+Mirrors reference tests/onebit/test_nccl_backend.py: the compressed
+allreduce is validated against the exact allreduce (error-feedback keeps the
+long-run average unbiased), and OnebitAdam trains end-to-end through the
+engine across its freeze_step boundary.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel.topology import build_mesh
+from deepspeed_tpu.runtime.comm import (CompressedBackend, pack_signs,
+                                        unpack_signs)
+from deepspeed_tpu.runtime.model import Model
+
+
+def test_pack_unpack_roundtrip():
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(256).astype(np.float32))
+    packed = pack_signs(x)
+    assert packed.dtype == jnp.uint8 and packed.size == 32
+    signs = unpack_signs(packed, jnp.float32(1.0))
+    np.testing.assert_array_equal(np.asarray(signs),
+                                  np.where(np.asarray(x) >= 0, 1.0, -1.0))
+
+
+def test_compressed_allreduce_single_shot_error_bounded():
+    mesh = build_mesh(data=8)
+    backend = CompressedBackend(mesh)
+    rs = np.random.RandomState(1)
+    values = jnp.asarray(rs.randn(8, 1024).astype(np.float32))
+    out, we, se = backend.compressed_allreduce(values)
+    true_mean = np.asarray(values).mean(axis=0)
+    # every rank gets the same result
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(out[3]),
+                               atol=1e-6)
+    # 1-bit quantization: correlation with the true mean, not equality
+    corr = np.corrcoef(np.asarray(out[0]), true_mean)[0, 1]
+    assert corr > 0.5, corr
+
+
+def test_error_feedback_makes_average_unbiased():
+    """sum of outputs telescopes: mean over T iterations -> true mean."""
+    mesh = build_mesh(data=8)
+    backend = CompressedBackend(mesh)
+    rs = np.random.RandomState(2)
+    values = jnp.asarray(rs.randn(8, 512).astype(np.float32))
+    true_mean = np.asarray(values).mean(axis=0)
+    we = se = None
+    acc = np.zeros(512, dtype=np.float64)
+    T = 200
+    for _ in range(T):
+        out, we, se = backend.compressed_allreduce(values, we, se)
+        acc += np.asarray(out[0], dtype=np.float64)
+    err = np.abs(acc / T - true_mean).mean() / np.abs(true_mean).mean()
+    assert err < 0.05, err
+
+
+def test_compressed_allreduce_padding():
+    mesh = build_mesh(data=8)
+    backend = CompressedBackend(mesh)
+    rs = np.random.RandomState(3)
+    n = 1000  # not divisible by 64
+    values = jnp.asarray(rs.randn(8, n).astype(np.float32))
+    out, we, se = backend.compressed_allreduce(values)
+    assert out.shape == (8, n)
+    assert we.shape[-1] == backend.padded_size(n)
+
+
+def test_onebit_adam_rejects_zero():
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "OneBitAdam", "params": {"lr": 1e-2}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+    }
+    with pytest.raises(ValueError, match="not compatible with ZeRO"):
+        deepspeed_tpu.initialize(
+            model=Model(lambda p, x, y: jnp.mean((x @ p["w"] - y) ** 2),
+                        {"w": jnp.zeros((16, 4))}),
+            config_params=config)
+
+
+def test_onebit_small_leaf_quantization_unbiased():
+    """Pad lanes must not deflate the scale for tiny leaves (size 2)."""
+    from deepspeed_tpu.runtime.fp16.onebit_adam import \
+        _quantize_with_feedback
+    x = jnp.asarray([0.5, -0.3], dtype=jnp.float32)
+    we = jnp.zeros(8, dtype=jnp.float32)
+    se = jnp.zeros(8, dtype=jnp.float32)
+    acc = np.zeros(2)
+    for _ in range(50):
+        out, we, se = _quantize_with_feedback(x, we, se)
+        acc += np.asarray(out)
+    avg = acc / 50
+    np.testing.assert_allclose(avg, [0.5, -0.3], atol=0.05)
+    # pad lanes of error feedback stay zero
+    np.testing.assert_array_equal(np.asarray(we[2:]), 0.0)
+
+
+def test_onebit_adam_through_engine():
+    rs = np.random.RandomState(0)
+    W_true = rs.randn(16, 4).astype(np.float32)
+
+    def apply_fn(params, x, y):
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    config = {
+        "train_batch_size": 32,
+        "steps_per_print": 100,
+        "optimizer": {"type": "OneBitAdam",
+                      "params": {"lr": 1e-2, "freeze_step": 10}},
+        "bf16": {"enabled": True},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=Model(apply_fn, {"w": jnp.zeros((16, 4))}),
+        config_params=config)
+    x = jnp.asarray(rs.randn(32, 16).astype(np.float32))
+    y = x @ jnp.asarray(W_true)
+    losses = []
+    for i in range(60):
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    # must keep converging after freeze_step (compression engaged at 10;
+    # 1-bit quantization error is large at 64 params, so the bar is steady
+    # descent, not rate — the reference only unit-tests the backend)
+    assert losses[-1] < 0.7 * losses[0], losses
+    assert losses[-1] < 0.8 * losses[12], losses
+    # error-feedback state is live once frozen
+    werr = jax.tree_util.tree_leaves(
+        engine.state["opt"]["worker_error"])[0]
+    assert float(jnp.abs(werr).sum()) > 0.0

@@ -173,6 +173,25 @@ def test_bert_squad_loss_runs():
     assert np.isfinite(float(loss))
 
 
+def test_bert_tp_partition_specs_place():
+    """Stacked (n_layers, ...) params must shard hidden dims, not the layer
+    dim, on the model axis."""
+    from deepspeed_tpu.parallel.topology import build_mesh
+    from deepspeed_tpu.runtime.zero.partition import ZeroShardingPlan
+    mesh = build_mesh(data=2, model=4)
+    config = bert.config_for("bert_base", vocab_size=128, max_seq_len=64,
+                             n_layers=2, d_model=64, n_heads=4,
+                             d_intermediate=128)
+    params = bert.init_params(config)
+    plan = ZeroShardingPlan(mesh, stage=0,
+                            model_spec_fn=bert.partition_spec_fn)
+    shardings = plan.tree_shardings(params, "param")
+    placed = jax.tree_util.tree_map(jax.device_put, params, shardings)
+    qkvw = placed["layers"]["attn_qkvw"]
+    assert qkvw.sharding.spec == jax.sharding.PartitionSpec(
+        None, None, "model")
+
+
 def test_bert_num_params_matches():
     config = bert.config_for("bert_base", vocab_size=128, max_seq_len=64,
                              n_layers=2, d_model=64, n_heads=4,

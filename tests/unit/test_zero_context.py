@@ -1,0 +1,92 @@
+"""zero.Init / GatheredParameters semantics (reference
+tests/unit/test_zero_context.py)."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel.topology import build_mesh
+from deepspeed_tpu.runtime.model import Model
+
+
+def _apply(params, x, y):
+    return jnp.mean((x @ params["w"] - y) ** 2)
+
+
+def test_init_shards_params_at_construction():
+    mesh = build_mesh(data=8)
+    with deepspeed_tpu.zero.Init(mesh=mesh, param_persistence_threshold=64):
+        model = Model(_apply, {"w": jnp.zeros((128, 16)),
+                               "b": jnp.zeros((4,))})
+    assert getattr(model, "ds_sharded", False)
+    w_spec = model.params["w"].sharding.spec
+    assert "data" in str(w_spec)
+    # small param below persistence threshold stays replicated
+    b_spec = model.params["b"].sharding.spec
+    assert "data" not in str(b_spec)
+
+
+def test_init_restores_model_ctor():
+    mesh = build_mesh(data=8)
+    with deepspeed_tpu.zero.Init(mesh=mesh):
+        pass
+    model = Model(_apply, {"w": jnp.zeros((16, 4))})
+    assert not getattr(model, "ds_sharded", False)
+
+
+def test_init_disabled_is_noop():
+    mesh = build_mesh(data=8)
+    with deepspeed_tpu.zero.Init(mesh=mesh, enabled=False):
+        model = Model(_apply, {"w": jnp.zeros((128, 16))})
+    assert not getattr(model, "ds_sharded", False)
+
+
+def test_gathered_parameters_read_and_modify():
+    mesh = build_mesh(data=8)
+    with deepspeed_tpu.zero.Init(mesh=mesh, param_persistence_threshold=0):
+        model = Model(_apply, {"w": jnp.ones((64, 8))})
+    with deepspeed_tpu.zero.GatheredParameters(model, modifier_rank=0) as full:
+        np.testing.assert_allclose(full["w"], np.ones((64, 8)))
+        full["w"][:] = 7.0
+    # modification written back, sharding preserved
+    assert float(model.params["w"][0, 0]) == 7.0
+    assert "data" in str(model.params["w"].sharding.spec)
+
+
+def test_gathered_parameters_no_modifier_discards():
+    mesh = build_mesh(data=8)
+    with deepspeed_tpu.zero.Init(mesh=mesh, param_persistence_threshold=0):
+        model = Model(_apply, {"w": jnp.ones((64, 8))})
+    with deepspeed_tpu.zero.GatheredParameters(model) as full:
+        full["w"][:] = 3.0
+    assert float(model.params["w"][0, 0]) == 1.0
+
+
+def test_init_model_trains_through_engine():
+    mesh = build_mesh(data=8)
+    with deepspeed_tpu.zero.Init(mesh=mesh, param_persistence_threshold=0):
+        model = Model(_apply, {"w": jnp.zeros((32, 8))})
+    config = {
+        "train_batch_size": 16,
+        "optimizer": {"type": "Adam", "params": {"lr": 5e-2}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 3},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                               config_params=config)
+    rs = np.random.RandomState(0)
+    W = rs.randn(32, 8).astype(np.float32)
+    x = jnp.asarray(rs.randn(16, 32).astype(np.float32))
+    y = x @ jnp.asarray(W)
+    losses = []
+    for _ in range(60):
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < 0.2 * losses[0], losses
+
+
+def test_register_external_parameter_noop():
+    deepspeed_tpu.zero.register_external_parameter(object(), object())

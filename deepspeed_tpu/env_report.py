@@ -1,0 +1,110 @@
+"""``ds_report``: environment / op compatibility report.
+
+Reference parity: deepspeed/env_report.py (main :~30-109) — prints the
+op install/compatibility matrix and framework versions. The CUDA columns
+become TPU platform columns: JAX/jaxlib versions, default backend, device
+inventory and (on TPU) the chip generation, plus the native-op build cache
+state.
+"""
+import importlib
+import os
+import sys
+
+from .ops.op_builder import ALL_OPS, PALLAS_OPS, cache_dir
+from .version import __version__
+
+GREEN = "\033[92m"
+RED = "\033[91m"
+YELLOW = "\033[93m"
+END = "\033[0m"
+OKAY = "{}[OKAY]{}".format(GREEN, END)
+NO = "{}[NO]{}".format(RED, END)
+WARNING = "{}[WARNING]{}".format(YELLOW, END)
+
+COLUMNS = ["op name", "installed", "compatible"]
+
+
+def op_report(out=sys.stdout):
+    max_dots = 23
+
+    print("-" * 64, file=out)
+    print("DeepSpeed-TPU C++/native op report", file=out)
+    print("-" * 64, file=out)
+    print("{}\n{}\n{}".format("JIT-compiled ops require a C++ compiler; "
+                              "builds are cached in",
+                              cache_dir(), "-" * 64), file=out)
+    print("{:<24}{:<16}{}".format(*COLUMNS), file=out)
+    for name, builder_cls in ALL_OPS.items():
+        builder = builder_cls()
+        compatible = builder.is_compatible()
+        installed = os.path.exists(builder.so_path()) if compatible else False
+        dots = "." * (max_dots - len(name))
+        print("{}{} {:<16}{}".format(
+            name, dots, OKAY if installed else NO,
+            OKAY if compatible else NO), file=out)
+
+    print("-" * 64, file=out)
+    print("Pallas/XLA ops (no build step; availability = import probe)",
+          file=out)
+    for name, module in PALLAS_OPS.items():
+        try:
+            importlib.import_module(module)
+            status = OKAY
+        except Exception:  # noqa: BLE001 - report, don't crash
+            status = NO
+        dots = "." * (max_dots - len(name))
+        print("{}{} {}".format(name, dots, status), file=out)
+    return out
+
+
+def platform_report(out=sys.stdout):
+    print("-" * 64, file=out)
+    print("DeepSpeed-TPU general environment info:", file=out)
+    print("-" * 64, file=out)
+    print("deepspeed_tpu install path ... {}".format(
+        os.path.dirname(os.path.abspath(__file__))), file=out)
+    print("deepspeed_tpu version ........ {}".format(__version__), file=out)
+    try:
+        import jax
+        import jaxlib
+        print("jax version .................. {}".format(jax.__version__),
+              file=out)
+        print("jaxlib version ............... {}".format(
+            jaxlib.__version__), file=out)
+        try:
+            backend = jax.default_backend()
+            print("default backend .............. {}".format(backend),
+                  file=out)
+            devices = jax.devices()
+            print("device count ................. {}".format(len(devices)),
+                  file=out)
+            if devices:
+                d = devices[0]
+                kind = getattr(d, "device_kind", "unknown")
+                print("device kind .................. {}".format(kind),
+                      file=out)
+                coords = getattr(d, "coords", None)
+                if coords is not None:
+                    print("ICI coords (device 0) ........ {}".format(coords),
+                          file=out)
+            print("process count ................ {}".format(
+                jax.process_count()), file=out)
+        except Exception as err:  # noqa: BLE001 - plugin/backend probing
+            print("backend ...................... NOT AVAILABLE ({})".format(
+                str(err).splitlines()[0]), file=out)
+    except Exception as err:  # noqa: BLE001
+        print("jax ........................... NOT AVAILABLE ({})".format(
+            err), file=out)
+    return out
+
+
+def main(out=sys.stdout):
+    op_report(out)
+    platform_report(out)
+    return 0
+
+
+cli_main = main
+
+if __name__ == "__main__":
+    sys.exit(main())

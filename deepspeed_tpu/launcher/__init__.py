@@ -1,0 +1,2 @@
+from .runner import (fetch_hostfile, parse_inclusion_exclusion,
+                     encode_world_info, decode_world_info)

@@ -1,0 +1,19 @@
+"""Launcher constants (reference deepspeed/launcher/constants.py)."""
+
+PDSH_LAUNCHER = "pdsh"
+PDSH_MAX_FAN_OUT = 1024
+
+OPENMPI_LAUNCHER = "openmpi"
+MVAPICH_LAUNCHER = "mvapich"
+MVAPICH_TMP_HOSTFILE = "/tmp/deepspeed_tpu_mvapich_hostfile"
+
+GCLOUD_LAUNCHER = "gcloud"  # TPU-pod ssh fanout via gcloud compute tpus
+
+DEFAULT_HOSTFILE = "/job/hostfile"
+DEFAULT_MASTER_PORT = 29500
+
+# Env prefixes forwarded to workers (reference runner.py:27-29 exports
+# NCCL*/PYTHON*/MV2*/UCX*; the TPU transport surface is JAX/XLA/TPU/LIBTPU)
+EXPORT_ENVS = ["JAX", "XLA", "TPU", "LIBTPU", "PYTHON", "MV2", "UCX"]
+DEEPSPEED_ENVIRONMENT_NAME = ".deepspeed_env"
+DEEPSPEED_ENVIRONMENT_PATHS = [".", "~"]

@@ -1,0 +1,77 @@
+"""Per-node process spawner (reference deepspeed/launcher/launch.py:67).
+
+The reference forks one subprocess per local GPU rank with
+CUDA_VISIBLE_DEVICES/RANK/LOCAL_RANK env. On TPU, JAX owns every local chip
+from a single process, so this spawner forks ONE worker per host; RANK is
+the node rank and WORLD_SIZE the host count (what
+``jax.distributed.initialize`` wants). ``DS_TPU_SLOTS`` forwards the
+hostfile's slot count for mesh sizing. Failure semantics are kept: if the
+child exits non-zero, the spawner kills the whole process group and exits
+with the child's code (reference :131-167).
+"""
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+from ..utils.logging import logger
+from .runner import decode_world_info
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--world_info", type=str, required=True)
+    parser.add_argument("--node_rank", type=str, default="0")
+    parser.add_argument("--master_addr", type=str, default="127.0.0.1")
+    parser.add_argument("--master_port", type=str, default="29500")
+    parser.add_argument("training_script", type=str)
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def build_env(args, world_info):
+    hosts = list(world_info.keys())
+    node_rank = int(args.node_rank.replace("%n", "0"))
+    env = os.environ.copy()
+    env["MASTER_ADDR"] = args.master_addr
+    env["MASTER_PORT"] = str(args.master_port)
+    env["WORLD_SIZE"] = str(len(hosts))
+    env["RANK"] = str(node_rank)
+    env["LOCAL_RANK"] = "0"
+    env["CROSS_RANK"] = str(node_rank)
+    env["CROSS_SIZE"] = str(len(hosts))
+    host = hosts[node_rank] if node_rank < len(hosts) else hosts[0]
+    env["DS_TPU_SLOTS"] = str(len(world_info[host]))
+    return env
+
+
+def main(args=None):
+    args = parse_args(args)
+    world_info = decode_world_info(args.world_info)
+    env = build_env(args, world_info)
+
+    cmd = [sys.executable, "-u", args.training_script] + \
+        args.training_script_args
+    logger.info("launch: rank={} world={} cmd={}".format(
+        env["RANK"], env["WORLD_SIZE"], cmd))
+
+    process = subprocess.Popen(cmd, env=env)
+
+    def sig_handler(signum, frame):
+        process.terminate()
+        sys.exit(1)
+
+    signal.signal(signal.SIGINT, sig_handler)
+    signal.signal(signal.SIGTERM, sig_handler)
+
+    process.wait()
+    if process.returncode != 0:
+        logger.error("worker exited with code {}".format(
+            process.returncode))
+        sys.exit(process.returncode)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

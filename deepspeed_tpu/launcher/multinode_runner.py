@@ -1,0 +1,128 @@
+"""Multi-node runner backends (reference launcher/multinode_runner.py).
+
+Each runner turns (args, world_info, resources) into a fan-out command that
+starts ``deepspeed_tpu.launcher.launch`` once per host. Environment
+propagation follows the reference (:27-29 + .deepspeed_env files) with the
+TPU transport prefixes (JAX*/XLA*/TPU*/LIBTPU*) in place of NCCL*/MV2*.
+"""
+import os
+import shutil
+import sys
+from abc import ABC, abstractmethod
+from shlex import quote
+
+from .constants import (DEEPSPEED_ENVIRONMENT_NAME,
+                        DEEPSPEED_ENVIRONMENT_PATHS, EXPORT_ENVS,
+                        PDSH_MAX_FAN_OUT, MVAPICH_TMP_HOSTFILE)
+
+
+class MultiNodeRunner(ABC):
+    def __init__(self, args, world_info_base64, resource_pool):
+        self.args = args
+        self.user_arguments = list(args.user_args)
+        self.user_script = args.user_script
+        self.world_info_base64 = world_info_base64
+        self.resource_pool = resource_pool
+        self.env = os.environ.copy()
+        self.exports = {}
+
+    @abstractmethod
+    def backend_exists(self):
+        ...
+
+    @abstractmethod
+    def get_cmd(self, environment, active_resources):
+        ...
+
+    def add_export(self, key, var):
+        self.exports[key.strip()] = var.strip()
+
+    def export_envs(self):
+        """Collect env to forward: prefix-matched vars + .deepspeed_env."""
+        for var, val in self.env.items():
+            if any(var.startswith(p) for p in EXPORT_ENVS):
+                self.add_export(var, val)
+        for path in DEEPSPEED_ENVIRONMENT_PATHS:
+            env_file = os.path.join(os.path.expanduser(path),
+                                    DEEPSPEED_ENVIRONMENT_NAME)
+            if os.path.isfile(env_file):
+                with open(env_file, "r") as fd:
+                    for line in fd.readlines():
+                        line = line.strip()
+                        if not line or "=" not in line:
+                            continue
+                        key, val = line.split("=", 1)
+                        self.add_export(key, val)
+        return self.exports
+
+    @property
+    def name(self):
+        return self.__class__.__name__.lower().replace("runner", "")
+
+
+class PDSHRunner(MultiNodeRunner):
+    """pdsh fanout: one launch.py per host (reference PDSHRunner)."""
+
+    def backend_exists(self):
+        return shutil.which("pdsh") is not None
+
+    def get_cmd(self, environment, active_resources):
+        environment["PDSH_RCMD_TYPE"] = "ssh"
+        active_workers = ",".join(active_resources.keys())
+
+        exports = ""
+        for key, val in self.exports.items():
+            exports += "export {}={}; ".format(key, quote(val))
+
+        deepspeed_launch = [
+            exports, "cd {};".format(os.path.abspath(".")),
+            sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+            "--world_info={}".format(self.world_info_base64),
+            "--node_rank=%n",
+            "--master_addr={}".format(self.args.master_addr),
+            "--master_port={}".format(self.args.master_port),
+        ]
+        return ["pdsh", "-f", str(PDSH_MAX_FAN_OUT), "-w",
+                active_workers] + deepspeed_launch + [self.user_script] + \
+            [quote(a) for a in self.user_arguments]
+
+
+class OpenMPIRunner(MultiNodeRunner):
+    """mpirun fanout, one rank per host (reference OpenMPIRunner)."""
+
+    def backend_exists(self):
+        return shutil.which("mpirun") is not None
+
+    def get_cmd(self, environment, active_resources):
+        total_procs = len(self.resource_pool)
+        mpirun_cmd = ["mpirun", "-n", str(total_procs), "-hostfile",
+                      self.args.hostfile, "--mca", "btl", "^openib",
+                      "--mca", "btl_tcp_if_include", "eth0"]
+        export_cmd = []
+        for key, val in self.exports.items():
+            export_cmd += ["-x", "{}={}".format(key, quote(val))]
+        python_exec = [sys.executable, "-u"]
+        return mpirun_cmd + export_cmd + python_exec + \
+            [self.user_script] + [quote(a) for a in self.user_arguments]
+
+
+class MVAPICHRunner(MultiNodeRunner):
+    """mpirun (MVAPICH2) fanout (reference MVAPICHRunner)."""
+
+    def backend_exists(self):
+        return shutil.which("mpirun") is not None and \
+            shutil.which("mpiname") is not None
+
+    def get_cmd(self, environment, active_resources):
+        with open(MVAPICH_TMP_HOSTFILE, "w") as fd:
+            for host in self.resource_pool.keys():
+                fd.write("{}\n".format(host.split()[0]))
+        total_procs = len(self.resource_pool)
+        mpirun_cmd = ["mpirun", "-np", str(total_procs), "--hostfile",
+                      MVAPICH_TMP_HOSTFILE]
+        export_cmd = []
+        for key, val in self.exports.items():
+            export_cmd += ["-env", "{}={}".format(key, quote(val))]
+        python_exec = [sys.executable, "-u"]
+        return mpirun_cmd + export_cmd + python_exec + \
+            [self.user_script] + [quote(a) for a in self.user_arguments]

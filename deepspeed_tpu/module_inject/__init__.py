@@ -1,0 +1,5 @@
+from .replace_module import (HFBertLayerPolicy, DSPolicy,
+                             replace_transformer_layer,
+                             revert_transformer_layer,
+                             hf_layer_to_ds_params,
+                             ds_params_to_hf_layer)

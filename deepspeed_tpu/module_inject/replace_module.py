@@ -1,0 +1,185 @@
+"""Module injection: swap HF transformer layers for the fused layer.
+
+Reference parity: deepspeed/module_inject/{replace_module.py,inject.py} +
+deepspeed/ops/module_inject.py — policy classes describe where a HuggingFace
+``BertLayer``'s weights live, ``replace_transformer_layer`` copies them
+(with transposes) into ``DeepSpeedTransformerLayer``s, and the reverse
+conversion restores the original module class.
+
+TPU re-founding: a "module" is a params subtree. HF *flax* checkpoints
+store kernels (in, out) — the same layout as our fused layer — so the
+torch-era transposes vanish; the policy's job is pure tree surgery:
+qkv fusion, renames, and the per-layer -> stacked-scan layout
+(models/bert.py). ``revert_transformer_layer`` inverts it exactly.
+"""
+import numpy as np
+
+import jax.numpy as jnp
+
+
+class DSPolicy:
+    """Base injection policy: maps one HF layer subtree to fused-layer
+    params and back (reference module_inject policy classes)."""
+
+    # pre-LN vs post-LN of the source architecture
+    pre_attn_norm = False
+
+    @staticmethod
+    def attention(layer):
+        raise NotImplementedError
+
+    @staticmethod
+    def mlp(layer):
+        raise NotImplementedError
+
+    @staticmethod
+    def layernorm(layer):
+        raise NotImplementedError
+
+
+class HFBertLayerPolicy(DSPolicy):
+    """HF (flax) BertLayer: attention.self.{query,key,value}.{kernel,bias},
+    attention.output.{dense,LayerNorm}, intermediate.dense,
+    output.{dense,LayerNorm} (reference replace_module.py HFBertLayerPolicy).
+    Post-LN architecture."""
+
+    pre_attn_norm = False
+
+    @staticmethod
+    def attention(layer):
+        att = layer["attention"]
+        return (att["self"]["query"]["kernel"], att["self"]["query"]["bias"],
+                att["self"]["key"]["kernel"], att["self"]["key"]["bias"],
+                att["self"]["value"]["kernel"], att["self"]["value"]["bias"],
+                att["output"]["dense"]["kernel"],
+                att["output"]["dense"]["bias"])
+
+    @staticmethod
+    def mlp(layer):
+        return (layer["intermediate"]["dense"]["kernel"],
+                layer["intermediate"]["dense"]["bias"],
+                layer["output"]["dense"]["kernel"],
+                layer["output"]["dense"]["bias"])
+
+    @staticmethod
+    def layernorm(layer):
+        attn_ln = layer["attention"]["output"]["LayerNorm"]
+        out_ln = layer["output"]["LayerNorm"]
+        return (attn_ln["scale"], attn_ln["bias"],
+                out_ln["scale"], out_ln["bias"])
+
+
+def hf_layer_to_ds_params(layer, policy=HFBertLayerPolicy):
+    """One HF layer subtree -> fused DeepSpeedTransformerLayer params."""
+    qw, qb, kw, kb, vw, vb, ow, ob = policy.attention(layer)
+    iw, ib, outw, outb = policy.mlp(layer)
+    attn_nw, attn_nb, norm_w, norm_b = policy.layernorm(layer)
+    cat = lambda *xs: jnp.concatenate([jnp.asarray(x) for x in xs], axis=-1)
+    return {
+        "attn_qkvw": cat(qw, kw, vw),
+        "attn_qkvb": cat(qb, kb, vb),
+        "attn_ow": jnp.asarray(ow),
+        "attn_ob": jnp.asarray(ob),
+        "attn_nw": jnp.asarray(attn_nw),
+        "attn_nb": jnp.asarray(attn_nb),
+        "inter_w": jnp.asarray(iw),
+        "inter_b": jnp.asarray(ib),
+        "output_w": jnp.asarray(outw),
+        "output_b": jnp.asarray(outb),
+        "norm_w": jnp.asarray(norm_w),
+        "norm_b": jnp.asarray(norm_b),
+    }
+
+
+def ds_params_to_hf_layer(params, policy=HFBertLayerPolicy):
+    """Inverse conversion (reference replace_module.py:93 revert path)."""
+    assert policy is HFBertLayerPolicy, "revert implemented for BERT policy"
+    d = params["attn_qkvw"].shape[0]
+    qw, kw, vw = jnp.split(params["attn_qkvw"], 3, axis=-1)
+    qb, kb, vb = jnp.split(params["attn_qkvb"], 3)
+    return {
+        "attention": {
+            "self": {
+                "query": {"kernel": qw, "bias": qb},
+                "key": {"kernel": kw, "bias": kb},
+                "value": {"kernel": vw, "bias": vb},
+            },
+            "output": {
+                "dense": {"kernel": params["attn_ow"],
+                          "bias": params["attn_ob"]},
+                "LayerNorm": {"scale": params["attn_nw"],
+                              "bias": params["attn_nb"]},
+            },
+        },
+        "intermediate": {"dense": {"kernel": params["inter_w"],
+                                   "bias": params["inter_b"]}},
+        "output": {
+            "dense": {"kernel": params["output_w"],
+                      "bias": params["output_b"]},
+            "LayerNorm": {"scale": params["norm_w"],
+                          "bias": params["norm_b"]},
+        },
+    }
+
+
+def _hf_encoder_layers(model_params):
+    """Locate the {'0': layer, '1': layer, ...} dict in a HF-flax params
+    tree (FlaxBertModel: params['encoder']['layer'])."""
+    tree = model_params
+    if "params" in tree:
+        tree = tree["params"]
+    for key in ("bert", "encoder"):
+        if key in tree:
+            tree = tree[key]
+    if "layer" in tree:
+        tree = tree["layer"]
+    if not all(k.isdigit() for k in tree.keys()):
+        raise ValueError("Could not locate HF encoder layers; got keys {}"
+                         .format(list(tree.keys())[:8]))
+    return tree
+
+
+def replace_transformer_layer(orig_layer_impl=None, model=None,
+                              policy=HFBertLayerPolicy, micro_batch_size=-1,
+                              config=None, seed=-1, max_seq_length=512,
+                              hidden_size=-1, heads=-1, fp16=False,
+                              training=True, model_params=None):
+    """HF-flax encoder params -> stacked fused-layer params + layer config.
+
+    Reference replace_transformer_layer(orig_layer_impl, model, policy, ...)
+    walked nn.Module children; here the walk is over the params tree.
+    Returns ``(stacked_params, DeepSpeedTransformerConfig)`` ready for
+    models/bert.py's scan encoder (``params['layers']``).
+    """
+    from ..ops.transformer.transformer import DeepSpeedTransformerConfig
+    source = model_params if model_params is not None else model
+    layers = _hf_encoder_layers(source)
+    per_layer = [hf_layer_to_ds_params(layers[str(i)], policy)
+                 for i in range(len(layers))]
+    stacked = {
+        key: jnp.stack([p[key] for p in per_layer])
+        for key in per_layer[0]
+    }
+    d = int(per_layer[0]["attn_qkvw"].shape[0])
+    di = int(per_layer[0]["inter_w"].shape[1])
+    layer_config = DeepSpeedTransformerConfig(
+        batch_size=micro_batch_size,
+        hidden_size=hidden_size if hidden_size > 0 else d,
+        intermediate_size=di,
+        heads=heads,
+        num_hidden_layers=len(per_layer),
+        fp16=fp16,
+        pre_layer_norm=policy.pre_attn_norm,
+        seed=seed,
+        training=training)
+    return stacked, layer_config
+
+
+def revert_transformer_layer(stacked_params, policy=HFBertLayerPolicy):
+    """Stacked fused params -> HF-flax {'0': layer, ...} dict."""
+    n = int(next(iter(stacked_params.values())).shape[0])
+    out = {}
+    for i in range(n):
+        per_layer = {k: v[i] for k, v in stacked_params.items()}
+        out[str(i)] = ds_params_to_hf_layer(per_layer, policy)
+    return out

@@ -52,30 +52,46 @@ def unpack_signs(packed, scale):
     return scale * (2.0 * bits.astype(jnp.float32) - 1.0).reshape(-1)
 
 
-def _compress(x):
-    """One buffer -> (packed signs, scalar scale, error residual)."""
-    n = x.size
-    scale = jnp.linalg.norm(x) / jnp.sqrt(float(n))
+def masked_compress(x, mask, count):
+    """Sign+scale quantize the lanes selected by ``mask`` (1.0/0.0 floats,
+    ``count`` = number of real lanes). Pad lanes must carry zero value AND
+    zero error feedback — quantizing a 0 lane to +scale would make its
+    error oscillate at ±scale and pollute ``||x||/sqrt(n)`` (torch's
+    sign(0)=0 gives the reference this for free). Returns (packed signs,
+    scale, decompressed, error residual)."""
+    masked = x * mask
+    scale = jnp.linalg.norm(masked) / jnp.sqrt(jnp.maximum(count, 1.0))
     packed = pack_signs(x)
-    decompressed = scale * jnp.where(x >= 0, 1.0, -1.0)
-    return packed, scale, x - decompressed
+    decompressed = scale * jnp.where(x >= 0, 1.0, -1.0) * mask
+    return packed, scale, decompressed, (x - decompressed) * mask
+
+
+def _compress(x):
+    """One full buffer -> (packed signs, scalar scale, error residual)."""
+    mask = jnp.ones(x.size, dtype=jnp.float32)
+    packed, scale, _, err = masked_compress(x, mask, float(x.size))
+    return packed, scale, err
 
 
 def compressed_allreduce_local(x, worker_error, server_error, axis_name,
-                               world_size):
+                               world_size, real_size=None):
     """The per-device body: call inside shard_map/pmap over ``axis_name``.
 
     ``x``: this device's local buffer (flat fp32, size divisible by
-    8*world_size). Returns (averaged buffer, new worker_error, new
-    server_error) — errors have the same shapes as the inputs
-    (server_error is 1/world_size of the buffer).
+    8*world_size; lanes >= ``real_size`` are padding). Returns (averaged
+    buffer, new worker_error, new server_error) — errors have the same
+    shapes as the inputs (server_error is 1/world_size of the buffer).
     """
     n = x.size
     chunk = n // world_size
+    if real_size is None:
+        real_size = n
+    mask = (jnp.arange(n) < real_size).astype(jnp.float32)
 
     # ---- phase 1: worker compression + exchange
     corrected = x + worker_error
-    packed, scale, new_worker_error = _compress(corrected)
+    packed, scale, _, new_worker_error = masked_compress(
+        corrected, mask, float(real_size))
     # rows: chunk destined to each server rank
     packed_rows = packed.reshape(world_size, chunk // 8)
     recv = jax.lax.all_to_all(packed_rows, axis_name, split_axis=0,
@@ -83,15 +99,23 @@ def compressed_allreduce_local(x, worker_error, server_error, axis_name,
     scales = jax.lax.all_gather(scale, axis_name)
 
     # ---- phase 2: server decompress, average, re-compress, broadcast
-    # recv[w] = my chunk's sign bytes from worker w
+    # recv[w] = my chunk's sign bytes from worker w; my chunk's lane mask
+    # and real-lane count depend on my position in the gather order
+    rank = jax.lax.axis_index(axis_name)
+    chunk_start = rank * chunk
+    chunk_mask = (jnp.arange(chunk) + chunk_start <
+                  real_size).astype(jnp.float32)
+    chunk_count = jnp.clip(real_size - chunk_start, 0, chunk).astype(
+        jnp.float32)
     per_worker = jax.vmap(unpack_signs)(recv, scales)      # (world, chunk)
-    server_chunk = per_worker.mean(axis=0) + server_error
-    server_packed, server_scale, new_server_error = _compress(server_chunk)
+    server_chunk = per_worker.mean(axis=0) * chunk_mask + server_error
+    server_packed, server_scale, _, new_server_error = masked_compress(
+        server_chunk, chunk_mask, chunk_count)
 
     gathered = jax.lax.all_gather(server_packed, axis_name)  # (world, chunk/8)
     gathered_scales = jax.lax.all_gather(server_scale, axis_name)
     result = jax.vmap(unpack_signs)(gathered, gathered_scales).reshape(-1)
-    return result, new_worker_error, new_server_error
+    return result * mask, new_worker_error, new_server_error
 
 
 class CompressedBackend:
@@ -114,16 +138,18 @@ class CompressedBackend:
         mult = 8 * self.world_size
         return ((n + mult - 1) // mult) * mult
 
-    def _build(self, n):
-        if n in self._jit_cache:
-            return self._jit_cache[n]
+    def _build(self, n, real_size):
+        key = (n, real_size)
+        if key in self._jit_cache:
+            return self._jit_cache[key]
         world = self.world_size
         axis = self.axis
 
         @jax.jit
         def run(values, worker_error, server_error):
             body = functools.partial(compressed_allreduce_local,
-                                     axis_name=axis, world_size=world)
+                                     axis_name=axis, world_size=world,
+                                     real_size=real_size)
 
             # shard_map splits the leading (world,) dim: each device sees
             # its own (1, n) row; drop/re-add the axis inside.
@@ -137,7 +163,7 @@ class CompressedBackend:
                 out_specs=(P(axis), P(axis), P(axis)))
             return sharded(values, worker_error, server_error)
 
-        self._jit_cache[n] = run
+        self._jit_cache[key] = run
         return run
 
     def compressed_allreduce(self, values, worker_error=None,
@@ -152,6 +178,6 @@ class CompressedBackend:
         if server_error is None:
             server_error = jnp.zeros((world, padded // world),
                                      dtype=jnp.float32)
-        out, we, se = self._build(padded)(values.astype(jnp.float32),
+        out, we, se = self._build(padded, n)(values.astype(jnp.float32),
                                           worker_error, server_error)
         return out[:, :n], we, se

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from ...ops.adam.fused_adam import FusedAdam
+from ..comm.compressed import masked_compress
 
 
 def _padded_flat_size(shape):
@@ -30,27 +31,20 @@ def _padded_flat_size(shape):
     return ((n + 7) // 8) * 8
 
 
-def _masked_compress(x, mask, n):
-    """Sign+scale quantize the first ``n`` lanes of a padded buffer. The
-    scale is taken over the real lanes only (padding would deflate
-    ||x||/sqrt(n) and its error feedback would oscillate at ±scale), and
-    pad lanes carry zero value/error."""
-    scale = jnp.linalg.norm(x * mask) / jnp.sqrt(float(n))
-    decompressed = scale * jnp.where(x >= 0, 1.0, -1.0) * mask
-    return decompressed, (x - decompressed) * mask
-
-
 def _quantize_with_feedback(x, worker_error, server_error):
     """Worker-compress then server-compress one buffer, updating both error
-    accumulators (the all-equal-workers form of compressed_allreduce_local)."""
+    accumulators (the all-equal-workers form of compressed_allreduce_local).
+    Pad-lane masking lives in comm.compressed.masked_compress."""
     n = x.size
     padded = worker_error.size
     flat = jnp.pad(x.reshape(-1), (0, padded - n))
     mask = (jnp.arange(padded) < n).astype(jnp.float32)
     corrected = flat + worker_error
-    worker_q, new_worker_error = _masked_compress(corrected, mask, n)
+    _, _, worker_q, new_worker_error = masked_compress(corrected, mask,
+                                                       float(n))
     server_in = worker_q + server_error
-    server_q, new_server_error = _masked_compress(server_in, mask, n)
+    _, _, server_q, new_server_error = masked_compress(server_in, mask,
+                                                       float(n))
     return server_q[:n].reshape(x.shape), new_worker_error, new_server_error
 
 

@@ -76,21 +76,58 @@ class Init:
                                     param_persistence_threshold=plan.persist_threshold,
                                     model_spec_fn=spec_fn)
 
+        host_mesh = self._host_mesh() if self.remote_device == "cpu" else None
+
         def place(path, leaf):
             arr = leaf
             if self.dtype is not None and hasattr(arr, "astype"):
                 arr = arr.astype(self.dtype)
-            if self.remote_device == "cpu":
-                # ZeRO-Offload params: shard stays in host memory. The
-                # engine streams it to HBM per use (cpu_offload path).
-                cpus = jax.devices("cpu")
-                return jax.device_put(arr, cpus[0])
             sharding = plan.param_sharding(path, np.shape(arr))
+            if self.remote_device == "cpu":
+                # ZeRO-Offload params: the SAME 1/N shard layout, kept in
+                # host memory (engine streams to HBM per use). Rebind the
+                # plan's spec onto a CPU-device mesh when one of matching
+                # shape exists; otherwise fall back to one host device.
+                if host_mesh is not None:
+                    from jax.sharding import NamedSharding
+                    return jax.device_put(
+                        arr, NamedSharding(host_mesh, sharding.spec))
+                return jax.device_put(arr, self._host_fallback_device())
             return jax.device_put(arr, sharding)
 
         from .partition import _path_str
         return jax.tree_util.tree_map_with_path(
             lambda kp, leaf: place(_path_str(kp), leaf), tree)
+
+    def _host_mesh(self):
+        """A CPU-device mesh mirroring the accelerator mesh's axis shape,
+        so offloaded shards keep the 1/N layout in host RAM. None when the
+        host doesn't expose enough CPU devices."""
+        if getattr(self, "_host_mesh_cache", False) is not False:
+            return self._host_mesh_cache
+        import jax as _jax
+        from jax.sharding import Mesh
+        try:
+            cpus = _jax.devices("cpu")
+        except RuntimeError:
+            cpus = []
+        need = int(np.prod(list(self.mesh.shape.values())))
+        if len(cpus) >= need:
+            arr = np.array(cpus[:need]).reshape(
+                tuple(self.mesh.shape.values()))
+            self._host_mesh_cache = Mesh(arr, tuple(self.mesh.shape.keys()))
+        else:
+            logger.warning(
+                "zero.Init(remote_device='cpu'): only %d CPU device(s) for "
+                "a %d-way mesh; offloaded params stay unsharded on host "
+                "(set --xla_force_host_platform_device_count to shard)",
+                len(cpus), need)
+            self._host_mesh_cache = None
+        return self._host_mesh_cache
+
+    def _host_fallback_device(self):
+        import jax as _jax
+        return _jax.devices("cpu")[0]
 
     # -- Model construction hook ---------------------------------------------
     def __enter__(self):
@@ -158,12 +195,13 @@ class GatheredParameters:
             return False
         if self.modifier_rank is None:
             return False
-        shardings = jax.tree_util.tree_map(
-            lambda leaf: getattr(leaf, "sharding", None), self.params)
+        # map over (new, old) pairs: None shardings can't ride a pytree
+        # (None is an empty container for tree_map)
         resharded = jax.tree_util.tree_map(
-            lambda new, s: (jax.device_put(jnp.asarray(new), s)
-                            if s is not None else jnp.asarray(new)),
-            self._full, shardings)
+            lambda new, old: (jax.device_put(jnp.asarray(new), old.sharding)
+                              if hasattr(old, "sharding")
+                              else jnp.asarray(new)),
+            self._full, self.params)
         if self._model is not None:
             self._model.params = resharded
         else:

@@ -1,0 +1,142 @@
+"""Launcher CLI + env report tests (reference tests for runner.py parsing
+live in its users; the grammar is locked here)."""
+import io
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from deepspeed_tpu.launcher import (fetch_hostfile,
+                                    parse_inclusion_exclusion,
+                                    encode_world_info, decode_world_info)
+from deepspeed_tpu.launcher.launch import build_env, parse_args as launch_args
+
+
+def _hostfile(tmp_path, text):
+    p = tmp_path / "hostfile"
+    p.write_text(textwrap.dedent(text))
+    return str(p)
+
+
+def test_fetch_hostfile(tmp_path):
+    path = _hostfile(tmp_path, """\
+        worker-0 slots=4
+        worker-1 slots=4
+    """)
+    pool = fetch_hostfile(path)
+    assert pool == {"worker-0": 4, "worker-1": 4}
+
+
+def test_fetch_hostfile_bad_format(tmp_path):
+    path = _hostfile(tmp_path, "worker-0 slots=x\n")
+    with pytest.raises(ValueError):
+        fetch_hostfile(path)
+
+
+def test_fetch_hostfile_duplicate(tmp_path):
+    path = _hostfile(tmp_path, "w0 slots=2\nw0 slots=4\n")
+    with pytest.raises(ValueError):
+        fetch_hostfile(path)
+
+
+def test_fetch_hostfile_missing():
+    assert fetch_hostfile("/nonexistent/hostfile") is None
+
+
+def test_include_filtering():
+    pool = {"w0": 4, "w1": 4, "w2": 4}
+    active = parse_inclusion_exclusion(pool, "w0:0,1@w2", "")
+    assert active == {"w0": [0, 1], "w2": [0, 1, 2, 3]}
+
+
+def test_exclude_filtering():
+    pool = {"w0": 4, "w1": 4}
+    active = parse_inclusion_exclusion(pool, "", "w1:2,3")
+    assert active == {"w0": [0, 1, 2, 3], "w1": [0, 1]}
+    active = parse_inclusion_exclusion(pool, "", "w1")
+    assert active == {"w0": [0, 1, 2, 3]}
+
+
+def test_include_exclude_mutually_exclusive():
+    with pytest.raises(ValueError):
+        parse_inclusion_exclusion({"w0": 1}, "w0", "w0")
+
+
+def test_include_unknown_host():
+    with pytest.raises(ValueError, match="not found"):
+        parse_inclusion_exclusion({"w0": 1}, "w9", "")
+
+
+def test_world_info_roundtrip():
+    info = {"w0": [0, 1], "w1": [0]}
+    assert decode_world_info(encode_world_info(info)) == info
+
+
+def test_launch_env_build():
+    info = {"w0": [0, 1, 2, 3], "w1": [0, 1, 2, 3]}
+    from deepspeed_tpu.launcher.runner import encode_world_info as enc
+    args = launch_args(["--world_info", enc(info), "--node_rank", "1",
+                        "--master_addr", "10.0.0.1", "--master_port",
+                        "29501", "train.py"])
+    env = build_env(args, decode_world_info(args.world_info))
+    assert env["MASTER_ADDR"] == "10.0.0.1"
+    assert env["MASTER_PORT"] == "29501"
+    assert env["RANK"] == "1" and env["WORLD_SIZE"] == "2"
+    assert env["DS_TPU_SLOTS"] == "4"
+
+
+def test_single_node_launch_end_to_end(tmp_path):
+    """deepspeed CLI -> launch.py -> user script, env propagated."""
+    out_file = tmp_path / "env.json"
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent("""\
+        import json, os, sys
+        json.dump({k: os.environ.get(k) for k in
+                   ("RANK", "WORLD_SIZE", "MASTER_ADDR", "DS_TPU_SLOTS")},
+                  open(sys.argv[1], "w"))
+    """))
+    env = dict(os.environ, PYTHONPATH="/root/repo")
+    proc = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+         "--hostfile", "/nonexistent", "--num_gpus", "2",
+         str(script), str(out_file)],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    result = json.load(open(out_file))
+    assert result["RANK"] == "0"
+    assert result["WORLD_SIZE"] == "1"
+    assert result["DS_TPU_SLOTS"] == "2"
+
+
+def test_pdsh_cmd_assembly():
+    from deepspeed_tpu.launcher.runner import parse_args
+    from deepspeed_tpu.launcher.multinode_runner import PDSHRunner
+    args = parse_args(["--master_addr", "10.0.0.1", "train.py", "--lr",
+                       "0.1"])
+    world = encode_world_info({"w0": [0], "w1": [0]})
+    os.environ["JAX_TEST_EXPORT_VAR"] = "1"
+    runner = PDSHRunner(args, world, {"w0": [0], "w1": [0]})
+    try:
+        cmd = runner.get_cmd(runner.export_envs(), {"w0": [0], "w1": [0]})
+    finally:
+        del os.environ["JAX_TEST_EXPORT_VAR"]
+    joined = " ".join(cmd)
+    assert cmd[0] == "pdsh"
+    assert "-w w0,w1" in joined
+    assert "--node_rank=%n" in joined
+    assert "JAX_TEST_EXPORT_VAR" in joined
+    assert "train.py" in joined
+
+
+def test_ds_report_smoke():
+    from deepspeed_tpu.env_report import main
+    buf = io.StringIO()
+    main(out=buf)
+    text = buf.getvalue()
+    assert "op report" in text
+    assert "cpu_adam" in text
+    assert "flash_attention" in text
+    assert "jax version" in text

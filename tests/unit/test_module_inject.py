@@ -1,0 +1,117 @@
+"""Module injection tests (reference: module_inject weight-copy policies).
+
+The conversion is validated two ways: exact roundtrip, and numerical
+equivalence of the fused layer on converted weights vs an unfused
+HF-semantics (post-LN) BERT layer.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.module_inject import (replace_transformer_layer,
+                                         revert_transformer_layer,
+                                         hf_layer_to_ds_params,
+                                         ds_params_to_hf_layer)
+from deepspeed_tpu.ops.transformer.transformer import \
+    transformer_layer_forward
+
+
+def _hf_layer(rs, d=32, di=64):
+    dense = lambda din, dout: {"kernel": rs.randn(din, dout) * 0.05,
+                               "bias": rs.randn(dout) * 0.01}
+    ln = lambda: {"scale": 1.0 + rs.randn(d) * 0.01, "bias": rs.randn(d) * 0.01}
+    return {
+        "attention": {
+            "self": {"query": dense(d, d), "key": dense(d, d),
+                     "value": dense(d, d)},
+            "output": {"dense": dense(d, d), "LayerNorm": ln()},
+        },
+        "intermediate": {"dense": dense(d, di)},
+        "output": {"dense": dense(di, d), "LayerNorm": ln()},
+    }
+
+
+def _hf_model_params(rs, n_layers=2, d=32, di=64):
+    return {"params": {"encoder": {"layer": {
+        str(i): _hf_layer(rs, d, di) for i in range(n_layers)}}}}
+
+
+def test_roundtrip_exact():
+    rs = np.random.RandomState(0)
+    layer = _hf_layer(rs)
+    back = ds_params_to_hf_layer(hf_layer_to_ds_params(layer))
+
+    flat_a = jax.tree_util.tree_leaves_with_path(
+        jax.tree_util.tree_map(jnp.asarray, layer))
+    flat_b = jax.tree_util.tree_leaves_with_path(back)
+    assert len(flat_a) == len(flat_b)
+    for (pa, va), (pb, vb) in zip(sorted(flat_a, key=lambda t: str(t[0])),
+                                  sorted(flat_b, key=lambda t: str(t[0]))):
+        assert str(pa) == str(pb)
+        np.testing.assert_allclose(np.asarray(va), np.asarray(vb), atol=1e-7)
+
+
+def test_replace_produces_stacked_params():
+    rs = np.random.RandomState(1)
+    params = _hf_model_params(rs, n_layers=3)
+    stacked, config = replace_transformer_layer(model_params=params, heads=4)
+    assert stacked["attn_qkvw"].shape == (3, 32, 96)
+    assert config.num_hidden_layers == 3
+    assert config.hidden_size == 32
+    assert config.intermediate_size == 64
+    assert not config.pre_layer_norm  # HF BERT is post-LN
+
+    reverted = revert_transformer_layer(stacked)
+    orig_q = params["params"]["encoder"]["layer"]["1"]["attention"]["self"][
+        "query"]["kernel"]
+    np.testing.assert_allclose(
+        np.asarray(reverted["1"]["attention"]["self"]["query"]["kernel"]),
+        orig_q, atol=1e-7)
+
+
+def _hf_reference_forward(layer, x, heads):
+    """Unfused post-LN BERT layer with HF semantics (exact-gelu close
+    enough at tanh tolerance)."""
+    d = x.shape[-1]
+    dh = d // heads
+
+    def ln(t, p):
+        mu = t.mean(-1, keepdims=True)
+        var = ((t - mu) ** 2).mean(-1, keepdims=True)
+        return (t - mu) / jnp.sqrt(var + 1e-12) * p["scale"] + p["bias"]
+
+    att = layer["attention"]
+    q = x @ att["self"]["query"]["kernel"] + att["self"]["query"]["bias"]
+    k = x @ att["self"]["key"]["kernel"] + att["self"]["key"]["bias"]
+    v = x @ att["self"]["value"]["kernel"] + att["self"]["value"]["bias"]
+    b, s, _ = x.shape
+    sh = lambda t: t.reshape(b, s, heads, dh)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", sh(q), sh(k)) / np.sqrt(dh)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, sh(v)).reshape(b, s, d)
+    attn_out = ctx @ att["output"]["dense"]["kernel"] + \
+        att["output"]["dense"]["bias"]
+    x = ln(x + attn_out, att["output"]["LayerNorm"])
+    inter = jax.nn.gelu(
+        x @ layer["intermediate"]["dense"]["kernel"] +
+        layer["intermediate"]["dense"]["bias"], approximate=True)
+    out = inter @ layer["output"]["dense"]["kernel"] + \
+        layer["output"]["dense"]["bias"]
+    return ln(x + out, layer["output"]["LayerNorm"])
+
+
+def test_fused_forward_matches_hf_reference():
+    rs = np.random.RandomState(2)
+    layer = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(x, jnp.float32), _hf_layer(rs))
+    ds_params = hf_layer_to_ds_params(layer)
+    stacked, config = replace_transformer_layer(
+        model_params={"params": {"encoder": {"layer": {"0": layer}}}},
+        heads=4)
+    x = jnp.asarray(rs.randn(2, 8, 32), dtype=jnp.float32)
+    fused = transformer_layer_forward(ds_params, x, None, config,
+                                      train=False)
+    ref = _hf_reference_forward(layer, x, heads=4)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(ref),
+                               atol=2e-5)

@@ -60,6 +60,26 @@ def test_error_feedback_makes_average_unbiased():
     assert err < 0.05, err
 
 
+def test_error_feedback_unbiased_with_padding():
+    """Non-divisible sizes: pad lanes must not bias the telescoping."""
+    mesh = build_mesh(data=8)
+    backend = CompressedBackend(mesh)
+    rs = np.random.RandomState(7)
+    n = 1000  # padded to 1024: 24 pad lanes
+    values = jnp.asarray(rs.randn(8, n).astype(np.float32))
+    true_mean = np.asarray(values).mean(axis=0)
+    we = se = None
+    acc = np.zeros(n, dtype=np.float64)
+    T = 200
+    for _ in range(T):
+        out, we, se = backend.compressed_allreduce(values, we, se)
+        acc += np.asarray(out[0], dtype=np.float64)
+    err = np.abs(acc / T - true_mean).mean() / np.abs(true_mean).mean()
+    assert err < 0.05, err
+    # pad-lane error feedback stays exactly zero
+    np.testing.assert_array_equal(np.asarray(we[:, n:]), 0.0)
+
+
 def test_compressed_allreduce_padding():
     mesh = build_mesh(data=8)
     backend = CompressedBackend(mesh)

@@ -88,5 +88,25 @@ def test_init_model_trains_through_engine():
     assert losses[-1] < 0.2 * losses[0], losses
 
 
+def test_gathered_parameters_plain_numpy_tree():
+    """Raw (unsharded) trees must not crash on exit (pytree None trap)."""
+    tree = {"w": np.ones((4, 4), dtype=np.float32)}
+    with deepspeed_tpu.zero.GatheredParameters(tree, modifier_rank=0) as full:
+        full["w"][:] = 2.0
+    np.testing.assert_allclose(np.asarray(tree["w"]), 2.0)
+
+
+def test_init_remote_device_cpu_keeps_shard_layout():
+    mesh = build_mesh(data=8)
+    with deepspeed_tpu.zero.Init(mesh=mesh, remote_device="cpu",
+                                 param_persistence_threshold=0):
+        model = Model(_apply, {"w": jnp.ones((64, 8))})
+    w = model.params["w"]
+    # on the CPU test mesh the host mesh mirrors the device mesh: the
+    # offloaded param keeps the 1/N sharded layout
+    assert "data" in str(w.sharding.spec)
+    assert all(d.platform == "cpu" for d in w.sharding.device_set)
+
+
 def test_register_external_parameter_noop():
     deepspeed_tpu.zero.register_external_parameter(object(), object())

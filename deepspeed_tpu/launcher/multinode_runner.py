@@ -6,6 +6,7 @@ propagation follows the reference (:27-29 + .deepspeed_env files) with the
 TPU transport prefixes (JAX*/XLA*/TPU*/LIBTPU*) in place of NCCL*/MV2*.
 """
 import os
+import shlex
 import shutil
 import sys
 from abc import ABC, abstractmethod
@@ -37,6 +38,10 @@ class MultiNodeRunner(ABC):
     def add_export(self, key, var):
         self.exports[key.strip()] = var.strip()
 
+    def launcher_args(self):
+        """User-supplied backend flags (--launcher_args)."""
+        return shlex.split(getattr(self.args, "launcher_args", "") or "")
+
     def export_envs(self):
         """Collect env to forward: prefix-matched vars + .deepspeed_env."""
         for var, val in self.env.items():
@@ -67,7 +72,7 @@ class PDSHRunner(MultiNodeRunner):
         return shutil.which("pdsh") is not None
 
     def get_cmd(self, environment, active_resources):
-        environment["PDSH_RCMD_TYPE"] = "ssh"
+        self.env["PDSH_RCMD_TYPE"] = "ssh"  # for the local pdsh Popen
         active_workers = ",".join(active_resources.keys())
 
         exports = ""
@@ -82,8 +87,9 @@ class PDSHRunner(MultiNodeRunner):
             "--master_addr={}".format(self.args.master_addr),
             "--master_port={}".format(self.args.master_port),
         ]
-        return ["pdsh", "-f", str(PDSH_MAX_FAN_OUT), "-w",
-                active_workers] + deepspeed_launch + [self.user_script] + \
+        return ["pdsh", "-f", str(PDSH_MAX_FAN_OUT)] + \
+            self.launcher_args() + ["-w", active_workers] + \
+            deepspeed_launch + [self.user_script] + \
             [quote(a) for a in self.user_arguments]
 
 
@@ -95,9 +101,13 @@ class OpenMPIRunner(MultiNodeRunner):
 
     def get_cmd(self, environment, active_resources):
         total_procs = len(self.resource_pool)
-        mpirun_cmd = ["mpirun", "-n", str(total_procs), "-hostfile",
+        # one rank per HOST (JAX owns all local chips): by-slot default
+        # would pack ranks onto the first slots=N node
+        mpirun_cmd = ["mpirun", "-n", str(total_procs),
+                      "--map-by", "ppr:1:node", "-hostfile",
                       self.args.hostfile, "--mca", "btl", "^openib",
-                      "--mca", "btl_tcp_if_include", "eth0"]
+                      "--mca", "btl_tcp_if_include", "eth0"] + \
+            self.launcher_args()
         export_cmd = []
         for key, val in self.exports.items():
             export_cmd += ["-x", "{}={}".format(key, quote(val))]
@@ -119,7 +129,7 @@ class MVAPICHRunner(MultiNodeRunner):
                 fd.write("{}\n".format(host.split()[0]))
         total_procs = len(self.resource_pool)
         mpirun_cmd = ["mpirun", "-np", str(total_procs), "--hostfile",
-                      MVAPICH_TMP_HOSTFILE]
+                      MVAPICH_TMP_HOSTFILE] + self.launcher_args()
         export_cmd = []
         for key, val in self.exports.items():
             export_cmd += ["-env", "{}={}".format(key, quote(val))]

@@ -154,7 +154,6 @@ def main(args=None):
 
     if not resource_pool:
         resource_pool = OrderedDict()
-        import multiprocessing
         local_slots = args.num_gpus if args.num_gpus > 0 else \
             int(os.environ.get("DS_TPU_LOCAL_CHIPS", "1"))
         resource_pool["localhost"] = local_slots

@@ -94,7 +94,6 @@ def hf_layer_to_ds_params(layer, policy=HFBertLayerPolicy):
 def ds_params_to_hf_layer(params, policy=HFBertLayerPolicy):
     """Inverse conversion (reference replace_module.py:93 revert path)."""
     assert policy is HFBertLayerPolicy, "revert implemented for BERT policy"
-    d = params["attn_qkvw"].shape[0]
     qw, kw, vw = jnp.split(params["attn_qkvw"], 3, axis=-1)
     qb, kb, vb = jnp.split(params["attn_qkvb"], 3)
     return {

@@ -324,8 +324,12 @@ class DeepSpeedEngine:
 
         def micro(state, batch, rng):
             kwargs = {**model.rng_kwargs(rng), **model.mode_kwargs(True)}
-            if self.progressive_layer_drop and model.accepts_kwargs:
-                kwargs.update(self.progressive_layer_drop.get_state())
+            if self.progressive_layer_drop:
+                # pass each PLD kwarg the model can actually accept
+                kwargs.update({
+                    k: v
+                    for k, v in self.progressive_layer_drop.get_state().items()
+                    if model.accepts_kwarg(k)})
 
             def loss_fn(compute_params):
                 out = apply_fn(compute_params, *batch, **kwargs)
@@ -757,9 +761,21 @@ class DeepSpeedEngine:
 
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True,
-                        load_lr_scheduler_states=True):
+                        load_lr_scheduler_states=True,
+                        load_from_fp32_weights=True):
         """Load a checkpoint; returns (path, client_state)
-        (reference engine.py:1379-1482)."""
+        (reference engine.py:1379-1482).
+
+        Elastic resharding is structural: state dicts store FULL (gathered)
+        trees, and loading device_puts each leaf with the CURRENT engine's
+        plan — a checkpoint written at dp=8 loads into a dp=4 or 3D mesh
+        unchanged (the reference needs bespoke re-slicing,
+        stage1.py:1048-1107; GSPMD makes it a placement detail).
+
+        ``load_from_fp32_weights``: restore the fp32 master from the saved
+        fp32 shards (exact resume) vs recast from the fp16/bf16 params
+        (reference stage2.py:1741-1763 toggle).
+        """
         if tag is None:
             tag = ckpt.read_latest(load_dir)
             if tag is None:
@@ -783,15 +799,18 @@ class DeepSpeedEngine:
                 jnp.asarray(x, dtype=old.dtype), s),
             sd["module"], self.state["params"], param_sh)
 
-        if self.mixed_precision and sd.get("master") is not None:
+        if self.mixed_precision and load_from_fp32_weights and \
+                sd.get("master") is not None:
             master_sh = plan.tree_shardings(self.state["master"], "master")
             self.state["master"] = jax.tree_util.tree_map(
                 lambda x, s: jax.device_put(jnp.asarray(x, jnp.float32), s),
                 sd["master"], master_sh)
         elif self.mixed_precision:
-            # load_from_fp32_weights fallback: recompute master from params
+            # recompute master from the (lower-precision) params
+            master_sh = plan.tree_shardings(self.state["master"], "master")
             self.state["master"] = jax.tree_util.tree_map(
-                lambda p: jnp.asarray(p, jnp.float32), self.state["params"])
+                lambda p, s: jax.device_put(jnp.asarray(p, jnp.float32), s),
+                self.state["params"], master_sh)
 
         if load_optimizer_states and sd.get("optimizer") is not None:
             opt = sd["optimizer"]

@@ -37,6 +37,10 @@ class Model:
             self.mode_kwarg = None
         self.accepts_kwargs = any(
             p.kind == inspect.Parameter.VAR_KEYWORD for p in sig_params.values())
+        self.param_names = set(sig_params)
+
+    def accepts_kwarg(self, name):
+        return self.accepts_kwargs or name in self.param_names
 
     def mode_kwargs(self, train):
         if self.mode_kwarg == "train":

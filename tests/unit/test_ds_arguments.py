@@ -1,0 +1,46 @@
+"""add_config_arguments tests (reference tests/unit/test_ds_arguments.py)."""
+import argparse
+
+import pytest
+
+import deepspeed_tpu
+
+
+def basic_parser():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num_epochs", type=int)
+    return parser
+
+
+def test_no_ds_arguments():
+    parser = basic_parser()
+    args = parser.parse_args(["--num_epochs", "2"])
+    assert args.num_epochs == 2
+    assert not hasattr(args, "deepspeed")
+
+
+def test_no_ds_enable_argument():
+    parser = deepspeed_tpu.add_config_arguments(basic_parser())
+    args = parser.parse_args(["--num_epochs", "2", "--deepspeed_config",
+                              "foo.json"])
+    assert args.num_epochs == 2
+    assert args.deepspeed is False
+    assert args.deepspeed_config == "foo.json"
+
+
+def test_full_ds_arguments():
+    parser = deepspeed_tpu.add_config_arguments(basic_parser())
+    args = parser.parse_args(["--num_epochs", "2", "--deepspeed",
+                              "--deepspeed_config", "foo.json",
+                              "--deepspeed_mpi"])
+    assert args.deepspeed is True
+    assert args.deepspeed_mpi is True
+    assert args.deepspeed_config == "foo.json"
+
+
+def test_core_deepspeed_arguments_defaults():
+    parser = deepspeed_tpu.add_config_arguments(basic_parser())
+    args = parser.parse_args(["--num_epochs", "1"])
+    assert args.deepspeed is False
+    assert args.deepspeed_config is None
+    assert args.deepspeed_mpi is False

@@ -1,0 +1,77 @@
+"""Dynamic loss scale schedule tests (reference
+tests/unit/test_dynamic_loss_scale.py: fault-free raising, overflow
+halving, hysteresis, min scale)."""
+import numpy as np
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.fp16 import loss_scaler as ls
+
+
+def _scaler(**kw):
+    return ls.create_loss_scaler(static_loss_scale=None, **kw)
+
+
+def test_no_overflow_raises_every_window():
+    state = _scaler(init_scale=2 ** 8, scale_window=4)
+    scales = []
+    for _ in range(12):
+        state = ls.update_scale(state, jnp.asarray(False))
+        scales.append(float(state.cur_scale))
+    # x2 at every 4th clean step
+    assert scales[3] == 2 ** 9
+    assert scales[7] == 2 ** 10
+    assert scales[11] == 2 ** 11
+
+
+def test_overflow_halves_immediately():
+    state = _scaler(init_scale=2 ** 8, scale_window=100)
+    state = ls.update_scale(state, jnp.asarray(True))
+    assert float(state.cur_scale) == 2 ** 7
+    state = ls.update_scale(state, jnp.asarray(True))
+    assert float(state.cur_scale) == 2 ** 6
+
+
+def test_window_resets_after_overflow():
+    state = _scaler(init_scale=2 ** 8, scale_window=4)
+    for _ in range(2):
+        state = ls.update_scale(state, jnp.asarray(False))
+    state = ls.update_scale(state, jnp.asarray(True))   # halve, reset window
+    assert float(state.cur_scale) == 2 ** 7
+    for _ in range(3):
+        state = ls.update_scale(state, jnp.asarray(False))
+    # only 3 clean steps since overflow: no growth yet
+    assert float(state.cur_scale) == 2 ** 7
+    state = ls.update_scale(state, jnp.asarray(False))
+    assert float(state.cur_scale) == 2 ** 8
+
+
+def test_min_scale_floor():
+    state = _scaler(init_scale=4, min_scale=1.0)
+    for _ in range(6):
+        state = ls.update_scale(state, jnp.asarray(True))
+    assert float(state.cur_scale) == 1.0
+
+
+def test_delayed_shift_hysteresis():
+    state = _scaler(init_scale=2 ** 8, delayed_shift=2)
+    # first overflow consumes hysteresis, scale unchanged
+    state = ls.update_scale(state, jnp.asarray(True))
+    assert float(state.cur_scale) == 2 ** 8
+    assert int(state.cur_hysteresis) == 1
+    # second overflow drops the scale
+    state = ls.update_scale(state, jnp.asarray(True))
+    assert float(state.cur_scale) == 2 ** 7
+
+
+def test_static_scale_never_moves():
+    state = ls.create_loss_scaler(static_loss_scale=128.0)
+    for flag in (True, False, True):
+        state = ls.update_scale(state, jnp.asarray(flag))
+    assert float(state.cur_scale) == 128.0
+
+
+def test_backward_scale():
+    state = ls.create_loss_scaler(static_loss_scale=64.0)
+    scaled = ls.backward_scale(jnp.asarray(2.0), state)
+    assert float(scaled) == 128.0

@@ -98,7 +98,9 @@ def test_single_node_launch_end_to_end(tmp_path):
                    ("RANK", "WORLD_SIZE", "MASTER_ADDR", "DS_TPU_SLOTS")},
                   open(sys.argv[1], "w"))
     """))
-    env = dict(os.environ, PYTHONPATH="/root/repo")
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ, PYTHONPATH=repo_root)
     proc = subprocess.run(
         [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
          "--hostfile", "/nonexistent", "--num_gpus", "2",

@@ -1,0 +1,146 @@
+"""LR schedule tests (reference tests/unit/test_lr_schedulers.py)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.ops.adam.fused_adam import FusedAdam
+from deepspeed_tpu.runtime.lr_schedules import (LRRangeTest, OneCycle,
+                                                WarmupLR, WarmupDecayLR,
+                                                SCHEDULE_CLASSES,
+                                                get_lr_schedule_class)
+from deepspeed_tpu.runtime.model import Model
+
+
+def test_schedule_registry():
+    assert set(SCHEDULE_CLASSES) == {"LRRangeTest", "OneCycle", "WarmupLR",
+                                     "WarmupDecayLR"}
+    assert get_lr_schedule_class("WarmupLR") is WarmupLR
+    with pytest.raises(ValueError):
+        get_lr_schedule_class("Nope")
+
+
+def test_lr_range_test_continuous():
+    opt = FusedAdam(lr=1e-3)
+    sched = LRRangeTest(opt, lr_range_test_min_lr=1e-4,
+                        lr_range_test_step_size=10,
+                        lr_range_test_step_rate=1.0)
+    lrs = []
+    for _ in range(20):
+        sched.step()
+        lrs.append(opt.lr)
+    # monotonic growth from min_lr
+    assert lrs[0] >= 1e-4 and all(b >= a for a, b in zip(lrs, lrs[1:]))
+    np.testing.assert_allclose(lrs[9], 1e-4 * 2.0, rtol=1e-6)
+
+
+def test_lr_range_test_staircase():
+    opt = FusedAdam(lr=1e-3)
+    sched = LRRangeTest(opt, lr_range_test_min_lr=1e-4,
+                        lr_range_test_step_size=5,
+                        lr_range_test_step_rate=1.0,
+                        lr_range_test_staircase=True)
+    lrs = []
+    for _ in range(10):
+        sched.step()
+        lrs.append(opt.lr)
+    # interval boundary: floor((i+1)/5) bumps at i=4 and i=9
+    assert len(set(np.round(lrs[:4], 10))) == 1
+    assert len(set(np.round(lrs[4:9], 10))) == 1
+    assert lrs[4] > lrs[0] and lrs[9] > lrs[4]
+
+
+def test_one_cycle_up_down():
+    opt = FusedAdam(lr=1e-3)
+    sched = OneCycle(opt, cycle_min_lr=1e-4, cycle_max_lr=1e-2,
+                     cycle_first_step_size=10)
+    lrs = []
+    for _ in range(20):
+        sched.step()
+        lrs.append(opt.lr)
+    peak = int(np.argmax(lrs))
+    assert 8 <= peak <= 11
+    np.testing.assert_allclose(max(lrs), 1e-2, rtol=1e-5)
+    assert lrs[-1] < 1e-2
+
+
+def test_one_cycle_momentum_cycle():
+    opt = FusedAdam(lr=1e-3)
+    sched = OneCycle(opt, cycle_min_lr=1e-4, cycle_max_lr=1e-2,
+                     cycle_first_step_size=10, cycle_min_mom=0.85,
+                     cycle_max_mom=0.99)
+    moms = []
+    for _ in range(20):
+        sched.step()
+        moms.append(sched.get_mom()[0][0])  # beta1 of group 0
+    # momentum cycles inversely to lr: falls then rises
+    trough = int(np.argmin(moms))
+    assert 8 <= trough <= 11
+
+
+def test_warmup_lr_then_constant():
+    opt = FusedAdam(lr=1e-3)
+    sched = WarmupLR(opt, warmup_min_lr=0.0, warmup_max_lr=1e-2,
+                     warmup_num_steps=10)
+    lrs = []
+    for _ in range(15):
+        sched.step()
+        lrs.append(opt.lr)
+    assert lrs[0] < lrs[5] < lrs[9]
+    np.testing.assert_allclose(lrs[10:], 1e-2, rtol=1e-6)
+
+
+def test_warmup_decay_lr():
+    opt = FusedAdam(lr=1e-3)
+    sched = WarmupDecayLR(opt, total_num_steps=20, warmup_min_lr=0.0,
+                          warmup_max_lr=1e-2, warmup_num_steps=10)
+    lrs = []
+    for _ in range(20):
+        sched.step()
+        lrs.append(opt.lr)
+    assert int(np.argmax(lrs)) in (9, 10)
+    assert lrs[-1] < lrs[10]
+
+
+def test_state_dict_roundtrip():
+    opt = FusedAdam(lr=1e-3)
+    sched = WarmupLR(opt, warmup_max_lr=1e-2, warmup_num_steps=10)
+    for _ in range(4):
+        sched.step()
+    sd = sched.state_dict()
+    opt2 = FusedAdam(lr=1e-3)
+    sched2 = WarmupLR(opt2, warmup_max_lr=1e-2, warmup_num_steps=10)
+    sched2.load_state_dict(sd)
+    sched.step()
+    sched2.step()
+    assert sched.get_last_lr() == sched2.get_last_lr()
+
+
+@pytest.mark.parametrize("name,params", [
+    ("LRRangeTest", {"lr_range_test_min_lr": 1e-4}),
+    ("OneCycle", {"cycle_min_lr": 1e-4, "cycle_max_lr": 1e-2}),
+    ("WarmupLR", {"warmup_max_lr": 1e-2, "warmup_num_steps": 5}),
+    ("WarmupDecayLR", {"warmup_max_lr": 1e-2, "warmup_num_steps": 5,
+                       "total_num_steps": 20}),
+])
+def test_schedulers_through_engine(name, params):
+    """Scheduler selected from config json steps per batch
+    (reference engine.py:465-480)."""
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "scheduler": {"type": name, "params": params},
+    }
+    engine, _, _, sched = deepspeed_tpu.initialize(
+        model=Model(lambda p, x, y: jnp.mean((x @ p["w"] - y) ** 2),
+                    {"w": jnp.zeros((4, 2))}),
+        config_params=config)
+    assert type(sched).__name__ == name
+    x = jnp.ones((8, 4))
+    y = jnp.ones((8, 2))
+    for _ in range(3):
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+    assert engine.lr_scheduler.last_batch_iteration == 2

@@ -1,0 +1,67 @@
+"""Multi-output model tests (reference tests/unit/test_multi_output_model.py:
+models returning (loss, aux...) tuples train correctly)."""
+import numpy as np
+
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.model import Model
+
+
+def test_tuple_output_first_element_is_loss():
+    def apply_fn(params, x, y):
+        pred = x @ params["w"]
+        loss = jnp.mean((pred - y) ** 2)
+        aux = jnp.mean(jnp.abs(pred))
+        return loss, aux
+
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 5e-2}},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=Model(apply_fn, {"w": jnp.zeros((16, 4))}),
+        config_params=config)
+    rs = np.random.RandomState(0)
+    W = rs.randn(16, 4).astype(np.float32)
+    x = jnp.asarray(rs.randn(8, 16).astype(np.float32))
+    y = x @ jnp.asarray(W)
+    losses = []
+    for _ in range(30):
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < 0.2 * losses[0], losses
+
+
+def test_weighted_multi_loss():
+    """Two losses combined with weights (the reference's multi-output
+    pattern)."""
+    w1, w2 = 0.7, 0.3
+
+    def apply_fn(params, x, y1, y2):
+        h = x @ params["w"]
+        loss1 = jnp.mean((h[:, :2] - y1) ** 2)
+        loss2 = jnp.mean((h[:, 2:] - y2) ** 2)
+        return w1 * loss1 + w2 * loss2
+
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 5e-2}},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=Model(apply_fn, {"w": jnp.zeros((8, 4))}),
+        config_params=config)
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(8, 8).astype(np.float32))
+    y1 = jnp.asarray(rs.randn(8, 2).astype(np.float32))
+    y2 = jnp.asarray(rs.randn(8, 2).astype(np.float32))
+    first = last = None
+    for _ in range(30):
+        loss = engine(x, y1, y2)
+        engine.backward(loss)
+        engine.step()
+        first = first if first is not None else float(loss)
+        last = float(loss)
+    assert last < first

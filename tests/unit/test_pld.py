@@ -1,0 +1,59 @@
+"""Progressive layer drop tests (reference tests/unit/test_pld.py)."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+from deepspeed_tpu.runtime.model import Model
+
+
+def test_theta_schedule():
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.001)
+    assert pld.get_theta() == 1.0
+    thetas = []
+    for step in range(0, 5000, 500):
+        pld.update_state(step)
+        thetas.append(pld.get_theta())
+    assert all(b <= a for a, b in zip(thetas, thetas[1:]))
+    assert thetas[0] == 1.0  # exp(0)
+    assert thetas[-1] > 0.5  # asymptote is theta_bar
+    pld.update_state(10 ** 9)
+    np.testing.assert_allclose(pld.get_theta(), 0.5, atol=1e-6)
+
+
+def test_pld_state_kwargs():
+    pld = ProgressiveLayerDrop(theta=0.6)
+    state = pld.get_state()
+    assert state["progressive_layer_drop"] is True
+    assert state["pld_theta"] == pld.get_theta()
+
+
+def test_pld_through_engine():
+    """Engine forwards pld kwargs into the model each step
+    (reference engine.py:899-900) and updates theta per global step."""
+    seen = []
+
+    def apply_fn(params, x, y, progressive_layer_drop=False, pld_theta=1.0):
+        seen.append((progressive_layer_drop, float(pld_theta)))
+        keep = jnp.asarray(pld_theta, dtype=jnp.float32)
+        return jnp.mean((x @ (params["w"] * keep) - y) ** 2)
+
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "progressive_layer_drop": {"enabled": True, "theta": 0.5,
+                                   "gamma": 0.01},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=Model(apply_fn, {"w": jnp.zeros((4, 2))}),
+        config_params=config)
+    assert engine.progressive_layer_drop is not None
+    x, y = jnp.ones((8, 4)), jnp.ones((8, 2))
+    for _ in range(3):
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+    assert seen and all(flag for flag, _ in seen)
+    assert engine.progressive_layer_drop.get_theta() < 1.0

@@ -37,45 +37,61 @@ def main():
 
     on_tpu = jax.default_backend() == "tpu"
     seq = 1024 if on_tpu else 128
-    micro_batch = 8 if on_tpu else 2
     steps = 20 if on_tpu else 3
     warmup = 3 if on_tpu else 1
+    # Largest stable micro-batch first (v5e 16G: 192 w/ full remat +
+    # chunked CE); fall back if the compiler rejects the footprint.
+    micro_batches = [192, 64, 16, 8] if on_tpu else [2]
 
     if on_tpu:
-        cfg = gpt2.config_for("gpt2_small", max_seq_len=seq, remat=True)
+        cfg = gpt2.config_for("gpt2_small", max_seq_len=seq, remat=True,
+                              loss_chunk=128)
     else:
         cfg = gpt2.GPT2Config(vocab_size=512, max_seq_len=seq, n_layers=2,
                               n_heads=4, d_model=128,
                               use_flash_attention=False, remat=False)
-    model = gpt2.make_gpt2_model(config=cfg)
     n_params = gpt2.num_params(cfg)
 
-    ds_config = {
-        "train_micro_batch_size_per_gpu": micro_batch,
-        "gradient_accumulation_steps": 1,
-        "bf16": {"enabled": True},
-        "zero_optimization": {"stage": 2},
-        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
-        "steps_per_print": 10 ** 9,
-    }
-    engine, _, _, _ = deepspeed.initialize(model=model, config_params=ds_config)
+    for micro_batch in micro_batches:
+        model = gpt2.make_gpt2_model(config=cfg)
+        ds_config = {
+            "train_micro_batch_size_per_gpu": micro_batch,
+            "gradient_accumulation_steps": 1,
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 2},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+            "steps_per_print": 10 ** 9,
+        }
+        engine, _, _, _ = deepspeed.initialize(model=model,
+                                               config_params=ds_config)
 
-    rng = np.random.RandomState(0)
-    global_batch = micro_batch * engine.dp_world_size
-    ids = rng.randint(0, cfg.vocab_size, size=(1, global_batch, seq)) \
-        .astype(np.int32)
-    batch = (ids, ids.copy())
+        rng = np.random.RandomState(0)
+        global_batch = micro_batch * engine.dp_world_size
+        ids = rng.randint(0, cfg.vocab_size, size=(1, global_batch, seq)) \
+            .astype(np.int32)
+        batch = (ids, ids.copy())
 
-    # compile + warmup
-    for _ in range(warmup):
-        loss = engine.train_batch(batch=batch)
-    jax.block_until_ready(engine.state["params"]["wte"])
+        try:
+            # compile + warmup
+            for _ in range(warmup):
+                loss = engine.train_batch(batch=batch)
+            jax.block_until_ready(engine.state["params"]["wte"])
 
-    t0 = time.time()
-    for _ in range(steps):
-        loss = engine.train_batch(batch=batch)
-    jax.block_until_ready(engine.state["params"]["wte"])
-    dt = time.time() - t0
+            t0 = time.time()
+            for _ in range(steps):
+                loss = engine.train_batch(batch=batch)
+            jax.block_until_ready(engine.state["params"]["wte"])
+            dt = time.time() - t0
+            break
+        except Exception as err:  # noqa: BLE001 - compiler OOM etc.
+            print("bench: micro_batch={} failed ({}), falling back".format(
+                micro_batch, str(err)[:80]), file=sys.stderr)
+            # free the failed attempt's state before building the next
+            # engine, or the retry runs with double the HBM footprint
+            del engine, model, batch
+            jax.clear_caches()
+    else:
+        raise RuntimeError("no benchmark configuration compiled")
 
     tokens_per_step = global_batch * seq
     tokens_per_sec = tokens_per_step * steps / dt

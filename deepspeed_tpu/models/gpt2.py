@@ -36,6 +36,8 @@ class GPT2Config:
     d_model: int = 768
     dropout: float = 0.0
     remat: bool = True             # activation checkpointing per block
+    remat_policy: str = "full"     # "full" | "dots" (save MXU outputs)
+    loss_chunk: int = 128          # CE seq-chunking (0 = dense logits)
     use_flash_attention: bool = True
     dtype: object = jnp.float32    # param dtype at init (engine recasts)
     # Sequence/context parallelism: "ring" | "ulysses" | None. When set,
@@ -203,8 +205,14 @@ def forward_hidden(params, input_ids, config, rng=None, train=False):
 
     block_fn = partial(_block, config=config, train=train)
     if config.remat:
-        block_fn = jax.checkpoint(block_fn,
-                                  policy=jax.checkpoint_policies.nothing_saveable)
+        # "full": recompute everything in bwd (min memory, ~4/3 flops);
+        # "dots": save matmul outputs, recompute elementwise only — the
+        # usual MFU sweet spot on TPU (HBM traffic for ln/gelu recompute is
+        # cheaper than re-running the gemms on the MXU).
+        policy = (jax.checkpoint_policies.nothing_saveable
+                  if config.remat_policy == "full" else
+                  jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        block_fn = jax.checkpoint(block_fn, policy=policy)
 
     rngs = (jax.random.split(rng, config.n_layers)
             if rng is not None else [None] * config.n_layers)
@@ -228,9 +236,46 @@ def causal_lm_cross_entropy(logits, labels):
     return -(token_ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
 
 
+def chunked_causal_lm_loss(hidden, wte, labels, chunk):
+    """Shifted masked CE without materializing the full (b, s, V) logits.
+
+    At GPT-2 vocab (50k) the dense fp32 logits are the single largest
+    activation (b=32, s=1024 -> 6.6 GB) and the reference's CUDA path never
+    holds them either (fused softmax-xent). A lax.scan over sequence chunks
+    computes each chunk's logits -> log-softmax -> gathered token ll and
+    drops them; jax.checkpoint on the body recomputes chunk logits in the
+    backward instead of saving them. Peak logits memory falls by s/chunk.
+    """
+    b, s, d = hidden.shape
+    shift_labels = jnp.concatenate(
+        [labels[:, 1:], jnp.full((b, 1), -100, labels.dtype)], axis=1)
+    n_chunks = s // chunk
+    h = hidden.reshape(b, n_chunks, chunk, d).transpose(1, 0, 2, 3)
+    lab = shift_labels.reshape(b, n_chunks, chunk).transpose(1, 0, 2)
+    wte_c = wte.astype(hidden.dtype)
+
+    def body(carry, xs):
+        hc, lc = xs
+        logits = (hc @ wte_c.T).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        mask = (lc != -100)
+        safe = jnp.where(mask, lc, 0)
+        ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        tot, cnt = carry
+        return (tot + (ll * mask).sum(),
+                cnt + mask.sum().astype(jnp.float32)), None
+
+    (tot, cnt), _ = jax.lax.scan(jax.checkpoint(body),
+                                 (jnp.float32(0), jnp.float32(0)), (h, lab))
+    return -tot / jnp.maximum(cnt, 1.0)
+
+
 def lm_loss(params, input_ids, labels, config, rng=None, train=True):
     """Causal LM cross-entropy (mean over tokens)."""
     hidden = forward_hidden(params, input_ids, config, rng=rng, train=train)
+    chunk = config.loss_chunk
+    if chunk and hidden.shape[1] % chunk == 0 and hidden.shape[1] > chunk:
+        return chunked_causal_lm_loss(hidden, params["wte"], labels, chunk)
     logits = hidden @ params["wte"].astype(hidden.dtype).T  # tied embedding
     return causal_lm_cross_entropy(logits, labels)
 

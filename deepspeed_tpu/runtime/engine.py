@@ -322,14 +322,16 @@ class DeepSpeedEngine:
         plan = self.zero_plan
         model = self.model
 
-        def micro(state, batch, rng):
+        def micro(state, batch, rng, pld_theta=None):
             kwargs = {**model.rng_kwargs(rng), **model.mode_kwargs(True)}
             if self.progressive_layer_drop:
-                # pass each PLD kwarg the model can actually accept
-                kwargs.update({
-                    k: v
-                    for k, v in self.progressive_layer_drop.get_state().items()
-                    if model.accepts_kwarg(k)})
+                # theta must arrive as a TRACED operand — reading
+                # get_theta() here would constant-fold the schedule's
+                # initial value into the compiled step
+                if model.accepts_kwarg("progressive_layer_drop"):
+                    kwargs["progressive_layer_drop"] = True
+                if model.accepts_kwarg("pld_theta"):
+                    kwargs["pld_theta"] = pld_theta
 
             def loss_fn(compute_params):
                 out = apply_fn(compute_params, *batch, **kwargs)
@@ -450,7 +452,8 @@ class DeepSpeedEngine:
         self._rng, step_rng = jax.random.split(self._rng)
         micro = self._get_jit("micro", self._micro_step_fn,
                               donate_argnums=(0,))
-        self.state, loss = micro(self.state, batch, step_rng)
+        self.state, loss = micro(self.state, batch, step_rng,
+                                 self._pld_theta())
         if self.wall_clock_breakdown():
             self.timers(FORWARD_MICRO_TIMER).stop()
         self._last_loss = loss
@@ -503,6 +506,12 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown():
             self.timers(STEP_MICRO_TIMER).stop()
 
+    def _pld_theta(self):
+        """Current PLD keep-prob as a traced-operand scalar (1.0 = off)."""
+        if self.progressive_layer_drop:
+            return jnp.float32(self.progressive_layer_drop.get_theta())
+        return jnp.float32(1.0)
+
     def _take_model_step(self, lr_kwargs=None):
         apply_fn = self._get_jit("apply", self._apply_step_fn,
                                  donate_argnums=(0,))
@@ -540,7 +549,8 @@ class DeepSpeedEngine:
         fused = self._get_jit("fused_train", self._fused_train_fn,
                               donate_argnums=(0,))
         self.state, (mean_loss, metrics) = fused(self.state, batch, step_rng,
-                                                 self._hyper())
+                                                 self._hyper(),
+                                                 self._pld_theta())
         overflow = bool(metrics["overflow"])
         if overflow:
             self.skipped_steps += 1
@@ -570,12 +580,12 @@ class DeepSpeedEngine:
         apply_step = self._apply_step_fn()
         gas = self.gradient_accumulation_steps()
 
-        def fused(state, stacked_batch, rng, hyper):
+        def fused(state, stacked_batch, rng, hyper, pld_theta):
             rngs = jax.random.split(rng, gas)
 
             def body(carry, xs):
                 batch_i, rng_i = xs
-                new_state, loss = micro(carry, batch_i, rng_i)
+                new_state, loss = micro(carry, batch_i, rng_i, pld_theta)
                 return new_state, loss
 
             leaves, treedef = jax.tree_util.tree_flatten(stacked_batch)

@@ -105,3 +105,23 @@ def test_num_params_formula():
     cfg = gpt2.config_for("gpt2_small")
     n = gpt2.num_params(cfg)
     assert 120e6 < n < 170e6  # 125M class (padded vocab)
+
+
+def test_chunked_lm_loss_matches_dense():
+    """loss_chunk CE == dense-logits CE in value and gradient."""
+    kw = dict(vocab_size=512, max_seq_len=256, n_layers=2, n_heads=4,
+              d_model=128, use_flash_attention=False, remat=False)
+    cfg_c = gpt2.GPT2Config(loss_chunk=64, **kw)
+    cfg_d = gpt2.GPT2Config(loss_chunk=0, **kw)
+    params = gpt2.init_params(cfg_c, seed=0)
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, 512, size=(2, 256)))
+    labels = ids.at[:, 5].set(-100)  # exercise the -100 mask
+    l_c = gpt2.lm_loss(params, ids, labels, cfg_c)
+    l_d = gpt2.lm_loss(params, ids, labels, cfg_d)
+    np.testing.assert_allclose(float(l_c), float(l_d), rtol=1e-5)
+    g_c = jax.grad(lambda p: gpt2.lm_loss(p, ids, labels, cfg_c))(params)
+    g_d = jax.grad(lambda p: gpt2.lm_loss(p, ids, labels, cfg_d))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g_c),
+                    jax.tree_util.tree_leaves(g_d)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)

@@ -32,28 +32,36 @@ def test_pld_state_kwargs():
 
 def test_pld_through_engine():
     """Engine forwards pld kwargs into the model each step
-    (reference engine.py:899-900) and updates theta per global step."""
-    seen = []
+    (reference engine.py:899-900) with theta as a TRACED operand — the
+    loss below returns the theta the compiled step actually used, so a
+    constant-folded schedule would show as a flat loss."""
 
     def apply_fn(params, x, y, progressive_layer_drop=False, pld_theta=1.0):
-        seen.append((progressive_layer_drop, float(pld_theta)))
-        keep = jnp.asarray(pld_theta, dtype=jnp.float32)
-        return jnp.mean((x @ (params["w"] * keep) - y) ** 2)
+        assert progressive_layer_drop
+        theta = jnp.asarray(pld_theta, dtype=jnp.float32)
+        return jnp.mean((x @ params["w"] - y) ** 2) * 0.0 + theta
 
     config = {
         "train_batch_size": 8,
         "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
         "progressive_layer_drop": {"enabled": True, "theta": 0.5,
-                                   "gamma": 0.01},
+                                   "gamma": 0.1},
     }
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=Model(apply_fn, {"w": jnp.zeros((4, 2))}),
         config_params=config)
     assert engine.progressive_layer_drop is not None
     x, y = jnp.ones((8, 4)), jnp.ones((8, 2))
-    for _ in range(3):
+    executed_thetas = []
+    for _ in range(5):
         loss = engine(x, y)
         engine.backward(loss)
         engine.step()
-    assert seen and all(flag for flag, _ in seen)
-    assert engine.progressive_layer_drop.get_theta() < 1.0
+        executed_thetas.append(float(loss))
+    # the model-side theta must follow the host schedule, not the
+    # trace-time constant 1.0; forward at step i sees theta(i-1) (the
+    # engine updates theta after each optimizer step)
+    host = [1.0, 1.0] + [(1.0 - 0.5) * np.exp(-0.1 * s) + 0.5
+                         for s in range(1, 4)]
+    np.testing.assert_allclose(executed_thetas, host, rtol=1e-5)
+    assert executed_thetas[-1] < 0.9, executed_thetas

@@ -86,6 +86,12 @@ class DeepSpeedEngine:
         self.training_dataloader = self.deepspeed_io(training_data) \
             if training_data is not None else None
 
+        from ..utils.monitor import SummaryMonitor
+        # rank-0 writer (reference :154); gate BEFORE construction so
+        # non-writer ranks never create files/handles
+        self.monitor = SummaryMonitor.from_config(
+            self._config, enabled=jax.process_index() == 0)
+
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_micro_batch_size_per_gpu(),
@@ -497,14 +503,37 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown():
             self.timers(STEP_MICRO_TIMER).start()
 
-        if self.is_gradient_accumulation_boundary():
+        boundary = self.is_gradient_accumulation_boundary()
+        if boundary:
             self._take_model_step(lr_kwargs)
 
         self.micro_steps += 1
         self.global_samples += self.train_micro_batch_size_per_gpu() * \
             self.dp_world_size
+        if boundary:
+            self._write_monitor_scalars(self._last_loss)
         if self.wall_clock_breakdown():
             self.timers(STEP_MICRO_TIMER).stop()
+
+    def _write_monitor_scalars(self, loss):
+        """Train/Samples/{lr,train_loss,loss_scale} at each global step
+        (reference engine.py:1110-1124)."""
+        if not self.monitor.enabled:
+            return
+        self.monitor.add_scalar("Train/Samples/lr", self.get_lr()[0],
+                                self.global_samples)
+        if loss is not None:
+            self.monitor.add_scalar("Train/Samples/train_loss", float(loss),
+                                    self.global_samples)
+        self.monitor.add_scalar("Train/Samples/loss_scale",
+                                float(self._step_metrics["loss_scale"]),
+                                self.global_samples)
+
+    def _adapt_state_dict(self, sd):
+        """Hook for subclasses to re-partition a loaded state dict before
+        placement (PipelineEngine re-shards body layers across a different
+        stage count)."""
+        return sd
 
     def _pld_theta(self):
         """Current PLD keep-prob as a traced-operand scalar (1.0 = off)."""
@@ -556,10 +585,14 @@ class DeepSpeedEngine:
             self.skipped_steps += 1
         elif self.lr_scheduler is not None:
             self.lr_scheduler.step()
+        if self.progressive_layer_drop:
+            self.progressive_layer_drop.update_state(self.global_steps)
         self.global_steps += 1
         self.micro_steps += gas
         self.global_samples += self.train_batch_size()
         self._step_metrics = metrics
+        self._last_loss = mean_loss
+        self._write_monitor_scalars(mean_loss)
         return mean_loss
 
     def _to_device_stacked(self, batch):
@@ -801,6 +834,7 @@ class DeepSpeedEngine:
                            "exist".format(path))
             return None, None
         sd = ckpt.load_state_dict(path)
+        sd = self._adapt_state_dict(sd)
 
         plan = self.zero_plan
         param_sh = plan.tree_shardings(self.state["params"], "param")

@@ -1,0 +1,3 @@
+from . import loss_scaler
+from .fused_optimizer import FP16_Optimizer, FP16_UnfusedOptimizer
+from .onebit_adam import OnebitAdam

@@ -1,0 +1,125 @@
+"""FP16_Optimizer: standalone mixed-precision wrapper.
+
+Reference parity: deepspeed/runtime/fp16/fused_optimizer.py (FP16_Optimizer
+:17) and unfused_optimizer.py (FP16_UnfusedOptimizer :17). Inside the
+engine this machinery is inlined into the jitted apply-step
+(engine._apply_step_fn); these classes exist for users driving an optimizer
+directly, with the reference's surface — flat fp32 master copy, overflow
+check -> dynamic loss scale update -> unscale/clip -> base step — in
+functional form: the torch version's ``backward(loss)`` becomes "hand me
+the (scaled) grads", since grads come from jax.value_and_grad, not
+autograd tape hooks.
+
+The "fused" vs "unfused" split (flat master buffer vs per-tensor masters,
+needed because LAMB wants per-tensor trust ratios) disappears: pytrees are
+per-tensor already, and the fused Adam/LAMB kernels consume them directly —
+both names are provided, one implementation.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..utils import CheckOverflow, clip_grad_norm_
+from . import loss_scaler as ls
+
+
+class FP16_Optimizer:
+    """Functional mixed-precision wrapper around a deepspeed_tpu optimizer
+    (FusedAdam / FusedLamb / ...)."""
+
+    def __init__(self, init_optimizer, static_loss_scale=1.0,
+                 dynamic_loss_scale=False, initial_dynamic_scale=2 ** 32,
+                 dynamic_loss_args=None, verbose=False, mpu=None,
+                 clip_grad=0.0, fused_adam_legacy=False):
+        self.optimizer = init_optimizer
+        self.clip_grad = clip_grad
+        args = dynamic_loss_args or {}
+        if dynamic_loss_scale:
+            self.scaler = ls.create_loss_scaler(
+                static_loss_scale=None,
+                init_scale=args.get("init_scale", initial_dynamic_scale),
+                scale_window=args.get("scale_window", 1000),
+                min_scale=args.get("min_scale", 1.0),
+                delayed_shift=args.get("delayed_shift", 1))
+        else:
+            self.scaler = ls.create_loss_scaler(
+                static_loss_scale=static_loss_scale)
+        self.overflow = False
+        self._master = None
+        self._opt_state = None
+
+    # -- state ---------------------------------------------------------------
+    def initialize_state(self, params):
+        """fp32 master copy + base optimizer state from (half) params."""
+        self._master = jax.tree_util.tree_map(
+            lambda p: jnp.asarray(p, jnp.float32), params)
+        self._opt_state = self.optimizer.init_state(self._master)
+        return self._master
+
+    @property
+    def loss_scale(self):
+        return float(self.scaler.cur_scale)
+
+    @property
+    def cur_scale(self):
+        return self.scaler.cur_scale
+
+    # -- the reference's backward(loss) half: scale ---------------------------
+    def scale_loss(self, loss):
+        """Multiply the loss by the current scale before value_and_grad
+        (reference backward() :181-186)."""
+        return ls.backward_scale(loss, self.scaler)
+
+    # -- step -----------------------------------------------------------------
+    def step(self, grads, params):
+        """Overflow check -> unscale -> clip -> base step -> recast.
+
+        ``grads`` are SCALED half/float grads of the half ``params``.
+        Returns (new_params, overflow: bool). Master/opt state carried
+        internally (reference step :33-132).
+        """
+        if self._master is None:
+            self.initialize_state(params)
+        overflow = CheckOverflow.has_overflow(grads)
+        inv = 1.0 / self.scaler.cur_scale
+        grads32 = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32) * inv, grads)
+        if self.clip_grad > 0:
+            grads32, _ = clip_grad_norm_(grads32, self.clip_grad)
+        h = self.optimizer.hyperparams()
+        new_master, new_opt = self.optimizer.update(
+            grads32, self._opt_state, self._master, **h)
+        keep = lambda new, old: jax.tree_util.tree_map(
+            lambda n, o: jnp.where(overflow, o, n), new, old)
+        self._master = keep(new_master, self._master)
+        self._opt_state = keep(new_opt, self._opt_state)
+        self.scaler = ls.update_scale(self.scaler, overflow)
+        self.overflow = bool(overflow)
+        new_params = jax.tree_util.tree_map(
+            lambda m, p: m.astype(p.dtype), self._master, params)
+        return new_params, self.overflow
+
+    # -- checkpoint -----------------------------------------------------------
+    def state_dict(self):
+        return {
+            "dynamic_loss_scale": self.scaler.dynamic,
+            "cur_scale": float(self.scaler.cur_scale),
+            "cur_iter": int(self.scaler.cur_iter),
+            "optimizer_state_dict": self._opt_state,
+            "fp32_groups_flat": self._master,
+            "clip_grad": self.clip_grad,
+        }
+
+    def load_state_dict(self, sd, load_optimizer_states=True):
+        self.scaler = self.scaler._replace(
+            cur_scale=jnp.asarray(sd["cur_scale"], jnp.float32),
+            cur_iter=jnp.asarray(sd["cur_iter"], jnp.int32))
+        self.clip_grad = sd.get("clip_grad", self.clip_grad)
+        if sd.get("fp32_groups_flat") is not None:
+            self._master = sd["fp32_groups_flat"]
+        if load_optimizer_states and sd.get("optimizer_state_dict") is not None:
+            self._opt_state = sd["optimizer_state_dict"]
+
+
+# Per-tensor-master variant needed for LAMB in the reference
+# (unfused_optimizer.py) — identical here, pytrees are per-tensor.
+FP16_UnfusedOptimizer = FP16_Optimizer

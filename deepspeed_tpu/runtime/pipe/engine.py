@@ -278,3 +278,58 @@ class PipelineEngine(DeepSpeedEngine):
 
     def is_gradient_accumulation_boundary(self):
         return True
+
+    # ------------------------------------------------------------ checkpoint
+    def save_checkpoint(self, save_dir, tag=None, client_state=None,
+                        save_latest=True):
+        """Engine checkpoint + per-layer body files
+        (reference pipe/module.py:536-546: layer_NN-model_00-model_states.pt
+        written so stages can be re-partitioned on load)."""
+        from .. import checkpointing as ckpt
+        ok = super().save_checkpoint(save_dir, tag=tag,
+                                     client_state=client_state,
+                                     save_latest=save_latest)
+        if jax.process_index() != 0:
+            return ok
+        tag = self._get_ckpt_tag(tag)
+        body = ckpt.tree_to_numpy(self.state["params"]["body"])
+        S = self.pipe_module.num_stages
+        L = self.pipe_module.layers_per_stage
+        for layer_id in range(S * L):
+            s, l = divmod(layer_id, L)
+            layer_tree = jax.tree_util.tree_map(lambda x: x[s][l], body)
+            ckpt.save_state_dict(
+                ckpt.layer_ckpt_name(save_dir, tag, layer_id), layer_tree)
+        return ok
+
+    def _adapt_state_dict(self, sd):
+        """Re-partition a checkpoint written at a different stage count:
+        body leaves are stacked (S, L, ...) in global layer order, so
+        re-sharding across stages is a reshape (the reference re-reads the
+        per-layer files; both layouts are written)."""
+        S = self.pipe_module.num_stages
+        L = self.pipe_module.layers_per_stage
+
+        def reshape_body(tree):
+            if not isinstance(tree, dict) or "body" not in tree:
+                return tree
+            def fix(leaf):
+                if hasattr(leaf, "shape") and len(leaf.shape) >= 2 and \
+                        leaf.shape[0] * leaf.shape[1] == S * L and \
+                        (leaf.shape[0], leaf.shape[1]) != (S, L):
+                    return leaf.reshape((S, L) + leaf.shape[2:])
+                return leaf
+            out = dict(tree)
+            out["body"] = jax.tree_util.tree_map(fix, tree["body"])
+            return out
+
+        sd = dict(sd)
+        for key in ("module", "master"):
+            if sd.get(key) is not None:
+                sd[key] = reshape_body(sd[key])
+        if sd.get("optimizer") is not None:
+            sd["optimizer"] = {
+                k: v if k == "step" else reshape_body(v)
+                for k, v in sd["optimizer"].items()
+            }
+        return sd

@@ -1,0 +1,63 @@
+"""Training-scalar monitor: TensorBoard when available, JSONL always.
+
+Reference parity: the engine's SummaryWriter usage (engine.py:154-155,
+256-281, 964-975, 1110-1124 — Train/Samples/{lr,loss,loss_scale} scalars
+keyed by global samples). On TPU hosts TensorBoard may be absent, so every
+scalar is also appended to ``events.jsonl`` in the output path — one
+``{"tag", "value", "step", "wall"}`` object per line — which xprof-era
+tooling and plain pandas both ingest.
+"""
+import json
+import os
+import time
+
+from .logging import logger
+
+
+class SummaryMonitor:
+    """SummaryWriter-shaped facade (add_scalar/flush/close)."""
+
+    def __init__(self, output_path, job_name="DeepSpeedJobName",
+                 enabled=True):
+        self.enabled = enabled and bool(output_path)
+        self.output_path = os.path.join(output_path or "", job_name or "")
+        self._tb = None
+        self._jsonl = None
+        if not self.enabled:
+            return
+        os.makedirs(self.output_path, exist_ok=True)
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            self._tb = SummaryWriter(log_dir=self.output_path)
+        except Exception:  # noqa: BLE001 - tensorboard genuinely optional
+            logger.info("tensorboard unavailable; monitor writes JSONL only")
+        self._jsonl = open(os.path.join(self.output_path, "events.jsonl"),
+                           "a", buffering=1)
+
+    @classmethod
+    def from_config(cls, config, enabled=True):
+        return cls(config.tensorboard_output_path,
+                   config.tensorboard_job_name,
+                   enabled=enabled and config.tensorboard_enabled)
+
+    def add_scalar(self, tag, value, step):
+        if not self.enabled:
+            return
+        value = float(value)
+        if self._tb is not None:
+            self._tb.add_scalar(tag, value, step)
+        if self._jsonl is not None:
+            self._jsonl.write(json.dumps(
+                {"tag": tag, "value": value, "step": int(step),
+                 "wall": time.time()}) + "\n")
+
+    def flush(self):
+        if self._tb is not None:
+            self._tb.flush()
+
+    def close(self):
+        if self._tb is not None:
+            self._tb.close()
+        if self._jsonl is not None:
+            self._jsonl.close()
+            self._jsonl = None

@@ -1,0 +1,79 @@
+"""FP16_Optimizer standalone wrapper tests (reference tests/unit/test_fp16).
+The engine path is covered in test_engine; this locks the direct-use API."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.adam.fused_adam import FusedAdam
+from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb
+from deepspeed_tpu.runtime.fp16.fused_optimizer import (FP16_Optimizer,
+                                                        FP16_UnfusedOptimizer)
+
+
+def _loss(params, x, y):
+    return jnp.mean((x @ params["w"] - y) ** 2)
+
+
+def test_converges_with_dynamic_scale():
+    opt = FP16_Optimizer(FusedAdam(lr=5e-2), dynamic_loss_scale=True,
+                         dynamic_loss_args={"init_scale": 2 ** 8})
+    rs = np.random.RandomState(0)
+    W = rs.randn(16, 4).astype(np.float32)
+    x = jnp.asarray(rs.randn(32, 16).astype(np.float32))
+    y = x @ jnp.asarray(W)
+    params = {"w": jnp.zeros((16, 4), dtype=jnp.bfloat16)}
+    losses = []
+    for _ in range(40):
+        def scaled_loss(p):
+            return opt.scale_loss(_loss(p, x, y))
+        grads = jax.grad(scaled_loss)(params)
+        params, overflow = opt.step(grads, params)
+        losses.append(float(_loss(params, x, y)))
+    assert losses[-1] < 0.1 * losses[0], losses
+
+
+def test_overflow_skips_and_halves_scale():
+    opt = FP16_Optimizer(FusedAdam(lr=1e-2), dynamic_loss_scale=True,
+                         dynamic_loss_args={"init_scale": 2 ** 8})
+    params = {"w": jnp.ones((4, 4), dtype=jnp.bfloat16)}
+    opt.initialize_state(params)
+    bad = {"w": jnp.full((4, 4), jnp.inf, dtype=jnp.float32)}
+    new_params, overflow = opt.step(bad, params)
+    assert overflow
+    assert opt.loss_scale == 2 ** 7
+    np.testing.assert_allclose(np.asarray(new_params["w"], dtype=np.float32),
+                               np.asarray(params["w"], dtype=np.float32))
+
+
+def test_static_scale_and_clip():
+    opt = FP16_Optimizer(FusedAdam(lr=1e-2), static_loss_scale=64.0,
+                         clip_grad=1.0)
+    assert opt.loss_scale == 64.0
+    loss = opt.scale_loss(jnp.asarray(2.0))
+    assert float(loss) == 128.0
+
+
+def test_state_dict_roundtrip():
+    opt = FP16_Optimizer(FusedAdam(lr=1e-2), dynamic_loss_scale=True)
+    params = {"w": jnp.ones((4, 2), dtype=jnp.bfloat16)}
+    grads = {"w": jnp.ones((4, 2), dtype=jnp.float32)}
+    opt.step(grads, params)
+    sd = opt.state_dict()
+    opt2 = FP16_Optimizer(FusedAdam(lr=1e-2), dynamic_loss_scale=True)
+    opt2.initialize_state(params)
+    opt2.load_state_dict(sd)
+    assert opt2.loss_scale == opt.loss_scale
+    np.testing.assert_allclose(np.asarray(opt2._master["w"]),
+                               np.asarray(opt._master["w"]))
+
+
+def test_unfused_is_fused_and_takes_lamb():
+    assert FP16_UnfusedOptimizer is FP16_Optimizer
+    opt = FP16_UnfusedOptimizer(FusedLamb(lr=1e-2))
+    params = {"w": jnp.ones((8, 4), dtype=jnp.bfloat16)}
+    grads = {"w": jnp.full((8, 4), 0.1, dtype=jnp.float32)}
+    new_params, overflow = opt.step(grads, params)
+    assert not overflow
+    assert not np.allclose(np.asarray(new_params["w"], dtype=np.float32),
+                           np.asarray(params["w"], dtype=np.float32))

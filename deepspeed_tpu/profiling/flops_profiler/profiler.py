@@ -39,14 +39,9 @@ class FlopsProfiler(object):
         self.bytes_accessed = None
 
     def profile_engine_step(self):
-        """Cost analysis of the engine's cached micro-step executable."""
-        eng = self.engine
-        micro = eng._jit_cache.get("micro") or eng._jit_cache.get("fused_train")
-        if micro is None:
-            return {}
-        # Costs for already-lowered executables are cached by jax; re-lowering
-        # with the live state is cheap because shapes are unchanged.
-        return {}
+        """Cost analysis of the engine's profiled step (recorded by the
+        engine at flops_profiler.profile_step — engine._flops_costs)."""
+        return getattr(self.engine, "_flops_costs", None) or {}
 
     def get_total_flops(self, fn=None, args=()):
         if fn is not None:

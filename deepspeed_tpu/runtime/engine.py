@@ -458,6 +458,14 @@ class DeepSpeedEngine:
         self._rng, step_rng = jax.random.split(self._rng)
         micro = self._get_jit("micro", self._micro_step_fn,
                               donate_argnums=(0,))
+        if flops_profiler:
+            # cost-analyze the EXACT executable about to run (lowering and
+            # compile are cached by jax; cheap at unchanged shapes). Some
+            # jax builds only expose costs on the compiled object.
+            lowered = micro.lower(self.state, batch, step_rng,
+                                  self._pld_theta())
+            self._flops_costs = lowered.cost_analysis() or \
+                lowered.compile().cost_analysis() or {}
         self.state, loss = micro(self.state, batch, step_rng,
                                  self._pld_theta())
         if self.wall_clock_breakdown():
@@ -528,6 +536,7 @@ class DeepSpeedEngine:
         self.monitor.add_scalar("Train/Samples/loss_scale",
                                 float(self._step_metrics["loss_scale"]),
                                 self.global_samples)
+        self.monitor.flush()
 
     def _adapt_state_dict(self, sd):
         """Hook for subclasses to re-partition a loaded state dict before
@@ -731,6 +740,10 @@ class DeepSpeedEngine:
         if getattr(self, "_flops_profiler_active", False):
             from ..profiling.flops_profiler.profiler import FlopsProfiler
             prof = FlopsProfiler(self)
+            costs = getattr(self, "_flops_costs", None) or {}
+            prof.flops = costs.get("flops", 0.0)
+            prof.bytes_accessed = costs.get("bytes accessed", 0.0)
+            self.flops_profiler = prof
             prof.print_model_profile()
             self._flops_profiler_active = False
 

@@ -104,15 +104,24 @@ class FP16_Optimizer:
             "dynamic_loss_scale": self.scaler.dynamic,
             "cur_scale": float(self.scaler.cur_scale),
             "cur_iter": int(self.scaler.cur_iter),
+            "last_overflow_iter": int(self.scaler.last_overflow_iter),
+            "cur_hysteresis": int(self.scaler.cur_hysteresis),
             "optimizer_state_dict": self._opt_state,
             "fp32_groups_flat": self._master,
             "clip_grad": self.clip_grad,
         }
 
     def load_state_dict(self, sd, load_optimizer_states=True):
+        # the full scaler schedule state must survive resume: growth window
+        # keys off last_overflow_iter, overflow response off hysteresis
         self.scaler = self.scaler._replace(
             cur_scale=jnp.asarray(sd["cur_scale"], jnp.float32),
-            cur_iter=jnp.asarray(sd["cur_iter"], jnp.int32))
+            cur_iter=jnp.asarray(sd["cur_iter"], jnp.int32),
+            last_overflow_iter=jnp.asarray(
+                sd.get("last_overflow_iter", -1), jnp.int32),
+            cur_hysteresis=jnp.asarray(
+                sd.get("cur_hysteresis", self.scaler.delayed_shift),
+                jnp.int32))
         self.clip_grad = sd.get("clip_grad", self.clip_grad)
         if sd.get("fp32_groups_flat") is not None:
             self._master = sd["fp32_groups_flat"]

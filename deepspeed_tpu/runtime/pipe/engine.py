@@ -252,6 +252,8 @@ class PipelineEngine(DeepSpeedEngine):
         self.micro_steps += self.micro_batches
         self.global_samples += self.train_batch_size()
         self._step_metrics = metrics
+        self._last_loss = mean_loss
+        self._write_monitor_scalars(mean_loss)
         return mean_loss
 
     def eval_batch(self, data_iter=None, batch=None):

@@ -19,13 +19,21 @@ class SummaryMonitor:
 
     def __init__(self, output_path, job_name="DeepSpeedJobName",
                  enabled=True):
-        self.enabled = enabled and bool(output_path)
+        self.enabled = enabled
+        if enabled and not output_path:
+            # reference SummaryWriter defaults to ./runs; don't silently
+            # drop scalars the user asked for
+            output_path = "runs"
+            logger.info("tensorboard enabled with no output_path; "
+                        "writing to ./runs")
         self.output_path = os.path.join(output_path or "", job_name or "")
         self._tb = None
         self._jsonl = None
         if not self.enabled:
             return
         os.makedirs(self.output_path, exist_ok=True)
+        import atexit
+        atexit.register(self.close)
         try:
             from torch.utils.tensorboard import SummaryWriter
             self._tb = SummaryWriter(log_dir=self.output_path)

@@ -1,0 +1,71 @@
+"""Flops profiler + wall-clock breakdown tests (reference
+tests/unit/test_flops_profiler.py)."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.profiling.flops_profiler.profiler import (
+    get_model_profile, cost_analysis_of)
+from deepspeed_tpu.runtime.model import Model
+
+
+def test_cost_analysis_counts_matmul_flops():
+    def fn(a, b):
+        return a @ b
+
+    a = jnp.ones((64, 128))
+    b = jnp.ones((128, 32))
+    costs = cost_analysis_of(fn, a, b)
+    # 2*M*N*K FMA-counted flops, allow backend accounting slack
+    assert costs.get("flops", 0) >= 64 * 128 * 32
+
+
+def test_get_model_profile():
+    def fn(x):
+        return (x @ jnp.ones((32, 8))).sum()
+
+    flops, macs, params = get_model_profile(fn, (jnp.ones((16, 32)),),
+                                            print_profile=False,
+                                            as_string=False)
+    assert flops > 0
+
+
+def test_engine_profiles_at_profile_step():
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "flops_profiler": {"enabled": True, "profile_step": 1},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=Model(lambda p, x, y: jnp.mean((x @ p["w"] - y) ** 2),
+                    {"w": jnp.zeros((16, 4))}),
+        config_params=config)
+    x, y = jnp.ones((8, 16)), jnp.ones((8, 4))
+    for _ in range(3):
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+    costs = engine.flops_profiler.profile_engine_step()
+    assert costs.get("flops", 0) > 0
+    assert engine.flops_profiler.flops == costs["flops"]
+
+
+def test_wall_clock_breakdown_timers():
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "wall_clock_breakdown": True,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=Model(lambda p, x, y: jnp.mean((x @ p["w"] - y) ** 2),
+                    {"w": jnp.zeros((16, 4))}),
+        config_params=config)
+    x, y = jnp.ones((8, 16)), jnp.ones((8, 4))
+    for _ in range(2):
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+    fwd = engine.timers("forward_microstep")
+    assert fwd.elapsed(reset=False) > 0.0

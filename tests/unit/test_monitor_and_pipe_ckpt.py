@@ -60,18 +60,6 @@ def test_engine_writes_monitor_scalars(tmp_path):
     assert losses[0]["step"] == 8 and losses[-1]["step"] == 24
 
 
-def _make_pipe(num_stages, n_layers=4):
-    from deepspeed_tpu.runtime.pipe.module import PipelineModule
-    from deepspeed_tpu.models import gpt2_pipe, gpt2
-    cfg = gpt2.GPT2Config(vocab_size=128, max_seq_len=32, n_layers=n_layers,
-                          n_heads=2, d_model=32, use_flash_attention=False,
-                          remat=False)
-    return gpt2_pipe.make_gpt2_pipeline(config=cfg, num_stages=num_stages,
-                                        num_dp=8 // max(num_stages, 1) //
-                                        (2 if num_stages == 2 else 1),
-                                        num_mp=1), cfg
-
-
 def test_pipeline_per_layer_files_and_repartition(tmp_path):
     from deepspeed_tpu.models import gpt2_pipe, gpt2
     cfg = gpt2.GPT2Config(vocab_size=128, max_seq_len=32, n_layers=4,

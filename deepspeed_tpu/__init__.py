@@ -13,8 +13,12 @@ from .runtime.config import DeepSpeedConfig, DeepSpeedConfigError
 from .runtime.activation_checkpointing import checkpointing
 from . import zero
 
-__git_hash__ = None
-__git_branch__ = None
+try:
+    from .git_version_info import git_hash as __git_hash__, \
+        git_branch as __git_branch__
+except ImportError:
+    __git_hash__ = None
+    __git_branch__ = None
 
 
 def initialize(args=None, model=None, optimizer=None, model_parameters=None,

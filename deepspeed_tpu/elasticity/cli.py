@@ -1,0 +1,35 @@
+"""``ds_elastic``: elasticity config explorer (reference bin/ds_elastic)."""
+import argparse
+import json
+
+from . import compute_elastic_config
+from ..version import __version__
+
+
+def main(args=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-c", "--config", type=str, required=True,
+                        help="DeepSpeed config json")
+    parser.add_argument("-w", "--world-size", type=int, default=0,
+                        help="Intended/current world size (chips)")
+    args = parser.parse_args(args=args)
+    with open(args.config, "r") as fd:
+        ds_config = json.load(fd)
+    print("Config:", json.dumps(ds_config.get("elasticity", {}), indent=2))
+    if args.world_size > 0:
+        batch, valid_chips, micro = compute_elastic_config(
+            ds_config, __version__, world_size=args.world_size)
+        print("Final batch size: {}".format(batch))
+        print("Valid chip counts: {}".format(valid_chips))
+        print("Micro batch size: {}".format(micro))
+        print("Grad accum steps: {}".format(
+            batch // (micro * args.world_size)))
+    else:
+        batch, valid_chips = compute_elastic_config(ds_config, __version__)
+        print("Final batch size: {}".format(batch))
+        print("Valid chip counts: {}".format(valid_chips))
+    return 0
+
+
+if __name__ == "__main__":
+    main()

@@ -13,9 +13,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# (8, 128) f32 tiles; block rows chosen to keep 4 in + 3 out blocks < VMEM.
-_LANE = 128
-_BLOCK_ROWS = 1024
+from ..pallas_utils import LANE as _LANE, BLOCK_ROWS as _BLOCK_ROWS, \
+    flatten_pad_2d
 
 
 def _adam_kernel(p_ref, g_ref, m_ref, v_ref, sc_ref,
@@ -69,21 +68,8 @@ def fused_adam_shard(p, g, m, v, lr, beta1, beta2, eps, weight_decay,
 
     Returns (new_p (in p.dtype), new_m, new_v). Scalars may be traced.
     """
-    shape, dtype = p.shape, p.dtype
-    n = p.size
-    p32 = p.reshape(-1).astype(jnp.float32)
-    g32 = g.reshape(-1).astype(jnp.float32)
-    m32 = m.reshape(-1)
-    v32 = v.reshape(-1)
-
-    pad = (-n) % (_LANE * 8)
-    if pad:
-        p32 = jnp.pad(p32, (0, pad))
-        g32 = jnp.pad(g32, (0, pad))
-        m32 = jnp.pad(m32, (0, pad))
-        v32 = jnp.pad(v32, (0, pad))
-    rows = p32.size // _LANE
-    to2d = lambda x: x.reshape(rows, _LANE)
+    dtype = p.dtype
+    (p32, g32, m32, v32), rows, unpad = flatten_pad_2d(p, g, m, v)
 
     scalars = jnp.stack([
         jnp.asarray(lr, jnp.float32), jnp.asarray(beta1, jnp.float32),
@@ -92,8 +78,6 @@ def fused_adam_shard(p, g, m, v, lr, beta1, beta2, eps, weight_decay,
         jnp.asarray(bc1, jnp.float32), jnp.asarray(bc2, jnp.float32)])
 
     new_p, new_m, new_v = _fused_adam_flat(
-        to2d(p32), to2d(g32), to2d(m32), to2d(v32), scalars,
-        adam_w_mode=bool(adam_w_mode))
+        p32, g32, m32, v32, scalars, adam_w_mode=bool(adam_w_mode))
 
-    unpad = lambda x: x.reshape(-1)[:n].reshape(shape)
     return unpad(new_p).astype(dtype), unpad(new_m), unpad(new_v)

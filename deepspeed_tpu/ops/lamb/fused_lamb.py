@@ -21,7 +21,7 @@ def lamb_init(params):
 
 def lamb_update(grads, state, params, lr, beta1, beta2, eps, weight_decay,
                 bias_correction=True, max_coeff=10.0, min_coeff=0.01,
-                eps_inside_sqrt=False):
+                eps_inside_sqrt=False, use_pallas=False):
     """One LAMB step over a pytree; returns (new_params, new_state)."""
     step = state["step"] + 1
     if bias_correction:
@@ -29,6 +29,13 @@ def lamb_update(grads, state, params, lr, beta1, beta2, eps, weight_decay,
         bc2 = 1.0 - jnp.power(beta2, step.astype(jnp.float32))
     else:
         bc1 = bc2 = 1.0
+
+    def pallas_leaf(p, g, m, v):
+        from .pallas_lamb import fused_lamb_shard
+        return fused_lamb_shard(p, g, m, v, lr, beta1, beta2, eps,
+                                weight_decay, bc1, bc2,
+                                max_coeff=max_coeff, min_coeff=min_coeff,
+                                eps_inside_sqrt=eps_inside_sqrt)
 
     def leaf(p, g, m, v):
         g = g.astype(jnp.float32)
@@ -52,7 +59,8 @@ def lamb_update(grads, state, params, lr, beta1, beta2, eps, weight_decay,
     flat_g = treedef.flatten_up_to(grads)
     flat_m = treedef.flatten_up_to(state["exp_avg"])
     flat_v = treedef.flatten_up_to(state["exp_avg_sq"])
-    out = [leaf(p, g, m, v) for p, g, m, v in
+    kernel = pallas_leaf if use_pallas else leaf
+    out = [kernel(p, g, m, v) for p, g, m, v in
            zip(flat_p, flat_g, flat_m, flat_v)]
     new_params = jax.tree_util.tree_unflatten(treedef, [o[0] for o in out])
     new_m = jax.tree_util.tree_unflatten(treedef, [o[1] for o in out])
@@ -70,9 +78,10 @@ class FusedLamb:
     def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
                  eps=1e-8, eps_inside_sqrt=False, weight_decay=0.0,
                  max_grad_norm=0.0, max_coeff=10.0, min_coeff=0.01,
-                 amsgrad=False, **kwargs):
+                 amsgrad=False, use_pallas=None, **kwargs):
         if amsgrad:
             raise RuntimeError("FusedLamb does not support the AMSGrad variant.")
+        self.use_pallas = use_pallas
         self.lr = lr
         self.bias_correction = bias_correction
         self.betas = tuple(betas)
@@ -96,7 +105,16 @@ class FusedLamb:
         }
 
     def update(self, grads, state, params, lr, beta1, beta2, eps, weight_decay):
+        if self.use_pallas is None:
+            import jax as _jax
+            # same dispatch rule as FusedAdam: Pallas on single-chip TPU,
+            # XLA-fused path under a multi-chip GSPMD mesh
+            use_pallas = (_jax.default_backend() == "tpu" and
+                          _jax.device_count() == 1)
+        else:
+            use_pallas = self.use_pallas
         return lamb_update(grads, state, params, lr, beta1, beta2, eps,
                            weight_decay, bias_correction=self.bias_correction,
                            max_coeff=self.max_coeff, min_coeff=self.min_coeff,
-                           eps_inside_sqrt=self.eps_inside_sqrt)
+                           eps_inside_sqrt=self.eps_inside_sqrt,
+                           use_pallas=use_pallas)

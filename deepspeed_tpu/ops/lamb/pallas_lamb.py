@@ -1,0 +1,120 @@
+"""Pallas fused LAMB kernel.
+
+Reference parity: csrc/lamb/fused_lamb_cuda_kernel.cu — stage 1 computes
+m/v/update and per-block partial squared norms of p and update; stage 2
+reduces the partials and applies ``p -= lr * trust_ratio * update``. The
+same two-stage shape maps to TPU: a VMEM-blocked elementwise kernel emits
+(m', v', update) plus per-grid-block norm partials in one HBM pass; the
+tiny partial reduction + trust-ratio scale runs in XLA (it fuses into the
+following elementwise apply).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..pallas_utils import LANE, BLOCK_ROWS, flatten_pad_2d, row_mask
+
+
+def _lamb_stage1_kernel(p_ref, g_ref, m_ref, v_ref, sc_ref,
+                        m_out, v_out, u_out, norms_out, *, eps_inside_sqrt,
+                        total_rows):
+    beta1 = sc_ref[0]
+    beta2 = sc_ref[1]
+    eps = sc_ref[2]
+    weight_decay = sc_ref[3]
+    bc1 = sc_ref[4]
+    bc2 = sc_ref[5]
+
+    p = p_ref[:]
+    g = g_ref[:]
+    m = beta1 * m_ref[:] + (1.0 - beta1) * g
+    v = beta2 * v_ref[:] + (1.0 - beta2) * (g * g)
+    if eps_inside_sqrt:
+        denom = jnp.sqrt(v / bc2 + eps)
+    else:
+        denom = jnp.sqrt(v / bc2) + eps
+    update = (m / bc1) / denom + weight_decay * p
+    m_out[:] = m
+    v_out[:] = v
+    u_out[:] = update
+    # Per-block partial squared norms (stage-2 reduces across blocks).
+    # The last grid block may be ragged: out-of-range rows hold
+    # unspecified values and MUST be masked out of the reductions
+    # (elementwise outputs above are cropped on write-back, reductions
+    # are not).
+    mask = row_mask(p.shape, pl.program_id(0), total_rows)
+    # partials ride a full (8, 128) VMEM tile per block (TPU block shapes
+    # must be tile-aligned); lanes [0,0]=||p||^2, [0,1]=||update||^2.
+    # Built with iota selects — .at[].set lowers to scatter, which the
+    # TPU Pallas backend doesn't support.
+    p_sq = jnp.sum(p * p * mask)
+    u_sq = jnp.sum(update * update * mask)
+    tile_rows = jax.lax.broadcasted_iota(jnp.int32, (8, LANE), 0)
+    tile_cols = jax.lax.broadcasted_iota(jnp.int32, (8, LANE), 1)
+    norms_out[:] = jnp.where(
+        (tile_rows == 0) & (tile_cols == 0), p_sq,
+        jnp.where((tile_rows == 0) & (tile_cols == 1), u_sq, 0.0))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("eps_inside_sqrt", "interpret"))
+def _lamb_stage1_flat(p, g, m, v, scalars, eps_inside_sqrt, interpret=False):
+    rows = p.shape[0]
+    block = min(BLOCK_ROWS, rows)
+    grid = (pl.cdiv(rows, block),)
+    spec = pl.BlockSpec((block, LANE), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    norm_spec = pl.BlockSpec((8, LANE), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        functools.partial(_lamb_stage1_kernel,
+                          eps_inside_sqrt=eps_inside_sqrt, total_rows=rows),
+        grid=grid,
+        in_specs=[spec, spec, spec, spec,
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=(spec, spec, spec, norm_spec),
+        out_shape=(jax.ShapeDtypeStruct(p.shape, jnp.float32),
+                   jax.ShapeDtypeStruct(p.shape, jnp.float32),
+                   jax.ShapeDtypeStruct(p.shape, jnp.float32),
+                   jax.ShapeDtypeStruct((grid[0] * 8, LANE), jnp.float32)),
+        interpret=interpret,
+    )(p, g, m, v, scalars)
+    new_m, new_v, update, norm_tiles = out
+    partials = norm_tiles.reshape(grid[0], 8, LANE)[:, 0, :2]
+    return new_m, new_v, update, partials
+
+
+def fused_lamb_shard(p, g, m, v, lr, beta1, beta2, eps, weight_decay,
+                     bc1, bc2, max_coeff=10.0, min_coeff=0.01,
+                     eps_inside_sqrt=False, interpret=False):
+    """LAMB step for one tensor via the Pallas kernel.
+
+    Returns (new_p (in p.dtype), new_m, new_v). The explicit zero-pad lanes
+    contribute 0 to both norms (p=g=m=v=0 there -> update=0); ragged-block
+    rows are masked inside the kernel.
+    """
+    dtype = p.dtype
+    (p32, g32, m32, v32), rows, unpad = flatten_pad_2d(p, g, m, v)
+
+    scalars = jnp.stack([
+        jnp.asarray(beta1, jnp.float32), jnp.asarray(beta2, jnp.float32),
+        jnp.asarray(eps, jnp.float32),
+        jnp.asarray(weight_decay, jnp.float32),
+        jnp.asarray(bc1, jnp.float32), jnp.asarray(bc2, jnp.float32)])
+
+    new_m, new_v, update, partials = _lamb_stage1_flat(
+        p32, g32, m32, v32, scalars,
+        eps_inside_sqrt=bool(eps_inside_sqrt), interpret=interpret)
+
+    # stage 2: reduce partials -> trust ratio -> apply (XLA fuses this)
+    p_norm = jnp.sqrt(partials[:, 0].sum())
+    u_norm = jnp.sqrt(partials[:, 1].sum())
+    trust_ratio = jnp.where((p_norm > 0) & (u_norm > 0),
+                            jnp.clip(p_norm / u_norm, min_coeff, max_coeff),
+                            1.0)
+    new_p = p32 - lr * trust_ratio * update
+
+    return unpad(new_p).astype(dtype), unpad(new_m), unpad(new_v)

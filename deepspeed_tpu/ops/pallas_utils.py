@@ -1,0 +1,44 @@
+"""Shared scaffolding for flat elementwise Pallas kernels (Adam, LAMB).
+
+A tensor of any shape is flattened, cast to f32, zero-padded to a multiple
+of one (8, 128) tile, and viewed as (rows, 128). Kernels block over rows;
+the last grid block may be ragged — Pallas fills the out-of-range region
+with unspecified values, so kernels that REDUCE must mask by global row id
+(``row_mask``); pure elementwise outputs are safe (out-of-range rows are
+dropped on write-back).
+"""
+import jax
+import jax.numpy as jnp
+
+LANE = 128
+BLOCK_ROWS = 1024
+
+
+def flatten_pad_2d(*arrays):
+    """Flatten + f32-cast + zero-pad each array to (rows, LANE); returns
+    (views, rows, unpad) where ``unpad(x2d)`` restores the first array's
+    shape."""
+    first = arrays[0]
+    shape = first.shape
+    n = first.size
+    pad = (-n) % (LANE * 8)
+    views = []
+    for a in arrays:
+        flat = a.reshape(-1).astype(jnp.float32)
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+        views.append(flat.reshape(-1, LANE))
+    rows = views[0].shape[0]
+
+    def unpad(x2d):
+        return x2d.reshape(-1)[:n].reshape(shape)
+
+    return views, rows, unpad
+
+
+def row_mask(block_shape, block_index, total_rows):
+    """f32 {0,1} mask of shape ``block_shape`` marking rows that exist in
+    the logical array (guards reductions in ragged last blocks)."""
+    base = block_index * block_shape[0]
+    row_ids = jax.lax.broadcasted_iota(jnp.int32, block_shape, 0) + base
+    return (row_ids < total_rows).astype(jnp.float32)

@@ -1,0 +1,27 @@
+"""Build entry (reference setup.py). Stamps git info into
+deepspeed_tpu/git_version_info.py at build time; op building is JIT-only on
+TPU (the native host ops compile on first use via op_builder), so the
+DS_BUILD_* ahead-of-time machinery of the reference is unnecessary."""
+import subprocess
+
+from setuptools import setup
+
+
+def _git(cmd):
+    try:
+        return subprocess.check_output(
+            ["git"] + cmd, stderr=subprocess.DEVNULL).decode().strip()
+    except Exception:
+        return "unknown"
+
+
+def stamp_git_version():
+    hash_ = _git(["rev-parse", "--short", "HEAD"])
+    branch = _git(["rev-parse", "--abbrev-ref", "HEAD"])
+    with open("deepspeed_tpu/git_version_info.py", "w") as fd:
+        fd.write('git_hash = "{}"\ngit_branch = "{}"\n'.format(hash_, branch))
+
+
+if __name__ == "__main__":
+    stamp_git_version()
+    setup()

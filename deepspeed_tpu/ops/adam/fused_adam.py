@@ -105,12 +105,8 @@ class FusedAdam:
 
     def update(self, grads, state, params, lr, beta1, beta2, eps, weight_decay):
         if self.use_pallas is None:
-            import jax as _jax
-            # Pallas path on single-chip TPU; under a multi-chip GSPMD mesh
-            # the kernel must go through shard_map (engine wires that up),
-            # so default to the XLA-fused path there.
-            use_pallas = (_jax.default_backend() == "tpu" and
-                          _jax.device_count() == 1)
+            from ..pallas_utils import default_use_pallas
+            use_pallas = default_use_pallas()
         else:
             use_pallas = self.use_pallas
         return adam_update(grads, state, params, lr, beta1, beta2, eps,

@@ -106,11 +106,8 @@ class FusedLamb:
 
     def update(self, grads, state, params, lr, beta1, beta2, eps, weight_decay):
         if self.use_pallas is None:
-            import jax as _jax
-            # same dispatch rule as FusedAdam: Pallas on single-chip TPU,
-            # XLA-fused path under a multi-chip GSPMD mesh
-            use_pallas = (_jax.default_backend() == "tpu" and
-                          _jax.device_count() == 1)
+            from ..pallas_utils import default_use_pallas
+            use_pallas = default_use_pallas()
         else:
             use_pallas = self.use_pallas
         return lamb_update(grads, state, params, lr, beta1, beta2, eps,

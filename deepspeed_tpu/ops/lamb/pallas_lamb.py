@@ -49,9 +49,10 @@ def _lamb_stage1_kernel(p_ref, g_ref, m_ref, v_ref, sc_ref,
     # partials ride a full (8, 128) VMEM tile per block (TPU block shapes
     # must be tile-aligned); lanes [0,0]=||p||^2, [0,1]=||update||^2.
     # Built with iota selects — .at[].set lowers to scatter, which the
-    # TPU Pallas backend doesn't support.
-    p_sq = jnp.sum(p * p * mask)
-    u_sq = jnp.sum(update * update * mask)
+    # TPU Pallas backend doesn't support. Masking must be where-based:
+    # ragged-block rows hold unspecified values and 0 * NaN/Inf = NaN.
+    p_sq = jnp.sum(jnp.where(mask != 0.0, p * p, 0.0))
+    u_sq = jnp.sum(jnp.where(mask != 0.0, update * update, 0.0))
     tile_rows = jax.lax.broadcasted_iota(jnp.int32, (8, LANE), 0)
     tile_cols = jax.lax.broadcasted_iota(jnp.int32, (8, LANE), 1)
     norms_out[:] = jnp.where(

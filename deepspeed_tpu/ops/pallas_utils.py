@@ -36,6 +36,15 @@ def flatten_pad_2d(*arrays):
     return views, rows, unpad
 
 
+def default_use_pallas():
+    """Shared kernel-dispatch rule for FusedAdam/FusedLamb: Pallas on a
+    single-chip TPU; under a multi-chip GSPMD mesh the kernel must go
+    through shard_map (the engine wires that up), so default to the
+    XLA-fused path there."""
+    import jax as _jax
+    return _jax.default_backend() == "tpu" and _jax.device_count() == 1
+
+
 def row_mask(block_shape, block_index, total_rows):
     """f32 {0,1} mask of shape ``block_shape`` marking rows that exist in
     the logical array (guards reductions in ragged last blocks)."""

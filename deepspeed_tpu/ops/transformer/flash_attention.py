@@ -51,7 +51,9 @@ def _num_visible(qi, block_q, block_k, num_k_blocks, causal):
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, block_q,
                 block_k, num_k_blocks, causal, seq_len):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * sm_scale          # (Bq, d)
+    # Dots run with the INPUT dtype (bf16 on the fast path -> full-rate
+    # MXU) and fp32 accumulation; the softmax itself stays fp32.
+    q = q_ref[0]                                          # (Bq, d)
     d = q.shape[-1]
 
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
@@ -59,10 +61,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, block_q,
 
     def body(ki, carry):
         acc, m, l = carry
-        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        s_blk = jax.lax.dot_general(q, k_blk,
-                                    (((1,), (1,)), ((), ())))  # (Bq, Bk)
+        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :]
+        s_blk = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # (Bq, Bk)
         k_pos = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, s_blk.shape, 1)
         mask = k_pos < seq_len          # zero-padded k tail
@@ -73,8 +76,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, block_q,
         p = jnp.exp(s_blk - m_new)
         corr = jnp.exp(m - m_new)
         l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * corr + jax.lax.dot_general(p, v_blk,
-                                               (((1,), (0,)), ((), ())))
+        acc = acc * corr + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         return acc, m_new, l
 
     acc = jnp.zeros((block_q, d), jnp.float32)
@@ -100,9 +104,9 @@ def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    q = q_ref[0].astype(jnp.float32)                     # (Bq, d)
+    q = q_ref[0]                                         # (Bq, d)
     o = o_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    do = do_ref[0]
     lse = lse_ref[0]                                     # (Bq, 1)
     d = q.shape[-1]
 
@@ -114,18 +118,20 @@ def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
     row_valid = q_pos[:, :1] < seq_len
     # q/o/do on padded rows are undefined (may be NaN); they enter dk/dv
     # through row reductions (ds.T@q, p.T@do, delta) where 0 * NaN = NaN,
-    # so every padded row is zeroed at the source.
-    q = jnp.where(row_valid, q, 0.0)
-    do = jnp.where(row_valid, do, 0.0)
+    # so every padded row is zeroed at the source. Dots run with the input
+    # dtype (full-rate MXU for bf16) and fp32 accumulation.
+    q = jnp.where(row_valid, q, jnp.zeros_like(q))
+    do = jnp.where(row_valid, do, jnp.zeros_like(do))
     delta = jnp.where(row_valid,
-                      jnp.sum(do * o, axis=-1, keepdims=True), 0.0)
-    qs = q * sm_scale
+                      jnp.sum(do.astype(jnp.float32) * o, axis=-1,
+                              keepdims=True), 0.0)
 
     def body(ki, dq):
-        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        s_blk = jax.lax.dot_general(qs, k_blk,
-                                    (((1,), (1,)), ((), ())))  # (Bq, Bk)
+        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :]
+        s_blk = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # (Bq, Bk)
         k_pos = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, s_blk.shape, 1)
         mask = k_pos < seq_len
@@ -134,15 +140,25 @@ def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         s_blk = jnp.where(mask, s_blk, NEG_INF)
         p = jnp.exp(s_blk - lse)                          # (Bq, Bk)
         p = jnp.where(jnp.logical_and(row_valid, mask), p, 0.0)
-        dv_upd = jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())))
+        p_cast = p.astype(do.dtype)
+        dv_upd = jax.lax.dot_general(
+            p_cast, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dv_acc[pl.ds(ki * block_k, block_k), :] = \
             dv_acc[pl.ds(ki * block_k, block_k), :] + dv_upd
-        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())))
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale                  # (Bq, Bk)
-        dk_upd = jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())))
+        ds_cast = ds.astype(q.dtype)
+        dk_upd = jax.lax.dot_general(
+            ds_cast, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dk_acc[pl.ds(ki * block_k, block_k), :] = \
             dk_acc[pl.ds(ki * block_k, block_k), :] + dk_upd
-        return dq + jax.lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())))
+        return dq + jax.lax.dot_general(
+            ds_cast, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     visible = _num_visible(qi, block_q, block_k, num_k_blocks, causal)
     dq = jax.lax.fori_loop(0, visible, body, jnp.zeros((block_q, d),

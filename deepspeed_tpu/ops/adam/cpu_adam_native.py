@@ -38,9 +38,11 @@ def adam_step_host(p, g, m, v, lr, beta1, beta2, eps, weight_decay,
     be mutated).
     """
     lib = _get_lib()
-    p = np.ascontiguousarray(p, dtype=np.float32).copy()
-    m = np.ascontiguousarray(m, dtype=np.float32).copy()
-    v = np.ascontiguousarray(v, dtype=np.float32).copy()
+    # np.array(copy=True) gives one contiguous writable copy per buffer
+    # (ascontiguousarray().copy() would do two when input is non-contig)
+    p = np.array(p, dtype=np.float32, order="C", copy=True)
+    m = np.array(m, dtype=np.float32, order="C", copy=True)
+    v = np.array(v, dtype=np.float32, order="C", copy=True)
     g = np.ascontiguousarray(g, dtype=np.float32)
     lib.ds_cpu_adam_step(_ptr(p), _ptr(g), _ptr(m), _ptr(v), p.size,
                          float(lr), float(beta1), float(beta2), float(eps),

@@ -136,7 +136,21 @@ class DeepSpeedCPUAdam(FusedAdam):
         self.use_native = use_native
 
     def update(self, grads, state, params, lr, beta1, beta2, eps, weight_decay):
-        if self.use_native is not False:
+        use_native = self.use_native
+        if use_native is None:
+            # The SIMD kernel's win comes from OpenMP across host cores; on
+            # a 1-2 core host the pure_callback round-trip costs more than
+            # the kernel saves (measured: tests/perf/adam_test.py), so
+            # default to XLA there. Count the cores this process can USE
+            # (affinity/cgroup aware, same as omp_get_max_threads), not the
+            # machine total.
+            import os
+            try:
+                cores = len(os.sched_getaffinity(0))
+            except AttributeError:  # non-Linux
+                cores = os.cpu_count() or 1
+            use_native = cores >= 4
+        if use_native:
             try:
                 from .cpu_adam_native import native_adam_update
                 return native_adam_update(

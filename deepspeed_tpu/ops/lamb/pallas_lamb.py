@@ -51,8 +51,8 @@ def _lamb_stage1_kernel(p_ref, g_ref, m_ref, v_ref, sc_ref,
     # Built with iota selects — .at[].set lowers to scatter, which the
     # TPU Pallas backend doesn't support. Masking must be where-based:
     # ragged-block rows hold unspecified values and 0 * NaN/Inf = NaN.
-    p_sq = jnp.sum(jnp.where(mask != 0.0, p * p, 0.0))
-    u_sq = jnp.sum(jnp.where(mask != 0.0, update * update, 0.0))
+    p_sq = jnp.sum(jnp.where(mask, p * p, 0.0))
+    u_sq = jnp.sum(jnp.where(mask, update * update, 0.0))
     tile_rows = jax.lax.broadcasted_iota(jnp.int32, (8, LANE), 0)
     tile_cols = jax.lax.broadcasted_iota(jnp.int32, (8, LANE), 1)
     norms_out[:] = jnp.where(

@@ -46,8 +46,10 @@ def default_use_pallas():
 
 
 def row_mask(block_shape, block_index, total_rows):
-    """f32 {0,1} mask of shape ``block_shape`` marking rows that exist in
-    the logical array (guards reductions in ragged last blocks)."""
+    """Bool mask of shape ``block_shape`` marking rows that exist in the
+    logical array (guards reductions in ragged last blocks). Use with
+    ``jnp.where`` — multiplicative masking would keep NaN/Inf garbage
+    (0 * NaN = NaN)."""
     base = block_index * block_shape[0]
     row_ids = jax.lax.broadcasted_iota(jnp.int32, block_shape, 0) + base
-    return (row_ids < total_rows).astype(jnp.float32)
+    return row_ids < total_rows

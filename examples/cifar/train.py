@@ -1,0 +1,79 @@
+"""CIFAR-style classifier with deepspeed_tpu (reference
+DeepSpeedExamples/cifar — BASELINE config 1 shape).
+
+Run: python examples/cifar/train.py --deepspeed_config examples/cifar/ds_config.json
+Uses synthetic CIFAR-shaped data so the example is hermetic; swap
+``SyntheticCifar`` for a real dataset loader to train for real.
+"""
+import argparse
+
+try:
+    import deepspeed_tpu as deepspeed
+except ImportError:  # running from a source checkout without install
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))))
+    import deepspeed_tpu as deepspeed
+
+import numpy as np
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.model import Model
+
+
+class SyntheticCifar:
+    """(3,32,32) images, 10 classes."""
+
+    def __init__(self, n=2048, seed=0):
+        rs = np.random.RandomState(seed)
+        self.x = rs.randn(n, 3, 32, 32).astype(np.float32)
+        self.y = rs.randint(0, 10, size=(n,))
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def make_model(seed=0):
+    rs = np.random.RandomState(seed)
+    d_in, d_h = 3 * 32 * 32, 256
+    params = {
+        "w1": jnp.asarray(rs.randn(d_in, d_h) * (1.0 / np.sqrt(d_in))),
+        "b1": jnp.zeros(d_h),
+        "w2": jnp.asarray(rs.randn(d_h, 10) * (1.0 / np.sqrt(d_h))),
+        "b2": jnp.zeros(10),
+    }
+
+    def apply_fn(p, x, y):
+        import jax
+        h = jnp.tanh(x.reshape(x.shape[0], -1) @ p["w1"] + p["b1"])
+        logits = h @ p["w2"] + p["b2"]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()
+
+    return Model(apply_fn, params)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=2)
+    parser = deepspeed.add_config_arguments(parser)
+    args = parser.parse_args()
+
+    engine, _, loader, _ = deepspeed.initialize(
+        args=args, model=make_model(), training_data=SyntheticCifar(),
+        config_params=args.deepspeed_config)
+
+    for epoch in range(args.epochs):
+        for x, y in loader:
+            loss = engine(jnp.asarray(x), jnp.asarray(y))
+            engine.backward(loss)
+            engine.step()
+        print("epoch {} loss {:.4f}".format(epoch, float(loss)))
+
+
+if __name__ == "__main__":
+    main()

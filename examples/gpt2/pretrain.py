@@ -1,0 +1,50 @@
+"""Megatron-GPT2 pretraining with deepspeed_tpu (reference
+DeepSpeedExamples/Megatron-LM — BASELINE configs 2/4/5 shape).
+
+Run (synthetic data):
+  python examples/gpt2/pretrain.py --size gpt2_small \
+      --deepspeed_config examples/gpt2/ds_config_zero2.json --steps 50
+"""
+import argparse
+
+try:
+    import deepspeed_tpu as deepspeed
+except ImportError:  # running from a source checkout without install
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))))
+    import deepspeed_tpu as deepspeed
+
+import numpy as np
+import jax.numpy as jnp
+
+from deepspeed_tpu.models import gpt2
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--size", default="gpt2_small",
+                        choices=sorted(gpt2.SIZES))
+    parser.add_argument("--seq_len", type=int, default=1024)
+    parser.add_argument("--steps", type=int, default=50)
+    parser = deepspeed.add_config_arguments(parser)
+    args = parser.parse_args()
+
+    model = gpt2.make_gpt2_model(size=args.size, max_seq_len=args.seq_len)
+    engine, _, _, _ = deepspeed.initialize(
+        args=args, model=model, config_params=args.deepspeed_config)
+
+    rs = np.random.RandomState(0)
+    mb = engine.train_micro_batch_size_per_gpu() * engine.dp_world_size
+    gas = engine.gradient_accumulation_steps()
+    for step in range(args.steps):
+        ids = rs.randint(0, model.config.vocab_size,
+                         size=(gas, mb, args.seq_len)).astype(np.int32)
+        loss = engine.train_batch(batch=(ids, ids.copy()))
+        if step % 10 == 0:
+            print("step {} loss {:.4f}".format(step, float(loss)))
+
+
+if __name__ == "__main__":
+    main()

@@ -210,6 +210,13 @@ class DeepSpeedEngine:
             raise ValueError(
                 "{} is not compatible with ZeRO (zero_optimization.stage "
                 ">= 1)".format(type(self.optimizer).__name__))
+        if self.zero_optimization() and self._config.zero_config.cpu_offload \
+                and name not in (ADAM_OPTIMIZER, "adamw"):
+            # the host step is Adam-only (reference restricts offload to
+            # DeepSpeedCPUAdam the same way)
+            raise ValueError(
+                "zero_optimization.cpu_offload requires the Adam/AdamW "
+                "optimizer, got '{}'".format(name))
         log_dist("Using DeepSpeed optimizer: {}".format(name), ranks=[0])
 
     def _configure_lr_scheduler(self, client_lr_scheduler):
@@ -238,6 +245,49 @@ class DeepSpeedEngine:
     def _init_state(self):
         """Place params/master/opt/grad-accum arrays with ZeRO shardings."""
         plan = self.zero_plan
+        self.host_state = None
+        if self.zero_cpu_offload():
+            # True ZeRO-Offload (reference stage2/3 cpu_offload): fp32
+            # master + Adam moments live in HOST memory as numpy; HBM only
+            # holds compute-dtype params + fp32 grad accumulators. The
+            # optimizer step runs on host cores (_host_apply_step).
+            # np.array(copy=True): np.asarray of a jax array is a READ-ONLY
+            # view aliasing the runtime's buffer — the in-place host Adam
+            # would crash (or scribble on JAX-owned memory via the C ptr)
+            master_np = jax.tree_util.tree_map(
+                lambda p: np.array(p, dtype=np.float32, copy=True),
+                self.model.params)
+            self.host_state = {
+                "master": master_np,
+                # static for the engine's life; cached for the per-step H2D
+                "param_shardings": plan.tree_shardings(master_np, "param"),
+                "opt": {
+                    "step": 0,
+                    "exp_avg": jax.tree_util.tree_map(
+                        lambda p: np.zeros(p.shape, np.float32), master_np),
+                    "exp_avg_sq": jax.tree_util.tree_map(
+                        lambda p: np.zeros(p.shape, np.float32), master_np),
+                },
+            }
+            param_sh = plan.tree_shardings(master_np, "param")
+            grad_sh = plan.tree_shardings(master_np, "grad")
+            compute_params = jax.tree_util.tree_map(
+                lambda p, s: jax.device_put(
+                    jnp.asarray(p, self.compute_dtype), s),
+                master_np, param_sh)
+            acc_grads = jax.tree_util.tree_map(
+                lambda p, s: jax.device_put(
+                    jnp.zeros(p.shape, jnp.float32), s), master_np, grad_sh)
+            self.state = {
+                "params": compute_params,
+                "master": None,
+                "opt": None,
+                "acc_grads": acc_grads,
+                "scaler": ls.loss_scaler_from_config(self._config),
+            }
+            self.model.params = None
+            return
+
         params_f32 = jax.tree_util.tree_map(
             lambda p: jnp.asarray(p, dtype=jnp.float32), self.model.params)
 
@@ -538,6 +588,96 @@ class DeepSpeedEngine:
                                 self.global_samples)
         self.monitor.flush()
 
+    def _host_apply_step(self):
+        """ZeRO-Offload optimizer step: grads D2H, host Adam on the numpy
+        master/moments, updated params H2D (reference stage2.py:780-908 +
+        csrc/adam/cpu_adam.cpp overlap streams; the jit boundary is the
+        stream boundary here)."""
+        hyper = self._hyper()
+        scaler = self.state["scaler"]
+        cur_scale = float(scaler.cur_scale)
+        inv_scale = 1.0 / cur_scale
+        clip = self.gradient_clipping()
+
+        flat_g, treedef = jax.tree_util.tree_flatten(self.state["acc_grads"])
+        # D2H; np.array = writable host copies (np.asarray views are RO)
+        grads_np = [np.array(g, dtype=np.float32) for g in flat_g]
+        overflow = not all(np.isfinite(g).all() for g in grads_np)
+
+        grad_norm = 0.0
+        if not overflow:
+            sq = sum(float((g.astype(np.float64) ** 2).sum())
+                     for g in grads_np) * (inv_scale ** 2)
+            grad_norm = float(np.sqrt(sq))
+            coef = inv_scale
+            if clip > 0 and grad_norm > clip:
+                coef *= clip / (grad_norm + 1e-6)
+
+            opt = self.host_state["opt"]
+            opt["step"] += 1
+            step = opt["step"]
+            beta1, beta2 = hyper["beta1"], hyper["beta2"]
+            bias_correction = getattr(self.optimizer, "bias_correction", True)
+            bc1 = 1.0 - beta1 ** step if bias_correction else 1.0
+            bc2 = 1.0 - beta2 ** step if bias_correction else 1.0
+            adam_w = 1 if getattr(self.optimizer, "adam_w_mode", True) else 0
+
+            flat_m = treedef.flatten_up_to(opt["exp_avg"])
+            flat_v = treedef.flatten_up_to(opt["exp_avg_sq"])
+            flat_master = treedef.flatten_up_to(self.host_state["master"])
+            lib = self._offload_lib()
+            for p, g, m, v in zip(flat_master, grads_np, flat_m, flat_v):
+                g *= coef  # unscale (+clip) in place on the host copy
+                if lib is not None:
+                    lib.ds_cpu_adam_step(
+                        p.ctypes.data, g.ctypes.data, m.ctypes.data,
+                        v.ctypes.data, p.size, hyper["lr"], beta1, beta2,
+                        hyper["eps"], hyper["weight_decay"],
+                        bc1, bc2, adam_w)
+                else:
+                    if not adam_w and hyper["weight_decay"]:
+                        # classic-L2 mode folds decay into the gradient
+                        # (matches csrc/cpu_adam.cpp adam_w_mode=0)
+                        g += hyper["weight_decay"] * p
+                    np.multiply(m, beta1, out=m)
+                    m += (1.0 - beta1) * g
+                    np.multiply(v, beta2, out=v)
+                    v += (1.0 - beta2) * np.square(g)
+                    update = (m / bc1) / (np.sqrt(v / bc2) + hyper["eps"])
+                    if adam_w:
+                        update += hyper["weight_decay"] * p
+                    p -= hyper["lr"] * update
+
+            # H2D: recast updated master into the compute params
+            self.state["params"] = jax.tree_util.tree_map(
+                lambda p, s: jax.device_put(
+                    jnp.asarray(p, self.compute_dtype), s),
+                self.host_state["master"],
+                self.host_state["param_shardings"])
+
+        self.state["acc_grads"] = jax.tree_util.tree_map(
+            jnp.zeros_like, self.state["acc_grads"])
+        self.state["scaler"] = ls.update_scale(scaler, overflow)
+        return {"overflow": overflow, "grad_norm": grad_norm,
+                "loss_scale": cur_scale}
+
+    def _offload_lib(self):
+        """The native SIMD Adam when built; None -> numpy fallback. Only
+        plain Adam/AdamW offloads (reference restricts the same way)."""
+        if getattr(self, "_offload_lib_cache", "unset") != "unset":
+            return self._offload_lib_cache
+        lib = None
+        if not getattr(self.optimizer, "adam_w_mode", None) is None:
+            try:
+                from ..ops.op_builder.cpu_adam import CPUAdamBuilder
+                lib = CPUAdamBuilder().load()
+            except Exception as err:  # noqa: BLE001
+                logger.warning(
+                    "ZeRO-Offload: native CPU Adam unavailable (%s); "
+                    "using the numpy fallback", err)
+        self._offload_lib_cache = lib
+        return lib
+
     def _adapt_state_dict(self, sd):
         """Hook for subclasses to re-partition a loaded state dict before
         placement (PipelineEngine re-shards body layers across a different
@@ -551,9 +691,12 @@ class DeepSpeedEngine:
         return jnp.float32(1.0)
 
     def _take_model_step(self, lr_kwargs=None):
-        apply_fn = self._get_jit("apply", self._apply_step_fn,
-                                 donate_argnums=(0,))
-        self.state, metrics = apply_fn(self.state, self._hyper())
+        if self.host_state is not None:
+            metrics = self._host_apply_step()
+        else:
+            apply_fn = self._get_jit("apply", self._apply_step_fn,
+                                     donate_argnums=(0,))
+            self.state, metrics = apply_fn(self.state, self._hyper())
         overflow = bool(metrics["overflow"])
         self._step_metrics = {k: v for k, v in metrics.items()}
         if overflow:
@@ -584,11 +727,18 @@ class DeepSpeedEngine:
         batch = self._to_device_stacked(batch)
 
         self._rng, step_rng = jax.random.split(self._rng)
-        fused = self._get_jit("fused_train", self._fused_train_fn,
-                              donate_argnums=(0,))
-        self.state, (mean_loss, metrics) = fused(self.state, batch, step_rng,
-                                                 self._hyper(),
-                                                 self._pld_theta())
+        if self.host_state is not None:
+            fused = self._get_jit("fused_micros", self._fused_micros_fn,
+                                  donate_argnums=(0,))
+            self.state, mean_loss = fused(self.state, batch, step_rng,
+                                          self._pld_theta())
+            metrics = self._host_apply_step()
+        else:
+            fused = self._get_jit("fused_train", self._fused_train_fn,
+                                  donate_argnums=(0,))
+            self.state, (mean_loss, metrics) = fused(
+                self.state, batch, step_rng, self._hyper(),
+                self._pld_theta())
         overflow = bool(metrics["overflow"])
         if overflow:
             self.skipped_steps += 1
@@ -616,6 +766,27 @@ class DeepSpeedEngine:
                 return jax.make_array_from_process_local_data(sharding, x)
             return jax.device_put(x, sharding)
         return jax.tree_util.tree_map(put, batch)
+
+    def _fused_micros_fn(self):
+        """Offload variant of the fused path: scan the micro-steps on
+        device, leave the optimizer apply to the host."""
+        micro = self._micro_step_fn()
+        gas = self.gradient_accumulation_steps()
+
+        def fused(state, stacked_batch, rng, pld_theta):
+            rngs = jax.random.split(rng, gas)
+            leaves, treedef = jax.tree_util.tree_flatten(stacked_batch)
+
+            def scan_body(carry, xs):
+                rng_i = xs[0]
+                batch_i = jax.tree_util.tree_unflatten(treedef, list(xs[1:]))
+                return micro(carry, batch_i, rng_i, pld_theta)
+
+            state, losses = jax.lax.scan(scan_body, state,
+                                         (rngs, *leaves), length=gas)
+            return state, jnp.mean(losses)
+
+        return fused
 
     def _fused_train_fn(self):
         micro = self._micro_step_fn()
@@ -724,8 +895,14 @@ class DeepSpeedEngine:
         return self.state["params"]
 
     def get_master_params(self):
+        if self.host_state is not None:
+            return self.host_state["master"]
         return self.state["master"] if self.mixed_precision \
             else self.state["params"]
+
+    def _opt_state_view(self):
+        return self.host_state["opt"] if self.host_state is not None \
+            else self.state["opt"]
 
     # --------------------------------------------------------------- profiler
     def _maybe_start_flops_profiler(self):
@@ -763,9 +940,10 @@ class DeepSpeedEngine:
         is_writer = jax.process_index() == 0
         sd = {
             "module": ckpt.tree_to_numpy(self.state["params"]),
-            "optimizer": ckpt.tree_to_numpy(self.state["opt"]),
-            "master": ckpt.tree_to_numpy(self.state["master"])
-                if self.mixed_precision else None,
+            "optimizer": ckpt.tree_to_numpy(self._opt_state_view()),
+            "master": ckpt.tree_to_numpy(self.get_master_params())
+                if (self.mixed_precision or self.host_state is not None)
+                else None,
             "scaler": ckpt.tree_to_numpy(
                 {"cur_scale": self.state["scaler"].cur_scale,
                  "cur_hysteresis": self.state["scaler"].cur_hysteresis,
@@ -856,7 +1034,23 @@ class DeepSpeedEngine:
                 jnp.asarray(x, dtype=old.dtype), s),
             sd["module"], self.state["params"], param_sh)
 
-        if self.mixed_precision and load_from_fp32_weights and \
+        if self.host_state is not None:
+            # offload: master/opt restore into HOST numpy state
+            if load_from_fp32_weights and sd.get("master") is not None:
+                src = sd["master"]
+            else:
+                src = sd["module"]
+            self.host_state["master"] = jax.tree_util.tree_map(
+                lambda x: np.array(x, dtype=np.float32), src)
+            if load_optimizer_states and sd.get("optimizer") is not None:
+                opt = sd["optimizer"]
+                self.host_state["opt"] = {
+                    key: int(val) if key == "step" else
+                    jax.tree_util.tree_map(
+                        lambda x: np.array(x, dtype=np.float32), val)
+                    for key, val in opt.items()
+                }
+        elif self.mixed_precision and load_from_fp32_weights and \
                 sd.get("master") is not None:
             master_sh = plan.tree_shardings(self.state["master"], "master")
             self.state["master"] = jax.tree_util.tree_map(
@@ -869,7 +1063,8 @@ class DeepSpeedEngine:
                 lambda p, s: jax.device_put(jnp.asarray(p, jnp.float32), s),
                 self.state["params"], master_sh)
 
-        if load_optimizer_states and sd.get("optimizer") is not None:
+        if self.host_state is None and load_optimizer_states and \
+                sd.get("optimizer") is not None:
             opt = sd["optimizer"]
             # shardings from each subtree's own leaf shapes (error buffers
             # etc. are not param-shaped)

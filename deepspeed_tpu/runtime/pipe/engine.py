@@ -60,6 +60,13 @@ class PipelineEngine(DeepSpeedEngine):
             name="pipeline")
         kwargs.setdefault("mpu", grid)
         super().__init__(args=args, model=wrapped, **kwargs)
+        if self.host_state is not None:
+            # the pipeline's fused path jits the optimizer apply; the host
+            # step isn't wired there (the reference calls ZeRO-Offload +
+            # pipeline fragile and restricts it too)
+            raise NotImplementedError(
+                "zero_optimization.cpu_offload is not supported with "
+                "pipeline parallelism")
         self.num_stages = model.num_stages
         self.micro_batches = self.gradient_accumulation_steps()
         log_dist("PipelineEngine: stages={} micro_batches={} mesh={}".format(

@@ -81,6 +81,11 @@ class DeepSpeedEngine:
         self._configure_optimizer(optimizer)
         self._configure_lr_scheduler(lr_scheduler)
         self._configure_pld()
+        if "activation_checkpointing" in (self._config._param_dict or {}):
+            # reference: user calls deepspeed.checkpointing.configure();
+            # when the config section is present the engine applies it
+            from .activation_checkpointing import checkpointing as act_ckpt
+            act_ckpt.configure(self.mpu, deepspeed_config=self._config)
         self._init_state()
 
         self.training_dataloader = self.deepspeed_io(training_data) \
@@ -177,6 +182,14 @@ class DeepSpeedEngine:
         from ..ops.lamb.fused_lamb import FusedLamb
 
         if client_optimizer is not None:
+            if self.zero_cpu_offload() and \
+                    getattr(client_optimizer, "adam_w_mode", None) is None:
+                # the host step implements Adam only; a client optimizer
+                # without Adam semantics would be silently replaced by it
+                raise ValueError(
+                    "zero_optimization.cpu_offload requires an Adam-family "
+                    "optimizer; got client optimizer {}".format(
+                        type(client_optimizer).__name__))
             self.optimizer = client_optimizer
             log_dist("Using client optimizer {}".format(
                 type(client_optimizer).__name__), ranks=[0])
@@ -251,6 +264,12 @@ class DeepSpeedEngine:
             # master + Adam moments live in HOST memory as numpy; HBM only
             # holds compute-dtype params + fp32 grad accumulators. The
             # optimizer step runs on host cores (_host_apply_step).
+            if jax.process_count() > 1:
+                # acc_grads span processes; the host gather/step would need
+                # per-process shard handling not wired up yet
+                raise NotImplementedError(
+                    "zero_optimization.cpu_offload is not supported in "
+                    "multi-process runs yet")
             # np.array(copy=True): np.asarray of a jax array is a READ-ONLY
             # view aliasing the runtime's buffer — the in-place host Adam
             # would crash (or scribble on JAX-owned memory via the C ptr)
@@ -269,12 +288,10 @@ class DeepSpeedEngine:
                         lambda p: np.zeros(p.shape, np.float32), master_np),
                 },
             }
-            param_sh = plan.tree_shardings(master_np, "param")
+            param_sh = self.host_state["param_shardings"]
             grad_sh = plan.tree_shardings(master_np, "grad")
             compute_params = jax.tree_util.tree_map(
-                lambda p, s: jax.device_put(
-                    jnp.asarray(p, self.compute_dtype), s),
-                master_np, param_sh)
+                self._host_to_device, master_np, param_sh)
             acc_grads = jax.tree_util.tree_map(
                 lambda p, s: jax.device_put(
                     jnp.zeros(p.shape, jnp.float32), s), master_np, grad_sh)
@@ -650,9 +667,7 @@ class DeepSpeedEngine:
 
             # H2D: recast updated master into the compute params
             self.state["params"] = jax.tree_util.tree_map(
-                lambda p, s: jax.device_put(
-                    jnp.asarray(p, self.compute_dtype), s),
-                self.host_state["master"],
+                self._host_to_device, self.host_state["master"],
                 self.host_state["param_shardings"])
 
         self.state["acc_grads"] = jax.tree_util.tree_map(
@@ -660,6 +675,21 @@ class DeepSpeedEngine:
         self.state["scaler"] = ls.update_scale(scaler, overflow)
         return {"overflow": overflow, "grad_norm": grad_norm,
                 "loss_scale": cur_scale}
+
+    def _host_to_device(self, p_np, sharding):
+        """Host fp32 leaf -> sharded compute-dtype device array WITHOUT
+        materializing the full array on one device (jnp.asarray-then-
+        device_put would transit device 0 unsharded — fatal for exactly
+        the large-model case offload targets). Cast in numpy (ml_dtypes
+        handles bf16, also halving the transfer), then device_put the
+        numpy array straight onto the NamedSharding."""
+        try:
+            import ml_dtypes
+            np_dtype = np.dtype(self.compute_dtype) \
+                if self.compute_dtype != jnp.bfloat16 else ml_dtypes.bfloat16
+            return jax.device_put(p_np.astype(np_dtype), sharding)
+        except ImportError:
+            return jax.device_put(p_np, sharding).astype(self.compute_dtype)
 
     def _offload_lib(self):
         """The native SIMD Adam when built; None -> numpy fallback. Only
@@ -834,7 +864,11 @@ class DeepSpeedEngine:
         return self._config.zero_optimization_stage
 
     def zero_cpu_offload(self):
-        return self._config.zero_config.cpu_offload
+        # offload is a ZeRO feature: a stage-0 config with the flag set
+        # must not activate the host Adam path (reference ties it to the
+        # ZeRO optimizers too)
+        return self.zero_optimization() and \
+            self._config.zero_config.cpu_offload
 
     def fp16_enabled(self):
         return self._config.fp16_enabled

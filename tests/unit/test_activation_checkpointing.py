@@ -136,3 +136,30 @@ def test_rng_tracker_duplicate_seed_raises():
 
 def test_public_api_reachable():
     assert deepspeed.checkpointing.checkpoint is ckpt.checkpoint
+
+
+def test_engine_applies_config_section():
+    """An activation_checkpointing config block configures the module at
+    engine init (the reference requires a manual configure() call)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.runtime.model import Model
+    from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "activation_checkpointing": {"partition_activations": True,
+                                     "cpu_checkpointing": True},
+    }
+    try:
+        deepspeed_tpu.initialize(
+            model=Model(lambda p, x, y: jnp.mean((x @ p["w"] - y) ** 2),
+                        {"w": jnp.zeros((4, 2))}),
+            config_params=config)
+        assert checkpointing.is_configured()
+        assert checkpointing.PARTITION_ACTIVATIONS
+        assert checkpointing.CPU_CHECKPOINT
+    finally:
+        checkpointing.reset() if hasattr(checkpointing, "reset") else None
+        checkpointing.PARTITION_ACTIVATIONS = False
+        checkpointing.CPU_CHECKPOINT = False

@@ -117,3 +117,27 @@ def test_offload_overflow_skips_host_step():
     # grads were zeroed for the next accumulation round
     assert float(jnp.abs(
         jax.tree_util.tree_leaves(engine.state["acc_grads"])[0]).sum()) == 0.0
+
+
+def test_stage0_cpu_offload_flag_ignored():
+    """cpu_offload without ZeRO must not activate the host Adam path."""
+    config = {
+        "train_batch_size": 16,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 0, "cpu_offload": True},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=Model(_apply, {"w": jnp.zeros((32, 8))}),
+        config_params=config)
+    assert engine.host_state is None
+
+
+def test_offload_rejects_non_adam_client_optimizer():
+    class NotAdam:
+        def hyperparams(self):
+            return {}
+
+    with pytest.raises(ValueError, match="Adam-family"):
+        deepspeed_tpu.initialize(
+            model=Model(_apply, {"w": jnp.zeros((32, 8))}),
+            optimizer=NotAdam(), config_params=_config())

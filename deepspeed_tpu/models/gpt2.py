@@ -38,6 +38,11 @@ class GPT2Config:
     remat: bool = True             # activation checkpointing per block
     remat_policy: str = "full"     # "full" | "dots" (save MXU outputs)
     loss_chunk: int = 128          # CE seq-chunking (0 = dense logits)
+    # lax.scan over stacked block params: one compiled block body instead
+    # of n_layers unrolled copies — compile time O(1) in depth (a 48-layer
+    # unrolled build takes ~20 min through a remote compiler). Off by
+    # default: the pipeline path owns its own stacking.
+    scan_blocks: bool = False
     use_flash_attention: bool = True
     dtype: object = jnp.float32    # param dtype at init (engine recasts)
     # Sequence/context parallelism: "ring" | "ulysses" | None. When set,
@@ -106,6 +111,8 @@ def init_params(config, seed=0):
     ones = lambda *shape: jnp.ones(shape, dtype=config.dtype)
 
     blocks = [init_block_params(config, rng) for _ in range(config.n_layers)]
+    if config.scan_blocks:
+        blocks = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
     return {
         "wte": norm(v, d),
         "wpe": norm(s, d, sd=std / 2),
@@ -115,18 +122,23 @@ def init_params(config, seed=0):
 
 
 def partition_spec_fn(path, shape):
-    """Megatron TP layout on the ``model`` mesh axis."""
+    """Megatron TP layout on the ``model`` mesh axis. Handles both the
+    per-layer list layout and the stacked scan_blocks layout (leading
+    (n_layers,) dim -> leading None in the spec)."""
     if path.endswith("wte"):
         return P(MODEL_AXIS, None)               # vocab-parallel embedding
+    spec = None
     if "qkv_kernel" in path or "fc_kernel" in path:
-        return P(None, MODEL_AXIS)               # column parallel
-    if "qkv_bias" in path or "fc_bias" in path:
-        return P(MODEL_AXIS)
-    if "attn" in path and "proj_kernel" in path:
-        return P(MODEL_AXIS, None)               # row parallel
-    if "mlp" in path and "proj_kernel" in path:
-        return P(MODEL_AXIS, None)
-    return None                                   # replicated (LN, wpe, biases)
+        spec = P(None, MODEL_AXIS)               # column parallel
+    elif "qkv_bias" in path or "fc_bias" in path:
+        spec = P(MODEL_AXIS)
+    elif "attn" in path and "proj_kernel" in path:
+        spec = P(MODEL_AXIS, None)               # row parallel
+    elif "mlp" in path and "proj_kernel" in path:
+        spec = P(MODEL_AXIS, None)
+    if spec is not None and len(shape) == len(spec) + 1:
+        spec = P(None, *spec)                    # stacked layer dim
+    return spec                                   # None: LN, wpe, biases
 
 
 def _layer_norm(x, scale, bias, eps=1e-5):
@@ -208,16 +220,30 @@ def forward_hidden(params, input_ids, config, rng=None, train=False):
         # "full": recompute everything in bwd (min memory, ~4/3 flops);
         # "dots": save matmul outputs, recompute elementwise only — the
         # usual MFU sweet spot on TPU (HBM traffic for ln/gelu recompute is
-        # cheaper than re-running the gemms on the MXU).
+        # cheaper than re-running the gemms on the MXU). Under scan the
+        # CSE-prevention barriers are unnecessary and inhibit fusion.
         policy = (jax.checkpoint_policies.nothing_saveable
                   if config.remat_policy == "full" else
                   jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
-        block_fn = jax.checkpoint(block_fn, policy=policy)
+        block_fn = jax.checkpoint(block_fn, policy=policy,
+                                  prevent_cse=not config.scan_blocks)
 
-    rngs = (jax.random.split(rng, config.n_layers)
-            if rng is not None else [None] * config.n_layers)
-    for i, bp in enumerate(params["blocks"]):
-        x = block_fn(x, bp, rng=rngs[i])
+    if config.scan_blocks:
+        n = config.n_layers
+        keys = (jax.random.split(rng, n) if rng is not None
+                else jnp.zeros((n, 2), dtype=jnp.uint32))
+
+        def scan_body(carry, layer):
+            bp, key = layer
+            out = block_fn(carry, bp, rng=key if rng is not None else None)
+            return out, None
+
+        x, _ = jax.lax.scan(scan_body, x, (params["blocks"], keys))
+    else:
+        rngs = (jax.random.split(rng, config.n_layers)
+                if rng is not None else [None] * config.n_layers)
+        for i, bp in enumerate(params["blocks"]):
+            x = block_fn(x, bp, rng=rngs[i])
     x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
     return x
 

@@ -83,9 +83,21 @@ class DeepSpeedEngine:
         self._configure_pld()
         if "activation_checkpointing" in (self._config._param_dict or {}):
             # reference: user calls deepspeed.checkpointing.configure();
-            # when the config section is present the engine applies it
+            # when the config section is present the engine applies it —
+            # unless the user already configured (their kwargs win), and
+            # never fatally (configs like contiguous+no-num_checkpoints
+            # need the manual call with explicit kwargs)
             from .activation_checkpointing import checkpointing as act_ckpt
-            act_ckpt.configure(self.mpu, deepspeed_config=self._config)
+            if not act_ckpt.is_configured():
+                try:
+                    act_ckpt.configure(self.mpu,
+                                       deepspeed_config=self._config)
+                except Exception as err:  # noqa: BLE001
+                    logger.warning(
+                        "activation_checkpointing config could not be "
+                        "auto-applied (%s); call deepspeed_tpu."
+                        "checkpointing.configure() with explicit kwargs",
+                        err)
         self._init_state()
 
         self.training_dataloader = self.deepspeed_io(training_data) \
@@ -680,16 +692,11 @@ class DeepSpeedEngine:
         """Host fp32 leaf -> sharded compute-dtype device array WITHOUT
         materializing the full array on one device (jnp.asarray-then-
         device_put would transit device 0 unsharded — fatal for exactly
-        the large-model case offload targets). Cast in numpy (ml_dtypes
-        handles bf16, also halving the transfer), then device_put the
-        numpy array straight onto the NamedSharding."""
-        try:
-            import ml_dtypes
-            np_dtype = np.dtype(self.compute_dtype) \
-                if self.compute_dtype != jnp.bfloat16 else ml_dtypes.bfloat16
-            return jax.device_put(p_np.astype(np_dtype), sharding)
-        except ImportError:
-            return jax.device_put(p_np, sharding).astype(self.compute_dtype)
+        the large-model case offload targets). Cast in numpy first
+        (np.dtype(bf16) resolves via ml_dtypes, halving the transfer),
+        then device_put straight onto the NamedSharding."""
+        return jax.device_put(p_np.astype(np.dtype(self.compute_dtype)),
+                              sharding)
 
     def _offload_lib(self):
         """The native SIMD Adam when built; None -> numpy fallback. Only

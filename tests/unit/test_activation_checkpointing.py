@@ -151,6 +151,9 @@ def test_engine_applies_config_section():
         "activation_checkpointing": {"partition_activations": True,
                                      "cpu_checkpointing": True},
     }
+    # the engine only auto-applies when unconfigured; earlier tests in
+    # this file may have called configure()
+    checkpointing.deepspeed_checkpointing_enabled = False
     try:
         deepspeed_tpu.initialize(
             model=Model(lambda p, x, y: jnp.mean((x @ p["w"] - y) ** 2),
@@ -160,6 +163,9 @@ def test_engine_applies_config_section():
         assert checkpointing.PARTITION_ACTIVATIONS
         assert checkpointing.CPU_CHECKPOINT
     finally:
-        checkpointing.reset() if hasattr(checkpointing, "reset") else None
+        # restore every global configure() mutated — later tests must see
+        # the unconfigured default
         checkpointing.PARTITION_ACTIVATIONS = False
         checkpointing.CPU_CHECKPOINT = False
+        checkpointing.deepspeed_checkpointing_enabled = False
+        checkpointing.mpu = None

@@ -1,0 +1,62 @@
+"""Distributed-init tests (reference tests/unit/test_dist.py).
+
+Multi-host rendezvous can't run in CI; what's locked here is the env
+contract: the launcher surface (MASTER_ADDR/RANK/WORLD_SIZE) and MPI
+discovery resolve to the right jax.distributed arguments, and
+single-process runs skip initialization.
+"""
+import os
+
+import pytest
+
+from deepspeed_tpu.utils import distributed as dist
+
+
+@pytest.fixture(autouse=True)
+def clean_env(monkeypatch):
+    for var in ("MASTER_ADDR", "MASTER_PORT", "RANK", "WORLD_SIZE",
+                "OMPI_COMM_WORLD_SIZE", "OMPI_COMM_WORLD_RANK",
+                "SLURM_NTASKS", "SLURM_PROCID", "PMI_SIZE", "PMI_RANK"):
+        monkeypatch.delenv(var, raising=False)
+    dist._initialized = False
+    yield
+    dist._initialized = True  # suite runs single-process; keep it marked
+
+
+def test_single_process_skips_init():
+    dist.init_distributed(verbose=False)
+    assert dist.is_initialized()
+
+
+def test_world_size_one_skips_init(monkeypatch):
+    monkeypatch.setenv("MASTER_ADDR", "10.0.0.1")
+    monkeypatch.setenv("WORLD_SIZE", "1")
+    monkeypatch.setenv("RANK", "0")
+    dist.init_distributed(verbose=False)
+    assert dist.is_initialized()
+
+
+def test_idempotent():
+    dist.init_distributed(verbose=False)
+    dist.init_distributed(verbose=False)  # second call is a no-op
+    assert dist.is_initialized()
+
+
+def test_mpi_env_detection(monkeypatch):
+    assert not dist._in_mpi_env()
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "4")
+    assert dist._in_mpi_env()
+
+
+def test_mpi_discovery_openmpi(monkeypatch):
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "4")
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "2")
+    addr, world, rank = dist._mpi_discovery(29500, "10.0.0.9:29500")
+    assert (addr, world, rank) == ("10.0.0.9:29500", 4, 2)
+
+
+def test_mpi_discovery_slurm(monkeypatch):
+    monkeypatch.setenv("SLURM_NTASKS", "8")
+    monkeypatch.setenv("SLURM_PROCID", "5")
+    addr, world, rank = dist._mpi_discovery(29501, "head:29501")
+    assert (addr, world, rank) == ("head:29501", 8, 5)

@@ -125,3 +125,46 @@ def test_chunked_lm_loss_matches_dense():
     for a, b in zip(jax.tree_util.tree_leaves(g_c),
                     jax.tree_util.tree_leaves(g_d)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_scan_blocks_matches_unrolled():
+    """scan_blocks encoder == python-loop encoder in value and grads."""
+    kw = dict(vocab_size=256, max_seq_len=128, n_layers=3, n_heads=4,
+              d_model=64, use_flash_attention=False, remat=True,
+              loss_chunk=0)
+    cfg_loop = gpt2.GPT2Config(scan_blocks=False, **kw)
+    cfg_scan = gpt2.GPT2Config(scan_blocks=True, **kw)
+    p_loop = gpt2.init_params(cfg_loop, seed=3)
+    p_scan = gpt2.init_params(cfg_scan, seed=3)
+    # same numbers, different layout
+    np.testing.assert_allclose(
+        np.asarray(p_scan["blocks"]["attn"]["qkv_kernel"][1]),
+        np.asarray(p_loop["blocks"][1]["attn"]["qkv_kernel"]))
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, 256, size=(2, 128)))
+    l1 = gpt2.lm_loss(p_loop, ids, ids, cfg_loop)
+    l2 = gpt2.lm_loss(p_scan, ids, ids, cfg_scan)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    g1 = jax.grad(lambda p: gpt2.lm_loss(p, ids, ids, cfg_loop))(p_loop)
+    g2 = jax.grad(lambda p: gpt2.lm_loss(p, ids, ids, cfg_scan))(p_scan)
+    np.testing.assert_allclose(
+        np.asarray(g2["blocks"]["mlp"]["fc_kernel"][2]),
+        np.asarray(g1["blocks"][2]["mlp"]["fc_kernel"]), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g2["wte"]), np.asarray(g1["wte"]),
+                               atol=1e-5)
+
+
+def test_scan_blocks_tp_specs_place():
+    from deepspeed_tpu.parallel.topology import build_mesh
+    from deepspeed_tpu.runtime.zero.partition import ZeroShardingPlan
+    mesh = build_mesh(data=2, model=4)
+    cfg = gpt2.GPT2Config(vocab_size=256, max_seq_len=64, n_layers=2,
+                          n_heads=4, d_model=64, scan_blocks=True,
+                          use_flash_attention=False, remat=False)
+    params = gpt2.init_params(cfg, seed=0)
+    plan = ZeroShardingPlan(mesh, stage=0,
+                            model_spec_fn=gpt2.partition_spec_fn)
+    placed = jax.tree_util.tree_map(
+        jax.device_put, params, plan.tree_shardings(params, "param"))
+    qkv = placed["blocks"]["attn"]["qkv_kernel"]
+    assert qkv.sharding.spec == P(None, None, "model")

@@ -40,7 +40,8 @@ from .fp16 import loss_scaler as ls
 from .lr_schedules import SCHEDULE_CLASSES
 from .model import Model, as_model
 from .progressive_layer_drop import ProgressiveLayerDrop
-from .utils import CheckOverflow, clip_grad_norm_, get_grad_norm, count_parameters
+from .utils import (CheckOverflow, clip_grad_norm_, get_grad_norm,
+                    count_parameters, see_memory_usage)
 from .zero.partition import ZeroShardingPlan
 
 MEMORY_OPT_ALLREDUCE_SIZE = 500000000
@@ -750,6 +751,9 @@ class DeepSpeedEngine:
             log_dist("step={}, lr={}, loss_scale={}".format(
                 self.global_steps, self.get_lr(),
                 float(metrics["loss_scale"])), ranks=[0])
+            if self.memory_breakdown():
+                see_memory_usage(
+                    "step {}".format(self.global_steps), force=True)
 
     # -------------------------------------------------- fused train-batch path
     def train_batch(self, data_iter=None, batch=None):

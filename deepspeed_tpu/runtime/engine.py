@@ -166,6 +166,15 @@ class DeepSpeedEngine:
         else:
             self.mesh = build_mesh(data=jax.device_count())
         self.grid = mpu if isinstance(mpu, MeshGrid) else None
+        # one source of truth for batch-dim sharding; meshes may drop the
+        # size-1 data axis (e.g. pure-sequence meshes)
+        self._batch_axis = DATA_AXIS if DATA_AXIS in self.mesh.shape else None
+        if self._batch_axis is None and jax.process_count() > 1:
+            # each process feeds different samples (deepspeed_io), which a
+            # replicated batch sharding would silently mis-treat as equal
+            raise NotImplementedError(
+                "multi-process runs need a 'data' mesh axis to shard the "
+                "batch over")
         self.dp_world_size = int(self.mesh.shape.get(DATA_AXIS, 1))
         self.mp_world_size = int(self.mesh.shape.get("model", 1))
         self.global_rank = jax.process_index()
@@ -378,7 +387,8 @@ class DeepSpeedEngine:
         return max(self.dp_world_size // jax.process_count(), 1)
 
     def _batch_sharding(self, ndim):
-        return NamedSharding(self.mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+        return NamedSharding(self.mesh,
+                             P(self._batch_axis, *([None] * (ndim - 1))))
 
     def _to_device(self, batch):
         """Numpy batch (global or per-process) -> sharded jax.Arrays."""
@@ -802,7 +812,8 @@ class DeepSpeedEngine:
             if x.ndim <= 1 or x.shape[1] % self.dp_world_size != 0:
                 return jax.device_put(x, NamedSharding(self.mesh, P()))
             sharding = NamedSharding(
-                self.mesh, P(None, DATA_AXIS, *([None] * (x.ndim - 2))))
+                self.mesh,
+                P(None, self._batch_axis, *([None] * (x.ndim - 2))))
             if jax.process_count() > 1:
                 return jax.make_array_from_process_local_data(sharding, x)
             return jax.device_put(x, sharding)

@@ -75,7 +75,11 @@ class ZeroShardingPlan:
         while len(base) < len(shape):
             base.append(None)
         numel = int(np.prod(shape)) if shape else 1
-        if numel < max(threshold, self.dp_size) or not shape:
+        # dp_size <= 1 also covers meshes that dropped the size-1 data
+        # axis entirely (e.g. a pure-sequence mesh): annotating 'data'
+        # there would name an axis the mesh doesn't carry
+        if self.dp_size <= 1 or numel < max(threshold, self.dp_size) \
+                or not shape:
             return P(*base) if tp_spec is not None else P()
         # Shard the first unclaimed axis divisible by dp
         for dim, size in enumerate(shape):

@@ -184,3 +184,53 @@ def call_to_str(base, *args, **kwargs):
 
 def count_parameters(params):
     return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params))
+
+
+class PartitionedTensor:
+    """Shard one tensor over a mesh axis; reassemble on demand.
+
+    Reference parity: runtime/utils.py PartitionedTensor (:396-503) — the
+    pipeline engine uses it to send tensor-parallel-partitioned activations
+    between stages. Here the partitioned form IS a sharded jax.Array
+    (flattened, padded to the axis size, NamedSharding over ``axis``);
+    ``full()`` restores the original shape (XLA inserts the all-gather),
+    and ``to_meta``/``from_meta`` round-trip the (shape, padded size) info
+    the reference ships alongside the data.
+    """
+
+    def __init__(self, tensor, mesh, axis="model", _meta=None):
+        from jax.sharding import NamedSharding, PartitionSpec
+        self.mesh = mesh
+        self.axis = axis
+        if _meta is not None:
+            # ``tensor`` is the GLOBAL padded flat (sharded) array, not a
+            # single rank's slice — under SPMD the sharded jax.Array IS the
+            # per-rank-partitioned form the reference ships piecewise
+            self.orig_shape, self.orig_size = _meta
+            self.local_data = tensor
+            return
+        self.orig_shape = tuple(tensor.shape)
+        self.orig_size = int(np.prod(self.orig_shape)) \
+            if self.orig_shape else 1
+        parts = int(mesh.shape.get(axis, 1))
+        flat = jnp.ravel(tensor)
+        pad = (-self.orig_size) % parts
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+        # replicate when the axis is absent/size-1 (naming a missing mesh
+        # axis in a PartitionSpec is an error)
+        spec = PartitionSpec(axis) if parts > 1 else PartitionSpec()
+        self.local_data = jax.device_put(flat, NamedSharding(mesh, spec))
+
+    def to_meta(self):
+        return (self.orig_shape, self.orig_size)
+
+    @classmethod
+    def from_meta(cls, meta, part_data, mesh, axis="model"):
+        """Rebuild from ``to_meta()`` info + the sharded flat array
+        (``PartitionedTensor.local_data``)."""
+        return cls(part_data, mesh, axis=axis, _meta=tuple(meta))
+
+    def full(self):
+        """Reassembled tensor in the original shape (all-gather by XLA)."""
+        return self.local_data[:self.orig_size].reshape(self.orig_shape)

@@ -210,8 +210,7 @@ class PartitionedTensor:
             self.local_data = tensor
             return
         self.orig_shape = tuple(tensor.shape)
-        self.orig_size = int(np.prod(self.orig_shape)) \
-            if self.orig_shape else 1
+        self.orig_size = int(np.prod(self.orig_shape))
         parts = int(mesh.shape.get(axis, 1))
         flat = jnp.ravel(tensor)
         pad = (-self.orig_size) % parts

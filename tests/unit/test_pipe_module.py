@@ -1,0 +1,105 @@
+"""PipelineModule structure tests (reference tests/unit/test_pipe_module.py:
+LayerSpec deferred build, tied layers, partition methods)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.pipe.module import (Layer, LayerSpec,
+                                               TiedLayerSpec, PipelineModule)
+
+
+class DenseBlock(Layer):
+    """A tiny named layer class so type:regex has something to match."""
+
+    built = 0
+
+    def __init__(self, dim):
+        DenseBlock.built += 1
+        self.dim = dim
+
+        def init(rng):
+            return {"w": jax.random.normal(rng, (dim, dim)) * 0.02}
+
+        def apply(p, x):
+            return jnp.tanh(x @ p["w"])
+
+        super().__init__(init, apply, name="DenseBlock")
+
+
+class Emb(Layer):
+    def __init__(self, vocab, dim):
+        def init(rng):
+            return {"wte": jax.random.normal(rng, (vocab, dim)) * 0.02}
+
+        def apply(p, x):
+            return p["wte"][x]
+
+        super().__init__(init, apply, name="Emb")
+
+
+def _specs(n_blocks=4, vocab=32, dim=16):
+    return ([LayerSpec(Emb, vocab, dim)] +
+            [LayerSpec(DenseBlock, dim) for _ in range(n_blocks)])
+
+
+def test_layer_spec_defers_build():
+    before = DenseBlock.built
+    spec = LayerSpec(DenseBlock, 8)
+    assert DenseBlock.built == before  # not built yet
+    layer = spec.build()
+    assert DenseBlock.built == before + 1
+    assert isinstance(layer, DenseBlock)
+    assert "DenseBlock" in repr(spec)
+
+
+def test_layer_spec_requires_callable():
+    with pytest.raises(RuntimeError):
+        LayerSpec("not-a-class", 8)
+
+
+@pytest.mark.parametrize("method", ["uniform", "parameters",
+                                    "type:DenseBlock"])
+def test_partition_methods(method):
+    net = PipelineModule(_specs(), num_stages=2, partition_method=method)
+    assert net.num_stages == 2
+    assert net.layers_per_stage == 2
+    assert len(net.pre_layers) == 1      # embedding hoisted to all stages
+    assert len(net.post_layers) == 0
+    # stacked body: (stages, layers_per_stage, dim, dim)
+    assert net.body_params["w"].shape[:2] == (2, 2)
+
+
+def test_type_regex_no_match_raises():
+    with pytest.raises(AssertionError):
+        PipelineModule(_specs(), num_stages=2,
+                       partition_method="type:NoSuchLayer")
+
+
+def test_body_must_divide_stages():
+    with pytest.raises(AssertionError, match="divide"):
+        PipelineModule(_specs(n_blocks=3), num_stages=2)
+
+
+def test_tied_layer_spec_shares_params():
+    specs = ([TiedLayerSpec("embed", Emb, 32, 16)] +
+             [LayerSpec(DenseBlock, 16) for _ in range(2)] +
+             [TiedLayerSpec("embed", Emb, 32, 16)])
+    net = PipelineModule(specs, num_stages=2)
+    # one shared parameter tree for the tied key
+    assert list(net.tied_params.keys()) == ["embed"]
+    assert list(net.tied_keys.keys()) == ["embed"]
+    # both tied entries reference the same key (no second build/params)
+    tied_entries = [e for e in net.layers if e[0] == "tied"]
+    assert len(tied_entries) == 2
+    assert all(e[1] == "embed" for e in tied_entries)
+
+
+def test_seed_layers_reproducible():
+    net1 = PipelineModule(_specs(), num_stages=2, seed_layers=True,
+                          base_seed=7)
+    net2 = PipelineModule(_specs(), num_stages=2, seed_layers=True,
+                          base_seed=7)
+    np.testing.assert_allclose(np.asarray(net1.body_params["w"]),
+                               np.asarray(net2.body_params["w"]))

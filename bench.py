@@ -1,4 +1,4 @@
-"""Benchmark: GPT-2 (125M) causal-LM pretraining throughput on one TPU chip.
+"""Benchmark: GPT-2 (350M) causal-LM pretraining throughput on one TPU chip.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
@@ -39,12 +39,13 @@ def main():
     seq = 1024 if on_tpu else 128
     steps = 20 if on_tpu else 3
     warmup = 3 if on_tpu else 1
-    # Largest stable micro-batch first (v5e 16G: 192 w/ full remat +
-    # chunked CE); fall back if the compiler rejects the footprint.
-    micro_batches = [192, 64, 16, 8] if on_tpu else [2]
+    # GPT-2 medium (350M): best measured MFU on one v5e chip — d_model
+    # 1024 tiles the MXU better than 125M's 768 (sweep:
+    # tests/perf/sweep_gpt2_mfu.py). Fall back on compiler OOM.
+    micro_batches = [96, 64, 32, 8] if on_tpu else [2]
 
     if on_tpu:
-        cfg = gpt2.config_for("gpt2_small", max_seq_len=seq, remat=True,
+        cfg = gpt2.config_for("gpt2_medium", max_seq_len=seq, remat=True,
                               loss_chunk=128)
     else:
         cfg = gpt2.GPT2Config(vocab_size=512, max_seq_len=seq, n_layers=2,
@@ -101,7 +102,7 @@ def main():
     mfu = achieved / peak_for(jax.devices()[0])
 
     print(json.dumps({
-        "metric": "gpt2_125m_pretrain_tokens_per_sec_per_chip",
+        "metric": "gpt2_350m_pretrain_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec / jax.device_count(), 2),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.45, 4),

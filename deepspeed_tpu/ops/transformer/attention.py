@@ -26,13 +26,12 @@ def causal_attention(q, k, v, use_flash=True, sm_scale=None, interpret=None):
         interpret = False
     backend_ok = jax.default_backend() == "tpu" or interpret
     if use_flash and backend_ok:
-        from .flash_attention import flash_attention
-        b, s, h, d = q.shape
-        fold = lambda t: t.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-        unfold = lambda t: t.reshape(b, h, s, d).transpose(0, 2, 1, 3)
-        out = flash_attention(fold(q), fold(k), fold(v), sm_scale, True,
-                              512, interpret)
-        return unfold(out)
+        # (b,s,h,d)-native kernel: no head fold/unfold relayout (that
+        # transpose costs more than the attention math at d_head 64).
+        # block_q 256: the packed kernel holds whole K/V (s, h*d) in VMEM,
+        # so a 512 q-block tips the 16M scoped-vmem limit at GPT-2 scale.
+        from .flash_attention import flash_attention_bshd
+        return flash_attention_bshd(q, k, v, sm_scale, True, 256, interpret)
     return reference_causal_attention(q, k, v, sm_scale)
 
 

@@ -327,8 +327,13 @@ class DeepSpeedEngine:
             self.model.params = None
             return
 
+        # copy=True: jnp.asarray of same-dtype input is a VIEW of the
+        # caller's arrays; the jitted step donates engine state, so an
+        # aliased user array would be invalidated ("Buffer has been deleted
+        # or donated") if the caller builds a second engine from it
         params_f32 = jax.tree_util.tree_map(
-            lambda p: jnp.asarray(p, dtype=jnp.float32), self.model.params)
+            lambda p: jnp.array(p, dtype=jnp.float32, copy=True),
+            self.model.params)
 
         param_sh = plan.tree_shardings(params_f32, "param")
         master_sh = plan.tree_shardings(params_f32, "master")

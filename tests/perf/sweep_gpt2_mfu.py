@@ -16,12 +16,12 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 
 def run_one(micro_batch, remat_policy, loss_chunk, seq=1024, steps=10,
-            warmup=2, remat=True):
+            warmup=2, remat=True, size="gpt2_small"):
     import jax
     import deepspeed_tpu as deepspeed
     from deepspeed_tpu.models import gpt2
 
-    cfg = gpt2.config_for("gpt2_small", max_seq_len=seq, remat=remat,
+    cfg = gpt2.config_for(size, max_seq_len=seq, remat=remat,
                           remat_policy=remat_policy, loss_chunk=loss_chunk)
     n_params = gpt2.num_params(cfg)
     model = gpt2.make_gpt2_model(config=cfg)
@@ -59,26 +59,24 @@ def run_one(micro_batch, remat_policy, loss_chunk, seq=1024, steps=10,
 
 def main():
     combos = [
-        # current bench config
-        (192, "full", 128, True),
-        # dots policy: saves matmul outputs, recompute elementwise only
-        (64, "dots", 128, True),
-        (96, "dots", 128, True),
-        (128, "dots", 128, True),
-        # no remat at all (fwd activations kept)
-        (32, "full", 128, False),
-        (64, "full", 128, False),
-        # bigger CE chunk
-        (192, "full", 256, True),
-        (96, "dots", 256, True),
+        # (size, micro_batch, policy, loss_chunk, remat)
+        ("gpt2_small", 192, "full", 128, True),   # current bench config
+        ("gpt2_small", 16, "dots", 128, True),    # dots: crash or OOM?
+        ("gpt2_small", 48, "dots", 128, True),
+        ("gpt2_small", 192, "full", 256, True),
+        ("gpt2_small", 256, "full", 64, True),
+        ("gpt2_medium", 96, "full", 128, True),   # d=1024: better MXU tiling
+        ("gpt2_medium", 64, "full", 128, True),
+        ("gpt2_small", 48, "full", 128, False),   # no remat
     ]
     results = []
-    for mb, pol, chunk, remat in combos:
+    for size, mb, pol, chunk, remat in combos:
         try:
-            r = run_one(mb, pol, chunk, remat=remat)
+            r = run_one(mb, pol, chunk, remat=remat, size=size)
         except Exception as e:  # noqa: BLE001
             r = dict(micro_batch=mb, remat_policy=pol, loss_chunk=chunk,
                      remat=remat, error=str(e)[:200])
+        r["size"] = size
         print(json.dumps(r), flush=True)
         results.append(r)
     ok = [r for r in results if "mfu" in r]

@@ -73,15 +73,19 @@ def main():
         batch = (ids, ids.copy())
 
         try:
-            # compile + warmup
+            # compile + warmup. float(loss) (not block_until_ready) is the
+            # sync: through the axon tunnel execution is lazy and only a
+            # literal value fetch forces it; steps chain sequentially
+            # through the donated state, so fetching the last loss fences
+            # the whole loop.
             for _ in range(warmup):
                 loss = engine.train_batch(batch=batch)
-            jax.block_until_ready(engine.state["params"]["wte"])
+            float(loss)
 
             t0 = time.time()
             for _ in range(steps):
                 loss = engine.train_batch(batch=batch)
-            jax.block_until_ready(engine.state["params"]["wte"])
+            float(loss)
             dt = time.time() - t0
             break
         except Exception as err:  # noqa: BLE001 - compiler OOM etc.

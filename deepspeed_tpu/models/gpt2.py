@@ -283,10 +283,16 @@ def chunked_causal_lm_loss(hidden, wte, labels, chunk):
     def body(carry, xs):
         hc, lc = xs
         logits = (hc @ wte_c.T).astype(jnp.float32)
-        logp = jax.nn.log_softmax(logits, axis=-1)
         mask = (lc != -100)
         safe = jnp.where(mask, lc, 0)
-        ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        # lse + one gathered logit instead of log_softmax: the full
+        # (rows, V) logp array never materializes (only reductions over
+        # the logits survive), halving the chunk's HBM traffic
+        m = jax.lax.stop_gradient(logits.max(axis=-1, keepdims=True))
+        lse = m[..., 0] + jnp.log(
+            jnp.exp(logits - m).sum(axis=-1))
+        ll = jnp.take_along_axis(logits, safe[..., None],
+                                 axis=-1)[..., 0] - lse
         tot, cnt = carry
         return (tot + (ll * mask).sum(),
                 cnt + mask.sum().astype(jnp.float32)), None

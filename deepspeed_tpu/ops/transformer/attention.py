@@ -28,10 +28,10 @@ def causal_attention(q, k, v, use_flash=True, sm_scale=None, interpret=None):
     if use_flash and backend_ok:
         # (b,s,h,d)-native kernel: no head fold/unfold relayout (that
         # transpose costs more than the attention math at d_head 64).
-        # block_q 256: the packed kernel holds whole K/V (s, h*d) in VMEM,
-        # so a 512 q-block tips the 16M scoped-vmem limit at GPT-2 scale.
-        from .flash_attention import flash_attention_bshd
-        return flash_attention_bshd(q, k, v, sm_scale, True, 256, interpret)
+        from .flash_attention import (flash_attention_bshd,
+                                      DEFAULT_BLOCK_PACKED)
+        return flash_attention_bshd(q, k, v, sm_scale, True,
+                                    DEFAULT_BLOCK_PACKED, interpret)
     return reference_causal_attention(q, k, v, sm_scale)
 
 

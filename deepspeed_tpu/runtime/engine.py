@@ -743,6 +743,20 @@ class DeepSpeedEngine:
             return jnp.float32(self.progressive_layer_drop.get_theta())
         return jnp.float32(1.0)
 
+    def _overflow_fetch_needed(self):
+        """Whether the optimizer step's overflow flag must be read back to
+        the host this step. Only dynamic loss scaling (fp16) needs it per
+        step — skipped_steps/lr-skip semantics depend on it. With a static
+        scale the reference does no overflow bookkeeping either, and the
+        fetch is a per-step device sync worth avoiding."""
+        if self.host_state is not None:
+            return True     # offload: metrics are already host values
+        # fp16 checks overflow per step even with a STATIC scale (the
+        # reference's FP16_Optimizer always runs CheckOverflow); only
+        # bf16/fp32 — where the reference has no overflow machinery — skip
+        return (bool(self.state["scaler"].dynamic)
+                or self.compute_dtype == jnp.float16)
+
     def _take_model_step(self, lr_kwargs=None):
         if self.host_state is not None:
             metrics = self._host_apply_step()
@@ -750,8 +764,9 @@ class DeepSpeedEngine:
             apply_fn = self._get_jit("apply", self._apply_step_fn,
                                      donate_argnums=(0,))
             self.state, metrics = apply_fn(self.state, self._hyper())
-        overflow = bool(metrics["overflow"])
         self._step_metrics = {k: v for k, v in metrics.items()}
+        overflow = (bool(metrics["overflow"])
+                    if self._overflow_fetch_needed() else False)
         if overflow:
             self.skipped_steps += 1
             log_dist("OVERFLOW! Skipping step. Attempted loss scale: {}".format(
@@ -795,7 +810,12 @@ class DeepSpeedEngine:
             self.state, (mean_loss, metrics) = fused(
                 self.state, batch, step_rng, self._hyper(),
                 self._pld_theta())
-        overflow = bool(metrics["overflow"])
+        # bf16/fp32: no bool() fetch — no host overflow bookkeeping in the
+        # reference's non-fp16 path either; the in-jit guard still no-ops a
+        # non-finite step on device, and skipping the fetch removes a
+        # per-step device sync, letting the host race ahead.
+        overflow = (bool(metrics["overflow"])
+                    if self._overflow_fetch_needed() else False)
         if overflow:
             self.skipped_steps += 1
         elif self.lr_scheduler is not None:

@@ -22,11 +22,11 @@ SEQ = 1024
 def _force(out):
     """Force execution through the axon tunnel: block_until_ready is a no-op
     there (lazy remote execution); a literal value fetch is what runs the
-    program. Fetch one scalar derived from the first leaf."""
+    program. Index ON DEVICE first so only one scalar crosses the tunnel —
+    np.asarray of a full leaf would drag the whole array through it."""
     import jax
-    import numpy as np
     leaf = jax.tree_util.tree_leaves(out)[0]
-    return float(np.asarray(leaf).ravel()[0])
+    return float(leaf.ravel()[0])
 
 
 def timed(fn, *args, reps=5):

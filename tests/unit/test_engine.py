@@ -228,3 +228,28 @@ def test_lamb_optimizer():
     dataset = SimpleDataset(256, HIDDEN)
     losses = train_steps(engine, dataset, 10)
     assert losses[-1] < losses[0]
+
+
+def test_overflow_fetch_policy():
+    """Per-step host overflow readback: required for fp16 (the reference's
+    FP16_Optimizer runs CheckOverflow even with a STATIC scale), skipped
+    for bf16/fp32 (reference non-fp16 path has no overflow machinery; the
+    in-jit guard still no-ops a non-finite step)."""
+    import jax.numpy as jnp
+
+    cfg = base_config(WORLD)
+    cfg["bf16"] = {"enabled": True}
+    assert not make_engine(cfg)._overflow_fetch_needed()
+
+    cfg = base_config(WORLD)
+    cfg["fp16"] = {"enabled": True, "loss_scale": 128}   # static fp16
+    eng = make_engine(cfg)
+    if eng.compute_dtype == jnp.float16:  # on TPU fp16 maps to bf16
+        assert eng._overflow_fetch_needed()
+
+    cfg = base_config(WORLD)
+    cfg["fp16"] = {"enabled": True}                      # dynamic fp16
+    eng = make_engine(cfg)
+    assert eng._overflow_fetch_needed() or eng.compute_dtype != jnp.float16
+    if eng.compute_dtype == jnp.float16:
+        assert eng.state["scaler"].dynamic

@@ -103,14 +103,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, block_q,
     lse_ref[0] = lse                                     # (Bq, 1)
 
 
-def _fwd_kernel_packed(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale,
-                       block_q, block_k, num_k_blocks, causal, seq_len,
-                       num_heads, d_head):
-    """(b, s, h*d)-packed forward: operands stay in the model's natural
-    activation layout (the qkv matmul's output), so no host-side head
-    transpose ever happens — the (b,s,h,d)->(bh,s,d) relayout at d_head 64
-    costs more HBM time than the attention math itself. Heads are a static
-    in-kernel loop over lane slices; all ref stores are full blocks."""
+def _fwd_kernel_packed_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                                sm_scale, block_q, block_k, num_k_blocks,
+                                causal, seq_len, num_heads, d_head):
+    """(b, s, h*d)-packed forward, whole K/V resident in VMEM: the fast
+    path for ordinary sequence lengths. The k loop's online-softmax state
+    lives in registers (no scratch round-trips), which measures ~3x faster
+    than the streaming variant at GPT-2 shapes; VMEM bounds it to roughly
+    s*h*d <= ~1M elements (seq 1024 at width 1024)."""
     qi = pl.program_id(1)
     q_all = q_ref[0]                                      # (Bq, h*d)
     outs, lses = [], []
@@ -127,6 +127,80 @@ def _fwd_kernel_packed(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale,
         lses.append(lse)
     o_ref[0] = jnp.concatenate(outs, axis=1)
     lse_ref[0] = jnp.concatenate(lses, axis=1)            # (Bq, h)
+
+
+# whole-K/V fwd stays fast up to this many packed elements (s * h * d);
+# beyond it the streaming kernel keeps long sequences compiling.
+RESIDENT_FWD_MAX_ELEMS = 1024 * 1024
+
+
+def _fwd_kernel_packed(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_s, m_s, l_s,
+                       *, sm_scale, block_q, block_k, num_k_blocks, causal,
+                       seq_len, num_heads, d_head):
+    """(b, s, h*d)-packed forward: operands stay in the model's natural
+    activation layout (the qkv matmul's output), so no host-side head
+    transpose ever happens — the (b,s,h,d)->(bh,s,d) relayout at d_head 64
+    costs more HBM time than the attention math itself. Heads are a static
+    in-kernel loop over lane slices; all ref stores are full blocks.
+
+    Grid (b, q blocks, k blocks): K/V are streamed block-by-block with the
+    online-softmax state (acc/m/l per head) carried in VMEM scratch across
+    the sequential innermost k dimension, so sequence length is bounded by
+    HBM, not by whole-K/V VMEM residency. Causal cells above the diagonal
+    are skipped (~2x less MXU work)."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    k_base = ki * block_k
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_s[:] = jnp.zeros_like(acc_s)
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+
+    live = k_base < (qi + 1) * block_q if causal else True
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = k_base + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = k_pos < seq_len                  # zero-padded k tail
+    if causal:
+        mask = jnp.logical_and(mask, q_pos >= k_pos)
+
+    @pl.when(live)
+    def _accumulate():
+        for hi in range(num_heads):
+            sl = slice(hi * d_head, (hi + 1) * d_head)
+            q = q_ref[0][:, sl]                           # (Bq, d)
+            k_blk = k_ref[0][:, sl]                       # (Bk, d)
+            v_blk = v_ref[0][:, sl]
+            s_blk = jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * sm_scale
+            s_blk = jnp.where(mask, s_blk, NEG_INF)
+            m_old = m_s[:, hi:hi + 1]                     # (Bq, 1)
+            m_new = jnp.maximum(m_old,
+                                jnp.max(s_blk, axis=-1, keepdims=True))
+            p = jnp.exp(s_blk - m_new)
+            corr = jnp.exp(m_old - m_new)
+            l_s[:, hi:hi + 1] = (l_s[:, hi:hi + 1] * corr
+                                 + jnp.sum(p, axis=-1, keepdims=True))
+            m_s[:, hi:hi + 1] = m_new
+            acc_s[:, sl] = acc_s[:, sl] * corr + jax.lax.dot_general(
+                p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _flush():
+        l = l_s[:]                                        # (Bq, h)
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        scale = 1.0 / l_safe                              # (Bq, h)
+        # per-head rescale: broadcast (Bq, h) -> lane slices of (Bq, h*d)
+        outs = [acc_s[:, hi * d_head:(hi + 1) * d_head]
+                * scale[:, hi:hi + 1] for hi in range(num_heads)]
+        o_ref[0] = jnp.concatenate(outs, axis=1).astype(o_ref.dtype)
+        lse_ref[0] = m_s[:] + jnp.log(l_safe)             # (Bq, h)
 
 
 def _bwd_compute(q, o, do, lse, load_kv, accum_dkv, *, qi, sm_scale,
@@ -390,12 +464,8 @@ def _bwd(q, k, v, o, do, lse, sm_scale, causal, block_q, block_k, interpret):
 def _fwd_packed(q, k, v, sm_scale, causal, block_q, block_k, interpret,
                 num_heads):
     """q/k/v: (b, s, h*d) packed; returns (out (b, s, h*d), lse (b, s, h)).
-
-    K/V stay whole in VMEM per (batch, q-block) cell: 2*s*h*d*2B, so the
-    forward caps out around s*h*d ~ 2M elements (seq 2048 at GPT-2-medium
-    width) against the 16M scoped-vmem limit with double buffering. Longer
-    sequences should go through ring attention (parallel/ring_attention.py)
-    or a k-blocked fwd grid like the split backward's."""
+    Every operand is blocked (grid b x q x k); sequence length is bounded
+    by HBM only."""
     b, s, hd = q.shape
     d = hd // num_heads
     block_q = min(block_q, s)
@@ -403,9 +473,33 @@ def _fwd_packed(q, k, v, sm_scale, causal, block_q, block_k, interpret,
     k, v = _pad_kv(k, v, block_k)
     s_p = k.shape[1]
     num_k_blocks = s_p // block_k
-    grid = (b, pl.cdiv(s, block_q))
-    q_spec = pl.BlockSpec((1, block_q, hd), lambda bi, qi: (bi, qi, 0))
-    kv_spec = pl.BlockSpec((1, s_p, hd), lambda bi, qi: (bi, 0, 0))
+
+    if s_p * hd <= RESIDENT_FWD_MAX_ELEMS:
+        # fast path: K/V whole per (batch, q-block) cell, softmax state in
+        # registers across an in-kernel fori over k blocks
+        grid = (b, pl.cdiv(s, block_q))
+        q_spec = pl.BlockSpec((1, block_q, hd), lambda bi, qi: (bi, qi, 0))
+        kv_spec = pl.BlockSpec((1, s_p, hd), lambda bi, qi: (bi, 0, 0))
+        return pl.pallas_call(
+            functools.partial(_fwd_kernel_packed_resident,
+                              sm_scale=sm_scale, block_q=block_q,
+                              block_k=block_k, num_k_blocks=num_k_blocks,
+                              causal=causal, seq_len=s,
+                              num_heads=num_heads, d_head=d),
+            grid=grid,
+            in_specs=[q_spec, kv_spec, kv_spec],
+            out_specs=(q_spec,
+                       pl.BlockSpec((1, block_q, num_heads),
+                                    lambda bi, qi: (bi, qi, 0))),
+            out_shape=(jax.ShapeDtypeStruct((b, s, hd), q.dtype),
+                       jax.ShapeDtypeStruct((b, s, num_heads),
+                                            jnp.float32)),
+            interpret=interpret,
+        )(q, k, v)
+
+    grid = (b, pl.cdiv(s, block_q), num_k_blocks)
+    q_spec = pl.BlockSpec((1, block_q, hd), lambda bi, qi, ki: (bi, qi, 0))
+    kv_spec = pl.BlockSpec((1, block_k, hd), lambda bi, qi, ki: (bi, ki, 0))
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel_packed, sm_scale=sm_scale,
                           block_q=block_q, block_k=block_k,
@@ -415,9 +509,12 @@ def _fwd_packed(q, k, v, sm_scale, causal, block_q, block_k, interpret,
         in_specs=[q_spec, kv_spec, kv_spec],
         out_specs=(q_spec,
                    pl.BlockSpec((1, block_q, num_heads),
-                                lambda bi, qi: (bi, qi, 0))),
+                                lambda bi, qi, ki: (bi, qi, 0))),
         out_shape=(jax.ShapeDtypeStruct((b, s, hd), q.dtype),
                    jax.ShapeDtypeStruct((b, s, num_heads), jnp.float32)),
+        scratch_shapes=[pltpu.VMEM((block_q, hd), jnp.float32),
+                        pltpu.VMEM((block_q, num_heads), jnp.float32),
+                        pltpu.VMEM((block_q, num_heads), jnp.float32)],
         interpret=interpret,
     )(q, k, v)
     return out, lse

@@ -757,6 +757,19 @@ class DeepSpeedEngine:
         return (bool(self.state["scaler"].dynamic)
                 or self.compute_dtype == jnp.float16)
 
+    def _read_overflow(self, metrics):
+        """The optimizer step's overflow flag, fetched per-step for fp16
+        (reference FP16_Optimizer semantics) and only at steps_per_print
+        boundaries for bf16/fp32 — the in-jit guard still no-ops a
+        non-finite step on device every step, and the periodic check keeps
+        a persistently-overflowing run observable (skipped_steps/log)
+        without a per-step device sync."""
+        if self._overflow_fetch_needed():
+            return bool(metrics["overflow"])
+        if (self.global_steps + 1) % self.steps_per_print() == 0:
+            return bool(metrics["overflow"])
+        return False
+
     def _take_model_step(self, lr_kwargs=None):
         if self.host_state is not None:
             metrics = self._host_apply_step()
@@ -765,8 +778,7 @@ class DeepSpeedEngine:
                                      donate_argnums=(0,))
             self.state, metrics = apply_fn(self.state, self._hyper())
         self._step_metrics = {k: v for k, v in metrics.items()}
-        overflow = (bool(metrics["overflow"])
-                    if self._overflow_fetch_needed() else False)
+        overflow = self._read_overflow(metrics)
         if overflow:
             self.skipped_steps += 1
             log_dist("OVERFLOW! Skipping step. Attempted loss scale: {}".format(
@@ -810,14 +822,11 @@ class DeepSpeedEngine:
             self.state, (mean_loss, metrics) = fused(
                 self.state, batch, step_rng, self._hyper(),
                 self._pld_theta())
-        # bf16/fp32: no bool() fetch — no host overflow bookkeeping in the
-        # reference's non-fp16 path either; the in-jit guard still no-ops a
-        # non-finite step on device, and skipping the fetch removes a
-        # per-step device sync, letting the host race ahead.
-        overflow = (bool(metrics["overflow"])
-                    if self._overflow_fetch_needed() else False)
+        overflow = self._read_overflow(metrics)
         if overflow:
             self.skipped_steps += 1
+            log_dist("OVERFLOW! Skipping step. Attempted loss scale: {}"
+                     .format(float(metrics["loss_scale"])), ranks=[0])
         elif self.lr_scheduler is not None:
             self.lr_scheduler.step()
         if self.progressive_layer_drop:

@@ -1,0 +1,243 @@
+// Native data IO: mmap'd indexed token dataset + threaded batch prefetch.
+//
+// TPU-native counterpart of the reference era's C++ dataset helpers (the
+// Megatron-GPT2 workloads the reference drives use mmap'd .bin/.idx token
+// files with native gather helpers; DeepSpeed itself wraps torch
+// DataLoader workers, deepspeed/runtime/dataloader.py). On a TPU host the
+// input pipeline runs on CPU while the chip computes, so the reader is:
+//   * zero-copy: documents live in one mmap'd .bin, never read up front;
+//   * OpenMP batch gather into caller-provided buffers;
+//   * double-buffered background prefetch (one producer thread filling a
+//     ring while the host thread feeds the previous batch to the device).
+//
+// File format (created by deepspeed_tpu.runtime.data.indexed_dataset):
+//   <name>.bin  raw little-endian tokens, dtype int32 or uint16
+//   <name>.idx  header: magic "DSTPUIDX" (8B), u32 version, u32 dtype code
+//               (4=int32, 2=uint16), u64 n_docs; then (n_docs+1) u64
+//               offsets (token units) into the .bin
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Dataset {
+  int bin_fd = -1;
+  const uint8_t* bin = nullptr;   // mmap'd token data
+  size_t bin_bytes = 0;
+  uint32_t dtype_code = 4;        // 4=int32, 2=uint16
+  uint64_t n_docs = 0;
+  std::vector<uint64_t> offsets;  // n_docs + 1, token units
+
+  // prefetch state
+  std::thread producer;
+  std::mutex mu;
+  std::condition_variable cv_full, cv_empty;
+  std::vector<int32_t> ring[2];
+  int ready[2] = {0, 0};          // slot filled?
+  int next_fill = 0, next_read = 0;
+  std::atomic<bool> stop{false};
+  uint64_t cursor = 0;            // next sample index
+  int batch = 0, seq = 0;
+  uint64_t n_samples = 0;         // contiguous seq-token samples available
+};
+
+uint64_t read_u64(FILE* f) {
+  uint64_t v = 0;
+  if (fread(&v, sizeof(v), 1, f) != 1) return 0;
+  return v;
+}
+
+int32_t token_at(const Dataset* ds, uint64_t i) {
+  if (ds->dtype_code == 2) {
+    return reinterpret_cast<const uint16_t*>(ds->bin)[i];
+  }
+  return reinterpret_cast<const int32_t*>(ds->bin)[i];
+}
+
+}  // namespace
+
+extern "C" {
+
+// Open <prefix>.idx / <prefix>.bin. Returns opaque handle or null.
+void* ds_dataio_open(const char* idx_path, const char* bin_path) {
+  FILE* f = fopen(idx_path, "rb");
+  if (!f) return nullptr;
+  char magic[8];
+  if (fread(magic, 1, 8, f) != 8 || memcmp(magic, "DSTPUIDX", 8) != 0) {
+    fclose(f);
+    return nullptr;
+  }
+  uint32_t version = 0, dtype_code = 0;
+  if (fread(&version, 4, 1, f) != 1 || fread(&dtype_code, 4, 1, f) != 1 ||
+      version != 1 || (dtype_code != 4 && dtype_code != 2)) {
+    fclose(f);
+    return nullptr;
+  }
+  auto* ds = new Dataset();
+  ds->dtype_code = dtype_code;
+  ds->n_docs = read_u64(f);
+  ds->offsets.resize(ds->n_docs + 1);
+  size_t got = fread(ds->offsets.data(), sizeof(uint64_t), ds->n_docs + 1, f);
+  fclose(f);
+  if (got != ds->n_docs + 1) {
+    delete ds;
+    return nullptr;
+  }
+
+  ds->bin_fd = open(bin_path, O_RDONLY);
+  if (ds->bin_fd < 0) {
+    delete ds;
+    return nullptr;
+  }
+  struct stat st;
+  fstat(ds->bin_fd, &st);
+  ds->bin_bytes = static_cast<size_t>(st.st_size);
+  // truncated/mismatched .bin would SIGBUS on a past-the-end mmap read in
+  // the producer thread; fail the open cleanly instead (caller falls back)
+  if (ds->offsets.back() * ds->dtype_code > ds->bin_bytes) {
+    close(ds->bin_fd);
+    delete ds;
+    return nullptr;
+  }
+  ds->bin = static_cast<const uint8_t*>(
+      mmap(nullptr, ds->bin_bytes, PROT_READ, MAP_PRIVATE, ds->bin_fd, 0));
+  if (ds->bin == MAP_FAILED) {
+    close(ds->bin_fd);
+    delete ds;
+    return nullptr;
+  }
+  // advise the kernel we'll stream through it
+  madvise(const_cast<uint8_t*>(ds->bin), ds->bin_bytes, MADV_WILLNEED);
+  return ds;
+}
+
+int64_t ds_dataio_num_docs(void* h) {
+  return static_cast<Dataset*>(h)->n_docs;
+}
+
+int64_t ds_dataio_num_tokens(void* h) {
+  auto* ds = static_cast<Dataset*>(h);
+  return ds->offsets.back();
+}
+
+int64_t ds_dataio_doc_len(void* h, int64_t doc) {
+  auto* ds = static_cast<Dataset*>(h);
+  return ds->offsets[doc + 1] - ds->offsets[doc];
+}
+
+// Copy one document's tokens into out (int32), returns length copied
+// (clamped to max_len).
+int64_t ds_dataio_get_doc(void* h, int64_t doc, int32_t* out,
+                          int64_t max_len) {
+  auto* ds = static_cast<Dataset*>(h);
+  uint64_t start = ds->offsets[doc], end = ds->offsets[doc + 1];
+  int64_t n = static_cast<int64_t>(end - start);
+  if (n > max_len) n = max_len;
+#pragma omp parallel for if (n > 1 << 16)
+  for (int64_t i = 0; i < n; ++i) out[i] = token_at(ds, start + i);
+  return n;
+}
+
+// Gather a batch of fixed-length samples by sample index, treating the
+// whole .bin as one token stream chopped into seq-length windows (the
+// GPT-2 pretraining convention). out is (n_samples, seq) int32.
+void ds_dataio_batch(void* h, const int64_t* sample_idx, int64_t n_samples,
+                     int64_t seq, int32_t* out) {
+  auto* ds = static_cast<Dataset*>(h);
+  const uint64_t total = ds->offsets.back();
+#pragma omp parallel for
+  for (int64_t s = 0; s < n_samples; ++s) {
+    uint64_t start = static_cast<uint64_t>(sample_idx[s]) * seq;
+    for (int64_t t = 0; t < seq; ++t) {
+      uint64_t pos = start + t;
+      out[s * seq + t] = pos < total ? token_at(ds, pos) : 0;
+    }
+  }
+}
+
+// ---- background prefetch: seq-window samples in linear-congruential
+// shuffled order, double-buffered ----
+
+static void fill_slot(Dataset* ds, int slot) {
+  const int64_t b = ds->batch, seq = ds->seq;
+  std::vector<int64_t> idx(b);
+  for (int64_t i = 0; i < b; ++i) {
+    // Weyl-sequence shuffle over n_samples: full-period, stateless
+    uint64_t j = (ds->cursor + i) % ds->n_samples;
+    idx[i] = (j * 2654435761ULL + 12345) % ds->n_samples;
+  }
+  ds->cursor += b;
+  ds->ring[slot].resize(b * seq);
+  ds_dataio_batch(ds, idx.data(), b, seq, ds->ring[slot].data());
+}
+
+static void producer_loop(Dataset* ds) {
+  while (!ds->stop.load()) {
+    std::unique_lock<std::mutex> lk(ds->mu);
+    ds->cv_empty.wait(lk, [ds] {
+      return ds->stop.load() || !ds->ready[ds->next_fill];
+    });
+    if (ds->stop.load()) return;
+    int slot = ds->next_fill;
+    lk.unlock();
+    fill_slot(ds, slot);
+    lk.lock();
+    ds->ready[slot] = 1;
+    ds->next_fill ^= 1;
+    ds->cv_full.notify_one();
+  }
+}
+
+int ds_dataio_start_prefetch(void* h, int64_t batch, int64_t seq) {
+  auto* ds = static_cast<Dataset*>(h);
+  if (ds->producer.joinable()) return -1;
+  ds->batch = static_cast<int>(batch);
+  ds->seq = static_cast<int>(seq);
+  ds->n_samples = ds->offsets.back() / seq;
+  if (ds->n_samples == 0) return -2;
+  ds->stop.store(false);
+  ds->producer = std::thread(producer_loop, ds);
+  return 0;
+}
+
+// Blocks until the next prefetched batch is ready, copies it into out
+// ((batch, seq) int32) and wakes the producer for the slot.
+int ds_dataio_next(void* h, int32_t* out) {
+  auto* ds = static_cast<Dataset*>(h);
+  std::unique_lock<std::mutex> lk(ds->mu);
+  ds->cv_full.wait(lk, [ds] { return ds->ready[ds->next_read] != 0; });
+  int slot = ds->next_read;
+  memcpy(out, ds->ring[slot].data(), ds->ring[slot].size() * sizeof(int32_t));
+  ds->ready[slot] = 0;
+  ds->next_read ^= 1;
+  ds->cv_empty.notify_one();
+  return 0;
+}
+
+void ds_dataio_close(void* h) {
+  auto* ds = static_cast<Dataset*>(h);
+  if (ds->producer.joinable()) {
+    ds->stop.store(true);
+    ds->cv_empty.notify_all();
+    ds->cv_full.notify_all();
+    ds->producer.join();
+  }
+  if (ds->bin && ds->bin != MAP_FAILED) {
+    munmap(const_cast<uint8_t*>(ds->bin), ds->bin_bytes);
+  }
+  if (ds->bin_fd >= 0) close(ds->bin_fd);
+  delete ds;
+}
+
+}  // extern "C"

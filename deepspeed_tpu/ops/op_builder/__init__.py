@@ -6,9 +6,11 @@ print one compatibility matrix for everything.
 """
 from .builder import OpBuilder, cache_dir
 from .cpu_adam import CPUAdamBuilder
+from .dataio import DataIOBuilder
 
 ALL_OPS = {
     CPUAdamBuilder.NAME: CPUAdamBuilder,
+    DataIOBuilder.NAME: DataIOBuilder,
 }
 
 

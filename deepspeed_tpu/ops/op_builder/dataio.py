@@ -1,0 +1,37 @@
+"""Builder for the native data-IO op (mmap indexed dataset + prefetch)."""
+import ctypes
+import os
+
+from .builder import OpBuilder, CSRC_DIR
+
+
+class DataIOBuilder(OpBuilder):
+    NAME = "ds_dataio"
+
+    def sources(self):
+        return [os.path.join(CSRC_DIR, "ds_dataio.cpp")]
+
+    def load(self):
+        lib = super().load()
+        lib.ds_dataio_open.restype = ctypes.c_void_p
+        lib.ds_dataio_open.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        for fn, res, args in [
+            ("ds_dataio_num_docs", ctypes.c_int64, [ctypes.c_void_p]),
+            ("ds_dataio_num_tokens", ctypes.c_int64, [ctypes.c_void_p]),
+            ("ds_dataio_doc_len", ctypes.c_int64,
+             [ctypes.c_void_p, ctypes.c_int64]),
+            ("ds_dataio_get_doc", ctypes.c_int64,
+             [ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+              ctypes.c_int64]),
+            ("ds_dataio_batch", None,
+             [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+              ctypes.c_int64, ctypes.c_void_p]),
+            ("ds_dataio_start_prefetch", ctypes.c_int,
+             [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]),
+            ("ds_dataio_next", ctypes.c_int,
+             [ctypes.c_void_p, ctypes.c_void_p]),
+            ("ds_dataio_close", None, [ctypes.c_void_p]),
+        ]:
+            getattr(lib, fn).restype = res
+            getattr(lib, fn).argtypes = args
+        return lib

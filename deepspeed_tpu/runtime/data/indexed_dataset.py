@@ -1,0 +1,231 @@
+"""Indexed token datasets with a native (C++) reader + prefetch loader.
+
+TPU-native counterpart of the reference era's mmap'd .bin/.idx token
+datasets (the Megatron-GPT2 workloads the reference drives; DeepSpeed's
+own loader is deepspeed/runtime/dataloader.py). The input pipeline is a
+host-side concern on TPU — the chip computes while a producer thread
+gathers the next batch from the mmap'd file through csrc/ds_dataio.cpp
+(OpenMP gather, double-buffered ring). A pure-numpy fallback keeps every
+feature working when the native op can't build.
+
+Format:
+  <prefix>.bin  raw little-endian tokens (int32 or uint16)
+  <prefix>.idx  "DSTPUIDX" magic, u32 version, u32 dtype code (4=int32,
+                2=uint16), u64 n_docs, (n_docs+1) u64 token offsets
+"""
+import os
+import struct
+import threading
+
+import numpy as np
+
+from ...utils.logging import logger
+
+_MAGIC = b"DSTPUIDX"
+_VERSION = 1
+_DTYPE_CODES = {np.dtype(np.int32): 4, np.dtype(np.uint16): 2}
+_CODE_DTYPES = {4: np.int32, 2: np.uint16}
+
+
+class IndexedDatasetBuilder:
+    """Stream documents (1-D token arrays) into a .bin/.idx pair."""
+
+    def __init__(self, prefix, dtype=np.int32):
+        self.prefix = prefix
+        self.dtype = np.dtype(dtype)
+        assert self.dtype in _DTYPE_CODES, self.dtype
+        self._bin = open(prefix + ".bin", "wb")
+        self._offsets = [0]
+
+    def add_doc(self, tokens):
+        arr = np.ascontiguousarray(tokens, dtype=self.dtype)
+        assert arr.ndim == 1
+        self._bin.write(arr.tobytes())
+        self._offsets.append(self._offsets[-1] + arr.size)
+
+    def finalize(self):
+        self._bin.close()
+        with open(self.prefix + ".idx", "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<II", _VERSION, _DTYPE_CODES[self.dtype]))
+            f.write(struct.pack("<Q", len(self._offsets) - 1))
+            f.write(np.asarray(self._offsets, dtype=np.uint64).tobytes())
+        return self.prefix
+
+
+def _load_native():
+    try:
+        from ...ops.op_builder.dataio import DataIOBuilder
+        return DataIOBuilder().load()
+    except Exception as err:  # noqa: BLE001
+        logger.warning("native data-IO unavailable (%s); numpy fallback",
+                       err)
+        return None
+
+
+class IndexedDataset:
+    """Read side. Documents by index, or fixed seq-length windows over the
+    concatenated token stream (GPT-2 pretraining convention)."""
+
+    def __init__(self, prefix, use_native=True):
+        self.prefix = prefix
+        self._lib = _load_native() if use_native else None
+        self._handle = None
+        idx_path = (prefix + ".idx").encode()
+        bin_path = (prefix + ".bin").encode()
+        if self._lib is not None:
+            self._handle = self._lib.ds_dataio_open(idx_path, bin_path)
+            if not self._handle:
+                logger.warning("native open failed for %s; numpy fallback",
+                               prefix)
+                self._lib = None
+        if self._lib is None:
+            self._np_open()
+        else:
+            self.num_docs = int(self._lib.ds_dataio_num_docs(self._handle))
+            self.num_tokens = int(
+                self._lib.ds_dataio_num_tokens(self._handle))
+
+    def _np_open(self):
+        with open(self.prefix + ".idx", "rb") as f:
+            assert f.read(8) == _MAGIC, "bad idx magic"
+            version, code = struct.unpack("<II", f.read(8))
+            assert version == _VERSION, \
+                "idx version {} != supported {}".format(version, _VERSION)
+            (n_docs,) = struct.unpack("<Q", f.read(8))
+            self._offsets = np.frombuffer(f.read(8 * (n_docs + 1)),
+                                          dtype=np.uint64)
+        self._tokens = np.memmap(self.prefix + ".bin", mode="r",
+                                 dtype=_CODE_DTYPES[code])
+        self.num_docs = int(n_docs)
+        self.num_tokens = int(self._offsets[-1])
+
+    # -- documents ---------------------------------------------------------
+    def doc(self, i):
+        if self._lib is not None:
+            n = int(self._lib.ds_dataio_doc_len(self._handle, i))
+            out = np.empty(n, dtype=np.int32)
+            got = self._lib.ds_dataio_get_doc(
+                self._handle, i, out.ctypes.data, n)
+            return out[:got]
+        s, e = int(self._offsets[i]), int(self._offsets[i + 1])
+        return np.asarray(self._tokens[s:e], dtype=np.int32)
+
+    def __len__(self):
+        return self.num_docs
+
+    def __getitem__(self, i):
+        return self.doc(i)
+
+    # -- fixed-window samples ---------------------------------------------
+    def num_samples(self, seq_len):
+        return self.num_tokens // seq_len
+
+    def batch(self, sample_idx, seq_len):
+        """Gather (len(sample_idx), seq_len) int32 windows."""
+        idx = np.ascontiguousarray(sample_idx, dtype=np.int64)
+        out = np.empty((idx.size, seq_len), dtype=np.int32)
+        if self._lib is not None:
+            self._lib.ds_dataio_batch(self._handle, idx.ctypes.data,
+                                      idx.size, seq_len, out.ctypes.data)
+            return out
+        for r, s in enumerate(idx):
+            start = int(s) * seq_len
+            chunk = np.asarray(self._tokens[start:start + seq_len],
+                               dtype=np.int32)
+            out[r, :chunk.size] = chunk
+            out[r, chunk.size:] = 0
+        return out
+
+    def close(self):
+        if self._lib is not None and self._handle:
+            self._lib.ds_dataio_close(self._handle)
+            self._handle = None
+            self._lib = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class NativePrefetchLoader:
+    """Infinite (batch, seq) int32 batches, produced ahead of consumption.
+
+    Native path: the C++ producer thread fills a double-buffered ring
+    (csrc/ds_dataio.cpp) while the previous batch feeds the device —
+    the role DataLoader worker processes play in the reference
+    (runtime/dataloader.py), without pickling/IPC. Numpy fallback uses a
+    Python thread with the same Weyl-sequence shuffled order."""
+
+    def __init__(self, dataset, batch_size, seq_len):
+        self.ds = dataset
+        self.batch_size = int(batch_size)
+        self.seq_len = int(seq_len)
+        self.n_samples = dataset.num_samples(seq_len)
+        assert self.n_samples > 0, "dataset smaller than one sample"
+        self._native = dataset._lib is not None
+        self._closed = False
+        if self._native:
+            rc = dataset._lib.ds_dataio_start_prefetch(
+                dataset._handle, self.batch_size, self.seq_len)
+            assert rc == 0, "prefetch start failed: {}".format(rc)
+        else:
+            self._cursor = 0
+            self._buf = None
+            self._cond = threading.Condition()
+            self._thread = threading.Thread(target=self._produce,
+                                            daemon=True)
+            self._thread.start()
+
+    def _indices(self, cursor):
+        # uint64 throughout: the C++ producer uses uint64, and int64 would
+        # silently overflow (and diverge from it) past ~3.5e9 samples
+        j = (np.uint64(cursor)
+             + np.arange(self.batch_size, dtype=np.uint64)) \
+            % np.uint64(self.n_samples)
+        return ((j * np.uint64(2654435761) + np.uint64(12345))
+                % np.uint64(self.n_samples)).astype(np.int64)
+
+    def _produce(self):
+        while not self._closed:
+            batch = self.ds.batch(self._indices(self._cursor), self.seq_len)
+            self._cursor += self.batch_size
+            with self._cond:
+                while self._buf is not None and not self._closed:
+                    self._cond.wait()
+                if self._closed:
+                    return
+                self._buf = batch
+                self._cond.notify_all()
+
+    def close(self):
+        """Stop producing. The native producer thread is owned by the
+        dataset and stops in IndexedDataset.close(); the fallback thread
+        stops here. next() after close raises."""
+        if self._closed:
+            return
+        self._closed = True
+        if not self._native:
+            with self._cond:
+                self._cond.notify_all()
+            self._thread.join(timeout=5)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._closed or (self._native and self.ds._lib is None):
+            raise RuntimeError("NativePrefetchLoader is closed (or its "
+                               "dataset was closed underneath it)")
+        if self._native:
+            out = np.empty((self.batch_size, self.seq_len), dtype=np.int32)
+            self.ds._lib.ds_dataio_next(self.ds._handle, out.ctypes.data)
+            return out
+        with self._cond:
+            while self._buf is None:
+                self._cond.wait()
+            out, self._buf = self._buf, None
+            self._cond.notify_all()
+        return out

@@ -4,6 +4,11 @@ DeepSpeedExamples/Megatron-LM — BASELINE configs 2/4/5 shape).
 Run (synthetic data):
   python examples/gpt2/pretrain.py --size gpt2_small \
       --deepspeed_config examples/gpt2/ds_config_zero2.json --steps 50
+
+Run (real tokens via the native mmap dataset + prefetch loader):
+  python examples/gpt2/pretrain.py --data_prefix /path/to/corpus ...
+where corpus.bin/.idx were written by
+deepspeed_tpu.runtime.data.IndexedDatasetBuilder.
 """
 import argparse
 
@@ -28,6 +33,9 @@ def main():
                         choices=sorted(gpt2.SIZES))
     parser.add_argument("--seq_len", type=int, default=1024)
     parser.add_argument("--steps", type=int, default=50)
+    parser.add_argument("--data_prefix", default=None,
+                        help=".bin/.idx token dataset prefix (default: "
+                             "synthetic random tokens)")
     parser = deepspeed.add_config_arguments(parser)
     args = parser.parse_args()
 
@@ -35,12 +43,28 @@ def main():
     engine, _, _, _ = deepspeed.initialize(
         args=args, model=model, config_params=args.deepspeed_config)
 
-    rs = np.random.RandomState(0)
     mb = engine.train_micro_batch_size_per_gpu() * engine.dp_world_size
     gas = engine.gradient_accumulation_steps()
+
+    if args.data_prefix:
+        from deepspeed_tpu.runtime.data import (IndexedDataset,
+                                                NativePrefetchLoader)
+        loader = NativePrefetchLoader(IndexedDataset(args.data_prefix),
+                                      batch_size=gas * mb,
+                                      seq_len=args.seq_len)
+
+        def next_batch(_):
+            ids = next(loader).reshape(gas, mb, args.seq_len)
+            return ids
+    else:
+        rs = np.random.RandomState(0)
+
+        def next_batch(_):
+            return rs.randint(0, model.config.vocab_size,
+                              size=(gas, mb, args.seq_len)).astype(np.int32)
+
     for step in range(args.steps):
-        ids = rs.randint(0, model.config.vocab_size,
-                         size=(gas, mb, args.seq_len)).astype(np.int32)
+        ids = next_batch(step)
         loss = engine.train_batch(batch=(ids, ids.copy()))
         if step % 10 == 0:
             print("step {} loss {:.4f}".format(step, float(loss)))

@@ -39,13 +39,16 @@ def run_one(micro_batch, remat_policy, loss_chunk, seq=1024, steps=10,
     ids = rng.randint(0, cfg.vocab_size,
                       size=(1, micro_batch, seq)).astype(np.int32)
     batch = (ids, ids.copy())
+    # float(loss) is the fence: execution through the axon tunnel is lazy
+    # (block_until_ready is a no-op); steps chain through donated state so
+    # fetching the last loss fences the whole loop
     for _ in range(warmup):
-        engine.train_batch(batch=batch)
-    jax.block_until_ready(engine.state["params"]["wte"])
+        loss = engine.train_batch(batch=batch)
+    float(loss)
     t0 = time.time()
     for _ in range(steps):
-        engine.train_batch(batch=batch)
-    jax.block_until_ready(engine.state["params"]["wte"])
+        loss = engine.train_batch(batch=batch)
+    float(loss)
     dt = (time.time() - t0) / steps
     toks = micro_batch * seq / dt
     sys.path.insert(0, ".")

@@ -192,14 +192,19 @@ def pretrain_loss(params, input_ids, token_type_ids, attention_mask,
     return mlm_loss - nsp_ll.mean()
 
 
+def squad_logits(params, hidden):
+    """(batch, seq, 2) fp32 start/end span logits from encoder hiddens."""
+    logits = (hidden @ params["squad"]["kernel"].astype(hidden.dtype)
+              + params["squad"]["bias"].astype(hidden.dtype))
+    return logits.astype(jnp.float32)
+
+
 def squad_loss(params, input_ids, token_type_ids, attention_mask,
                start_positions, end_positions, config, rng=None, train=True):
     """SQuAD span-extraction loss (BingBertSquad e2e workload)."""
     hidden = encode(params, input_ids, token_type_ids, attention_mask,
                     config, rng, train)
-    logits = (hidden @ params["squad"]["kernel"].astype(hidden.dtype)
-              + params["squad"]["bias"].astype(hidden.dtype))
-    logits = logits.astype(jnp.float32)
+    logits = squad_logits(params, hidden)
     start_logits, end_logits = logits[..., 0], logits[..., 1]
 
     def ce(lg, pos):

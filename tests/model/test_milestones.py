@@ -253,16 +253,20 @@ def test_milestone6_bert_squad_finetune():
     assert acc >= 0.9, (pred, np.asarray(start))
 
 
-# --- milestone 7: sequence parallelism trains (ring attention leg) ---------
-def test_milestone7_sequence_parallel_vs_dp():
-    """GPT-2 with ring-attention sequence parallelism over a
-    (data=2, sequence=4) mesh: loss curve must track the pure-DP run
-    closely (same model/data; only the attention sharding differs)."""
+# --- milestone 7: sequence parallelism trains (ring + ulysses legs) --------
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_milestone7_sequence_parallel_vs_dp(impl):
+    """GPT-2 with sequence parallelism over a (data=2, sequence=4) mesh:
+    loss curve must track the pure-DP run closely (same model/data; only
+    the attention sharding differs)."""
     import dataclasses
     from deepspeed_tpu.parallel.topology import build_mesh
     from deepspeed_tpu.runtime.engine import DeepSpeedEngine
 
-    base_cfg = _gpt2_cfg(max_seq_len=64)
+    # ulysses all-to-all shards heads over the sequence axis -> heads must
+    # divide by sp degree (4); ring has no such constraint
+    base_cfg = _gpt2_cfg(max_seq_len=64,
+                         n_heads=4 if impl == "ulysses" else 2)
     config = {
         "train_micro_batch_size_per_gpu": 2,
         "gradient_accumulation_steps": 1,
@@ -273,7 +277,7 @@ def test_milestone7_sequence_parallel_vs_dp():
     }
 
     sp_mesh = build_mesh(data=2, sequence=4)
-    sp_cfg = dataclasses.replace(base_cfg, sequence_parallel="ring",
+    sp_cfg = dataclasses.replace(base_cfg, sequence_parallel=impl,
                                  sp_mesh=sp_mesh)
     sp_engine = DeepSpeedEngine(
         model=gpt2.make_gpt2_model(config=sp_cfg, seed=0), mesh=sp_mesh,

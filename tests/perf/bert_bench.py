@@ -1,0 +1,77 @@
+"""BERT-large pretraining throughput on one chip — the reference's
+headline benchmark (docs/_posts/2020-05-28-fastest-bert-training.md:
+64 TFLOPS/GPU and 272 samples/s at seq 128, 53 TFLOPS and 52 samples/s at
+seq 512, on one V100-32G). Prints the same two shapes measured here.
+
+    python tests/perf/bert_bench.py
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def run(seq, micro_batch, steps=10, warmup=2):
+    import jax
+    import deepspeed_tpu as deepspeed
+    from deepspeed_tpu.models import bert
+
+    cfg = bert.config_for("bert_large", max_seq_len=seq, dropout=0.0,
+                          attn_dropout=0.0)
+    model = bert.make_bert_model(config=cfg)
+    n_params = bert.num_params(cfg)
+    engine, _, _, _ = deepspeed.initialize(model=model, config_params={
+        "train_micro_batch_size_per_gpu": micro_batch,
+        "gradient_accumulation_steps": 1,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+        "optimizer": {"type": "Lamb", "params": {"lr": 2e-3}},
+        "steps_per_print": 10 ** 9,
+    })
+    rs = np.random.RandomState(0)
+    b = micro_batch
+    batch = tuple(x[None] for x in (
+        rs.randint(0, cfg.vocab_size, size=(b, seq)).astype(np.int32),
+        np.zeros((b, seq), np.int32),
+        np.ones((b, seq), np.int32),
+        rs.randint(0, cfg.vocab_size, size=(b, seq)).astype(np.int32),
+        rs.randint(0, 2, size=(b,)).astype(np.int32),
+    ))
+    for _ in range(warmup):
+        loss = engine.train_batch(batch=batch)
+    float(loss)
+    t0 = time.time()
+    for _ in range(steps):
+        loss = engine.train_batch(batch=batch)
+    float(loss)
+    dt = (time.time() - t0) / steps
+    samples_per_s = b / dt
+    # 6N per token + attention scores/ctx (non-causal: full s^2)
+    flops_per_token = (6.0 * n_params
+                       + 12.0 * cfg.n_layers * cfg.d_model * seq)
+    tflops = samples_per_s * seq * flops_per_token / 1e12
+    return dict(seq=seq, micro_batch=b, step_ms=round(dt * 1e3, 1),
+                samples_per_s=round(samples_per_s, 1),
+                tflops_per_chip=round(tflops, 1),
+                ref_v100=dict(seq128="64 TFLOPS / 272 samples/s",
+                              seq512="53 TFLOPS / 52 samples/s")[
+                    "seq{}".format(seq)] if seq in (128, 512) else None)
+
+
+def main():
+    for seq, mb_ladder in [(128, [256, 128, 64]), (512, [64, 32, 16])]:
+        for mb in mb_ladder:
+            try:
+                print(json.dumps(run(seq, mb)), flush=True)
+                break
+            except Exception as e:  # noqa: BLE001
+                print("seq={} mb={} failed: {}".format(seq, mb, str(e)[:80]),
+                      file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

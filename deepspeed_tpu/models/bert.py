@@ -136,7 +136,10 @@ def encode(params, input_ids, token_type_ids=None, attention_mask=None,
     x = x + emb["token_type"][token_type_ids]
     x = fused_layer_norm(x, emb["ln_scale"], emb["ln_bias"],
                          config.layer_norm_eps)
-    x = x.astype(config.dtype)
+    # compute dtype follows the (engine-cast) params, NOT config.dtype —
+    # config.dtype is the init dtype (fp32); casting activations to it
+    # would silently run the whole encoder in fp32 under a bf16 engine
+    x = x.astype(emb["word"].dtype)
 
     layer_cfg = _layer_config(config)
     n = config.n_layers

@@ -49,10 +49,12 @@ def _num_visible(qi, block_q, block_k, num_k_blocks, causal):
 
 
 def _fwd_compute(q, load_kv, out_dtype, *, qi, sm_scale, block_q, block_k,
-                 num_k_blocks, causal, seq_len):
+                 num_k_blocks, causal, seq_len, load_bias=None):
     """Online-softmax forward over one q block. ``load_kv(ki)`` returns the
     ki-th (Bk, d) K/V slices — the only layout-dependent part, so the 3D
-    (bh, s, d) and 4D (b, s, h, d) kernels share this body."""
+    (bh, s, d) and 4D (b, s, h, d) kernels share this body.
+    ``load_bias(ki)`` (optional) returns a (1, Bk) additive score bias —
+    the key-padding mask path."""
     d = q.shape[-1]
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
@@ -63,6 +65,8 @@ def _fwd_compute(q, load_kv, out_dtype, *, qi, sm_scale, block_q, block_k,
         s_blk = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # (Bq, Bk)
+        if load_bias is not None:
+            s_blk = s_blk + load_bias(ki)
         k_pos = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, s_blk.shape, 1)
         mask = k_pos < seq_len          # zero-padded k tail
@@ -103,9 +107,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, block_q,
     lse_ref[0] = lse                                     # (Bq, 1)
 
 
-def _fwd_kernel_packed_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                                sm_scale, block_q, block_k, num_k_blocks,
-                                causal, seq_len, num_heads, d_head):
+def _fwd_kernel_packed_resident(q_ref, k_ref, v_ref, bias_ref, o_ref,
+                                lse_ref, *, sm_scale, block_q, block_k,
+                                num_k_blocks, causal, seq_len, num_heads,
+                                d_head):
     """(b, s, h*d)-packed forward, whole K/V resident in VMEM: the fast
     path for ordinary sequence lengths. The k loop's online-softmax state
     lives in registers (no scratch round-trips), which measures ~3x faster
@@ -113,6 +118,7 @@ def _fwd_kernel_packed_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     s*h*d <= ~1M elements (seq 1024 at width 1024)."""
     qi = pl.program_id(1)
     q_all = q_ref[0]                                      # (Bq, h*d)
+    load_bias = lambda ki: bias_ref[0, :, pl.ds(ki * block_k, block_k)]
     outs, lses = [], []
     for hi in range(num_heads):
         sl = slice(hi * d_head, (hi + 1) * d_head)
@@ -122,21 +128,23 @@ def _fwd_kernel_packed_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         out, lse = _fwd_compute(q_all[:, sl], load_kv, o_ref.dtype, qi=qi,
                                 sm_scale=sm_scale, block_q=block_q,
                                 block_k=block_k, num_k_blocks=num_k_blocks,
-                                causal=causal, seq_len=seq_len)
+                                causal=causal, seq_len=seq_len,
+                                load_bias=load_bias)
         outs.append(out)
         lses.append(lse)
     o_ref[0] = jnp.concatenate(outs, axis=1)
     lse_ref[0] = jnp.concatenate(lses, axis=1)            # (Bq, h)
 
 
-# whole-K/V fwd stays fast up to this many packed elements (s * h * d);
-# beyond it the streaming kernel keeps long sequences compiling.
+# whole-K/V fwd stays fast up to this many packed elements (s * h * d),
+# calibrated for bf16 operands (2 MB per K/V buffer); wider dtypes halve
+# it. Beyond, the streaming kernel keeps long sequences compiling.
 RESIDENT_FWD_MAX_ELEMS = 1024 * 1024
 
 
-def _fwd_kernel_packed(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_s, m_s, l_s,
-                       *, sm_scale, block_q, block_k, num_k_blocks, causal,
-                       seq_len, num_heads, d_head):
+def _fwd_kernel_packed(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+                       acc_s, m_s, l_s, *, sm_scale, block_q, block_k,
+                       num_k_blocks, causal, seq_len, num_heads, d_head):
     """(b, s, h*d)-packed forward: operands stay in the model's natural
     activation layout (the qkv matmul's output), so no host-side head
     transpose ever happens — the (b,s,h,d)->(bh,s,d) relayout at d_head 64
@@ -178,6 +186,7 @@ def _fwd_kernel_packed(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_s, m_s, l_s,
             s_blk = jax.lax.dot_general(
                 q, k_blk, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * sm_scale
+            s_blk = s_blk + bias_ref[0]                   # (1, Bk) bias
             s_blk = jnp.where(mask, s_blk, NEG_INF)
             m_old = m_s[:, hi:hi + 1]                     # (Bq, 1)
             m_new = jnp.maximum(m_old,
@@ -293,13 +302,15 @@ def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd_head_terms(q, k_blk, v_blk, do, lse, delta, mask, sm_scale):
+def _bwd_head_terms(q, k_blk, v_blk, do, lse, delta, mask, sm_scale, bias):
     """Per-head backward intermediates shared by the packed dq and dk/dv
     kernels (one definition so a numerics change cannot diverge them):
-    p = masked softmax probabilities, ds = dL/dscores (input dtype)."""
+    p = masked softmax probabilities, ds = dL/dscores (input dtype).
+    ``bias`` is the (1, Bk) additive score bias (key-padding mask)."""
     s_blk = jax.lax.dot_general(
         q, k_blk, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * sm_scale      # (Bq, Bk)
+    s_blk = s_blk + bias
     p = jnp.where(mask, jnp.exp(s_blk - lse), 0.0)
     dp = jax.lax.dot_general(
         do, v_blk, (((1,), (1,)), ((), ())),
@@ -309,8 +320,9 @@ def _bwd_head_terms(q, k_blk, v_blk, do, lse, delta, mask, sm_scale):
 
 
 def _bwd_dq_kernel_packed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dq_ref, dq_acc, *, sm_scale, block_q, block_k,
-                          num_k_blocks, causal, seq_len, num_heads, d_head):
+                          bias_ref, dq_ref, dq_acc, *, sm_scale, block_q,
+                          block_k, num_k_blocks, causal, seq_len, num_heads,
+                          d_head):
     """Packed-layout dq: grid (b, q blocks, k blocks), accumulating into a
     (Bq, h*d) fp32 scratch across the (sequential, innermost) k dimension.
     The flash backward is split MaxText-style into a dq kernel and a dk/dv
@@ -343,7 +355,7 @@ def _bwd_dq_kernel_packed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             _, ds = _bwd_head_terms(
                 q_ref[0][:, sl], k_blk, v_ref[0][:, sl], do_ref[0][:, sl],
                 lse_ref[0][:, hi:hi + 1], delta_ref[0][:, hi:hi + 1],
-                mask, sm_scale)
+                mask, sm_scale, bias_ref[0])
             dq_acc[:, sl] = dq_acc[:, sl] + jax.lax.dot_general(
                 ds, k_blk, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
@@ -354,9 +366,9 @@ def _bwd_dq_kernel_packed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dkv_kernel_packed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                           dk_ref, dv_ref, dk_acc, dv_acc, *, sm_scale,
-                           block_q, block_k, num_q_blocks, causal, seq_len,
-                           num_heads, d_head):
+                           bias_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                           sm_scale, block_q, block_k, num_q_blocks, causal,
+                           seq_len, num_heads, d_head):
     """Packed-layout dk/dv: grid (b, k blocks, q blocks) — each cell sees
     one (Bq, h*d) q/do slab and one (Bk, h*d) K/V slab, accumulating into
     (Bk, h*d) fp32 scratch across the (sequential, innermost) q dimension.
@@ -392,7 +404,7 @@ def _bwd_dkv_kernel_packed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             p, ds = _bwd_head_terms(
                 q, k_ref[0][:, sl], v_ref[0][:, sl], do,
                 lse_ref[0][:, hi:hi + 1], delta_ref[0][:, hi:hi + 1],
-                mask, sm_scale)
+                mask, sm_scale, bias_ref[0])
             dv_acc[:, sl] = dv_acc[:, sl] + jax.lax.dot_general(
                 p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
@@ -461,11 +473,26 @@ def _bwd(q, k, v, o, do, lse, sm_scale, causal, block_q, block_k, interpret):
     return dq, dk[:, :s], dv[:, :s]
 
 
-def _fwd_packed(q, k, v, sm_scale, causal, block_q, block_k, interpret,
-                num_heads):
+def _pad_bias(bias, b, s, block_k):
+    """(b, s) / (b, 1, s) additive bias -> (b, 1, s_p) fp32. The k-tail
+    padding value (0) is harmless: padded keys are masked by seq_len
+    in-kernel. (The zero-bias default lives in flash_attention_bshd.)"""
+    pad = (-s) % block_k
+    if bias.ndim == 2:
+        bias = bias[:, None, :]
+    bias = bias.astype(jnp.float32)
+    if pad:
+        bias = jnp.pad(bias, ((0, 0), (0, 0), (0, pad)))
+    return bias
+
+
+def _fwd_packed(q, k, v, bias, sm_scale, causal, block_q, block_k,
+                interpret, num_heads):
     """q/k/v: (b, s, h*d) packed; returns (out (b, s, h*d), lse (b, s, h)).
     Every operand is blocked (grid b x q x k); sequence length is bounded
-    by HBM only."""
+    by HBM only. ``bias``: (b, 1, s_p) fp32 additive scores (key-padding
+    mask), always present (zeros when unused — the uniform operand keeps
+    one kernel per path)."""
     b, s, hd = q.shape
     d = hd // num_heads
     block_q = min(block_q, s)
@@ -474,12 +501,13 @@ def _fwd_packed(q, k, v, sm_scale, causal, block_q, block_k, interpret,
     s_p = k.shape[1]
     num_k_blocks = s_p // block_k
 
-    if s_p * hd <= RESIDENT_FWD_MAX_ELEMS:
+    if s_p * hd * q.dtype.itemsize <= RESIDENT_FWD_MAX_ELEMS * 2:
         # fast path: K/V whole per (batch, q-block) cell, softmax state in
         # registers across an in-kernel fori over k blocks
         grid = (b, pl.cdiv(s, block_q))
         q_spec = pl.BlockSpec((1, block_q, hd), lambda bi, qi: (bi, qi, 0))
         kv_spec = pl.BlockSpec((1, s_p, hd), lambda bi, qi: (bi, 0, 0))
+        bias_spec = pl.BlockSpec((1, 1, s_p), lambda bi, qi: (bi, 0, 0))
         return pl.pallas_call(
             functools.partial(_fwd_kernel_packed_resident,
                               sm_scale=sm_scale, block_q=block_q,
@@ -487,7 +515,7 @@ def _fwd_packed(q, k, v, sm_scale, causal, block_q, block_k, interpret,
                               causal=causal, seq_len=s,
                               num_heads=num_heads, d_head=d),
             grid=grid,
-            in_specs=[q_spec, kv_spec, kv_spec],
+            in_specs=[q_spec, kv_spec, kv_spec, bias_spec],
             out_specs=(q_spec,
                        pl.BlockSpec((1, block_q, num_heads),
                                     lambda bi, qi: (bi, qi, 0))),
@@ -495,18 +523,19 @@ def _fwd_packed(q, k, v, sm_scale, causal, block_q, block_k, interpret,
                        jax.ShapeDtypeStruct((b, s, num_heads),
                                             jnp.float32)),
             interpret=interpret,
-        )(q, k, v)
+        )(q, k, v, bias)
 
     grid = (b, pl.cdiv(s, block_q), num_k_blocks)
     q_spec = pl.BlockSpec((1, block_q, hd), lambda bi, qi, ki: (bi, qi, 0))
     kv_spec = pl.BlockSpec((1, block_k, hd), lambda bi, qi, ki: (bi, ki, 0))
+    bias_spec = pl.BlockSpec((1, 1, block_k), lambda bi, qi, ki: (bi, 0, ki))
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel_packed, sm_scale=sm_scale,
                           block_q=block_q, block_k=block_k,
                           num_k_blocks=num_k_blocks, causal=causal,
                           seq_len=s, num_heads=num_heads, d_head=d),
         grid=grid,
-        in_specs=[q_spec, kv_spec, kv_spec],
+        in_specs=[q_spec, kv_spec, kv_spec, bias_spec],
         out_specs=(q_spec,
                    pl.BlockSpec((1, block_q, num_heads),
                                 lambda bi, qi, ki: (bi, qi, 0))),
@@ -516,14 +545,14 @@ def _fwd_packed(q, k, v, sm_scale, causal, block_q, block_k, interpret,
                         pltpu.VMEM((block_q, num_heads), jnp.float32),
                         pltpu.VMEM((block_q, num_heads), jnp.float32)],
         interpret=interpret,
-    )(q, k, v)
+    )(q, k, v, bias)
     return out, lse
 
 
-def _bwd_packed(q, k, v, o, do, lse, sm_scale, causal, block_q, block_k,
-                interpret, num_heads):
+def _bwd_packed(q, k, v, bias, o, do, lse, sm_scale, causal, block_q,
+                block_k, interpret, num_heads):
     """Two pallas calls (dq; then dk/dv over k-blocks) — see the kernels
-    for why the backward is split."""
+    for why the backward is split. ``bias`` as in _fwd_packed."""
     b, s, hd = q.shape
     d = hd // num_heads
     block_q = min(block_q, s)
@@ -553,6 +582,8 @@ def _bwd_packed(q, k, v, o, do, lse, sm_scale, causal, block_q, block_k,
     dq_kv_spec = pl.BlockSpec((1, block_k, hd), lambda bi, qi, ki: (bi, ki, 0))
     dq_lse_spec = pl.BlockSpec((1, block_q, num_heads),
                                lambda bi, qi, ki: (bi, qi, 0))
+    dq_bias_spec = pl.BlockSpec((1, 1, block_k),
+                                lambda bi, qi, ki: (bi, 0, ki))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel_packed, sm_scale=sm_scale,
                           block_q=block_q, block_k=block_k,
@@ -560,32 +591,33 @@ def _bwd_packed(q, k, v, o, do, lse, sm_scale, causal, block_q, block_k,
                           seq_len=s, num_heads=num_heads, d_head=d),
         grid=(b, nqb, num_k_blocks),
         in_specs=[dq_q_spec, dq_kv_spec, dq_kv_spec, dq_q_spec,
-                  dq_lse_spec, dq_lse_spec],
+                  dq_lse_spec, dq_lse_spec, dq_bias_spec],
         out_specs=dq_q_spec,
         out_shape=jax.ShapeDtypeStruct((b, s_qp, hd), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, hd), jnp.float32)],
         interpret=interpret,
-    )(q_p, k, v, do_p, lse_p, delta_p)
+    )(q_p, k, v, do_p, lse_p, delta_p, bias)
     dq = dq[:, :s]
 
     q_blk = pl.BlockSpec((1, block_q, hd), lambda bi, ki, qi: (bi, qi, 0))
     kv_blk = pl.BlockSpec((1, block_k, hd), lambda bi, ki, qi: (bi, ki, 0))
     lse_blk = pl.BlockSpec((1, block_q, num_heads),
                            lambda bi, ki, qi: (bi, qi, 0))
+    bias_blk = pl.BlockSpec((1, 1, block_k), lambda bi, ki, qi: (bi, 0, ki))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel_packed, sm_scale=sm_scale,
                           block_q=block_q, block_k=block_k,
                           num_q_blocks=nqb, causal=causal, seq_len=s,
                           num_heads=num_heads, d_head=d),
         grid=(b, num_k_blocks, nqb),
-        in_specs=[q_blk, kv_blk, kv_blk, q_blk, lse_blk, lse_blk],
+        in_specs=[q_blk, kv_blk, kv_blk, q_blk, lse_blk, lse_blk, bias_blk],
         out_specs=(kv_blk, kv_blk),
         out_shape=(jax.ShapeDtypeStruct((b, s_kp, hd), q.dtype),
                    jax.ShapeDtypeStruct((b, s_kp, hd), q.dtype)),
         scratch_shapes=[pltpu.VMEM((block_k, hd), jnp.float32),
                         pltpu.VMEM((block_k, hd), jnp.float32)],
         interpret=interpret,
-    )(q_p, k, v, do_p, lse_p, delta_p)
+    )(q_p, k, v, do_p, lse_p, delta_p, bias)
     return dq, dk[:, :s], dv[:, :s]
 
 
@@ -594,49 +626,71 @@ def _bwd_packed(q, k, v, o, do, lse, sm_scale, causal, block_q, block_k,
 DEFAULT_BLOCK_PACKED = 256
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_bshd_core(q, k, v, bias, sm_scale, causal, block_q, interpret,
+                     block_k):
+    out, _ = _flash_fwd_bshd(q, k, v, bias, sm_scale, causal, block_q,
+                             interpret, block_k)
+    return out
+
+
+def _flash_fwd_bshd(q, k, v, bias, sm_scale, causal, block_q, interpret,
+                    block_k):
+    b, s, h, d = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
+    pack = lambda t: t.reshape(b, s, h * d)
+    bias_p = _pad_bias(bias, b, s, min(block_k, s))
+    out, lse = _fwd_packed(pack(q), pack(k), pack(v), bias_p, scale, causal,
+                           block_q, block_k, interpret, h)
+    return out.reshape(b, s, h, d), (q, k, v, bias_p, out, lse)
+
+
+def _flash_fwd_bshd_rule(q, k, v, bias, sm_scale, causal, block_q,
+                         interpret, block_k=DEFAULT_BLOCK_PACKED):
+    return _flash_fwd_bshd(q, k, v, bias, sm_scale, causal, block_q,
+                           interpret, block_k)
+
+
+def _flash_bwd_bshd_rule(sm_scale, causal, block_q, interpret, block_k,
+                         res, do):
+    q, k, v, bias_p, out, lse = res  # q/k/v (b,s,h,d); out packed
+    b, s, h, d = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
+    pack = lambda t: t.reshape(b, s, h * d)
+    dq, dk, dv = _bwd_packed(pack(q), pack(k), pack(v), bias_p, out,
+                             pack(do), lse, scale, causal, block_q, block_k,
+                             interpret, h)
+    unpack = lambda t: t.reshape(b, s, h, d)
+    # bias is a MASK, not a trainable term: zero cotangent by contract
+    # (the wrapper stop_gradients it too)
+    return unpack(dq), unpack(dk), unpack(dv), jnp.zeros_like(bias_p[:, :, :s])
+
+
+_flash_bshd_core.defvjp(_flash_fwd_bshd_rule, _flash_bwd_bshd_rule)
+
+
 def flash_attention_bshd(q, k, v, sm_scale=None, causal=True,
                          block_q=DEFAULT_BLOCK_PACKED, interpret=False,
-                         block_k=DEFAULT_BLOCK_PACKED):
+                         block_k=DEFAULT_BLOCK_PACKED, mask_bias=None):
     """q/k/v: (batch, seq, heads, d_head) -> same layout. Heads are never
     transposed: the arrays are viewed as packed (b, s, h*d) — a free
     minor-dim merge — and the kernel loops heads over lane slices. (The
     (b,s,h,d)->(b*h,s,d) relayout at d_head 64 costs more HBM time than
     the attention math itself: measured 275 ms vs ~25 ms per GPT-2-125M
-    forward at batch 192.)"""
-    out, _ = _flash_fwd_bshd(q, k, v, sm_scale, causal, block_q, interpret,
-                             block_k)
-    return out
+    forward at batch 192.)
 
-
-def _flash_fwd_bshd(q, k, v, sm_scale, causal, block_q, interpret, block_k):
-    b, s, h, d = q.shape
-    scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
-    pack = lambda t: t.reshape(b, s, h * d)
-    out, lse = _fwd_packed(pack(q), pack(k), pack(v), scale, causal,
-                           block_q, block_k, interpret, h)
-    return out.reshape(b, s, h, d), (q, k, v, out, lse)
-
-
-def _flash_fwd_bshd_rule(q, k, v, sm_scale, causal, block_q, interpret,
-                         block_k=DEFAULT_BLOCK_PACKED):
-    return _flash_fwd_bshd(q, k, v, sm_scale, causal, block_q, interpret,
-                           block_k)
-
-
-def _flash_bwd_bshd_rule(sm_scale, causal, block_q, interpret, block_k,
-                         res, do):
-    q, k, v, out, lse = res      # q/k/v (b,s,h,d); out packed (b,s,h*d)
-    b, s, h, d = q.shape
-    scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
-    pack = lambda t: t.reshape(b, s, h * d)
-    dq, dk, dv = _bwd_packed(pack(q), pack(k), pack(v), out, pack(do), lse,
-                             scale, causal, block_q, block_k, interpret, h)
-    unpack = lambda t: t.reshape(b, s, h, d)
-    return unpack(dq), unpack(dk), unpack(dv)
-
-
-flash_attention_bshd.defvjp(_flash_fwd_bshd_rule, _flash_bwd_bshd_rule)
+    ``mask_bias``: optional (b, s) additive score bias per KEY position
+    (0 keep / -1e9 drop — the BERT key-padding mask). Treated as a
+    constant: no gradient flows into it."""
+    b, s, _, _ = q.shape
+    if mask_bias is None:
+        bias = jnp.zeros((b, 1, s), jnp.float32)
+    else:
+        bias = jax.lax.stop_gradient(mask_bias.astype(jnp.float32))
+        if bias.ndim == 2:
+            bias = bias[:, None, :]
+    return _flash_bshd_core(q, k, v, bias, sm_scale, causal, block_q,
+                            interpret, block_k)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))

@@ -148,17 +148,34 @@ def _expand_mask(attention_mask, dtype):
 
 
 def _self_attention(x, params, config, mask, rng, train):
-    """Bidirectional multi-head attention. XLA attention (einsum) rather
-    than the causal Pallas flash kernel: encoder masks are arbitrary
-    per-example patterns, and the softmax(QK^T)V chain at BERT sizes is
-    MXU-bound under XLA already (the fused-kernel win the reference chases
-    on V100 comes from epilogue fusion, which XLA performs)."""
+    """Bidirectional multi-head attention.
+
+    Fast path: the packed Pallas flash kernel with an additive key-padding
+    bias — scores/probs never reach HBM (at seq 512 the materialized
+    (b,h,s,s) fp32 probs dominate the einsum path's time). Falls back to
+    XLA einsum attention for arbitrary (s, s)-shaped masks or when
+    attention-probability dropout is active (the flash kernel has no prob
+    dropout)."""
     b, s, d = x.shape
     h = config.heads
     dh = d // h
     qkv = x @ params["attn_qkvw"] + params["attn_qkvb"]
     q, k, v = jnp.split(qkv, 3, axis=-1)
     split = lambda t: t.reshape(b, s, h, dh)
+
+    dropout_on = train and config.attn_dropout_ratio > 0 and rng is not None
+    key_padding_only = mask is None or (mask.ndim == 4
+                                        and mask.shape[1] == 1
+                                        and mask.shape[2] == 1)
+    if (jax.default_backend() == "tpu" and key_padding_only
+            and not dropout_on):
+        from .flash_attention import flash_attention_bshd
+        mask_bias = None if mask is None else mask[:, 0, 0, :]
+        ctx = flash_attention_bshd(split(q), split(k), split(v),
+                                   1.0 / math.sqrt(dh), False,
+                                   mask_bias=mask_bias)
+        return ctx.reshape(b, s, d) @ params["attn_ow"]
+
     scores = jnp.einsum("bqhd,bkhd->bhqk", split(q), split(k)) / math.sqrt(dh)
     if mask is not None:
         scores = scores + mask
@@ -166,7 +183,7 @@ def _self_attention(x, params, config, mask, rng, train):
 
     def apply_dropout_and_context(probs):
         p = probs
-        if train and config.attn_dropout_ratio > 0 and rng is not None:
+        if dropout_on:
             keep = 1.0 - config.attn_dropout_ratio
             drop_mask = jax.random.bernoulli(rng, keep, p.shape)
             p = jnp.where(drop_mask, p / keep, 0.0).astype(p.dtype)

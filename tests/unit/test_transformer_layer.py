@@ -199,3 +199,24 @@ def test_bert_num_params_matches():
     params = bert.init_params(config)
     from deepspeed_tpu.runtime.utils import count_parameters
     assert count_parameters(params) == bert.num_params(config)
+
+
+def test_encoder_activations_follow_param_dtype():
+    """Regression: activations must follow the (engine-cast) param dtype,
+    not BertConfig.dtype (the init dtype). A config.dtype cast after the
+    embedding LN silently ran the whole encoder in fp32 under a bf16
+    engine — a ~30% throughput loss before it was caught."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.models import bert
+
+    cfg = bert.config_for("bert_base", vocab_size=64, max_seq_len=16,
+                          n_layers=1, n_heads=2, d_model=32,
+                          d_intermediate=64, dropout=0.0, attn_dropout=0.0,
+                          remat=False)
+    assert cfg.dtype == jnp.float32          # init dtype stays fp32
+    params = bert.init_params(cfg, seed=0)
+    params_bf16 = jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.bfloat16), params)
+    ids = jnp.zeros((2, 16), jnp.int32)
+    hidden = bert.encode(params_bf16, ids, None, None, cfg, None, False)
+    assert hidden.dtype == jnp.bfloat16, hidden.dtype

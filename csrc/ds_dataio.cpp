@@ -47,6 +47,10 @@ struct Dataset {
   int ready[2] = {0, 0};          // slot filled?
   int next_fill = 0, next_read = 0;
   std::atomic<bool> stop{false};
+  // threads inside ds_dataio_next; atomic and incremented BEFORE the
+  // mutex acquisition so close()'s drain also sees consumers still
+  // blocked on the lock itself
+  std::atomic<int> consumers{0};
   uint64_t cursor = 0;            // next sample index
   int batch = 0, seq = 0;
   uint64_t n_samples = 0;         // contiguous seq-token samples available
@@ -172,10 +176,26 @@ void ds_dataio_batch(void* h, const int64_t* sample_idx, int64_t n_samples,
 static void fill_slot(Dataset* ds, int slot) {
   const int64_t b = ds->batch, seq = ds->seq;
   std::vector<int64_t> idx(b);
+  // Epoch-varying affine shuffle. Every multiplier is a prime >= the
+  // enforced n_samples bound (2654435761), hence coprime with n_samples
+  // -> each epoch's map is a bijection; j*mult < 2^32*2^32 cannot wrap
+  // uint64, and the additive term is reduced mod n BEFORE the sum (a
+  // wrap of the sum would split the map and break the bijection).
+  // Varying the MULTIPLIER per epoch (not just the offset) changes the
+  // successor structure of the permutation — a constant-only mix would
+  // merely rotate one fixed cyclic order each epoch. MUST stay in
+  // lockstep with NativePrefetchLoader._indices (indexed_dataset.py).
+  static const uint64_t kMult[16] = {
+      2654435761ULL, 2754435769ULL, 2854435811ULL, 2954435791ULL,
+      3054435863ULL, 3154435859ULL, 3254435857ULL, 3354435823ULL,
+      3454435837ULL, 3554435839ULL, 3654435857ULL, 3754435859ULL,
+      3854435863ULL, 3954435869ULL, 4054435873ULL, 4154435867ULL};
   for (int64_t i = 0; i < b; ++i) {
-    // Weyl-sequence shuffle over n_samples: full-period, stateless
-    uint64_t j = (ds->cursor + i) % ds->n_samples;
-    idx[i] = (j * 2654435761ULL + 12345) % ds->n_samples;
+    uint64_t pos = ds->cursor + i;
+    uint64_t j = pos % ds->n_samples;
+    uint64_t epoch = pos / ds->n_samples;
+    uint64_t c = (12345 + epoch * 0x9E3779B97F4A7C15ULL) % ds->n_samples;
+    idx[i] = (j * kMult[epoch % 16] % ds->n_samples + c) % ds->n_samples;
   }
   ds->cursor += b;
   ds->ring[slot].resize(b * seq);
@@ -206,6 +226,10 @@ int ds_dataio_start_prefetch(void* h, int64_t batch, int64_t seq) {
   ds->seq = static_cast<int>(seq);
   ds->n_samples = ds->offsets.back() / seq;
   if (ds->n_samples == 0) return -2;
+  // bijection precondition of the affine shuffle in fill_slot(): the
+  // multiplier must be coprime with n_samples and j*mult must not wrap
+  // 2^64 — both guaranteed by n_samples < 2654435761 (prime)
+  if (ds->n_samples >= 2654435761ULL) return -3;
   ds->stop.store(false);
   ds->producer = std::thread(producer_loop, ds);
   return 0;
@@ -215,24 +239,63 @@ int ds_dataio_start_prefetch(void* h, int64_t batch, int64_t seq) {
 // ((batch, seq) int32) and wakes the producer for the slot.
 int ds_dataio_next(void* h, int32_t* out) {
   auto* ds = static_cast<Dataset*>(h);
+  ds->consumers.fetch_add(1);
   std::unique_lock<std::mutex> lk(ds->mu);
-  ds->cv_full.wait(lk, [ds] { return ds->ready[ds->next_read] != 0; });
+  // stop must be part of the predicate: a consumer blocked here while
+  // another thread calls ds_dataio_close would otherwise wait forever.
+  ds->cv_full.wait(lk, [ds] {
+    return ds->stop.load() || ds->ready[ds->next_read] != 0;
+  });
+  if (ds->stop.load() && ds->ready[ds->next_read] == 0) {
+    ds->consumers.fetch_sub(1);  // under the lock: drain can't miss it
+    ds->cv_empty.notify_all();   // wake close()'s drain wait
+    return -1;
+  }
   int slot = ds->next_read;
   memcpy(out, ds->ring[slot].data(), ds->ring[slot].size() * sizeof(int32_t));
   ds->ready[slot] = 0;
   ds->next_read ^= 1;
-  ds->cv_empty.notify_one();
+  ds->consumers.fetch_sub(1);
+  ds->cv_empty.notify_all();
   return 0;
+}
+
+// Phase 1 of shutdown: stop the producer and wake every consumer blocked
+// in ds_dataio_next (they return -1), WITHOUT freeing the Dataset. Lets a
+// caller quiesce its own threads before ds_dataio_close frees memory —
+// the two-phase protocol NativePrefetchLoader/IndexedDataset.close use.
+void ds_dataio_stop(void* h) {
+  auto* ds = static_cast<Dataset*>(h);
+  if (ds->producer.joinable()) {
+    // stop must be stored under the mutex: a waiter that has evaluated its
+    // predicate (stop still false) but not yet released the mutex to block
+    // would otherwise miss the notify forever (lost wakeup), deadlocking
+    // both the drain below and producer.join()
+    {
+      std::lock_guard<std::mutex> lk(ds->mu);
+      ds->stop.store(true);
+    }
+    ds->cv_empty.notify_all();
+    ds->cv_full.notify_all();
+    ds->producer.join();
+    // drain: wait until every consumer inside ds_dataio_next has left
+    // before the Dataset (and its mutex) is freed below. A simple
+    // lock_guard barrier is NOT enough — a notified consumer re-acquires
+    // the mutex in unspecified order and could still be blocked on it when
+    // delete runs; nor is a lock-protected count — the atomic is bumped
+    // BEFORE the lock so threads still blocked acquiring it are counted
+    // too. A call racing close() before its fetch_add executes is caller
+    // misuse (use-after-close) and not defended.
+    {
+      std::unique_lock<std::mutex> lk(ds->mu);
+      ds->cv_empty.wait(lk, [ds] { return ds->consumers.load() == 0; });
+    }
+  }
 }
 
 void ds_dataio_close(void* h) {
   auto* ds = static_cast<Dataset*>(h);
-  if (ds->producer.joinable()) {
-    ds->stop.store(true);
-    ds->cv_empty.notify_all();
-    ds->cv_full.notify_all();
-    ds->producer.join();
-  }
+  ds_dataio_stop(h);
   if (ds->bin && ds->bin != MAP_FAILED) {
     munmap(const_cast<uint8_t*>(ds->bin), ds->bin_bytes);
   }

@@ -30,6 +30,7 @@ class DataIOBuilder(OpBuilder):
              [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]),
             ("ds_dataio_next", ctypes.c_int,
              [ctypes.c_void_p, ctypes.c_void_p]),
+            ("ds_dataio_stop", None, [ctypes.c_void_p]),
             ("ds_dataio_close", None, [ctypes.c_void_p]),
         ]:
             getattr(lib, fn).restype = res

@@ -27,6 +27,7 @@ weights with the same transposes.
 import copy
 import json
 import math
+import warnings
 
 import numpy as np
 
@@ -83,6 +84,13 @@ class DeepSpeedTransformerConfig(TransformerConfig):
         self.adjust_init_range = adjust_init_range
         self.attn_dropout_checkpoint = attn_dropout_checkpoint
         self.stochastic_mode = stochastic_mode
+        if stochastic_mode:
+            warnings.warn(
+                "stochastic_mode has no distinct kernel on TPU: XLA already "
+                "applies the fast-math reassociations the reference's "
+                "stochastic transformer op (op_builder/stochastic_transformer"
+                ".py) trades determinism for, so this flag is a no-op here",
+                stacklevel=2)
         self.huggingface = huggingface
         self.training = training
 

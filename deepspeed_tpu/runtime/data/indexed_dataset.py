@@ -21,6 +21,15 @@ import numpy as np
 
 from ...utils.logging import logger
 
+# per-epoch shuffle multipliers; all prime and >= 2654435761 (the enforced
+# n_samples bound) so each is coprime with n_samples. Mirrors kMult[] in
+# csrc/ds_dataio.cpp — keep both tables identical.
+_SHUFFLE_MULTS = np.array(
+    [2654435761, 2754435769, 2854435811, 2954435791,
+     3054435863, 3154435859, 3254435857, 3354435823,
+     3454435837, 3554435839, 3654435857, 3754435859,
+     3854435863, 3954435869, 4054435873, 4154435867], dtype=np.uint64)
+
 _MAGIC = b"DSTPUIDX"
 _VERSION = 1
 _DTYPE_CODES = {np.dtype(np.int32): 4, np.dtype(np.uint16): 2}
@@ -71,6 +80,11 @@ class IndexedDataset:
         self.prefix = prefix
         self._lib = _load_native() if use_native else None
         self._handle = None
+        # close() handshake: native calls register in-flight so close()
+        # can quiesce them (via ds_dataio_stop) before freeing the handle
+        self._io_cond = threading.Condition()
+        self._inflight = 0
+        self._closing = False
         idx_path = (prefix + ".idx").encode()
         bin_path = (prefix + ".bin").encode()
         if self._lib is not None:
@@ -79,6 +93,7 @@ class IndexedDataset:
                 logger.warning("native open failed for %s; numpy fallback",
                                prefix)
                 self._lib = None
+        self._was_native = self._lib is not None
         if self._lib is None:
             self._np_open()
         else:
@@ -100,14 +115,37 @@ class IndexedDataset:
         self.num_docs = int(n_docs)
         self.num_tokens = int(self._offsets[-1])
 
+    # -- close()-safe native-call guard ------------------------------------
+    def _enter_io(self):
+        """Register a native call in flight; returns (lib, handle), or
+        None for numpy-backed readers. Raises once close() has begun so a
+        racing reader can never touch a freed handle. Callers MUST pair a
+        non-None return with _exit_io() in a finally block."""
+        with self._io_cond:
+            if self._closing or (self._was_native and self._lib is None):
+                raise RuntimeError("IndexedDataset is closed")
+            if self._lib is None:
+                return None
+            self._inflight += 1
+            return self._lib, self._handle
+
+    def _exit_io(self):
+        with self._io_cond:
+            self._inflight -= 1
+            self._io_cond.notify_all()
+
     # -- documents ---------------------------------------------------------
     def doc(self, i):
-        if self._lib is not None:
-            n = int(self._lib.ds_dataio_doc_len(self._handle, i))
-            out = np.empty(n, dtype=np.int32)
-            got = self._lib.ds_dataio_get_doc(
-                self._handle, i, out.ctypes.data, n)
-            return out[:got]
+        io = self._enter_io()
+        if io is not None:
+            lib, handle = io
+            try:
+                n = int(lib.ds_dataio_doc_len(handle, i))
+                out = np.empty(n, dtype=np.int32)
+                got = lib.ds_dataio_get_doc(handle, i, out.ctypes.data, n)
+                return out[:got]
+            finally:
+                self._exit_io()
         s, e = int(self._offsets[i]), int(self._offsets[i + 1])
         return np.asarray(self._tokens[s:e], dtype=np.int32)
 
@@ -125,10 +163,15 @@ class IndexedDataset:
         """Gather (len(sample_idx), seq_len) int32 windows."""
         idx = np.ascontiguousarray(sample_idx, dtype=np.int64)
         out = np.empty((idx.size, seq_len), dtype=np.int32)
-        if self._lib is not None:
-            self._lib.ds_dataio_batch(self._handle, idx.ctypes.data,
-                                      idx.size, seq_len, out.ctypes.data)
-            return out
+        io = self._enter_io()
+        if io is not None:
+            lib, handle = io
+            try:
+                lib.ds_dataio_batch(handle, idx.ctypes.data,
+                                    idx.size, seq_len, out.ctypes.data)
+                return out
+            finally:
+                self._exit_io()
         for r, s in enumerate(idx):
             start = int(s) * seq_len
             chunk = np.asarray(self._tokens[start:start + seq_len],
@@ -138,10 +181,24 @@ class IndexedDataset:
         return out
 
     def close(self):
-        if self._lib is not None and self._handle:
-            self._lib.ds_dataio_close(self._handle)
-            self._handle = None
-            self._lib = None
+        """Two-phase close: ds_dataio_stop wakes any reader blocked inside
+        a native call (prefetch next returns -1), then we wait for the
+        in-flight count to drain before ds_dataio_close frees the C++
+        Dataset — no reader can touch a freed handle."""
+        with self._io_cond:
+            if self._closing:
+                return
+            self._closing = True
+            lib, handle = self._lib, self._handle
+        if lib is not None and handle:
+            lib.ds_dataio_stop(handle)
+            with self._io_cond:
+                while self._inflight > 0:
+                    self._io_cond.wait(timeout=10)
+            lib.ds_dataio_close(handle)
+            with self._io_cond:
+                self._handle = None
+                self._lib = None
 
     def __del__(self):
         try:
@@ -157,7 +214,8 @@ class NativePrefetchLoader:
     (csrc/ds_dataio.cpp) while the previous batch feeds the device —
     the role DataLoader worker processes play in the reference
     (runtime/dataloader.py), without pickling/IPC. Numpy fallback uses a
-    Python thread with the same Weyl-sequence shuffled order."""
+    Python thread with the same epoch-mixed affine shuffled order
+    (see _indices)."""
 
     def __init__(self, dataset, batch_size, seq_len):
         self.ds = dataset
@@ -165,11 +223,22 @@ class NativePrefetchLoader:
         self.seq_len = int(seq_len)
         self.n_samples = dataset.num_samples(seq_len)
         assert self.n_samples > 0, "dataset smaller than one sample"
+        # bijection precondition of the affine shuffle (multiplier coprime
+        # with n_samples, no 2^64 wrap); the native side enforces the same
+        if self.n_samples >= 2654435761:
+            raise ValueError(
+                "dataset has {} seq-{} samples; the shuffle supports fewer "
+                "than 2654435761 — use a longer seq_len or shard the "
+                "corpus".format(self.n_samples, seq_len))
         self._native = dataset._lib is not None
         self._closed = False
         if self._native:
-            rc = dataset._lib.ds_dataio_start_prefetch(
-                dataset._handle, self.batch_size, self.seq_len)
+            lib, handle = dataset._enter_io()
+            try:
+                rc = lib.ds_dataio_start_prefetch(
+                    handle, self.batch_size, self.seq_len)
+            finally:
+                dataset._exit_io()
             assert rc == 0, "prefetch start failed: {}".format(rc)
         else:
             self._cursor = 0
@@ -181,23 +250,45 @@ class NativePrefetchLoader:
 
     def _indices(self, cursor):
         # uint64 throughout: the C++ producer uses uint64, and int64 would
-        # silently overflow (and diverge from it) past ~3.5e9 samples
-        j = (np.uint64(cursor)
-             + np.arange(self.batch_size, dtype=np.uint64)) \
-            % np.uint64(self.n_samples)
-        return ((j * np.uint64(2654435761) + np.uint64(12345))
-                % np.uint64(self.n_samples)).astype(np.int64)
+        # silently overflow (and diverge from it) past ~3.5e9 samples.
+        # Epoch-varying affine shuffle: every multiplier is a prime >= the
+        # enforced n_samples bound (2654435761), hence coprime with
+        # n_samples -> each epoch's map is a bijection, and j*mult stays
+        # below 2^64; the additive term is reduced mod n BEFORE the sum (a
+        # wrap of the sum would break the bijection). Varying the
+        # MULTIPLIER per epoch changes the successor structure — a
+        # constant-only mix would merely rotate one fixed cyclic order.
+        # MUST stay in lockstep with fill_slot() in csrc/ds_dataio.cpp.
+        n = np.uint64(self.n_samples)
+        pos = (np.uint64(cursor)
+               + np.arange(self.batch_size, dtype=np.uint64))
+        j = pos % n
+        epoch = pos // n
+        c = (np.uint64(12345)
+             + epoch * np.uint64(0x9E3779B97F4A7C15)) % n
+        mult = _SHUFFLE_MULTS[(epoch % np.uint64(16)).astype(np.int64)]
+        return ((j * mult % n + c) % n).astype(np.int64)
 
     def _produce(self):
-        while not self._closed:
-            batch = self.ds.batch(self._indices(self._cursor), self.seq_len)
-            self._cursor += self.batch_size
+        try:
+            while not self._closed:
+                batch = self.ds.batch(self._indices(self._cursor),
+                                      self.seq_len)
+                self._cursor += self.batch_size
+                with self._cond:
+                    while self._buf is not None and not self._closed:
+                        self._cond.wait()
+                    if self._closed:
+                        return
+                    self._buf = batch
+                    self._cond.notify_all()
+        except RuntimeError:
+            # dataset closed underneath us (ds.batch raises once
+            # IndexedDataset.close() begins): mark the loader closed and
+            # wake consumers so a blocked __next__ raises instead of
+            # waiting forever on a producer that no longer exists
             with self._cond:
-                while self._buf is not None and not self._closed:
-                    self._cond.wait()
-                if self._closed:
-                    return
-                self._buf = batch
+                self._closed = True
                 self._cond.notify_all()
 
     def close(self):
@@ -221,10 +312,26 @@ class NativePrefetchLoader:
                                "dataset was closed underneath it)")
         if self._native:
             out = np.empty((self.batch_size, self.seq_len), dtype=np.int32)
-            self.ds._lib.ds_dataio_next(self.ds._handle, out.ctypes.data)
+            lib, handle = self.ds._enter_io()   # raises once close() began
+            try:
+                rc = lib.ds_dataio_next(handle, out.ctypes.data)
+            finally:
+                self.ds._exit_io()
+            if rc != 0:
+                # producer stopped (dataset closed underneath us): out was
+                # never written — surfacing it would feed garbage token ids
+                raise RuntimeError(
+                    "NativePrefetchLoader: dataset closed while waiting "
+                    "for the next batch (rc={})".format(rc))
             return out
         with self._cond:
             while self._buf is None:
+                if self._closed:
+                    # mirror the native path: close() while blocked here
+                    # must raise, not hang (the producer thread is gone)
+                    raise RuntimeError(
+                        "NativePrefetchLoader: dataset closed while "
+                        "waiting for the next batch")
                 self._cond.wait()
             out, self._buf = self._buf, None
             self._cond.notify_all()

@@ -181,6 +181,15 @@ class DeepSpeedEngine:
         self.world_size = self.dp_world_size
 
     def _configure_precision(self):
+        if self._config.amp_enabled:
+            # Reference routes "amp" through NVIDIA apex (engine.py:580-600);
+            # on TPU the equivalent mixed-precision mode is bf16 compute with
+            # fp32 master state, so amp is reinterpreted — loudly, because any
+            # apex-specific opts (opt_level, ...) are dropped.
+            log_dist(
+                "'amp' config block is reinterpreted as bf16 mixed precision "
+                "on TPU; amp-specific options {} are ignored".format(
+                    self._config.amp_params or "{}"), ranks=[0])
         if self._config.bf16_enabled or self._config.amp_enabled:
             self.compute_dtype = jnp.bfloat16
         elif self._config.fp16_enabled:
@@ -323,6 +332,9 @@ class DeepSpeedEngine:
                 "opt": None,
                 "acc_grads": acc_grads,
                 "scaler": ls.loss_scaler_from_config(self._config),
+                # no skip_count here: the host optimizer step observes the
+                # overflow flag every step, so the host counter is already
+                # exact on the offload path
             }
             self.model.params = None
             return
@@ -370,6 +382,9 @@ class DeepSpeedEngine:
             "opt": opt_state,
             "acc_grads": acc_grads,
             "scaler": ls.loss_scaler_from_config(self._config),
+            # device-resident skipped-step counter: keeps skipped_steps exact
+            # even when the overflow flag is only fetched periodically
+            "skip_count": jnp.int32(0),
         }
         del params_f32
         self.model.params = None  # single source of truth is the state
@@ -503,6 +518,9 @@ class DeepSpeedEngine:
                 for key, val in new_opt.items()
             }
             new_state["scaler"] = ls.update_scale(scaler, overflow)
+            if "skip_count" in state:
+                new_state["skip_count"] = (
+                    state["skip_count"] + overflow.astype(jnp.int32))
 
             metrics = {
                 "overflow": overflow,
@@ -763,12 +781,31 @@ class DeepSpeedEngine:
         boundaries for bf16/fp32 — the in-jit guard still no-ops a
         non-finite step on device every step, and the periodic check keeps
         a persistently-overflowing run observable (skipped_steps/log)
-        without a per-step device sync."""
+        without a per-step device sync. At those boundaries skipped_steps
+        is re-synced from the device-resident skip_count counter, so the
+        host total stays exact over the unfetched window (the lr scheduler
+        still advances on unfetched skipped steps — the documented cost of
+        avoiding the sync)."""
         if self._overflow_fetch_needed():
             return bool(metrics["overflow"])
         if (self.global_steps + 1) % self.steps_per_print() == 0:
+            # -1 compensates the caller's += 1 for this step's overflow
+            self._sync_skipped_steps(
+                exclude_current_overflow=bool(metrics["overflow"]))
             return bool(metrics["overflow"])
         return False
+
+    def _sync_skipped_steps(self, exclude_current_overflow=False):
+        """Re-sync the host skipped_steps counter from the device-resident
+        skip_count, which is exact even over windows where the overflow
+        flag was never fetched. max() keeps paths where the host counter
+        is already authoritative (per-step fetch, host offload) intact."""
+        if self.state is None or "skip_count" not in self.state:
+            return
+        device_skips = int(self.state["skip_count"])
+        if exclude_current_overflow:
+            device_skips -= 1
+        self.skipped_steps = max(self.skipped_steps, device_skips)
 
     def _take_model_step(self, lr_kwargs=None):
         if self.host_state is not None:
@@ -1028,6 +1065,10 @@ class DeepSpeedEngine:
         client_state = client_state or {}
 
         is_writer = jax.process_index() == 0
+        # bf16/static-scale runs only fetch the overflow flag at print
+        # boundaries; without this the saved value would freeze the
+        # unfetched window's drift into the checkpoint
+        self._sync_skipped_steps()
         sd = {
             "module": ckpt.tree_to_numpy(self.state["params"]),
             "optimizer": ckpt.tree_to_numpy(self._opt_state_view()),
@@ -1183,6 +1224,9 @@ class DeepSpeedEngine:
         self.global_samples = sd.get(
             "global_samples", self.global_steps * self.train_batch_size())
         self.skipped_steps = sd.get("skipped_steps", 0)
+        if self.state is not None and "skip_count" in self.state:
+            # keep the device counter aligned so periodic re-syncs stay exact
+            self.state["skip_count"] = jnp.int32(self.skipped_steps)
         self.loaded_checkpoint_dp_world_size = sd.get("dp_world_size")
 
         known = {"module", "optimizer", "master", "scaler", "lr_scheduler",

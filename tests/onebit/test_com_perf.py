@@ -64,7 +64,9 @@ def main():
                       mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS))
         return f(v)
 
-    we = jnp.zeros_like(values)
+    # Error buffers live at the backend's padded width, not n — sizes not
+    # divisible by 8*devices would shape-error inside jit otherwise.
+    we = jnp.zeros((world, backend.padded_size(n)), jnp.float32)
     se = jnp.zeros((world, backend.padded_size(n) // world), jnp.float32)
 
     t_exact = timeit(exact, values)

@@ -90,3 +90,57 @@ def test_prefetch_loader(corpus, use_native):
     ds2.close()
     with pytest.raises(RuntimeError):
         next(loader)
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_close_while_blocked_in_next(corpus, use_native):
+    """close() while a consumer is blocked in next() must raise in the
+    consumer, not deadlock (ds_dataio.cpp stop-aware wait + drain; numpy
+    fallback _closed check)."""
+    import threading
+    import time
+
+    prefix, _ = corpus
+    ds = IndexedDataset(prefix, use_native=use_native)
+    if use_native and ds._lib is None:
+        pytest.skip("native op unavailable")
+    loader = NativePrefetchLoader(ds, batch_size=4, seq_len=32)
+    outcome = []
+
+    def consumer():
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                next(loader)
+            outcome.append("never stopped")
+        except RuntimeError:
+            outcome.append("raised")
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.2)
+    # dataset-first close on BOTH paths: the numpy fallback must also
+    # surface a closed dataset as a raise in the consumer, not a hang
+    ds.close()
+    loader.close()
+    t.join(timeout=10)
+    assert not t.is_alive(), "consumer deadlocked after close()"
+    assert outcome == ["raised"], outcome
+
+
+def test_epoch_dependent_shuffle(corpus):
+    """Each epoch is a bijection over the samples and consecutive epochs
+    traverse different permutations (epoch-mixed affine map)."""
+    prefix, _ = corpus
+    ds = IndexedDataset(prefix, use_native=False)
+    loader = NativePrefetchLoader(ds, batch_size=1, seq_len=32)
+    n = loader.n_samples
+    loader.close()              # stop the producer before poking internals
+    loader.batch_size = n       # one call = one full epoch of indices
+    e0 = loader._indices(0)
+    e1 = loader._indices(n)
+    assert sorted(e0.tolist()) == list(range(n))
+    assert sorted(e1.tolist()) == list(range(n))
+    assert not np.array_equal(e0, e1)
+    loader.close()
+    ds.close()

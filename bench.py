@@ -41,8 +41,11 @@ def main():
     warmup = 3 if on_tpu else 1
     # GPT-2 medium (350M): best measured MFU on one v5e chip — d_model
     # 1024 tiles the MXU better than 125M's 768 (sweep:
-    # tests/perf/sweep_gpt2_mfu.py). Fall back on compiler OOM.
-    micro_batches = [96, 64, 32, 8] if on_tpu else [2]
+    # tests/perf/sweep_gpt2_mfu.py). With the fused-attention remat path
+    # (ctx+lse saved per layer) the HBM sweet spot is micro_batch 24
+    # (0.503 MFU measured; 28 and 16 both lower, 32+ OOMs) —
+    # tests/perf/probe_fused_mb.py. Fall back on compiler OOM.
+    micro_batches = [24, 16, 8] if on_tpu else [2]
 
     if on_tpu:
         cfg = gpt2.config_for("gpt2_medium", max_seq_len=seq, remat=True,

@@ -146,7 +146,10 @@ def _layer_norm(x, scale, bias, eps=1e-5):
     return fused_layer_norm(x, scale, bias, eps)
 
 
-def _attention(x, block, config, rng, train):
+def _attn_ctx(x, block, config, train):
+    """QKV projection + attention mixing -> (b, s, d) context, BEFORE the
+    output projection (which lives in _block_rest so the fused and unfused
+    paths share one copy of everything downstream of the context)."""
     b, s, d = x.shape
     h, dh = config.n_heads, config.d_head
     qkv = x @ block["qkv_kernel"].astype(x.dtype) + \
@@ -176,13 +179,7 @@ def _attention(x, block, config, rng, train):
             attn_fn=attn_fn)
     else:
         ctx = causal_attention(q, k, v, use_flash=config.use_flash_attention)
-    ctx = ctx.reshape(b, s, d)
-    out = ctx @ block["proj_kernel"].astype(x.dtype) + \
-        block["proj_bias"].astype(x.dtype)
-    if train and config.dropout > 0.0 and rng is not None:
-        keep = jax.random.bernoulli(rng, 1.0 - config.dropout, out.shape)
-        out = jnp.where(keep, out / (1.0 - config.dropout), 0.0)
-    return out
+    return ctx.reshape(b, s, d)
 
 
 def _mlp(x, block, config, rng, train):
@@ -198,14 +195,48 @@ def _mlp(x, block, config, rng, train):
 
 
 def _block(x, block_params, config, rng, train):
-    r1, r2 = (None, None) if rng is None else jax.random.split(rng)
+    """Unfused block: LN1 + attention context, then the shared
+    _block_rest tail (proj/residual/MLP — one copy for both paths)."""
     ln1 = _layer_norm(x, block_params["ln1"]["scale"],
                       block_params["ln1"]["bias"])
-    x = x + _attention(ln1, block_params["attn"], config, r1, train)
+    ctx = _attn_ctx(ln1, block_params["attn"], config, train)
+    return _block_rest(x, ctx, block_params, config, rng, train)
+
+
+def _use_fused_attn(config):
+    """The fused LN+QKV+flash op applies on the plain TPU flash path (the
+    sequence-parallel impls own their attention; the reference jnp path
+    keeps gradients for CPU tests)."""
+    return (config.use_flash_attention and not config.sequence_parallel
+            and jax.default_backend() == "tpu")
+
+
+def _block_rest(x, ctx, block_params, config, rng, train):
+    """Everything after the attention context: proj + residual + MLP. Split
+    out so per-block remat can wrap THIS while the fused attention op stays
+    outside (its custom_vjp saves out/lse and recomputes LN+QKV in the
+    backward — re-running the flash forward kernel inside the remat rebuild
+    is the single biggest avoidable cost at bench shapes)."""
+    r1, r2 = (None, None) if rng is None else jax.random.split(rng)
+    attn = block_params["attn"]
+    out = ctx @ attn["proj_kernel"].astype(x.dtype) + \
+        attn["proj_bias"].astype(x.dtype)
+    if train and config.dropout > 0.0 and r1 is not None:
+        keep = jax.random.bernoulli(r1, 1.0 - config.dropout, out.shape)
+        out = jnp.where(keep, out / (1.0 - config.dropout), 0.0)
+    x = x + out
     ln2 = _layer_norm(x, block_params["ln2"]["scale"],
                       block_params["ln2"]["bias"])
     x = x + _mlp(ln2, block_params["mlp"], config, r2, train)
     return x
+
+
+def _fused_attn_ctx(x, block_params, config):
+    from ..ops.transformer.flash_attention import fused_ln_qkv_attention
+    return fused_ln_qkv_attention(
+        x, block_params["ln1"]["scale"], block_params["ln1"]["bias"],
+        block_params["attn"]["qkv_kernel"],
+        block_params["attn"]["qkv_bias"], config.n_heads)
 
 
 def forward_hidden(params, input_ids, config, rng=None, train=False):
@@ -215,18 +246,30 @@ def forward_hidden(params, input_ids, config, rng=None, train=False):
     x = jnp.take(params["wte"], input_ids, axis=0).astype(compute_dtype) + \
         params["wpe"][:s].astype(compute_dtype)
 
-    block_fn = partial(_block, config=config, train=train)
-    if config.remat:
-        # "full": recompute everything in bwd (min memory, ~4/3 flops);
-        # "dots": save matmul outputs, recompute elementwise only — the
-        # usual MFU sweet spot on TPU (HBM traffic for ln/gelu recompute is
-        # cheaper than re-running the gemms on the MXU). Under scan the
-        # CSE-prevention barriers are unnecessary and inhibit fusion.
-        policy = (jax.checkpoint_policies.nothing_saveable
-                  if config.remat_policy == "full" else
-                  jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
-        block_fn = jax.checkpoint(block_fn, policy=policy,
-                                  prevent_cse=not config.scan_blocks)
+    # "full": recompute everything in bwd (min memory, ~4/3 flops);
+    # "dots": save matmul outputs, recompute elementwise only — the usual
+    # MFU sweet spot on TPU (HBM traffic for ln/gelu recompute is cheaper
+    # than re-running the gemms on the MXU). Under scan the CSE-prevention
+    # barriers are unnecessary and inhibit fusion.
+    policy = (jax.checkpoint_policies.nothing_saveable
+              if config.remat_policy == "full" else
+              jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    if _use_fused_attn(config):
+        # attention runs OUTSIDE the remat region via its own custom_vjp
+        # (saves ctx+lse, recomputes LN+QKV in bwd, never re-runs the flash
+        # forward); only the proj/MLP remainder is rematerialized, under
+        # the same remat_policy as the unfused path.
+        rest_fn = partial(_block_rest, config=config, train=train)
+        if config.remat:
+            rest_fn = jax.checkpoint(rest_fn, policy=policy,
+                                     prevent_cse=not config.scan_blocks)
+        block_fn = lambda x, bp, rng: rest_fn(
+            x, _fused_attn_ctx(x, bp, config), bp, rng=rng)
+    else:
+        block_fn = partial(_block, config=config, train=train)
+        if config.remat:
+            block_fn = jax.checkpoint(block_fn, policy=policy,
+                                      prevent_cse=not config.scan_blocks)
 
     if config.scan_blocks:
         n = config.n_layers

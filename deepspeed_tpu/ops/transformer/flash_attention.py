@@ -621,14 +621,18 @@ def _bwd_packed(q, k, v, bias, o, do, lse, sm_scale, causal, block_q,
     return dq, dk[:, :s], dv[:, :s]
 
 
-# Packed-kernel block default: 256 (not the 3D kernels' 512) — a 512
-# q-block on (Bq, h*d) slabs tips the 16M scoped-vmem limit at GPT-2 width.
+# Packed-kernel block defaults: q 256 (a 512 q-block on (Bq, h*d) slabs
+# tips the 16M scoped-vmem limit at GPT-2 width), k 512 (fewer, larger
+# dots amortize the MXU fill/drain latency that dominates at d_head 64:
+# measured 11.0 -> 6.8 ms/layer fwd at the GPT-2-medium bench shape;
+# k = 1024 measured worse and OOMs the backward).
 DEFAULT_BLOCK_PACKED = 256
+DEFAULT_BLOCK_PACKED_K = 512
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
 def _flash_bshd_core(q, k, v, bias, sm_scale, causal, block_q, interpret,
-                     block_k):
+                     block_k, bwd_block_q, bwd_block_k):
     out, _ = _flash_fwd_bshd(q, k, v, bias, sm_scale, causal, block_q,
                              interpret, block_k)
     return out
@@ -646,20 +650,25 @@ def _flash_fwd_bshd(q, k, v, bias, sm_scale, causal, block_q, interpret,
 
 
 def _flash_fwd_bshd_rule(q, k, v, bias, sm_scale, causal, block_q,
-                         interpret, block_k=DEFAULT_BLOCK_PACKED):
+                         interpret, block_k, bwd_block_q, bwd_block_k):
     return _flash_fwd_bshd(q, k, v, bias, sm_scale, causal, block_q,
                            interpret, block_k)
 
 
 def _flash_bwd_bshd_rule(sm_scale, causal, block_q, interpret, block_k,
-                         res, do):
+                         bwd_block_q, bwd_block_k, res, do):
     q, k, v, bias_p, out, lse = res  # q/k/v (b,s,h,d); out packed
     b, s, h, d = q.shape
     scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
     pack = lambda t: t.reshape(b, s, h * d)
-    dq, dk, dv = _bwd_packed(pack(q), pack(k), pack(v), bias_p, out,
-                             pack(do), lse, scale, causal, block_q, block_k,
-                             interpret, h)
+    bbq = bwd_block_q or block_q
+    bbk = bwd_block_k or block_k
+    # bias was padded to the FWD block_k grain; re-pad to the bwd grain so
+    # the kernels' (1, 1, block_k) bias slices can never run off the end
+    bias_b = _pad_bias(bias_p[:, 0, :s], b, s, min(bbk, s))
+    dq, dk, dv = _bwd_packed(pack(q), pack(k), pack(v), bias_b, out,
+                             pack(do), lse, scale, causal,
+                             bbq, bbk, interpret, h)
     unpack = lambda t: t.reshape(b, s, h, d)
     # bias is a MASK, not a trainable term: zero cotangent by contract
     # (the wrapper stop_gradients it too)
@@ -671,7 +680,8 @@ _flash_bshd_core.defvjp(_flash_fwd_bshd_rule, _flash_bwd_bshd_rule)
 
 def flash_attention_bshd(q, k, v, sm_scale=None, causal=True,
                          block_q=DEFAULT_BLOCK_PACKED, interpret=False,
-                         block_k=DEFAULT_BLOCK_PACKED, mask_bias=None):
+                         block_k=DEFAULT_BLOCK_PACKED_K, mask_bias=None,
+                         bwd_block_q=None, bwd_block_k=None):
     """q/k/v: (batch, seq, heads, d_head) -> same layout. Heads are never
     transposed: the arrays are viewed as packed (b, s, h*d) — a free
     minor-dim merge — and the kernel loops heads over lane slices. (The
@@ -690,7 +700,74 @@ def flash_attention_bshd(q, k, v, sm_scale=None, causal=True,
         if bias.ndim == 2:
             bias = bias[:, None, :]
     return _flash_bshd_core(q, k, v, bias, sm_scale, causal, block_q,
-                            interpret, block_k)
+                            interpret, block_k, bwd_block_q, bwd_block_k)
+
+
+# ---------------------------------------------------------------------------
+# Fused LN + QKV-projection + flash attention with remat-friendly residuals.
+#
+# Under per-block jax.checkpoint (full remat), the backward rebuild re-runs
+# the flash FORWARD kernel just to regenerate the custom_vjp residuals
+# (q/k/v/out/lse) — ~6.8 ms/layer at the GPT-2-medium bench shape. This op
+# moves the attention out of the remat region and picks its residuals
+# deliberately: save (out, lse), recompute q/k/v from the block input via
+# LN + QKV gemm in the backward (cheap MXU work the full-remat path was
+# recomputing anyway). Saved per layer: out (shared with the downstream
+# checkpoint's input — one buffer) + lse. The backward derives the LN/gemm
+# cotangents with jax.vjp of the same recompute function, so the fused path
+# cannot numerically diverge from the unfused one.
+# ---------------------------------------------------------------------------
+def _lnqkv(x, ln_scale, ln_bias, qkv_w, qkv_b, eps):
+    """Block input -> packed (b, s, h*d) q, k, v (the model's natural
+    layout; heads stay merged in the minor dim)."""
+    from .fused_ops import fused_layer_norm
+    ln = fused_layer_norm(x, ln_scale, ln_bias, eps)
+    qkv = ln @ qkv_w.astype(ln.dtype) + qkv_b.astype(ln.dtype)
+    return jnp.split(qkv, 3, axis=-1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def fused_ln_qkv_attention(x, ln_scale, ln_bias, qkv_w, qkv_b, num_heads,
+                           eps=1e-5, causal=True,
+                           block_q=DEFAULT_BLOCK_PACKED,
+                           block_k=DEFAULT_BLOCK_PACKED_K, interpret=False):
+    """x: (b, s, d_model) -> attention context (b, s, d_model), causal,
+    sm_scale fixed at 1/sqrt(d_head)."""
+    out, _ = _fused_lnqkv_attn_fwd(x, ln_scale, ln_bias, qkv_w, qkv_b,
+                                   num_heads, eps, causal, block_q, block_k,
+                                   interpret)
+    return out
+
+
+def _fused_lnqkv_attn_fwd(x, ln_scale, ln_bias, qkv_w, qkv_b, num_heads,
+                          eps, causal, block_q, block_k, interpret):
+    b, s, hd = x.shape
+    d = hd // num_heads
+    q, k, v = _lnqkv(x, ln_scale, ln_bias, qkv_w, qkv_b, eps)
+    bias = jnp.zeros((b, 1, ((s + block_k - 1) // block_k) * block_k),
+                     jnp.float32)
+    out, lse = _fwd_packed(q, k, v, bias, 1.0 / (d ** 0.5), causal,
+                           block_q, block_k, interpret, num_heads)
+    return out, (x, ln_scale, ln_bias, qkv_w, qkv_b, out, lse)
+
+
+def _fused_lnqkv_attn_bwd(num_heads, eps, causal, block_q, block_k,
+                          interpret, res, do):
+    x, ln_scale, ln_bias, qkv_w, qkv_b, out, lse = res
+    b, s, hd = x.shape
+    d = hd // num_heads
+    (q, k, v), lnqkv_vjp = jax.vjp(
+        lambda x_, s_, b_, w_, bb_: _lnqkv(x_, s_, b_, w_, bb_, eps),
+        x, ln_scale, ln_bias, qkv_w, qkv_b)
+    bias = jnp.zeros((b, 1, ((s + block_k - 1) // block_k) * block_k),
+                     jnp.float32)
+    dq, dk, dv = _bwd_packed(q, k, v, bias, out, do, lse,
+                             1.0 / (d ** 0.5), causal, block_q, block_k,
+                             interpret, num_heads)
+    return lnqkv_vjp([dq, dk, dv])  # list: matches _lnqkv's jnp.split output
+
+
+fused_ln_qkv_attention.defvjp(_fused_lnqkv_attn_fwd, _fused_lnqkv_attn_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))

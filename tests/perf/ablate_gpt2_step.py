@@ -70,13 +70,13 @@ def main():
 
     # no attention (identity instead of attention mixing)
     import deepspeed_tpu.models.gpt2 as g
-    orig_attn = g._attention
-    g._attention = lambda x, blk, c, r, t: x
+    orig_attn = g._attn_ctx
+    g._attn_ctx = lambda x, blk, c, t: x
     try:
         rows["fwd_bwd_no_attn"] = timed(jax.jit(jax.grad(loss_fn)),
                                         params, ids)
     finally:
-        g._attention = orig_attn
+        g._attn_ctx = orig_attn
 
     # no remat
     import dataclasses

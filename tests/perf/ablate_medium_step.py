@@ -1,0 +1,80 @@
+"""Step-time breakdown for the BENCH shape (GPT-2 medium, mb=96, seq=1024).
+
+Times jitted variants on the real chip and prints a ms-per-step table.
+Manual harness:
+
+    python tests/perf/ablate_medium_step.py [--mb 96]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+SEQ = 1024
+
+
+def _force(out):
+    import jax
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    return float(leaf.ravel()[0])
+
+
+def timed(fn, *args, reps=3):
+    _force(fn(*args))
+    t0 = time.time()
+    for _ in range(reps):
+        out = fn(*args)
+        _force(out)
+    return round((time.time() - t0) / reps * 1e3, 1)  # ms
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mb", type=int, default=96)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models import gpt2
+
+    cfg = gpt2.config_for("gpt2_medium", max_seq_len=SEQ, remat=True,
+                          loss_chunk=128)
+    params = jax.tree_util.tree_map(
+        lambda p: jnp.asarray(p, jnp.bfloat16), gpt2.init_params(cfg, 0))
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, size=(args.mb, SEQ)),
+                      jnp.int32)
+
+    rows = {}
+
+    def loss_fn(p, ids):
+        return gpt2.lm_loss(p, ids, ids, cfg, rng=None, train=False)
+
+    rows["fwd_only"] = timed(jax.jit(loss_fn), params, ids)
+    rows["fwd_bwd"] = timed(jax.jit(jax.grad(loss_fn)), params, ids)
+
+    def hidden_loss(p, ids):
+        h = gpt2.forward_hidden(p, ids, cfg, rng=None, train=False)
+        return h.astype(jnp.float32).mean()
+
+    rows["fwd_bwd_no_ce"] = timed(jax.jit(jax.grad(hidden_loss)), params, ids)
+
+    import deepspeed_tpu.models.gpt2 as g
+    orig_attn = g._attn_ctx
+    g._attn_ctx = lambda x, blk, c, t: x
+    try:
+        rows["fwd_bwd_no_attn"] = timed(jax.jit(jax.grad(loss_fn)),
+                                        params, ids)
+    finally:
+        g._attn_ctx = orig_attn
+
+    print(json.dumps(rows, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+"""Isolated attention fwd+bwd timings at the bench shape.
+
+Compares (per GPT-2-medium layer shape, b=96 s=1024 h=16 d=64):
+  - this repo's packed flash kernel ((b,s,h,d) view, no transposes)
+  - JAX's builtin pallas TPU flash kernel ((b,h,s,d), incl. transposes
+    from the model's packed layout)
+  - plain XLA einsum attention (scores materialize)
+
+Times grad(sum(ctx)) wrt (q,k,v) — the training-path cost. Manual:
+
+    python tests/perf/attn_kernel_compare.py [--b 96]
+"""
+import argparse
+import sys
+import os
+import time
+import json
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def _force(x):
+    import jax
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    return float(leaf.ravel()[0])
+
+
+def timed(fn, *args, reps=5):
+    _force(fn(*args))
+    t0 = time.time()
+    for _ in range(reps):
+        out = fn(*args)
+        _force(out)
+    return round((time.time() - t0) / reps * 1e3, 1)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--b", type=int, default=96)
+    parser.add_argument("--s", type=int, default=1024)
+    parser.add_argument("--h", type=int, default=16)
+    parser.add_argument("--d", type=int, default=64)
+    args = parser.parse_args()
+    b, s, h, d = args.b, args.s, args.h, args.d
+
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    mk = lambda: jnp.asarray(rng.randn(b, s, h, d) * 0.1, jnp.bfloat16)
+    q, k, v = mk(), mk(), mk()
+    rows = {}
+
+    # ---- repo packed kernel --------------------------------------------
+    from deepspeed_tpu.ops.transformer.flash_attention import (
+        flash_attention_bshd)
+
+    def loss_repo(q, k, v):
+        return flash_attention_bshd(q, k, v).astype(jnp.float32).sum()
+
+    rows["repo_packed_fwd"] = timed(
+        jax.jit(lambda q, k, v: flash_attention_bshd(q, k, v)), q, k, v)
+    rows["repo_packed_grad"] = timed(
+        jax.jit(jax.grad(loss_repo, argnums=(0, 1, 2))), q, k, v)
+
+    # ---- jax builtin pallas flash ((b,h,s,d)) ---------------------------
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as jflash)
+
+        def to_bhsd(t):
+            return t.transpose(0, 2, 1, 3)
+
+        def loss_jax(q, k, v):
+            out = jflash(to_bhsd(q), to_bhsd(k), to_bhsd(v), causal=True,
+                         sm_scale=1.0 / d ** 0.5)
+            return out.astype(jnp.float32).sum()
+
+        rows["jax_flash_fwd"] = timed(
+            jax.jit(lambda q, k, v: jflash(
+                to_bhsd(q), to_bhsd(k), to_bhsd(v), causal=True,
+                sm_scale=1.0 / d ** 0.5)), q, k, v)
+        rows["jax_flash_grad"] = timed(
+            jax.jit(jax.grad(loss_jax, argnums=(0, 1, 2))), q, k, v)
+    except Exception as e:  # noqa: BLE001
+        rows["jax_flash"] = "failed: " + str(e)[:120]
+
+    # ---- plain XLA einsum attention ------------------------------------
+    def loss_xla(q, k, v):
+        qh = q.transpose(0, 2, 1, 3)  # (b,h,s,d)
+        kh = k.transpose(0, 2, 1, 3)
+        vh = v.transpose(0, 2, 1, 3)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                            preferred_element_type=jnp.float32) / d ** 0.5
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask, scores, -1e30)
+        p = jax.nn.softmax(scores, axis=-1).astype(qh.dtype)
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+        return ctx.astype(jnp.float32).sum()
+
+    try:
+        rows["xla_einsum_grad"] = timed(
+            jax.jit(jax.grad(loss_xla, argnums=(0, 1, 2))), q, k, v)
+    except Exception as e:  # noqa: BLE001
+        rows["xla_einsum_grad"] = "failed: " + str(e)[:120]
+
+    # ideal MXU time for reference: causal fwd+bwd ~ 3x fwd flops
+    fwd_flops = 4.0 * b * h * (s * s / 2) * d * 2  # qk^T + pv, causal half
+    rows["_ideal_fwd_ms_at_peak"] = round(fwd_flops / 197e12 * 1e3, 1)
+    print(json.dumps(rows, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+"""Probe: fused-attention-remat GPT-2 medium throughput at a given
+micro-batch (bench.py shape). Usage:
+
+    python tests/perf/probe_fused_mb.py --mb 48
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mb", type=int, default=48)
+    parser.add_argument("--steps", type=int, default=10)
+    parser.add_argument("--chunk", type=int, default=128)
+    args = parser.parse_args()
+
+    import jax
+    import deepspeed_tpu as deepspeed
+    from deepspeed_tpu.models import gpt2
+
+    seq = 1024
+    cfg = gpt2.config_for("gpt2_medium", max_seq_len=seq, remat=True,
+                          loss_chunk=args.chunk)
+    model = gpt2.make_gpt2_model(config=cfg)
+    ds_config = {
+        "train_micro_batch_size_per_gpu": args.mb,
+        "gradient_accumulation_steps": 1,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = deepspeed.initialize(model=model,
+                                           config_params=ds_config)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, size=(1, args.mb, seq)) \
+        .astype(np.int32)
+    batch = (ids, ids.copy())
+    for _ in range(3):
+        loss = engine.train_batch(batch=batch)
+    float(loss)
+    t0 = time.time()
+    for _ in range(args.steps):
+        loss = engine.train_batch(batch=batch)
+    float(loss)
+    dt = time.time() - t0
+    toks = args.mb * seq * args.steps / dt
+    n = gpt2.num_params(cfg)
+    fpt = 6.0 * n + 12.0 * cfg.n_layers * cfg.d_model * seq
+    print(json.dumps({"mb": args.mb, "tokens_per_sec": round(toks, 1),
+                      "mfu": round(toks * fpt / 197e12, 4)}))
+
+
+if __name__ == "__main__":
+    main()

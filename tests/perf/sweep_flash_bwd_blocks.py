@@ -1,0 +1,70 @@
+"""Backward-block sweep for the packed flash kernels (fwd pinned at
+256/512, the measured best). Amortized scan timing; grad-only deltas.
+
+    python tests/perf/sweep_flash_bwd_blocks.py [--b 96]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+REPS = 8
+
+
+def timed_scan(step_fn, init, reps=REPS):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(x):
+        def body(c, _):
+            return step_fn(c), None
+        out, _ = jax.lax.scan(body, x, None, length=reps)
+        return out.astype(jnp.float32).ravel()[0]
+
+    float(run(init))
+    t0 = time.time()
+    float(run(init))
+    return round(((time.time() - t0) - 0.094) / reps * 1e3, 1)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--b", type=int, default=96)
+    parser.add_argument("--s", type=int, default=1024)
+    parser.add_argument("--h", type=int, default=16)
+    parser.add_argument("--d", type=int, default=64)
+    args = parser.parse_args()
+    b, s, h, d = args.b, args.s, args.h, args.d
+
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.transformer import flash_attention as fa
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(b, s, h, d) * 0.1, jnp.bfloat16)
+
+    rows = {}
+    for bbq, bbk in [(256, 512), (256, 256), (128, 512), (128, 1024),
+                     (256, 1024), (512, 512), (128, 256), (512, 256)]:
+        def grad_step(t, bbq=bbq, bbk=bbk):
+            g = jax.grad(lambda q: fa.flash_attention_bshd(
+                q, q, q, bwd_block_q=bbq, bwd_block_k=bbk)
+                .astype(jnp.float32).sum())(t)
+            return g.astype(t.dtype)
+
+        key = "bwd_q{}_k{}".format(bbq, bbk)
+        try:
+            rows[key] = timed_scan(grad_step, x)
+        except Exception as e:  # noqa: BLE001
+            rows[key] = "failed: " + str(e)[:90]
+    print(json.dumps(rows, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -226,3 +226,75 @@ def test_streaming_fwd_key_padding_mask(monkeypatch):
                                          mask_bias=bias)
     np.testing.assert_allclose(np.asarray(stream_out), np.asarray(ref_out),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_fused_ln_qkv_attention_matches_unfused():
+    """fused_ln_qkv_attention (the remat-friendly custom_vjp: saves
+    out/lse, recomputes LN+QKV in bwd) must match the straight-line
+    LN -> QKV gemm -> flash composition in value and all five grads."""
+    from deepspeed_tpu.ops.transformer.flash_attention import (
+        fused_ln_qkv_attention, flash_attention_bshd)
+    from deepspeed_tpu.ops.transformer.fused_ops import fused_layer_norm
+
+    b, s, h, d = 2, 128, 4, 32
+    dm = h * d
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(b, s, dm) * 0.3, jnp.float32)
+    ln_s = jnp.asarray(1.0 + 0.1 * rng.randn(dm), jnp.float32)
+    ln_b = jnp.asarray(0.1 * rng.randn(dm), jnp.float32)
+    w = jnp.asarray(rng.randn(dm, 3 * dm) * 0.05, jnp.float32)
+    bb = jnp.asarray(0.01 * rng.randn(3 * dm), jnp.float32)
+
+    def loss_fused(x, ln_s, ln_b, w, bb):
+        out = fused_ln_qkv_attention(x, ln_s, ln_b, w, bb, h,
+                                     1e-5, True, 64, 64, True)
+        return jnp.sum(out * jnp.sin(out))
+
+    def loss_ref(x, ln_s, ln_b, w, bb):
+        ln = fused_layer_norm(x, ln_s, ln_b, 1e-5)
+        qkv = ln @ w + bb
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        rs = lambda t: t.reshape(b, s, h, d)
+        out = flash_attention_bshd(rs(q), rs(k), rs(v), None, True,
+                                   64, True, 64)
+        return jnp.sum(out.reshape(b, s, dm)
+                       * jnp.sin(out.reshape(b, s, dm)))
+
+    np.testing.assert_allclose(
+        np.asarray(loss_fused(x, ln_s, ln_b, w, bb)),
+        np.asarray(loss_ref(x, ln_s, ln_b, w, bb)), rtol=1e-4, atol=1e-4)
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2, 3, 4))(x, ln_s, ln_b, w, bb)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3, 4))(x, ln_s, ln_b, w, bb)
+    for a, b_ in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-3, atol=2e-3)
+
+
+def test_fused_attn_under_remat_matches():
+    """jax.checkpoint around the consumer of the fused op: gradients must
+    survive the remat rebuild unchanged (the whole point of the op)."""
+    from deepspeed_tpu.ops.transformer.flash_attention import (
+        fused_ln_qkv_attention)
+
+    b, s, h, d = 2, 128, 4, 32
+    dm = h * d
+    rng = np.random.RandomState(11)
+    x = jnp.asarray(rng.randn(b, s, dm) * 0.3, jnp.float32)
+    ln_s = jnp.ones((dm,), jnp.float32)
+    ln_b = jnp.zeros((dm,), jnp.float32)
+    w = jnp.asarray(rng.randn(dm, 3 * dm) * 0.05, jnp.float32)
+    bb = jnp.zeros((3 * dm,), jnp.float32)
+
+    def network(x, w, remat):
+        ctx = fused_ln_qkv_attention(x, ln_s, ln_b, w, bb, h,
+                                     1e-5, True, 64, 64, True)
+        rest = lambda x, ctx: jnp.sum((x + ctx) ** 2)
+        if remat:
+            rest = jax.checkpoint(rest)
+        return rest(x, ctx)
+
+    g_plain = jax.grad(network, argnums=(0, 1))(x, w, False)
+    g_remat = jax.grad(network, argnums=(0, 1))(x, w, True)
+    for a, b_ in zip(g_plain, g_remat):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-5, atol=1e-5)

@@ -144,3 +144,29 @@ def test_epoch_dependent_shuffle(corpus):
     assert not np.array_equal(e0, e1)
     loader.close()
     ds.close()
+
+
+def test_native_numpy_shuffle_parity_across_epochs(corpus):
+    """The duplicated multiplier tables (kMult in csrc/ds_dataio.cpp and
+    _SHUFFLE_MULTS in indexed_dataset.py) must stay in lockstep — drive
+    BOTH loaders through several epoch boundaries and compare every batch
+    (epoch >= 1 exercises mult[1], mult[2] and the epoch-mixed constant)."""
+    prefix, _ = corpus
+    nat_ds = IndexedDataset(prefix, use_native=True)
+    if nat_ds._lib is None:
+        nat_ds.close()
+        pytest.skip("native op unavailable")
+    np_ds = IndexedDataset(prefix, use_native=False)
+    nat = NativePrefetchLoader(nat_ds, batch_size=4, seq_len=32)
+    ref = NativePrefetchLoader(np_ds, batch_size=4, seq_len=32)
+    n = nat.n_samples
+    batches_for_3_epochs = (3 * n) // 4 + 2
+    for i in range(batches_for_3_epochs):
+        np.testing.assert_array_equal(
+            next(nat), next(ref),
+            err_msg="native/numpy order diverged at batch {} "
+                    "(~epoch {})".format(i, (i * 4) // n))
+    nat.close()
+    ref.close()
+    nat_ds.close()
+    np_ds.close()

@@ -100,7 +100,11 @@ class PipelineEngine(DeepSpeedEngine):
         return apply_fn
 
     # -------------------------------------------------------------- pipeline
-    def _pipeline_forward_fn(self):
+    def _pipeline_forward_fn(self, train=True):
+        """``train=False`` builds the forward-only variant for eval_batch
+        (reference InferenceSchedule, schedule.py:129-179): same fill/drain
+        pipe loop and stage memory partitioning, but no rng threading into
+        the stage bodies (dropout off)."""
         module = self.pipe_module
         num_stages = self.num_stages
         M = self.micro_batches
@@ -108,13 +112,19 @@ class PipelineEngine(DeepSpeedEngine):
 
         compute_dtype = self.compute_dtype
 
+        # per-stage REAL layer counts (ragged partitions pad to the deepest
+        # stage; the padded slots are skipped by depth inside the stage scan)
+        stage_depths = jnp.asarray(module.stage_depths, jnp.int32)
+
         def pipeline_losses(params, inputs_stack, labels_stack, rng):
             """(M, ...) microbatch stacks -> (M,) per-microbatch losses."""
 
-            def shard_fn(body_params, other_params, inputs, labels, rng):
+            def shard_fn(body_params, depths, other_params, inputs, labels,
+                         rng):
                 # body_params leaves: (1, layers_per_stage, ...) local stage
                 local_body = jax.tree_util.tree_map(
                     lambda t: t[0], body_params)
+                depth = depths[0]
                 stage = jax.lax.axis_index(PIPE_AXIS)
                 total_steps = M + num_stages - 1
 
@@ -141,8 +151,10 @@ class PipelineEngine(DeepSpeedEngine):
                         lambda e: jax.lax.dynamic_index_in_dim(
                             e, m_c, axis=0, keepdims=False), embeds)
                     x = jnp.where(stage == 0, x_first, recv)
-                    step_rng = jax.random.fold_in(rng, t * num_stages + stage)
-                    y = module.apply_body_stage(local_body, x, rng=step_rng)
+                    step_rng = (jax.random.fold_in(rng, t * num_stages + stage)
+                                if train else None)
+                    y = module.apply_body_stage(local_body, x, rng=step_rng,
+                                                depth=depth)
                     # last stage stores y for microbatch m when valid; the
                     # output head + loss run ONCE over the M collected
                     # outputs after the loop, not per pipeline step.
@@ -191,12 +203,13 @@ class PipelineEngine(DeepSpeedEngine):
 
             return jax.shard_map(
                 shard_fn, mesh=mesh,
-                in_specs=(body_leaves_spec, other_spec, in_spec_batch,
-                          in_spec_labels, P()),
+                in_specs=(body_leaves_spec, P(PIPE_AXIS), other_spec,
+                          in_spec_batch, in_spec_labels, P()),
                 out_specs=P(),
                 axis_names={PIPE_AXIS},
                 check_vma=False,
-            )(params["body"], other, inputs_stack, labels_stack, rng)
+            )(params["body"], stage_depths, other, inputs_stack,
+              labels_stack, rng)
 
         return pipeline_losses
 
@@ -264,26 +277,32 @@ class PipelineEngine(DeepSpeedEngine):
         return mean_loss
 
     def eval_batch(self, data_iter=None, batch=None):
-        """Forward-only evaluation using the sequential (reference-semantics)
-        program (reference eval_batch :320)."""
+        """Forward-only evaluation THROUGH the pipe loop (reference
+        InferenceSchedule, schedule.py:129-179): each stage touches only
+        its own layers, so eval keeps the pipeline's memory partitioning —
+        a model too big for one stage's budget still evaluates. Dropout is
+        off (no rng reaches the stage bodies)."""
         if batch is None:
             assert data_iter is not None
             batch = self._stack_microbatches(data_iter)
         batch = self._to_device_stacked(batch)
         inputs_stack, labels_stack = batch
 
-        def eval_fn(params, inputs_stack, labels_stack):
-            def one(m_loss, xs):
-                inputs, labels = xs
-                loss = self.model.apply_fn(params, inputs, labels)
-                return m_loss + loss, None
-            total, _ = jax.lax.scan(
-                one, jnp.asarray(0.0, jnp.float32),
-                (inputs_stack, labels_stack))
-            return total / self.micro_batches
+        def build():
+            pipeline_losses = self._pipeline_forward_fn(train=False)
 
-        fn = self._get_jit("pipe_eval", lambda: eval_fn)
-        return fn(self.state["params"], inputs_stack, labels_stack)
+            def eval_fn(params, inputs_stack, labels_stack, rng):
+                losses = pipeline_losses(params, inputs_stack, labels_stack,
+                                         rng)
+                return jnp.mean(losses)
+
+            return eval_fn
+
+        fn = self._get_jit("pipe_eval", build)
+        # rng operand kept for a stable pipeline_losses signature; unused
+        # when train=False
+        return fn(self.state["params"], inputs_stack, labels_stack,
+                  jax.random.PRNGKey(0))
 
     def is_gradient_accumulation_boundary(self):
         return True
@@ -293,8 +312,16 @@ class PipelineEngine(DeepSpeedEngine):
                         save_latest=True):
         """Engine checkpoint + per-layer body files
         (reference pipe/module.py:536-546: layer_NN-model_00-model_states.pt
-        written so stages can be re-partitioned on load)."""
+        written so stages can be re-partitioned on load). Only REAL layers
+        are written — ragged partitions' padded slots are skipped. The
+        stage layout (parts) rides along in the main state dict so load can
+        re-partition a ragged checkpoint exactly."""
         from .. import checkpointing as ckpt
+        client_state = dict(client_state or {})
+        client_state["pipe_layout"] = {
+            "parts": list(self.pipe_module.parts),
+            "layers_per_stage": self.pipe_module.layers_per_stage,
+        }
         ok = super().save_checkpoint(save_dir, tag=tag,
                                      client_state=client_state,
                                      save_latest=save_latest)
@@ -302,34 +329,68 @@ class PipelineEngine(DeepSpeedEngine):
             return ok
         tag = self._get_ckpt_tag(tag)
         body = ckpt.tree_to_numpy(self.state["params"]["body"])
-        S = self.pipe_module.num_stages
-        L = self.pipe_module.layers_per_stage
-        for layer_id in range(S * L):
-            s, l = divmod(layer_id, L)
+        module = self.pipe_module
+        for layer_id in range(len(module.body_layers)):
+            s, l = self._global_to_slot(module, layer_id)
             layer_tree = jax.tree_util.tree_map(lambda x: x[s][l], body)
             ckpt.save_state_dict(
                 ckpt.layer_ckpt_name(save_dir, tag, layer_id), layer_tree)
         return ok
 
+    @staticmethod
+    def _global_to_slot(module, layer_id):
+        """Global body-layer id -> (stage, slot) under the module's parts."""
+        parts = module.parts
+        for s in range(module.num_stages):
+            if parts[s] <= layer_id < parts[s + 1]:
+                return s, layer_id - parts[s]
+        raise IndexError(layer_id)
+
     def _adapt_state_dict(self, sd):
-        """Re-partition a checkpoint written at a different stage count:
-        body leaves are stacked (S, L, ...) in global layer order, so
-        re-sharding across stages is a reshape (the reference re-reads the
-        per-layer files; both layouts are written)."""
-        S = self.pipe_module.num_stages
-        L = self.pipe_module.layers_per_stage
+        """Re-partition a checkpoint written at a different stage layout.
+
+        Body leaves are stacked (S_old, L_old, ...). With the saved
+        ``pipe_layout`` (parts written at save time) the old stack is
+        unpadded into global layer order and re-padded under THIS module's
+        parts — exact for ragged layouts. Checkpoints without the layout
+        key (equal-stage era) fall back to the pure reshape."""
+        module = self.pipe_module
+        S, L = module.num_stages, module.layers_per_stage
+        old = sd.get("pipe_layout")
+
+        def restack(leaf):
+            if not (hasattr(leaf, "shape") and len(leaf.shape) >= 2):
+                return leaf
+            if old is not None:
+                o_parts = list(old["parts"])
+                o_L = int(old["layers_per_stage"])
+                o_S = len(o_parts) - 1
+                if (leaf.shape[0], leaf.shape[1]) != (o_S, o_L):
+                    return leaf
+                # unpad to the global layer list...
+                layers = [leaf[s, i - o_parts[s]]
+                          for s in range(o_S)
+                          for i in range(o_parts[s], o_parts[s + 1])]
+                if len(layers) != module.parts[-1]:
+                    return leaf
+                # ...and re-pad under the new parts (padded slots repeat the
+                # stage's first layer, matching _init_params)
+                slots = []
+                for s in range(S):
+                    stage = layers[module.parts[s]:module.parts[s + 1]]
+                    stage = stage + [stage[0]] * (L - len(stage))
+                    slots.extend(stage)
+                return np.stack(slots).reshape((S, L) + leaf.shape[2:])
+            if leaf.shape[0] * leaf.shape[1] == S * L and \
+                    (leaf.shape[0], leaf.shape[1]) != (S, L):
+                return leaf.reshape((S, L) + leaf.shape[2:])
+            return leaf
 
         def reshape_body(tree):
             if not isinstance(tree, dict) or "body" not in tree:
                 return tree
-            def fix(leaf):
-                if hasattr(leaf, "shape") and len(leaf.shape) >= 2 and \
-                        leaf.shape[0] * leaf.shape[1] == S * L and \
-                        (leaf.shape[0], leaf.shape[1]) != (S, L):
-                    return leaf.reshape((S, L) + leaf.shape[2:])
-                return leaf
             out = dict(tree)
-            out["body"] = jax.tree_util.tree_map(fix, tree["body"])
+            out["body"] = jax.tree_util.tree_map(restack, tree["body"])
             return out
 
         sd = dict(sd)

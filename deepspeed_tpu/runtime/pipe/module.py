@@ -212,26 +212,36 @@ class PipelineModule:
         assert body_idx == list(range(body_idx[0], body_idx[-1] + 1)), \
             "pipelined body must be contiguous"
         n_body = len(body_idx)
-        assert n_body % self.num_stages == 0, \
-            "pipelined body of {} layers must divide num_stages={} (pad with " \
-            "identity layers or change partitioning)".format(n_body,
-                                                             self.num_stages)
+        assert n_body >= self.num_stages, \
+            "pipelined body of {} layers is shallower than num_stages={}" \
+            .format(n_body, self.num_stages)
         self.body_start = body_idx[0]
         self.body_end = body_idx[-1] + 1
-        self.layers_per_stage = n_body // self.num_stages
         self.pre_layers = entries[:self.body_start]
         self.body_layers = entries[self.body_start:self.body_end]
         self.post_layers = entries[self.body_end:]
 
-        # parts[i] = first body-layer of stage i (reference partition
-        # bookkeeping; contiguous equal split since the body is homogeneous —
-        # partition_balanced reduces to uniform for equal weights)
+        # parts[i] = first body-layer of stage i (reference partitioning,
+        # module.py:348-403): 'parameters' balances by trainable-parameter
+        # weight, everything else splits uniformly. Stages may come out
+        # UNEQUAL — stage s owns [parts[s], parts[s+1]). The stacked layout
+        # pads every stage to the deepest one; apply_body_stage() skips the
+        # padded slots by depth, so ragged partitions execute correctly
+        # while keeping the one-program SPMD pipeline.
         if self.partition_method == "parameters":
             weights = [self._layer_weight(e) for e in self.body_layers]
             self.parts = partition_balanced(weights, self.num_stages)
         else:
             self.parts = partition_uniform(len(self.body_layers),
                                            self.num_stages)
+        self.stage_depths = np.array(
+            [self.parts[s + 1] - self.parts[s]
+             for s in range(self.num_stages)], dtype=np.int32)
+        assert int(self.stage_depths.min()) >= 1, \
+            "partitioning produced an empty stage: parts={}".format(self.parts)
+        # max depth = stacked slot count; equal partitions keep the old
+        # meaning (body/num_stages) exactly
+        self.layers_per_stage = int(self.stage_depths.max())
 
     def _init_params(self):
         """Init: tied + pre/post params as plain trees; body params stacked
@@ -267,11 +277,21 @@ class PipelineModule:
             else:
                 key, sub = jax.random.split(key)
             body_param_list.append(init_entry(e, sub))
-        # stack: (num_stages, layers_per_stage, *param_shape)
+        # stack: (num_stages, layers_per_stage, *param_shape). Ragged
+        # partitions pad each stage to the deepest one; padded slots hold a
+        # COPY of the stage's first real layer (not zeros) so any layer's
+        # apply stays finite on them — apply_body_stage discards their
+        # outputs by depth, and the discarding select zeroes their grads.
+        slot_params = []
+        for s in range(self.num_stages):
+            start, stop = self.parts[s], self.parts[s + 1]
+            stage = body_param_list[start:stop]
+            stage += [stage[0]] * (self.layers_per_stage - len(stage))
+            slot_params.extend(stage)
         stacked = jax.tree_util.tree_map(
             lambda *leaves: jnp.stack(leaves).reshape(
                 (self.num_stages, self.layers_per_stage) + leaves[0].shape),
-            *body_param_list)
+            *slot_params)
         self.body_params = stacked
 
         self.params = {
@@ -331,11 +351,16 @@ class PipelineModule:
         except (TypeError, ValueError):
             return False
 
-    def apply_body_stage(self, stage_params, x, rng=None):
-        """Apply one stage's layers_per_stage body layers; ``stage_params``
-        has leading dim layers_per_stage. lax.scan keeps every stage the same
-        program regardless of depth; ``activation_checkpoint_interval`` N
-        remats every N layers (reference forward :292-346)."""
+    def apply_body_stage(self, stage_params, x, rng=None, depth=None):
+        """Apply one stage's body layers; ``stage_params`` has leading dim
+        layers_per_stage. lax.scan keeps every stage the same program
+        regardless of depth; ``activation_checkpoint_interval`` N remats
+        every N layers (reference forward :292-346).
+
+        ``depth`` (int scalar, static or traced): number of REAL layers in
+        this stage — slots past it are ragged-partition padding whose
+        output is discarded (the select also zeroes their grads). None
+        means the stage is full."""
         proto_layer = self.body_layers[0][2]
         L = self.layers_per_stage
         interval = self.activation_checkpoint_interval
@@ -346,7 +371,11 @@ class PipelineModule:
             kwargs = {}
             if thread_rng:
                 kwargs["rng"] = jax.random.fold_in(rng, i)
-            return (proto_layer.apply(layer_params, x, **kwargs), i + 1), None
+            y = proto_layer.apply(layer_params, x, **kwargs)
+            if depth is not None:
+                y = jax.tree_util.tree_map(
+                    lambda yl, xl: jnp.where(i < depth, yl, xl), y, x)
+            return (y, i + 1), None
 
         # Clamp interval to the stage depth (interval >= L == remat the whole
         # stage as one chunk); non-divisor intervals fall back to per-layer
@@ -383,7 +412,11 @@ class PipelineModule:
                     kwargs["rng"] = jax.random.fold_in(rng, i)
                 apply = jax.checkpoint(
                     lambda p, x: proto_layer.apply(p, x, **kwargs))
-                return (apply(layer_params, x), i + 1), None
+                y = apply(layer_params, x)
+                if depth is not None:
+                    y = jax.tree_util.tree_map(
+                        lambda yl, xl: jnp.where(i < depth, yl, xl), y, x)
+                return (y, i + 1), None
             (x, _), _ = jax.lax.scan(one_remat, (x, jnp.asarray(0)),
                                      stage_params)
             return x
@@ -397,7 +430,8 @@ class PipelineModule:
         x = self.apply_pre(params, x, **kwargs)
         for s in range(self.num_stages):
             x = self.apply_body_stage(
-                jax.tree_util.tree_map(lambda t: t[s], params["body"]), x)
+                jax.tree_util.tree_map(lambda t: t[s], params["body"]), x,
+                depth=int(self.stage_depths[s]))
         x = self.apply_post(params, x, **kwargs)
         return x
 
@@ -439,6 +473,7 @@ class PipelineModule:
         return {
             "num_stages": self.num_stages,
             "layers_per_stage": self.layers_per_stage,
+            "stage_depths": self.stage_depths.tolist(),
             "pre": len(self.pre_layers),
             "post": len(self.post_layers),
             "parts": self.parts,

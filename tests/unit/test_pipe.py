@@ -64,10 +64,16 @@ def test_pipeline_module_partitioning():
     assert w.shape == (2, 4, DIM, DIM)
 
 
-def test_pipeline_body_must_divide():
-    with pytest.raises(AssertionError):
-        PipelineModule(layers=[LayerSpec(TanhLinear, DIM) for _ in range(5)],
-                       num_stages=2, loss_fn=mse_loss)
+def test_pipeline_ragged_partition():
+    """A body that does not divide the stage count partitions raggedly:
+    stage depths sum to the body and differ by at most one (uniform)."""
+    net = PipelineModule(layers=[LayerSpec(TanhLinear, DIM) for _ in range(5)],
+                         num_stages=2, loss_fn=mse_loss)
+    assert sorted(net.stage_depths.tolist()) == [2, 3]
+    assert net.layers_per_stage == 3          # padded to the deepest stage
+    # stacked body carries the padded slot
+    leaf = jax.tree_util.tree_leaves(net.params["body"])[0]
+    assert leaf.shape[:2] == (2, 3)
 
 
 def test_pipeline_matches_sequential_training():
@@ -155,3 +161,84 @@ def test_pipeline_eval_batch():
     assert ev1 == pytest.approx(tr, rel=5e-2, abs=5e-3)
     ev2 = float(engine.eval_batch(batch=(x, y)))
     assert ev2 < ev1  # training improved the model
+
+
+def test_ragged_pipeline_matches_sequential_training():
+    """Unequal-depth stages (5 layers over 2 stages -> 3+2) must train
+    identically to a plain-engine run of the same 5-layer network — the
+    milestone-5-class check for the ragged partitioning path."""
+    M = 4
+    net = PipelineModule(layers=[LayerSpec(TanhLinear, DIM) for _ in range(5)],
+                         num_stages=2, loss_fn=mse_loss, num_dp=4)
+    depths = net.stage_depths.tolist()
+    assert sorted(depths) == [2, 3]
+    parts = net.parts
+
+    # reference params: the REAL layers only, in global order
+    ref_body = {
+        "w": jnp.stack([net.params["body"]["w"][s, i - parts[s]]
+                        for s in range(2)
+                        for i in range(parts[s], parts[s + 1])]),
+        "b": jnp.stack([net.params["body"]["b"][s, i - parts[s]]
+                        for s in range(2)
+                        for i in range(parts[s], parts[s + 1])]),
+    }
+
+    pipe_engine, _, _, _ = deepspeed.initialize(
+        model=net, config_params=pipe_config(gas=M))
+
+    def ref_apply(params, x, y):
+        def one(x, lp):
+            return TanhLinear(DIM).apply(lp, x), None
+        out, _ = jax.lax.scan(one, x, params)
+        return mse_loss(out, y)
+
+    ref_engine, _, _, _ = deepspeed.initialize(
+        model=Model(ref_apply, ref_body),
+        config_params=pipe_config(gas=M))
+
+    batch_per_micro = 16
+    for step in range(3):
+        x, y = make_batches(M, batch_per_micro, seed=step)
+        pipe_loss = float(pipe_engine.train_batch(batch=(x, y)))
+        ref_losses = []
+        for m in range(M):
+            loss = ref_engine(x[m], y[m])
+            ref_engine.backward(loss)
+            ref_engine.step()
+            ref_losses.append(float(loss))
+        assert pipe_loss == pytest.approx(np.mean(ref_losses), rel=2e-2,
+                                          abs=2e-3)
+
+    # trained REAL layers match the reference layer-for-layer; padded slots
+    # received zero gradient (only decayless Adam state drift is possible)
+    pipe_body = pipe_engine.get_params()["body"]
+    for name in ("w", "b"):
+        trained = np.stack([np.asarray(pipe_body[name][s, i - parts[s]],
+                                       np.float32)
+                            for s in range(2)
+                            for i in range(parts[s], parts[s + 1])])
+        np.testing.assert_allclose(
+            trained, np.asarray(ref_engine.get_params()[name], np.float32),
+            rtol=2e-2, atol=2e-2)
+
+
+def test_pipelined_eval_matches_sequential():
+    """eval_batch runs THROUGH the pipe loop (InferenceSchedule parity);
+    its loss must equal the sequential-apply loss exactly, including on a
+    ragged (2+1) partition."""
+    M = 3
+    net = PipelineModule(layers=[LayerSpec(TanhLinear, DIM) for _ in range(3)],
+                         num_stages=2, loss_fn=mse_loss, num_dp=4)
+    engine, _, _, _ = deepspeed.initialize(model=net,
+                                           config_params=pipe_config(gas=M))
+    x, y = make_batches(M, 16, seed=5)
+    ev = float(engine.eval_batch(batch=(x, y)))
+
+    params = engine.state["params"]
+    seq_losses = [
+        float(mse_loss(net.apply_sequential(
+            jax.tree_util.tree_map(lambda t: jnp.asarray(t), params),
+            jnp.asarray(x[m], params["body"]["w"].dtype)), y[m]))
+        for m in range(M)]
+    assert ev == pytest.approx(np.mean(seq_losses), rel=1e-3, abs=1e-4)

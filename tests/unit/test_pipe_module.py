@@ -77,9 +77,12 @@ def test_type_regex_no_match_raises():
                        partition_method="type:NoSuchLayer")
 
 
-def test_body_must_divide_stages():
-    with pytest.raises(AssertionError, match="divide"):
-        PipelineModule(_specs(n_blocks=3), num_stages=2)
+def test_ragged_body_partitions():
+    net = PipelineModule(_specs(n_blocks=3), num_stages=2)
+    assert sorted(net.stage_depths.tolist()) == [1, 2]
+    assert net.parts[-1] == 3
+    # sequential apply still runs every real layer exactly once
+    assert net.layers_per_stage == 2
 
 
 def test_tied_layer_spec_shares_params():

@@ -18,9 +18,16 @@ import jax
 def tree_to_numpy(tree):
     def to_np(x):
         if isinstance(x, jax.Array):
-            if hasattr(x, "is_fully_replicated") and not x.is_fully_addressable:
+            if not x.is_fully_addressable:
+                if getattr(x.sharding, "is_fully_replicated", False):
+                    # every device shard IS the global value
+                    return np.asarray(x.addressable_data(0))
                 from jax.experimental import multihost_utils
-                return np.asarray(multihost_utils.process_allgather(x))
+                # tiled: the shards tile the global shape (the non-tiled
+                # mode stacks a leading processes dim, which is not what a
+                # checkpoint of a sharded leaf means)
+                return np.asarray(
+                    multihost_utils.process_allgather(x, tiled=True))
             return np.asarray(x)
         return x
     return jax.tree_util.tree_map(to_np, tree)

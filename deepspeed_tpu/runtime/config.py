@@ -418,6 +418,7 @@ class DeepSpeedConfig(object):
 
         self._initialize_params(self._param_dict)
         self._configure_train_batch_size()
+        self._validate_known_keys()
         self._do_sanity_check()
 
     def _configure_elasticity(self):
@@ -567,6 +568,86 @@ class DeepSpeedConfig(object):
     def _configure_train_batch_size(self):
         self._set_batch_related_parameters()
         self._batch_assertion()
+
+    # The accepted config surface. docs/_pages/config-json.md documents
+    # exactly these keys; _validate_known_keys keeps doc and parser from
+    # drifting (unknown keys warn by default, raise under
+    # "config_validation": "strict", silent under "ignore").
+    KNOWN_TOP_LEVEL_KEYS = {
+        "train_batch_size", "train_micro_batch_size_per_gpu",
+        "gradient_accumulation_steps", "optimizer", "scheduler",
+        "fp16", "bf16", "amp", "gradient_clipping",
+        "zero_optimization", "zero_allow_untested_optimizer",
+        "steps_per_print", "wall_clock_breakdown", "dump_state",
+        "memory_breakdown", "tensorboard", "flops_profiler",
+        "activation_checkpointing", "sparse_attention",
+        "progressive_layer_drop", "elasticity", "checkpoint",
+        "sparse_gradients", "prescale_gradients",
+        "gradient_predivide_factor", "disable_allgather", "fp32_allreduce",
+        "vocabulary_size", "config_validation",
+        # deprecated boolean form + its companion (read_zero_config_deprecated)
+        "allgather_size",
+    }
+    KNOWN_SUBDICT_KEYS = {
+        "fp16": {"enabled", "loss_scale", "initial_scale_power",
+                 "loss_scale_window", "hysteresis", "min_loss_scale"},
+        "bf16": {"enabled"},
+        "zero_optimization": {
+            "stage", "allgather_partitions", "allgather_bucket_size",
+            "overlap_comm", "reduce_scatter",
+            "reduce_bucket_size", "contiguous_gradients", "cpu_offload",
+            "cpu_offload_params", "cpu_offload_use_pin_memory",
+            "sub_group_size", "stage3_prefetch_bucket_size",
+            "stage3_max_live_parameters", "stage3_max_reuse_distance",
+            "stage3_param_persistence_threshold", "elastic_checkpoint",
+            "load_from_fp32_weights",
+            "stage3_gather_fp16_weights_on_model_save"},
+        "flops_profiler": {"enabled", "profile_step", "module_depth",
+                           "top_modules", "detailed"},
+        "activation_checkpointing": {
+            "partition_activations", "contiguous_memory_optimization",
+            "cpu_checkpointing", "number_checkpoints",
+            "synchronize_checkpoint_boundary", "profile"},
+        "progressive_layer_drop": {"enabled", "theta", "gamma"},
+        "tensorboard": {"enabled", "output_path", "job_name"},
+        "checkpoint": {"tag_validation"},
+        "elasticity": {"enabled", "max_train_batch_size",
+                       "micro_batch_sizes", "min_gpus", "max_gpus",
+                       "min_time", "prefer_larger_batch",
+                       "ignore_non_elastic_batch_info", "version"},
+        # optimizer/scheduler "params" and "amp" bodies are free-form
+        # passthrough (per-type / apex-parity); sparse_attention keys vary
+        # by mode and are validated by the layout builders themselves
+    }
+
+    def _validate_known_keys(self):
+        mode = str(self._param_dict.get("config_validation", "warn")).lower()
+        if mode not in ("warn", "strict", "ignore"):
+            raise DeepSpeedConfigError(
+                "config_validation must be one of warn|strict|ignore, got "
+                "{!r}".format(mode))
+        if mode == "ignore":
+            return
+        problems = []
+        for key in self._param_dict:
+            if key not in self.KNOWN_TOP_LEVEL_KEYS:
+                problems.append("unknown top-level key {!r}".format(key))
+        for section, known in self.KNOWN_SUBDICT_KEYS.items():
+            sub = self._param_dict.get(section)
+            if not isinstance(sub, dict):
+                continue
+            for key in sub:
+                if key not in known:
+                    problems.append("unknown key {!r} in {!r}".format(
+                        key, section))
+        if not problems:
+            return
+        msg = ("DeepSpeedConfig: {} (the accepted surface is documented in "
+               "docs/_pages/config-json.md; set \"config_validation\": "
+               "\"ignore\" to bypass)").format("; ".join(problems))
+        if mode == "strict":
+            raise DeepSpeedConfigError(msg)
+        logger.warning(msg)
 
     def _do_sanity_check(self):
         self._do_error_check()

@@ -51,6 +51,28 @@ BACKWARD_MICRO_TIMER = "backward_microstep"
 STEP_MICRO_TIMER = "step_microstep"
 
 
+def _shard_key(index):
+    """Hashable (and checkpoint-serializable) key for a shard's index
+    tuple-of-slices."""
+    return tuple((s.start, s.stop, s.step) for s in index)
+
+
+def _key_to_index(key):
+    return tuple(slice(a, b, c) for a, b, c in key)
+
+
+def _unique_shard_indices(arr):
+    """This process's unique addressable shard indices of a jax array
+    (replicated placements collapse to one entry)."""
+    seen, out = set(), []
+    for sh in arr.addressable_shards:
+        key = _shard_key(sh.index)
+        if key not in seen:
+            seen.add(key)
+            out.append(sh.index)
+    return out
+
+
 class DeepSpeedEngine:
     """Wraps a model to provide distributed data-parallel (+ZeRO) training on
     a TPU mesh with the DeepSpeed train API."""
@@ -295,37 +317,46 @@ class DeepSpeedEngine:
             # master + Adam moments live in HOST memory as numpy; HBM only
             # holds compute-dtype params + fp32 grad accumulators. The
             # optimizer step runs on host cores (_host_apply_step).
-            if jax.process_count() > 1:
-                # acc_grads span processes; the host gather/step would need
-                # per-process shard handling not wired up yet
-                raise NotImplementedError(
-                    "zero_optimization.cpu_offload is not supported in "
-                    "multi-process runs yet")
+            #
+            # Multi-process (reference stage2.py:780-908 distributed
+            # offload): every process keeps only the host shards matching
+            # its ADDRESSABLE acc_grad shards (the ZeRO grad partition), so
+            # host memory, PCIe transfer and the host Adam all split
+            # process-ways. Single-process is the degenerate one-shard (or
+            # all-shards) case of the same machinery.
+            #
             # np.array(copy=True): np.asarray of a jax array is a READ-ONLY
             # view aliasing the runtime's buffer — the in-place host Adam
             # would crash (or scribble on JAX-owned memory via the C ptr)
             master_np = jax.tree_util.tree_map(
                 lambda p: np.array(p, dtype=np.float32, copy=True),
                 self.model.params)
-            self.host_state = {
-                "master": master_np,
-                # static for the engine's life; cached for the per-step H2D
-                "param_shardings": plan.tree_shardings(master_np, "param"),
-                "opt": {
-                    "step": 0,
-                    "exp_avg": jax.tree_util.tree_map(
-                        lambda p: np.zeros(p.shape, np.float32), master_np),
-                    "exp_avg_sq": jax.tree_util.tree_map(
-                        lambda p: np.zeros(p.shape, np.float32), master_np),
-                },
-            }
-            param_sh = self.host_state["param_shardings"]
+            param_sh = plan.tree_shardings(master_np, "param")
             grad_sh = plan.tree_shardings(master_np, "grad")
             compute_params = jax.tree_util.tree_map(
                 self._host_to_device, master_np, param_sh)
             acc_grads = jax.tree_util.tree_map(
                 lambda p, s: jax.device_put(
                     jnp.zeros(p.shape, jnp.float32), s), master_np, grad_sh)
+            # flat per-leaf shard lists [(index, master, exp_avg,
+            # exp_avg_sq)], one entry per UNIQUE addressable shard index of
+            # the grad sharding (replicated leaves dedupe to one full-size
+            # entry); aligned with tree_flatten(acc_grads)
+            flat_master, treedef = jax.tree_util.tree_flatten(master_np)
+            flat_acc = treedef.flatten_up_to(acc_grads)
+            shard_leaves = [
+                [(idx, np.array(p[idx], dtype=np.float32, copy=True),
+                  np.zeros(p[idx].shape, np.float32),
+                  np.zeros(p[idx].shape, np.float32))
+                 for idx in _unique_shard_indices(g)]
+                for p, g in zip(flat_master, flat_acc)]
+            self.host_state = {
+                "shard_leaves": shard_leaves,
+                "treedef": treedef,
+                "step": 0,
+                # static for the engine's life; cached for the per-step H2D
+                "param_shardings": param_sh,
+            }
             self.state = {
                 "params": compute_params,
                 "master": None,
@@ -651,76 +682,126 @@ class DeepSpeedEngine:
                                 self.global_samples)
         self.monitor.flush()
 
+    def _offload_check_fn(self):
+        """(all-finite, UNSCALED sum of squares) over the GLOBAL
+        acc_grads — a tiny jitted reduction whose replicated outputs every
+        process can fetch, replacing a host-side full-gradient scan (which
+        a process with only its shards could not do). The squares are taken
+        AFTER unscaling so a large loss scale cannot push a finite
+        gradient's square past fp32 range; a non-finite sumsq that survives
+        the elementwise check is treated as overflow by the caller."""
+
+        def check(grads, inv_scale):
+            leaves = jax.tree_util.tree_leaves(grads)
+            finite = jnp.bool_(True)
+            sumsq = jnp.float32(0)
+            for g in leaves:
+                finite = jnp.logical_and(finite, jnp.isfinite(g).all())
+                sumsq = sumsq + jnp.sum(
+                    (g.astype(jnp.float32) * inv_scale) ** 2)
+            return finite, sumsq
+
+        return check
+
     def _host_apply_step(self):
-        """ZeRO-Offload optimizer step: grads D2H, host Adam on the numpy
-        master/moments, updated params H2D (reference stage2.py:780-908 +
-        csrc/adam/cpu_adam.cpp overlap streams; the jit boundary is the
-        stream boundary here)."""
+        """ZeRO-Offload optimizer step, shard-wise (reference
+        stage2.py:780-908 + csrc/adam/cpu_adam.cpp): each process D2Hs only
+        its ADDRESSABLE acc_grad shards, runs the host Adam on its host
+        master/moment shards, H2Ds the updated shards and reshards to the
+        param layout on device (the all-gather of updated partitions rides
+        ICI, not PCIe). Overflow/grad-norm are global jitted reductions so
+        every process agrees without owning every gradient."""
         hyper = self._hyper()
         scaler = self.state["scaler"]
         cur_scale = float(scaler.cur_scale)
         inv_scale = 1.0 / cur_scale
         clip = self.gradient_clipping()
 
-        flat_g, treedef = jax.tree_util.tree_flatten(self.state["acc_grads"])
-        # D2H; np.array = writable host copies (np.asarray views are RO)
-        grads_np = [np.array(g, dtype=np.float32) for g in flat_g]
-        overflow = not all(np.isfinite(g).all() for g in grads_np)
+        check = self._get_jit("offload_check", self._offload_check_fn)
+        finite, sumsq = check(self.state["acc_grads"],
+                              np.float32(inv_scale))
+        # a sumsq that overflowed despite finite elements is an overflow
+        # too: clipping against an inf norm would silently zero the update
+        overflow = (not bool(finite)) or not np.isfinite(float(sumsq))
 
         grad_norm = 0.0
         if not overflow:
-            sq = sum(float((g.astype(np.float64) ** 2).sum())
-                     for g in grads_np) * (inv_scale ** 2)
-            grad_norm = float(np.sqrt(sq))
+            grad_norm = float(np.sqrt(float(sumsq)))
             coef = inv_scale
             if clip > 0 and grad_norm > clip:
                 coef *= clip / (grad_norm + 1e-6)
 
-            opt = self.host_state["opt"]
-            opt["step"] += 1
-            step = opt["step"]
+            hs = self.host_state
+            hs["step"] += 1
+            step = hs["step"]
             beta1, beta2 = hyper["beta1"], hyper["beta2"]
             bias_correction = getattr(self.optimizer, "bias_correction", True)
             bc1 = 1.0 - beta1 ** step if bias_correction else 1.0
             bc2 = 1.0 - beta2 ** step if bias_correction else 1.0
             adam_w = 1 if getattr(self.optimizer, "adam_w_mode", True) else 0
 
-            flat_m = treedef.flatten_up_to(opt["exp_avg"])
-            flat_v = treedef.flatten_up_to(opt["exp_avg_sq"])
-            flat_master = treedef.flatten_up_to(self.host_state["master"])
+            flat_acc = hs["treedef"].flatten_up_to(self.state["acc_grads"])
             lib = self._offload_lib()
-            for p, g, m, v in zip(flat_master, grads_np, flat_m, flat_v):
-                g *= coef  # unscale (+clip) in place on the host copy
-                if lib is not None:
-                    lib.ds_cpu_adam_step(
-                        p.ctypes.data, g.ctypes.data, m.ctypes.data,
-                        v.ctypes.data, p.size, hyper["lr"], beta1, beta2,
-                        hyper["eps"], hyper["weight_decay"],
-                        bc1, bc2, adam_w)
-                else:
-                    if not adam_w and hyper["weight_decay"]:
-                        # classic-L2 mode folds decay into the gradient
-                        # (matches csrc/cpu_adam.cpp adam_w_mode=0)
-                        g += hyper["weight_decay"] * p
-                    np.multiply(m, beta1, out=m)
-                    m += (1.0 - beta1) * g
-                    np.multiply(v, beta2, out=v)
-                    v += (1.0 - beta2) * np.square(g)
-                    update = (m / bc1) / (np.sqrt(v / bc2) + hyper["eps"])
-                    if adam_w:
-                        update += hyper["weight_decay"] * p
-                    p -= hyper["lr"] * update
+            for g_arr, shards in zip(flat_acc, hs["shard_leaves"]):
+                local = {_shard_key(sh.index): sh.data
+                         for sh in g_arr.addressable_shards}
+                for idx, p, m, v in shards:
+                    # D2H of this shard only; writable copy for in-place ops
+                    g = np.array(local[_shard_key(idx)], dtype=np.float32)
+                    g *= coef  # unscale (+clip) in place on the host copy
+                    if lib is not None:
+                        lib.ds_cpu_adam_step(
+                            p.ctypes.data, g.ctypes.data, m.ctypes.data,
+                            v.ctypes.data, p.size, hyper["lr"], beta1, beta2,
+                            hyper["eps"], hyper["weight_decay"],
+                            bc1, bc2, adam_w)
+                    else:
+                        if not adam_w and hyper["weight_decay"]:
+                            # classic-L2 mode folds decay into the gradient
+                            # (matches csrc/cpu_adam.cpp adam_w_mode=0)
+                            g += hyper["weight_decay"] * p
+                        np.multiply(m, beta1, out=m)
+                        m += (1.0 - beta1) * g
+                        np.multiply(v, beta2, out=v)
+                        v += (1.0 - beta2) * np.square(g)
+                        update = (m / bc1) / (np.sqrt(v / bc2) +
+                                              hyper["eps"])
+                        if adam_w:
+                            update += hyper["weight_decay"] * p
+                        p -= hyper["lr"] * update
 
-            # H2D: recast updated master into the compute params
-            self.state["params"] = jax.tree_util.tree_map(
-                self._host_to_device, self.host_state["master"],
-                self.host_state["param_shardings"])
+            self.state["params"] = self._host_shards_to_params(flat_acc)
 
         self.state["acc_grads"] = jax.tree_util.tree_map(
             jnp.zeros_like, self.state["acc_grads"])
         self.state["scaler"] = ls.update_scale(scaler, overflow)
         return {"overflow": overflow, "grad_norm": grad_norm,
                 "loss_scale": cur_scale}
+
+    def _host_shards_to_params(self, flat_acc):
+        """Updated host master shards -> compute params: per leaf, build a
+        grad-sharded global device array from the local shards (shard-wise
+        H2D, compute dtype), then one jitted reshard to the param layout —
+        the cross-process all-gather happens on device."""
+        hs = self.host_state
+        cdtype = np.dtype(self.compute_dtype)
+        flat_params = []
+        for g_arr, shards in zip(flat_acc, hs["shard_leaves"]):
+            by_key = {_shard_key(idx): p for idx, p, _, _ in shards}
+            sharding = g_arr.sharding
+            dev_map = sharding.addressable_devices_indices_map(g_arr.shape)
+            singles = [
+                jax.device_put(np.ascontiguousarray(
+                    by_key[_shard_key(idx)].astype(cdtype)), dev)
+                for dev, idx in dev_map.items()]
+            flat_params.append(jax.make_array_from_single_device_arrays(
+                g_arr.shape, sharding, singles))
+        grad_layout = hs["treedef"].unflatten(flat_params)
+        reshard = self._get_jit(
+            "offload_reshard",
+            lambda: lambda t: t,
+            out_shardings=hs["param_shardings"])
+        return reshard(grad_layout)
 
     def _host_to_device(self, p_np, sharding):
         """Host fp32 leaf -> sharded compute-dtype device array WITHOUT
@@ -1024,13 +1105,41 @@ class DeepSpeedEngine:
 
     def get_master_params(self):
         if self.host_state is not None:
-            return self.host_state["master"]
+            return self._assemble_host_tree(field=1)
         return self.state["master"] if self.mixed_precision \
             else self.state["params"]
 
+    def _assemble_host_tree(self, field):
+        """Full fp32 tree from the host shards (field: 1 master, 2 exp_avg,
+        3 exp_avg_sq). Only possible when this process's shards cover every
+        leaf (single-process, or replicated layouts) — a partitioned
+        multi-process layout raises; the per-process zero checkpoint files
+        own the shards there."""
+        hs = self.host_state
+        flat_acc = hs["treedef"].flatten_up_to(self.state["acc_grads"])
+        leaves = []
+        for g_arr, shards in zip(flat_acc, hs["shard_leaves"]):
+            out = np.empty(g_arr.shape, np.float32)
+            covered = 0
+            for tup in shards:
+                out[tup[0]] = tup[field]
+                covered += int(tup[field].size)
+            if covered < int(np.prod(g_arr.shape)):
+                raise RuntimeError(
+                    "host optimizer state is partitioned across processes; "
+                    "use the per-process zero checkpoint files instead of a "
+                    "gathered view")
+            leaves.append(out)
+        return hs["treedef"].unflatten(leaves)
+
     def _opt_state_view(self):
-        return self.host_state["opt"] if self.host_state is not None \
-            else self.state["opt"]
+        if self.host_state is not None:
+            return {
+                "step": self.host_state["step"],
+                "exp_avg": self._assemble_host_tree(field=2),
+                "exp_avg_sq": self._assemble_host_tree(field=3),
+            }
+        return self.state["opt"]
 
     # --------------------------------------------------------------- profiler
     def _maybe_start_flops_profiler(self):
@@ -1070,11 +1179,18 @@ class DeepSpeedEngine:
         # boundaries; without this the saved value would freeze the
         # unfetched window's drift into the checkpoint
         self._sync_skipped_steps()
+        # partitioned multi-process offload: the gathered master/opt views
+        # are unavailable (each process owns shards); the per-process zero
+        # files below carry the state instead
+        offload_sharded = (self.host_state is not None
+                           and jax.process_count() > 1)
         sd = {
             "module": ckpt.tree_to_numpy(self.state["params"]),
-            "optimizer": ckpt.tree_to_numpy(self._opt_state_view()),
+            "optimizer": None if offload_sharded
+                else ckpt.tree_to_numpy(self._opt_state_view()),
             "master": ckpt.tree_to_numpy(self.get_master_params())
-                if (self.mixed_precision or self.host_state is not None)
+                if ((self.mixed_precision or self.host_state is not None)
+                    and not offload_sharded)
                 else None,
             "scaler": ckpt.tree_to_numpy(
                 {"cur_scale": self.state["scaler"].cur_scale,
@@ -1097,16 +1213,111 @@ class DeepSpeedEngine:
                                         mp_rank=0)
             ckpt.save_state_dict(path, sd)
             logger.info("Saved checkpoint: {}".format(path))
-            if self.zero_optimization():
-                # Optimizer shards file kept separate for layout parity.
-                zpath = ckpt.zero_ckpt_name(save_dir, tag, dp_rank=0)
-                ckpt.save_state_dict(zpath, {
-                    "optimizer_state_dict": sd["optimizer"],
-                    "master": sd["master"],
-                })
-            if save_latest:
-                ckpt.save_latest(save_dir, tag)
+        if offload_sharded:
+            # EVERY process writes its own zero file with its host shards
+            # (reference zero_pp_rank_N layout); keys serialize the shard
+            # index so load re-slots them exactly
+            zpath = ckpt.zero_ckpt_name(save_dir, tag,
+                                        dp_rank=jax.process_index())
+            ckpt.save_state_dict(zpath, {
+                "offload_shards": [
+                    [(_shard_key(idx), p, m, v) for idx, p, m, v in shards]
+                    for shards in self.host_state["shard_leaves"]],
+                "offload_step": self.host_state["step"],
+            })
+        elif is_writer and self.zero_optimization():
+            # Optimizer shards file kept separate for layout parity.
+            zpath = ckpt.zero_ckpt_name(save_dir, tag, dp_rank=0)
+            ckpt.save_state_dict(zpath, {
+                "optimizer_state_dict": sd["optimizer"],
+                "master": sd["master"],
+            })
+        if is_writer and save_latest:
+            ckpt.save_latest(save_dir, tag)
         return True
+
+    def _load_host_state(self, load_dir, tag, sd, load_optimizer_states,
+                         load_from_fp32_weights):
+        """Restore the ZeRO-Offload host shards.
+
+        A checkpoint written by a MULTI-process offload run carries its
+        master/optimizer state ONLY in per-process zero shard files
+        (sd["master"] is None there) — resuming it requires the exact same
+        shard layout (same process count / ZeRO partitioning); a mismatch
+        raises instead of silently resetting state differently per rank.
+        Checkpoints with full gathered trees restore by slicing this
+        process's shard indices out of them."""
+        hs = self.host_state
+        zpath = ckpt.zero_ckpt_name(load_dir, tag,
+                                    dp_rank=jax.process_index())
+        zsd = None
+        if os.path.isfile(zpath):
+            zsd = ckpt.load_state_dict(zpath)
+        sharded_only = sd.get("master") is None and \
+            sd.get("optimizer") is None
+        if zsd is not None and "offload_shards" in zsd:
+            want = [[_shard_key(idx) for idx, *_ in shards]
+                    for shards in hs["shard_leaves"]]
+            got = [[tuple(map(tuple, key)) for key, *_ in shards]
+                   for shards in zsd["offload_shards"]]
+            if want == got:
+                # master always restores from the exact fp32 shards unless
+                # the caller explicitly asked for a half-precision recast;
+                # moments/step only when the optimizer state is wanted
+                recast = not load_from_fp32_weights
+                module_flat = hs["treedef"].flatten_up_to(sd["module"]) \
+                    if recast else None
+                hs["shard_leaves"] = [
+                    [(_key_to_index(key),
+                      np.array(np.asarray(module_flat[i])[_key_to_index(key)],
+                               dtype=np.float32, copy=True) if recast
+                      else np.array(p, np.float32),
+                      np.array(m, np.float32) if load_optimizer_states
+                      else np.zeros(np.shape(p), np.float32),
+                      np.array(v, np.float32) if load_optimizer_states
+                      else np.zeros(np.shape(p), np.float32))
+                     for key, p, m, v in shards]
+                    for i, shards in enumerate(zsd["offload_shards"])]
+                hs["step"] = int(zsd["offload_step"]) \
+                    if load_optimizer_states else 0
+                return
+            if sharded_only:
+                raise RuntimeError(
+                    "offload checkpoint {} was written with a different "
+                    "shard layout (process count / ZeRO partitioning) and "
+                    "has no gathered master to re-slice — resume with the "
+                    "layout it was saved under".format(zpath))
+            logger.warning(
+                "zero shard file %s has a different shard layout; falling "
+                "back to the gathered checkpoint trees", zpath)
+        elif sharded_only:
+            raise RuntimeError(
+                "offload checkpoint has per-process shard files but none "
+                "for process {} ({}) — it was written with a different "
+                "process count; resume with the layout it was saved "
+                "under".format(jax.process_index(), zpath))
+
+        src = sd["master"] if (load_from_fp32_weights
+                               and sd.get("master") is not None) \
+            else sd["module"]
+        flat_src = hs["treedef"].flatten_up_to(src)
+        opt = sd.get("optimizer") if load_optimizer_states else None
+        flat_m = hs["treedef"].flatten_up_to(opt["exp_avg"]) if opt else None
+        flat_v = hs["treedef"].flatten_up_to(opt["exp_avg_sq"]) if opt \
+            else None
+        hs["shard_leaves"] = [
+            [(idx,
+              np.array(np.asarray(full)[idx], dtype=np.float32, copy=True),
+              np.array(np.asarray(flat_m[i])[idx], dtype=np.float32,
+                       copy=True) if opt else np.zeros(
+                           np.asarray(full)[idx].shape, np.float32),
+              np.array(np.asarray(flat_v[i])[idx], dtype=np.float32,
+                       copy=True) if opt else np.zeros(
+                           np.asarray(full)[idx].shape, np.float32))
+             for idx, *_ in shards]
+            for i, (full, shards) in enumerate(
+                zip(flat_src, hs["shard_leaves"]))]
+        hs["step"] = int(opt["step"]) if opt else 0
 
     def _validate_tag(self, tag):
         if not self._config.checkpoint_tag_validation_enabled:
@@ -1167,21 +1378,8 @@ class DeepSpeedEngine:
             sd["module"], self.state["params"], param_sh)
 
         if self.host_state is not None:
-            # offload: master/opt restore into HOST numpy state
-            if load_from_fp32_weights and sd.get("master") is not None:
-                src = sd["master"]
-            else:
-                src = sd["module"]
-            self.host_state["master"] = jax.tree_util.tree_map(
-                lambda x: np.array(x, dtype=np.float32), src)
-            if load_optimizer_states and sd.get("optimizer") is not None:
-                opt = sd["optimizer"]
-                self.host_state["opt"] = {
-                    key: int(val) if key == "step" else
-                    jax.tree_util.tree_map(
-                        lambda x: np.array(x, dtype=np.float32), val)
-                    for key, val in opt.items()
-                }
+            self._load_host_state(load_dir, tag, sd, load_optimizer_states,
+                                  load_from_fp32_weights)
         elif self.mixed_precision and load_from_fp32_weights and \
                 sd.get("master") is not None:
             master_sh = plan.tree_shardings(self.state["master"], "master")

@@ -60,13 +60,6 @@ class PipelineEngine(DeepSpeedEngine):
             name="pipeline")
         kwargs.setdefault("mpu", grid)
         super().__init__(args=args, model=wrapped, **kwargs)
-        if self.host_state is not None:
-            # the pipeline's fused path jits the optimizer apply; the host
-            # step isn't wired there (the reference calls ZeRO-Offload +
-            # pipeline fragile and restricts it too)
-            raise NotImplementedError(
-                "zero_optimization.cpu_offload is not supported with "
-                "pipeline parallelism")
         self.num_stages = model.num_stages
         self.micro_batches = self.gradient_accumulation_steps()
         log_dist("PipelineEngine: stages={} micro_batches={} mesh={}".format(
@@ -213,14 +206,14 @@ class PipelineEngine(DeepSpeedEngine):
 
         return pipeline_losses
 
-    def _fused_train_fn(self):
-        """Pipeline version of the engine's fused step: forward+backward
-        through the pipe loop, then the shared apply-step."""
+    def _pipe_grads_fn(self):
+        """Forward+backward through the pipe loop, accumulating into
+        acc_grads (shared by the fused one-jit step and the ZeRO-Offload
+        split, where the optimizer step runs on host)."""
         pipeline_losses = self._pipeline_forward_fn()
-        apply_step = self._apply_step_fn()
         plan = self.zero_plan
 
-        def fused(state, stacked_batch, rng, hyper):
+        def micros(state, stacked_batch, rng):
             inputs_stack, labels_stack = stacked_batch
 
             def loss_fn(compute_params):
@@ -237,6 +230,18 @@ class PipelineEngine(DeepSpeedEngine):
                 grads)
             new_state = dict(state)
             new_state["acc_grads"] = plan.constrain(acc, "grad")
+            return new_state, mean_loss
+
+        return micros
+
+    def _fused_train_fn(self):
+        """Pipeline version of the engine's fused step: forward+backward
+        through the pipe loop, then the shared apply-step."""
+        micros = self._pipe_grads_fn()
+        apply_step = self._apply_step_fn()
+
+        def fused(state, stacked_batch, rng, hyper):
+            new_state, mean_loss = micros(state, stacked_batch, rng)
             new_state, metrics = apply_step(new_state, hyper)
             return new_state, (mean_loss, metrics)
 
@@ -259,10 +264,19 @@ class PipelineEngine(DeepSpeedEngine):
         batch = self._to_device_stacked(batch)
 
         self._rng, step_rng = jax.random.split(self._rng)
-        fused = self._get_jit("pipe_train", self._fused_train_fn,
-                              donate_argnums=(0,))
-        self.state, (mean_loss, metrics) = fused(self.state, batch, step_rng,
-                                                 self._hyper())
+        if self.host_state is not None:
+            # ZeRO-Offload under pipelines: jit only the pipe loop's
+            # grad accumulation; the optimizer step runs on host
+            # (shard-wise D2H/H2D, same as the base engine's offload path)
+            micros = self._get_jit("pipe_micros", self._pipe_grads_fn,
+                                   donate_argnums=(0,))
+            self.state, mean_loss = micros(self.state, batch, step_rng)
+            metrics = self._host_apply_step()
+        else:
+            fused = self._get_jit("pipe_train", self._fused_train_fn,
+                                  donate_argnums=(0,))
+            self.state, (mean_loss, metrics) = fused(self.state, batch,
+                                                     step_rng, self._hyper())
         overflow = bool(metrics["overflow"])
         if overflow:
             self.skipped_steps += 1

@@ -8,7 +8,8 @@ import pytest
 
 import jax
 
-from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.config import (DeepSpeedConfig,
+                                          DeepSpeedConfigError)
 
 WORLD = None  # resolved lazily (8 on the CPU test mesh)
 
@@ -193,3 +194,62 @@ def test_checkpoint_tag_validation_modes():
         })
         assert cfg.checkpoint_tag_validation_enabled == enabled
         assert cfg.checkpoint_tag_validation_fail == fail
+
+
+def test_unknown_key_warns_by_default():
+    import logging
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+
+    records = []
+
+    class _Cap(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    cfg_dict = {"train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 1,
+                "definitely_not_a_key": True,
+                "fp16": {"enabled": True, "loss_scael": 0}}
+    cap = _Cap(level=logging.WARNING)
+    ds_logger.addHandler(cap)
+    try:
+        DeepSpeedConfig(None, param_dict=cfg_dict)
+    finally:
+        ds_logger.removeHandler(cap)
+    joined = " ".join(records)
+    assert "definitely_not_a_key" in joined
+    assert "loss_scael" in joined
+
+
+def test_unknown_key_strict_raises():
+    cfg_dict = {"train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 1,
+                "config_validation": "strict",
+                "zero_optimization": {"stgae": 2}}
+    with pytest.raises(DeepSpeedConfigError, match="stgae"):
+        DeepSpeedConfig(None, param_dict=cfg_dict)
+
+
+def test_unknown_key_ignore_mode():
+    cfg_dict = {"train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 1,
+                "config_validation": "ignore",
+                "whatever": 1}
+    DeepSpeedConfig(None, param_dict=cfg_dict)  # no raise, no warning needed
+
+
+def test_doc_covers_every_known_key():
+    """docs/_pages/config-json.md must mention every accepted key (and the
+    parser must accept every key the doc shows) — the strict-or-warn
+    validator makes this the single source of truth."""
+    import os
+    doc_path = os.path.join(os.path.dirname(__file__), "..", "..",
+                            "docs", "_pages", "config-json.md")
+    doc = open(doc_path).read()
+    for key in DeepSpeedConfig.KNOWN_TOP_LEVEL_KEYS:
+        assert "`{}`".format(key) in doc or '"{}"'.format(key) in doc, \
+            "top-level key {} undocumented".format(key)
+    for section, keys in DeepSpeedConfig.KNOWN_SUBDICT_KEYS.items():
+        for key in keys:
+            assert "`{}`".format(key) in doc or '"{}"'.format(key) in doc, \
+                "{}.{} undocumented".format(section, key)

@@ -34,8 +34,12 @@ def _make(stage=2):
 def test_offload_state_lives_on_host():
     engine = _make()
     assert engine.host_state is not None
-    assert isinstance(engine.host_state["master"]["w"], np.ndarray)
-    assert isinstance(engine.host_state["opt"]["exp_avg"]["w"], np.ndarray)
+    # shard-wise host state: [(index, master, exp_avg, exp_avg_sq)]
+    shards = engine.host_state["shard_leaves"][0]
+    assert all(isinstance(t, np.ndarray) for _, *arrs in shards
+               for t in arrs)
+    assert isinstance(engine.get_master_params()["w"], np.ndarray)
+    assert isinstance(engine._opt_state_view()["exp_avg"]["w"], np.ndarray)
     # device state has no master/opt copies
     assert engine.state["master"] is None and engine.state["opt"] is None
 
@@ -53,9 +57,9 @@ def test_offload_converges_and_counts_steps():
         engine.step()
         losses.append(float(loss))
     assert losses[-1] < 0.2 * losses[0], losses
-    assert engine.host_state["opt"]["step"] == 40
+    assert engine.host_state["step"] == 40
     # moments actually updated on host
-    assert np.abs(engine.host_state["opt"]["exp_avg"]["w"]).sum() > 0
+    assert np.abs(engine._opt_state_view()["exp_avg"]["w"]).sum() > 0
 
 
 def test_offload_train_batch_path():
@@ -81,9 +85,9 @@ def test_offload_checkpoint_resume(tmp_path):
 
     engine2 = _make()
     engine2.load_checkpoint(str(tmp_path))
-    np.testing.assert_allclose(engine2.host_state["master"]["w"],
-                               engine.host_state["master"]["w"])
-    assert engine2.host_state["opt"]["step"] == 4
+    np.testing.assert_allclose(engine2.get_master_params()["w"],
+                               engine.get_master_params()["w"])
+    assert engine2.host_state["step"] == 4
     np.testing.assert_allclose(float(engine2(x, y)), float(engine(x, y)),
                                rtol=1e-6)
     # resumed training continues
@@ -110,10 +114,10 @@ def test_offload_overflow_skips_host_step():
     engine.state["acc_grads"] = jax.tree_util.tree_map(
         lambda g: g.at[0].set(jnp.inf), engine.state["acc_grads"])
     engine._pending_backward = False
-    before = engine.host_state["master"]["w"].copy()
+    before = engine.get_master_params()["w"].copy()
     engine.step()
     assert engine.skipped_steps == 1
-    np.testing.assert_array_equal(engine.host_state["master"]["w"], before)
+    np.testing.assert_array_equal(engine.get_master_params()["w"], before)
     # grads were zeroed for the next accumulation round
     assert float(jnp.abs(
         jax.tree_util.tree_leaves(engine.state["acc_grads"])[0]).sum()) == 0.0

@@ -50,6 +50,12 @@ class GPT2Config:
     # (parallel/ring_attention.py) so activations shard over sequence.
     sequence_parallel: object = None
     sp_mesh: object = None
+    # Sparse embedding-gradient exchange (ds_config "sparse_gradients" /
+    # reference CSR allreduce): backward ships (ids, rows) over the data
+    # axis instead of the dense (vocab, d) cotangent. Needs the engine's
+    # global mesh (same contract as sp_mesh).
+    sparse_embedding_grads: bool = False
+    embedding_grad_mesh: object = None
 
     @property
     def d_head(self):
@@ -243,8 +249,13 @@ def forward_hidden(params, input_ids, config, rng=None, train=False):
     """Embedding + transformer stack -> final hidden states."""
     b, s = input_ids.shape
     compute_dtype = params["ln_f"]["scale"].dtype
-    x = jnp.take(params["wte"], input_ids, axis=0).astype(compute_dtype) + \
-        params["wpe"][:s].astype(compute_dtype)
+    if config.sparse_embedding_grads:
+        from ..ops.sparse_grads import sparse_embedding_lookup
+        tok = sparse_embedding_lookup(params["wte"], input_ids,
+                                      mesh=config.embedding_grad_mesh)
+    else:
+        tok = jnp.take(params["wte"], input_ids, axis=0)
+    x = tok.astype(compute_dtype) + params["wpe"][:s].astype(compute_dtype)
 
     # "full": recompute everything in bwd (min memory, ~4/3 flops);
     # "dots": save matmul outputs, recompute elementwise only — the usual
@@ -355,6 +366,78 @@ def lm_loss(params, input_ids, labels, config, rng=None, train=True):
     return causal_lm_cross_entropy(logits, labels)
 
 
+def profile_spec(config, batch_size, seq=None, seed=0):
+    """Module-tree spec for the per-module flops profiler
+    (profiling/flops_profiler: profile_module_tree/format_module_profile —
+    the reference's per-module aggregated table, profiler.py:515-677).
+    Each node prices one forward sub-function via XLA cost_analysis.
+    ``seq`` should be the ACTUAL training sequence length (attention is
+    quadratic in it); defaults to config.max_seq_len."""
+    import jax
+    s, d, v, L = (seq or config.max_seq_len, config.d_model,
+                  config.vocab_size, config.n_layers)
+    dt = jnp.bfloat16
+    rng = np.random.RandomState(seed)
+    bp = jax.tree_util.tree_map(lambda t: jnp.asarray(t, dt),
+                                init_block_params(config, rng))
+    wte = jnp.asarray(rng.randn(v, d) * 0.02, dt)
+    wpe = jnp.asarray(rng.randn(s, d) * 0.01, dt)
+    ln_f = {"scale": jnp.ones((d,), dt), "bias": jnp.zeros((d,), dt)}
+    x = jax.ShapeDtypeStruct((batch_size, s, d), dt)
+    ids = jax.ShapeDtypeStruct((batch_size, s), jnp.int32)
+
+    def embed(ids):
+        return jnp.take(wte, ids, axis=0) + wpe[None]
+
+    def attn(xv):
+        ln1 = _layer_norm(xv, bp["ln1"]["scale"], bp["ln1"]["bias"])
+        # jnp reference attention: cost_analysis cannot see inside a
+        # pallas custom call, and the dense math IS the flop count
+        import dataclasses
+        cfg_ref = dataclasses.replace(config, use_flash_attention=False,
+                                      sequence_parallel=None)
+        ctx = _attn_ctx(ln1, bp["attn"], cfg_ref, train=False)
+        return xv + ctx @ bp["attn"]["proj_kernel"] + bp["attn"]["proj_bias"]
+
+    def mlp(xv):
+        ln2 = _layer_norm(xv, bp["ln2"]["scale"], bp["ln2"]["bias"])
+        return xv + _mlp(ln2, bp["mlp"], config, None, False)
+
+    def block_fn(xv):
+        return mlp(attn(xv))
+
+    def head_loss(hidden, labels):
+        if config.loss_chunk and s % config.loss_chunk == 0 \
+                and s > config.loss_chunk:
+            return chunked_causal_lm_loss(hidden, wte, labels,
+                                          config.loss_chunk)
+        logits = hidden @ wte.T
+        return causal_lm_cross_entropy(logits, labels)
+
+    per_block = 12 * d * d + 13 * d
+    return {
+        "name": "gpt2(fwd, b={} s={})".format(batch_size, s),
+        "params": num_params(config),
+        "children": [
+            {"name": "embedding", "fn": embed, "args": (ids,),
+             "params": v * d + s * d},
+            {"name": "block", "fn": block_fn, "args": (x,),
+             "count": L, "params": per_block,
+             "children": [
+                 {"name": "attention", "fn": attn, "args": (x,),
+                  "params": 4 * d * d + 5 * d},
+                 {"name": "mlp", "fn": mlp, "args": (x,),
+                  "params": 8 * d * d + 7 * d},
+             ]},
+            {"name": "final_norm",
+             "fn": lambda xv: _layer_norm(xv, ln_f["scale"], ln_f["bias"]),
+             "args": (x,), "params": 2 * d},
+            {"name": "lm_head+ce", "fn": head_loss, "args": (x, ids),
+             "params": 0},
+        ],
+    }
+
+
 def make_gpt2_model(config=None, size="gpt2_small", seed=0, **overrides):
     """Build a :class:`deepspeed_tpu.runtime.model.Model` for the engine."""
     from ..runtime.model import Model
@@ -368,6 +451,8 @@ def make_gpt2_model(config=None, size="gpt2_small", seed=0, **overrides):
     model = Model(apply_fn, params, partition_spec_fn=partition_spec_fn,
                   name="gpt2")
     model.config = config
+    model.profile_spec_fn = lambda batch_size, seq=None: profile_spec(
+        config, batch_size, seq=seq)
     return model
 
 

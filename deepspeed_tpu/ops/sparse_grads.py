@@ -1,0 +1,75 @@
+"""Sparse embedding-gradient exchange (the reference's CSR path).
+
+Reference parity: deepspeed/runtime/engine.py:1285-1341
+(sparse_allreduce_bucket): embedding gradients are exchanged as CSR
+(indices + rows) because a step touches at most batch*seq rows of the
+(vocab, d) table — the dense allreduce wastes vocab/(batch*seq) of its
+bandwidth. The TPU-native equivalent keeps the exchange INSIDE the jitted
+step: a custom_vjp on the lookup whose backward all-gathers each data
+shard's (ids, cotangent-rows) over the ``data`` mesh axis — the CSR
+payload — and densifies locally, instead of letting GSPMD cross-replica-
+reduce the dense (vocab, d) cotangent. Wire cost per step becomes
+2 * batch * seq * (d + 1) elements instead of vocab * d.
+
+Like the reference (which gathers every rank's sparse tensors and adds
+them locally), duplicate token ids across shards are resolved by the
+scatter-add.
+"""
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.topology import DATA_AXIS
+
+
+def sparse_embedding_lookup(wte, ids, mesh=None, axis=DATA_AXIS):
+    """``jnp.take(wte, ids, axis=0)`` with sparse gradient exchange.
+
+    Falls back to the plain dense-gradient lookup when there is no mesh,
+    the axis is trivial, or the batch does not shard evenly (shapes are
+    static, so the choice is made at trace time)."""
+    if mesh is None or int(dict(mesh.shape).get(axis, 1)) <= 1 or \
+            ids.shape[0] % int(dict(mesh.shape)[axis]) != 0:
+        return jnp.take(wte, ids, axis=0)
+    vocab, d = wte.shape
+    return _sparse_lookup(wte, ids, mesh, axis, vocab, d,
+                          jnp.dtype(wte.dtype).name)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def _sparse_lookup(wte, ids, mesh, axis, vocab, d, dtype_name):
+    return jnp.take(wte, ids, axis=0)
+
+
+def _sparse_lookup_fwd(wte, ids, mesh, axis, vocab, d, dtype_name):
+    return jnp.take(wte, ids, axis=0), ids
+
+
+def _sparse_lookup_bwd(mesh, axis, vocab, d, dtype_name, ids, dout):
+    wte_dtype = jnp.dtype(dtype_name)
+
+    def local(ids_l, dout_l):
+        # the CSR payload: every shard's ids + rows, gathered over data
+        ids_g = jax.lax.all_gather(ids_l, axis, tiled=True)
+        rows_g = jax.lax.all_gather(dout_l, axis, tiled=True)
+        flat_ids = ids_g.reshape(-1)
+        flat_rows = rows_g.reshape(-1, d).astype(jnp.float32)
+        dense = jnp.zeros((vocab, d), jnp.float32) \
+            .at[flat_ids].add(flat_rows)
+        return dense.astype(wte_dtype)
+
+    grad = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis), P(axis)),
+        out_specs=P(),
+        axis_names={axis},
+        check_vma=False,    # post-gather the result is replica-invariant
+    )(ids, dout)
+    return grad, np.zeros(ids.shape, jax.dtypes.float0)
+
+
+_sparse_lookup.defvjp(_sparse_lookup_fwd, _sparse_lookup_bwd)

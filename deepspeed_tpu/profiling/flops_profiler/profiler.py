@@ -61,6 +61,18 @@ class FlopsProfiler(object):
             _fmt(params), _fmt(self.flops or 0),
             _fmt(self.bytes_accessed or 0)))
 
+    def print_module_table(self, spec, module_depth=-1, top_modules=3,
+                           detailed=True):
+        """Per-module aggregated table from a module-tree spec; returns the
+        formatted string (also logged)."""
+        tree = profile_module_tree(spec)
+        self.module_tree = tree
+        table = format_module_profile(tree, module_depth=module_depth,
+                                      top_modules=top_modules,
+                                      detailed=detailed)
+        logger.info("\n" + table)
+        return table
+
 
 def get_model_profile(model_fn, args=(), print_profile=True, detailed=True,
                       module_depth=-1, top_modules=3, warm_up=1, as_string=True):
@@ -75,3 +87,102 @@ def get_model_profile(model_fn, args=(), print_profile=True, detailed=True,
     if as_string:
         return _fmt(flops), _fmt(flops / 2), None
     return flops, flops / 2, None
+
+
+# --------------------------------------------------------------------------
+# Per-module attribution (reference profiler.py:515-677 prints aggregated
+# per-module tables with module_depth / top_modules controls). The torch
+# reference hooks every nn.Module; pure-functional JAX models have no
+# module objects, so attribution works off an explicit MODULE TREE: each
+# node names a sub-function plus example args, and XLA's own
+# cost_analysis() prices it. Model families ship a builder (e.g.
+# models/gpt2.py:profile_spec) so engine configs get the table for free.
+# --------------------------------------------------------------------------
+class ModuleProfile:
+    """One node of the per-module profile tree."""
+
+    def __init__(self, name, flops=0.0, bytes_accessed=0.0, params=0,
+                 count=1):
+        self.name = name
+        self.flops = flops              # per single invocation
+        self.bytes_accessed = bytes_accessed
+        self.params = params
+        self.count = count              # invocations per step (e.g. layers)
+        self.children = []
+
+    @property
+    def total_flops(self):
+        return self.flops * self.count
+
+    @property
+    def total_bytes(self):
+        return self.bytes_accessed * self.count
+
+    @property
+    def total_params(self):
+        return self.params * self.count
+
+
+def profile_module_tree(spec):
+    """spec: {"name", "fn", "args", optional "params", "count",
+    "children": [spec...]}. Returns a ModuleProfile tree; nodes without
+    "fn" aggregate their children."""
+    costs = {}
+    if spec.get("fn") is not None:
+        costs = cost_analysis_of(spec["fn"], *spec.get("args", ()))
+    node = ModuleProfile(
+        spec["name"],
+        flops=float(costs.get("flops", 0.0) or 0.0),
+        bytes_accessed=float(costs.get("bytes accessed", 0.0) or 0.0),
+        params=int(spec.get("params", 0)),
+        count=int(spec.get("count", 1)))
+    for child in spec.get("children", ()):
+        node.children.append(profile_module_tree(child))
+    if node.flops == 0.0 and node.children:
+        node.flops = sum(c.total_flops for c in node.children)
+        node.bytes_accessed = sum(c.total_bytes for c in node.children)
+    if node.params == 0 and node.children:
+        node.params = sum(c.total_params for c in node.children)
+    return node
+
+
+def format_module_profile(root, module_depth=-1, top_modules=3,
+                          detailed=True, step_time_s=None):
+    """Reference-style aggregated table. ``module_depth`` limits the depth
+    (-1 = all); ``top_modules`` limits how many children print per level
+    (largest flops first); ``step_time_s`` adds achieved-FLOPS lines."""
+    lines = []
+    lines.append("-" * 26 + " flops profiler " + "-" * 26)
+    lines.append("model: {}".format(root.name))
+    lines.append("params: {}".format(_fmt(root.total_params)))
+    lines.append("flops/step: {}".format(_fmt(root.total_flops)))
+    lines.append("bytes accessed/step: {}".format(_fmt(root.total_bytes)))
+    if step_time_s:
+        lines.append("step time: {:.1f} ms, achieved: {}FLOPS".format(
+            step_time_s * 1e3, _fmt(root.total_flops / step_time_s)))
+
+    def walk(node, depth, prefix):
+        if module_depth >= 0 and depth > module_depth:
+            return
+        total = root.total_flops or 1.0
+        # every column is count-multiplied (per-step totals), so children
+        # roll up to their parent consistently
+        lines.append("{}{}{}: flops={} ({:.1%}), params={}, bytes={}".format(
+            prefix, node.name,
+            " (x{})".format(node.count) if node.count != 1 else "",
+            _fmt(node.total_flops), node.total_flops / total,
+            _fmt(node.total_params), _fmt(node.total_bytes)))
+        if not detailed and depth >= 1:
+            return
+        ranked = sorted(node.children, key=lambda c: -c.total_flops)
+        for child in ranked[:top_modules if top_modules > 0 else None]:
+            walk(child, depth + 1, prefix + "  ")
+        dropped = len(ranked) - (top_modules if top_modules > 0
+                                 else len(ranked))
+        if dropped > 0:
+            lines.append("{}  ... {} smaller module(s) not shown".format(
+                prefix, dropped))
+
+    walk(root, 0, "")
+    lines.append("-" * 68)
+    return "\n".join(lines)

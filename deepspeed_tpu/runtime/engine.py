@@ -146,6 +146,36 @@ class DeepSpeedEngine:
         self._rng = jax.random.PRNGKey(
             int(os.environ.get("DEEPSPEED_SEED", 42)))
 
+        # sparse embedding-gradient exchange (reference CSR allreduce,
+        # engine.py:1285-1341): models opt in via their config (e.g.
+        # GPT2Config.sparse_embedding_grads -> ops/sparse_grads.py); the
+        # engine records the module names for checkpoint parity and flags
+        # a config/model mismatch
+        self.csr_tensor_module_names = set()
+        model_cfg = getattr(self.model, "config", None)
+        if getattr(model_cfg, "sparse_embedding_grads", False):
+            # only record when the exchange is actually LIVE: without a
+            # nontrivial mesh axis sparse_embedding_lookup falls back to
+            # the dense path and the checkpoint must not claim otherwise
+            grad_mesh = getattr(model_cfg, "embedding_grad_mesh", None)
+            axis_size = (int(dict(grad_mesh.shape).get(DATA_AXIS, 1))
+                         if grad_mesh is not None else 1)
+            if axis_size > 1:
+                self.csr_tensor_module_names.add("wte")
+            else:
+                logger.warning(
+                    "sparse_embedding_grads is set but embedding_grad_mesh "
+                    "has no nontrivial '%s' axis — the lookup falls back "
+                    "to dense gradients", DATA_AXIS)
+        if self.sparse_gradients_enabled() and \
+                not self.csr_tensor_module_names:
+            logger.warning(
+                "sparse_gradients is enabled in ds_config but the model "
+                "does not route any embedding through "
+                "sparse_embedding_lookup (e.g. "
+                "GPT2Config.sparse_embedding_grads=True with "
+                "embedding_grad_mesh); gradients stay dense")
+
         if self._config.dump_state:
             self._config.print("DeepSpeedEngine configuration")
 
@@ -608,6 +638,11 @@ class DeepSpeedEngine:
             # jax builds only expose costs on the compiled object.
             lowered = micro.lower(self.state, batch, step_rng,
                                   self._pld_theta())
+            # actual profiled sequence length (per-module attribution must
+            # price the run's shapes, not config.max_seq_len)
+            leaf = jax.tree_util.tree_leaves(batch)[0]
+            self._profile_seq = (int(leaf.shape[1])
+                                 if getattr(leaf, "ndim", 0) >= 2 else None)
             self._flops_costs = lowered.cost_analysis() or \
                 lowered.compile().cost_analysis() or {}
         self.state, loss = micro(self.state, batch, step_rng,
@@ -1159,6 +1194,21 @@ class DeepSpeedEngine:
             prof.bytes_accessed = costs.get("bytes accessed", 0.0)
             self.flops_profiler = prof
             prof.print_model_profile()
+            # per-module table (reference profiler.py:515-677) when the
+            # model ships a profile spec (e.g. models/gpt2.py)
+            spec_fn = getattr(self.model, "profile_spec_fn", None)
+            if spec_fn is not None:
+                cfg = self._config.flops_profiler_config
+                try:
+                    spec = spec_fn(self.train_micro_batch_size_per_gpu(),
+                                   seq=getattr(self, "_profile_seq", None))
+                except TypeError:   # spec builder without a seq kwarg
+                    spec = spec_fn(self.train_micro_batch_size_per_gpu())
+                prof.print_module_table(
+                    spec,
+                    module_depth=cfg.module_depth,
+                    top_modules=cfg.top_modules,
+                    detailed=cfg.detailed)
             self._flops_profiler_active = False
 
     # ------------------------------------------------------------- checkpoint
@@ -1199,7 +1249,7 @@ class DeepSpeedEngine:
                  "cur_iter": self.state["scaler"].cur_iter}),
             "lr_scheduler": self.lr_scheduler.state_dict()
                 if self.lr_scheduler is not None else None,
-            "csr_tensor_module_names": set(),
+            "csr_tensor_module_names": set(self.csr_tensor_module_names),
             "skipped_steps": self.skipped_steps,
             "global_steps": self.global_steps,
             "global_samples": self.global_samples,

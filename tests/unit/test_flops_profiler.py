@@ -69,3 +69,82 @@ def test_wall_clock_breakdown_timers():
         engine.step()
     fwd = engine.timers("forward_microstep")
     assert fwd.elapsed(reset=False) > 0.0
+
+
+def test_per_module_table_for_gpt2():
+    """Per-module aggregated table (reference profiler.py:515-677): every
+    GPT-2 module appears with nonzero flops, blocks dominate, and the
+    depth/top_modules controls prune the output."""
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.profiling.flops_profiler.profiler import (
+        profile_module_tree, format_module_profile)
+
+    cfg = gpt2.config_for("gpt2_small", max_seq_len=128, n_layers=2,
+                          vocab_size=512, use_flash_attention=False,
+                          remat=False)
+    spec = gpt2.profile_spec(cfg, batch_size=2)
+    tree = profile_module_tree(spec)
+
+    names = {c.name: c for c in tree.children}
+    assert set(names) == {"embedding", "block", "final_norm", "lm_head+ce"}
+    assert tree.total_flops > 0
+    block = names["block"]
+    assert block.count == 2 and block.flops > 0
+    sub = {c.name: c for c in block.children}
+    assert sub["mlp"].flops > 0 and sub["attention"].flops > 0
+    # the transformer blocks dominate a fwd pass at tiny vocab
+    assert block.total_flops > names["embedding"].total_flops
+    # params roll up: root total matches the analytic count
+    assert tree.total_params == gpt2.num_params(cfg)
+
+    table = format_module_profile(tree, module_depth=-1, top_modules=10)
+    for name in ("embedding", "block (x2)", "attention", "mlp",
+                 "final_norm", "lm_head+ce"):
+        assert name in table, table
+    # depth filter removes the block's children
+    shallow = format_module_profile(tree, module_depth=1, top_modules=10)
+    assert "attention" not in shallow and "block (x2)" in shallow
+    # top_modules=1 keeps only the biggest child per level
+    top1 = format_module_profile(tree, module_depth=-1, top_modules=1)
+    assert "smaller module(s) not shown" in top1
+
+
+def test_engine_prints_module_table(caplog):
+    """The engine's flops_profiler config prints the per-module table for
+    models that ship a profile spec."""
+    from deepspeed_tpu.models import gpt2
+    cfg = gpt2.config_for("gpt2_small", max_seq_len=64, n_layers=2,
+                          vocab_size=256, d_model=64, n_heads=2,
+                          use_flash_attention=False, remat=False)
+    model = gpt2.make_gpt2_model(config=cfg)
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "flops_profiler": {"enabled": True, "profile_step": 1},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                               config_params=config)
+    import logging
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+
+    records = []
+
+    class _Cap(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    cap = _Cap(level=logging.INFO)
+    ds_logger.addHandler(cap)
+    try:
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size, size=(8, 64)).astype(np.int32)
+        for _ in range(3):
+            loss = engine(ids, ids)
+            engine.backward(loss)
+            engine.step()
+    finally:
+        ds_logger.removeHandler(cap)
+    joined = "\n".join(records)
+    assert "flops profiler" in joined
+    assert "block (x2)" in joined and "lm_head+ce" in joined

@@ -239,6 +239,7 @@ def _block_rest(x, ctx, block_params, config, rng, train):
 
 def _fused_attn_ctx(x, block_params, config):
     from ..ops.transformer.flash_attention import fused_ln_qkv_attention
+    # block sizes resolve by width inside the op (auto_blocks)
     return fused_ln_qkv_attention(
         x, block_params["ln1"]["scale"], block_params["ln1"]["bias"],
         block_params["attn"]["qkv_kernel"],

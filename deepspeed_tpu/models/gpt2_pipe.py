@@ -100,9 +100,12 @@ def make_gpt2_pipeline(config=None, size="gpt2_small", num_stages=2,
                        activation_checkpoint_interval=1, **overrides):
     if config is None:
         config = config_for(size, **overrides)
-    assert config.n_layers % num_stages == 0, \
-        "num_stages ({}) must evenly divide n_layers ({})".format(
-            num_stages, config.n_layers)
+    assert config.n_layers >= num_stages, \
+        "num_stages ({}) exceeds n_layers ({})".format(num_stages,
+                                                       config.n_layers)
+    # n_layers need not divide num_stages: PipelineModule partitions
+    # raggedly (stage depths differ by at most one for uniform weights)
+    # and pads each stage's stack to the deepest one
 
     layers = [TiedLayerSpec("embed", EmbeddingLayer, config,
                             forward_fn=None)]

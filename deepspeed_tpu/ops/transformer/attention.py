@@ -27,11 +27,12 @@ def causal_attention(q, k, v, use_flash=True, sm_scale=None, interpret=None):
     backend_ok = jax.default_backend() == "tpu" or interpret
     if use_flash and backend_ok:
         # (b,s,h,d)-native kernel: no head fold/unfold relayout (that
-        # transpose costs more than the attention math at d_head 64).
-        from .flash_attention import (flash_attention_bshd,
-                                      DEFAULT_BLOCK_PACKED)
+        # transpose costs more than the attention math at d_head 64);
+        # block sizes resolve by width inside the op (auto_blocks), so
+        # wide models (gpt2-xl's h*d=1600) stay inside scoped vmem.
+        from .flash_attention import flash_attention_bshd
         return flash_attention_bshd(q, k, v, sm_scale, True,
-                                    DEFAULT_BLOCK_PACKED, interpret)
+                                    interpret=interpret)
     return reference_causal_attention(q, k, v, sm_scale)
 
 

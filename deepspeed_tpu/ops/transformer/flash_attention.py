@@ -630,6 +630,30 @@ DEFAULT_BLOCK_PACKED = 256
 DEFAULT_BLOCK_PACKED_K = 512
 
 
+def auto_blocks(hd):
+    """BACKWARD (block_q, block_k) for the packed kernels by activation
+    width h*d. The bwd kernels hold q/do (Bq, hd) and k/v (Bk, hd) slabs
+    double-buffered plus a (Bq or Bk, hd) fp32 scratch in the 16M
+    scoped-vmem budget; (256, 512) measures fastest up to GPT-2-medium
+    width but overflows by ~1M at gpt2-xl's hd=1600, so blocks shrink as
+    the width grows."""
+    if hd <= 1024:
+        return DEFAULT_BLOCK_PACKED, DEFAULT_BLOCK_PACKED_K
+    if hd <= 1280:
+        return 256, 256
+    return 128, 256
+
+
+def auto_fwd_blocks(hd):
+    """FORWARD (block_q, block_k): lighter working set than the backward
+    (no fp32 dq scratch, fewer operands), so the measured-fast (256, 512)
+    holds to wider models; past hd=1024 the conservative (256, 256) keeps
+    the streaming kernel comfortably inside scoped vmem."""
+    if hd <= 1024:
+        return DEFAULT_BLOCK_PACKED, DEFAULT_BLOCK_PACKED_K
+    return 256, 256
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
 def _flash_bshd_core(q, k, v, bias, sm_scale, causal, block_q, interpret,
                      block_k, bwd_block_q, bwd_block_k):
@@ -679,8 +703,8 @@ _flash_bshd_core.defvjp(_flash_fwd_bshd_rule, _flash_bwd_bshd_rule)
 
 
 def flash_attention_bshd(q, k, v, sm_scale=None, causal=True,
-                         block_q=DEFAULT_BLOCK_PACKED, interpret=False,
-                         block_k=DEFAULT_BLOCK_PACKED_K, mask_bias=None,
+                         block_q=None, interpret=False,
+                         block_k=None, mask_bias=None,
                          bwd_block_q=None, bwd_block_k=None):
     """q/k/v: (batch, seq, heads, d_head) -> same layout. Heads are never
     transposed: the arrays are viewed as packed (b, s, h*d) — a free
@@ -692,7 +716,17 @@ def flash_attention_bshd(q, k, v, sm_scale=None, causal=True,
     ``mask_bias``: optional (b, s) additive score bias per KEY position
     (0 keep / -1e9 drop — the BERT key-padding mask). Treated as a
     constant: no gradient flows into it."""
-    b, s, _, _ = q.shape
+    b, s, h, d = q.shape
+    # None block args resolve by width so EVERY caller (GPT-2, the BERT
+    # encoder layer, module_inject'ed models) stays inside scoped vmem.
+    # Explicit fwd blocks still win and (as before) flow to the bwd
+    # unless bwd blocks are ALSO explicit — sweep harnesses rely on that.
+    fq, fk = auto_fwd_blocks(h * d)
+    bq_auto, bk_auto = auto_blocks(h * d)
+    bwd_block_q = bwd_block_q or block_q or bq_auto
+    bwd_block_k = bwd_block_k or block_k or bk_auto
+    block_q = block_q or fq
+    block_k = block_k or fk
     if mask_bias is None:
         bias = jnp.zeros((b, 1, s), jnp.float32)
     else:
@@ -726,21 +760,38 @@ def _lnqkv(x, ln_scale, ln_bias, qkv_w, qkv_b, eps):
     return jnp.split(qkv, 3, axis=-1)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
 def fused_ln_qkv_attention(x, ln_scale, ln_bias, qkv_w, qkv_b, num_heads,
-                           eps=1e-5, causal=True,
-                           block_q=DEFAULT_BLOCK_PACKED,
-                           block_k=DEFAULT_BLOCK_PACKED_K, interpret=False):
+                           eps=1e-5, causal=True, block_q=None,
+                           block_k=None, interpret=False,
+                           bwd_block_q=None, bwd_block_k=None):
     """x: (b, s, d_model) -> attention context (b, s, d_model), causal,
-    sm_scale fixed at 1/sqrt(d_head)."""
+    sm_scale fixed at 1/sqrt(d_head). None block args resolve by width
+    (auto_fwd_blocks / auto_blocks); explicit fwd blocks flow to the bwd
+    unless bwd blocks are also explicit."""
+    hd = x.shape[-1]
+    fq, fk = auto_fwd_blocks(hd)
+    bq_auto, bk_auto = auto_blocks(hd)
+    bwd_block_q = bwd_block_q or block_q or bq_auto
+    bwd_block_k = bwd_block_k or block_k or bk_auto
+    return _fused_lnqkv_core(x, ln_scale, ln_bias, qkv_w, qkv_b, num_heads,
+                             eps, causal, block_q or fq, block_k or fk,
+                             interpret, bwd_block_q, bwd_block_k)
+
+
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12))
+def _fused_lnqkv_core(x, ln_scale, ln_bias, qkv_w, qkv_b, num_heads,
+                      eps, causal, block_q, block_k, interpret,
+                      bwd_block_q, bwd_block_k):
     out, _ = _fused_lnqkv_attn_fwd(x, ln_scale, ln_bias, qkv_w, qkv_b,
                                    num_heads, eps, causal, block_q, block_k,
-                                   interpret)
+                                   interpret, bwd_block_q, bwd_block_k)
     return out
 
 
 def _fused_lnqkv_attn_fwd(x, ln_scale, ln_bias, qkv_w, qkv_b, num_heads,
-                          eps, causal, block_q, block_k, interpret):
+                          eps, causal, block_q, block_k, interpret,
+                          bwd_block_q, bwd_block_k):
     b, s, hd = x.shape
     d = hd // num_heads
     q, k, v = _lnqkv(x, ln_scale, ln_bias, qkv_w, qkv_b, eps)
@@ -752,22 +803,23 @@ def _fused_lnqkv_attn_fwd(x, ln_scale, ln_bias, qkv_w, qkv_b, num_heads,
 
 
 def _fused_lnqkv_attn_bwd(num_heads, eps, causal, block_q, block_k,
-                          interpret, res, do):
+                          interpret, bwd_block_q, bwd_block_k, res, do):
     x, ln_scale, ln_bias, qkv_w, qkv_b, out, lse = res
     b, s, hd = x.shape
     d = hd // num_heads
     (q, k, v), lnqkv_vjp = jax.vjp(
         lambda x_, s_, b_, w_, bb_: _lnqkv(x_, s_, b_, w_, bb_, eps),
         x, ln_scale, ln_bias, qkv_w, qkv_b)
-    bias = jnp.zeros((b, 1, ((s + block_k - 1) // block_k) * block_k),
-                     jnp.float32)
+    bias = jnp.zeros(
+        (b, 1, ((s + bwd_block_k - 1) // bwd_block_k) * bwd_block_k),
+        jnp.float32)
     dq, dk, dv = _bwd_packed(q, k, v, bias, out, do, lse,
-                             1.0 / (d ** 0.5), causal, block_q, block_k,
-                             interpret, num_heads)
+                             1.0 / (d ** 0.5), causal, bwd_block_q,
+                             bwd_block_k, interpret, num_heads)
     return lnqkv_vjp([dq, dk, dv])  # list: matches _lnqkv's jnp.split output
 
 
-fused_ln_qkv_attention.defvjp(_fused_lnqkv_attn_fwd, _fused_lnqkv_attn_bwd)
+_fused_lnqkv_core.defvjp(_fused_lnqkv_attn_fwd, _fused_lnqkv_attn_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))

@@ -601,7 +601,10 @@ class DeepSpeedConfig(object):
             "stage3_max_live_parameters", "stage3_max_reuse_distance",
             "stage3_param_persistence_threshold", "elastic_checkpoint",
             "load_from_fp32_weights",
-            "stage3_gather_fp16_weights_on_model_save"},
+            "stage3_gather_fp16_weights_on_model_save",
+            # short alias of stage3_param_persistence_threshold (the
+            # zero.Init config-dict spelling)
+            "param_persistence_threshold"},
         "flops_profiler": {"enabled", "profile_step", "module_depth",
                            "top_modules", "detailed"},
         "activation_checkpointing": {

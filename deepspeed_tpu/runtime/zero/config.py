@@ -87,9 +87,13 @@ class DeepSpeedZeroConfig(object):
                                     ZERO_OPTIMIZATION_MAX_REUSE_DISTANCE_DEFAULT)
         self.prefetch_bucket_size = g(ZERO_OPTIMIZATION_PREFETCH_BUCKET_SIZE,
                                       ZERO_OPTIMIZATION_PREFETCH_BUCKET_SIZE_DEFAULT)
+        # the stage3_-prefixed reference spelling wins; the short alias is
+        # also accepted (zero.Init's config-dict path uses it)
         self.param_persistence_threshold = g(
             ZERO_OPTIMIZATION_PARAM_PERSISTENCE_THRESHOLD,
-            ZERO_OPTIMIZATION_PARAM_PERSISTENCE_THRESHOLD_DEFAULT)
+            zero_config_dict.get(
+                "param_persistence_threshold",
+                ZERO_OPTIMIZATION_PARAM_PERSISTENCE_THRESHOLD_DEFAULT))
         self.gather_fp16_weights_on_model_save = g(
             ZERO_OPTIMIZATION_GATHER_FP16_WEIGHTS_ON_MODEL_SAVE,
             ZERO_OPTIMIZATION_GATHER_FP16_WEIGHTS_ON_MODEL_SAVE_DEFAULT)

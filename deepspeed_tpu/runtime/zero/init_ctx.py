@@ -36,7 +36,10 @@ def _threshold_from_config(ds_config):
         return 100000
     if isinstance(ds_config, dict):
         zero_cfg = ds_config.get("zero_optimization", {})
-        return zero_cfg.get("param_persistence_threshold", 100000)
+        # canonical stage3_-prefixed spelling wins; short alias accepted
+        return zero_cfg.get(
+            "stage3_param_persistence_threshold",
+            zero_cfg.get("param_persistence_threshold", 100000))
     return getattr(ds_config, "zero_param_persistence_threshold", 100000)
 
 

@@ -47,122 +47,164 @@ def build_block_index(layout):
 
 
 def _attn_fwd_kernel(nact_ref, idx_ref, q_ref, k_ref, v_ref, kpm_ref,
-                     bias_ref, o_ref, lse_ref, *, sm_scale, block, causal,
-                     has_kpm, has_bias):
-    h = pl.program_id(1)
+                     bias_ref, o_ref, lse_ref, acc_s, m_s, l_s, *, sm_scale,
+                     block, causal, has_kpm, has_bias, max_n, shared):
+    """Grid (batch, heads, q-block, active-slot): the ACTIVE k/v blocks are
+    STREAMED by prefetch-dependent BlockSpec index maps (idx_ref drives the
+    DMA), so VMEM holds one (block, d) k/v pair at a time — sequence length
+    is HBM-bound, not VMEM-bound (whole-K/V residency OOM'd at seq 8k).
+    Online-softmax state is carried in scratch across the slot dim. Dots
+    run in the input dtype (full-rate MXU for bf16) with fp32 accumulation.
+    """
+    h = 0 if shared else pl.program_id(1)
     qi = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32) * sm_scale          # (B, d)
-    d = q.shape[-1]
-    q_pos = qi * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
-    k_iota = jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+    j = pl.program_id(3)
+    ki = idx_ref[h, qi, j]
 
-    def body(j, carry):
-        acc, m, l = carry
-        ki = idx_ref[h, qi, j]
-        k_blk = k_ref[0, 0, pl.ds(ki * block, block), :].astype(jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(ki * block, block), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())))
+    @pl.when(j == 0)
+    def _init():
+        acc_s[:] = jnp.zeros_like(acc_s)
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+
+    @pl.when(j < nact_ref[h, qi])
+    def _accumulate():
+        q = q_ref[0, 0]                                     # (B, d)
+        k_blk = k_ref[0, 0]                                 # (B, d) streamed
+        v_blk = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
         if has_kpm:
-            s = s + kpm_ref[0, pl.ds(ki * block, block)][None, :]
+            s = s + kpm_ref[0][None, :]
         if has_bias:
-            s = s + bias_ref[:, pl.ds(ki * block, block)]
+            s = s + bias_ref[...]
         if causal:
+            q_pos = qi * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 0)
+            k_iota = jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
             s = jnp.where(q_pos >= ki * block + k_iota, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        m_old = m_s[:]
+        m_new = jnp.maximum(m_old, jnp.max(s, axis=-1, keepdims=True))
         # Rows where every score so far is masked (m_new still NEG_INF)
         # must not resolve exp(NEG_INF - NEG_INF) to 1.
         p = jnp.where(m_new <= NEG_INF, 0.0, jnp.exp(s - m_new))
-        corr = jnp.exp(m - m_new)
-        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * corr + jax.lax.dot_general(p, v_blk,
-                                               (((1,), (0,)), ((), ())))
-        return acc, m_new, l
+        corr = jnp.exp(m_old - m_new)
+        l_s[:] = l_s[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        m_s[:] = m_new
+        acc_s[:] = acc_s[:] * corr + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    init = (jnp.zeros((block, d), jnp.float32),
-            jnp.full((block, 1), NEG_INF, jnp.float32),
-            jnp.zeros((block, 1), jnp.float32))
-    acc, m, l = jax.lax.fori_loop(0, nact_ref[h, qi], body, init)
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[0, 0] = jnp.where(l == 0.0, NEG_INF, m + jnp.log(l_safe))
+    @pl.when(j == max_n - 1)
+    def _flush():
+        l = l_s[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_s[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = jnp.where(l == 0.0, NEG_INF,
+                                  m_s[:] + jnp.log(l_safe))
 
 
 def _attn_dq_kernel(nact_ref, idx_ref, q_ref, k_ref, v_ref, kpm_ref, bias_ref,
-                    do_ref, lse_ref, delta_ref, dq_ref, *, sm_scale, block,
-                    causal, has_kpm, has_bias):
-    h = pl.program_id(1)
+                    do_ref, lse_ref, delta_ref, dq_ref, dq_s, *, sm_scale,
+                    block, causal, has_kpm, has_bias, max_n, shared):
+    h = 0 if shared else pl.program_id(1)
     qi = pl.program_id(2)
-    qs = q_ref[0, 0].astype(jnp.float32) * sm_scale
-    do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0]
-    delta = delta_ref[0, 0]
-    d = qs.shape[-1]
-    q_pos = qi * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
-    k_iota = jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+    j = pl.program_id(3)
+    ki = idx_ref[h, qi, j]
 
-    def body(j, dq):
-        ki = idx_ref[h, qi, j]
-        k_blk = k_ref[0, 0, pl.ds(ki * block, block), :].astype(jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(ki * block, block), :].astype(jnp.float32)
-        s = jax.lax.dot_general(qs, k_blk, (((1,), (1,)), ((), ())))
+    @pl.when(j == 0)
+    def _init():
+        dq_s[:] = jnp.zeros_like(dq_s)
+
+    @pl.when(j < nact_ref[h, qi])
+    def _accumulate():
+        q = q_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        k_blk = k_ref[0, 0]                                 # streamed
+        v_blk = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
         if has_kpm:
-            s = s + kpm_ref[0, pl.ds(ki * block, block)][None, :]
+            s = s + kpm_ref[0][None, :]
         if has_bias:
-            s = s + bias_ref[:, pl.ds(ki * block, block)]
+            s = s + bias_ref[...]
         if causal:
+            q_pos = qi * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 0)
+            k_iota = jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
             s = jnp.where(q_pos >= ki * block + k_iota, s, NEG_INF)
         # Rows with no surviving score (lse == NEG_INF) contribute nothing.
         p = jnp.where(lse <= NEG_INF, 0.0, jnp.exp(s - lse))
-        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())))
-        ds = p * (dp - delta) * sm_scale
-        return dq + jax.lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())))
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * sm_scale).astype(k_blk.dtype)
+        dq_s[:] = dq_s[:] + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, nact_ref[h, qi], body,
-                           jnp.zeros((block, d), jnp.float32))
-    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+    @pl.when(j == max_n - 1)
+    def _flush():
+        dq_ref[0, 0] = dq_s[:].astype(dq_ref.dtype)
 
 
 def _attn_dkdv_kernel(nact_ref, idx_ref, q_ref, k_ref, v_ref, kpm_ref,
-                      bias_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *,
-                      sm_scale, block, causal, has_kpm, has_bias):
-    h = pl.program_id(1)
+                      bias_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                      dk_s, dv_s, *, sm_scale, block, causal, has_kpm,
+                      has_bias, max_n, shared):
+    """Transposed walk: k/v (and the kpm columns) stay resident per
+    (head, k-block) while the ACTIVE q/do/lse/delta blocks stream in via
+    the transposed index list."""
+    h = 0 if shared else pl.program_id(1)
     ki = pl.program_id(2)
-    k_blk = k_ref[0, 0].astype(jnp.float32)                  # (B, d)
-    v_blk = v_ref[0, 0].astype(jnp.float32)
-    d = k_blk.shape[-1]
-    k_pos = ki * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
-    q_iota = jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
-    if has_kpm:
-        kpm_cols = kpm_ref[0, pl.ds(ki * block, block)][None, :]
+    j = pl.program_id(3)
+    qi = idx_ref[h, ki, j]
 
-    def body(j, carry):
-        dk, dv = carry
-        qi = idx_ref[h, ki, j]
-        q_blk = q_ref[0, 0, pl.ds(qi * block, block), :].astype(jnp.float32)
-        do_blk = do_ref[0, 0, pl.ds(qi * block, block), :].astype(jnp.float32)
-        lse_blk = lse_ref[0, 0, pl.ds(qi * block, block), :]
-        delta_blk = delta_ref[0, 0, pl.ds(qi * block, block), :]
-        qs = q_blk * sm_scale
-        s = jax.lax.dot_general(qs, k_blk, (((1,), (1,)), ((), ())))
+    @pl.when(j == 0)
+    def _init():
+        dk_s[:] = jnp.zeros_like(dk_s)
+        dv_s[:] = jnp.zeros_like(dv_s)
+
+    @pl.when(j < nact_ref[h, ki])
+    def _accumulate():
+        k_blk = k_ref[0, 0]                                 # resident
+        v_blk = v_ref[0, 0]
+        q_blk = q_ref[0, 0]                                 # streamed
+        do_blk = do_ref[0, 0]
+        lse_blk = lse_ref[0, 0]
+        delta_blk = delta_ref[0, 0]
+        s = jax.lax.dot_general(
+            q_blk, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
         if has_kpm:
-            s = s + kpm_cols
+            s = s + kpm_ref[0][None, :]
         if has_bias:
-            s = s + bias_ref[pl.ds(qi * block, block), pl.ds(ki * block,
-                                                             block)]
+            s = s + bias_ref[...]
         if causal:
+            k_pos = ki * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 1)
+            q_iota = jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
             s = jnp.where(qi * block + q_iota >= k_pos, s, NEG_INF)
         p = jnp.where(lse_blk <= NEG_INF, 0.0, jnp.exp(s - lse_blk))
-        dv = dv + jax.lax.dot_general(p, do_blk, (((0,), (0,)), ((), ())))
-        dp = jax.lax.dot_general(do_blk, v_blk, (((1,), (1,)), ((), ())))
-        ds = p * (dp - delta_blk) * sm_scale
-        dk = dk + jax.lax.dot_general(ds, q_blk, (((0,), (0,)), ((), ())))
-        return dk, dv
+        dv_s[:] = dv_s[:] + jax.lax.dot_general(
+            p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do_blk, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta_blk) * sm_scale).astype(q_blk.dtype)
+        dk_s[:] = dk_s[:] + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    init = (jnp.zeros((block, d), jnp.float32),
-            jnp.zeros((block, d), jnp.float32))
-    dk, dv = jax.lax.fori_loop(0, nact_ref[h, ki], body, init)
-    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+    @pl.when(j == max_n - 1)
+    def _flush():
+        dk_ref[0, 0] = dk_s[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_s[:].astype(dv_ref.dtype)
 
 
 def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
@@ -180,20 +222,45 @@ def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
     layout = np.asarray(layout)
     heads, nb, _ = layout.shape
     seq = nb * block
-    nact_f, idx_f = build_block_index(layout)
-    nact_b, idx_b = build_block_index(layout.transpose(0, 2, 1))
+    # The prefetch index lists live in SMEM (~1M): collapse them to ONE
+    # copy when every head shares the layout (different_layout_per_head
+    # False, the default) — at seq 16k the per-head transposed list alone
+    # is 16*128*128 int32 = 1M and OOMs SMEM.
+    shared = bool((layout == layout[:1]).all())
+    idx_layout = layout[:1] if shared else layout
+    nact_f, idx_f = build_block_index(idx_layout)
+    nact_b, idx_b = build_block_index(idx_layout.transpose(0, 2, 1))
+    max_f = int(idx_f.shape[-1])
+    max_b = int(idx_b.shape[-1])
 
     def _specs(batch_d):
-        blk = pl.BlockSpec((1, 1, block, batch_d),
-                           lambda b, h, i, *_: (b, h, i, 0))
-        full = pl.BlockSpec((1, 1, seq, batch_d),
-                            lambda b, h, i, *_: (b, h, 0, 0))
-        col = pl.BlockSpec((1, 1, block, 1), lambda b, h, i, *_: (b, h, i, 0))
-        fcol = pl.BlockSpec((1, 1, seq, 1), lambda b, h, i, *_: (b, h, 0, 0))
-        kpm = pl.BlockSpec((1, seq), lambda b, h, i, *_: (b, 0))
-        bias = pl.BlockSpec((block, seq), lambda b, h, i, *_: (i, 0))
-        fbias = pl.BlockSpec((seq, seq), lambda b, h, i, *_: (0, 0))
-        return blk, full, col, fcol, kpm, bias, fbias
+        """Grid (batch, head, row-block, active-slot). ``anchor`` blocks
+        keep their index while the slot dim varies (pallas holds them
+        resident); ``stream`` blocks follow the scalar-prefetch index list
+        — the pipeline DMAs exactly the active block for each slot, so
+        VMEM never holds whole-sequence operands (the former whole-K/V
+        residency OOM'd scoped vmem at seq 8k)."""
+        hsel = (lambda h: 0) if shared else (lambda h: h)
+        anchor = pl.BlockSpec((1, 1, block, batch_d),
+                              lambda b, h, i, j, n, ix: (b, h, i, 0))
+        stream = pl.BlockSpec(
+            (1, 1, block, batch_d),
+            lambda b, h, i, j, n, ix: (b, h, ix[hsel(h), i, j], 0))
+        anchor_col = pl.BlockSpec((1, 1, block, 1),
+                                  lambda b, h, i, j, n, ix: (b, h, i, 0))
+        stream_col = pl.BlockSpec(
+            (1, 1, block, 1),
+            lambda b, h, i, j, n, ix: (b, h, ix[hsel(h), i, j], 0))
+        kpm_stream = pl.BlockSpec(
+            (1, block), lambda b, h, i, j, n, ix: (b, ix[hsel(h), i, j]))
+        kpm_anchor = pl.BlockSpec((1, block),
+                                  lambda b, h, i, j, n, ix: (b, i))
+        bias_fwd = pl.BlockSpec(
+            (block, block), lambda b, h, i, j, n, ix: (i, ix[hsel(h), i, j]))
+        bias_bwd = pl.BlockSpec(
+            (block, block), lambda b, h, i, j, n, ix: (ix[hsel(h), i, j], i))
+        return (anchor, stream, anchor_col, stream_col, kpm_stream,
+                kpm_anchor, bias_fwd, bias_bwd)
 
     def _mask_ops(kpm, bias):
         ops = []
@@ -207,20 +274,25 @@ def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
         batch, h, s, d = q.shape
         assert h == heads and s == seq, (q.shape, layout.shape, block)
         scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
-        blk, full, col, fcol, kpm_s, bias_s, _ = _specs(d)
-        in_specs = [blk, full, full] + ([kpm_s] if has_kpm else []) + \
+        (anchor, stream, anchor_col, _, kpm_s, _, bias_s, _) = _specs(d)
+        in_specs = [anchor, stream, stream] + \
+                   ([kpm_s] if has_kpm else []) + \
                    ([bias_s] if has_bias else [])
         ops = [q, k, v] + _mask_ops(kpm, bias)
         kernel = functools.partial(
             _kernel_shim, _attn_fwd_kernel, has_kpm, has_bias,
-            sm_scale=scale, block=block, causal=causal)
+            sm_scale=scale, block=block, causal=causal, max_n=max_f,
+            shared=shared)
         out, lse = pl.pallas_call(
             kernel,
             grid_spec=pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=2,
-                grid=(batch, heads, nb),
+                grid=(batch, heads, nb, max_f),
                 in_specs=in_specs,
-                out_specs=(blk, col)),
+                out_specs=(anchor, anchor_col),
+                scratch_shapes=[pltpu.VMEM((block, d), jnp.float32),
+                                pltpu.VMEM((block, 1), jnp.float32),
+                                pltpu.VMEM((block, 1), jnp.float32)]),
             out_shape=(jax.ShapeDtypeStruct(q.shape, q.dtype),
                        jax.ShapeDtypeStruct((batch, h, s, 1), jnp.float32)),
             interpret=interpret,
@@ -232,41 +304,48 @@ def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
         scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
         delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                         axis=-1, keepdims=True)
-        blk, full, col, fcol, kpm_s, bias_s, fbias_s = _specs(d)
+        (anchor, stream, anchor_col, stream_col, kpm_stream, kpm_anchor,
+         bias_fwd, bias_bwd) = _specs(d)
 
-        mask_specs = ([kpm_s] if has_kpm else []) + \
-                     ([bias_s] if has_bias else [])
+        mask_specs = ([kpm_stream] if has_kpm else []) + \
+                     ([bias_fwd] if has_bias else [])
         mask_ops = _mask_ops(kpm, bias)
         dq_kernel = functools.partial(
             _kernel_shim, _attn_dq_kernel, has_kpm, has_bias,
-            sm_scale=scale, block=block, causal=causal)
+            sm_scale=scale, block=block, causal=causal, max_n=max_f,
+            shared=shared)
         dq = pl.pallas_call(
             dq_kernel,
             grid_spec=pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=2,
-                grid=(batch, heads, nb),
-                in_specs=[blk, full, full] + mask_specs + [blk, col, col],
-                out_specs=blk),
+                grid=(batch, heads, nb, max_f),
+                in_specs=[anchor, stream, stream] + mask_specs +
+                         [anchor, anchor_col, anchor_col],
+                out_specs=anchor,
+                scratch_shapes=[pltpu.VMEM((block, d), jnp.float32)]),
             out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
             interpret=interpret,
         )(jnp.asarray(nact_f), jnp.asarray(idx_f), q, k, v, *mask_ops, do,
           lse, delta)
 
-        # dk/dv pass walks the transposed layout: full-bias block rows are
-        # indexed dynamically, so the bias is passed whole.
-        mask_specs_t = ([kpm_s] if has_kpm else []) + \
-                       ([fbias_s] if has_bias else [])
+        # dk/dv pass walks the transposed layout: k/v anchored per
+        # k-block, q/do/lse/delta streamed by the transposed index list.
+        mask_specs_t = ([kpm_anchor] if has_kpm else []) + \
+                       ([bias_bwd] if has_bias else [])
         dkdv_kernel = functools.partial(
             _kernel_shim, _attn_dkdv_kernel, has_kpm, has_bias,
-            sm_scale=scale, block=block, causal=causal)
+            sm_scale=scale, block=block, causal=causal, max_n=max_b,
+            shared=shared)
         dk, dv = pl.pallas_call(
             dkdv_kernel,
             grid_spec=pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=2,
-                grid=(batch, heads, nb),
-                in_specs=[full, blk, blk] + mask_specs_t +
-                         [full, fcol, fcol],
-                out_specs=(blk, blk)),
+                grid=(batch, heads, nb, max_b),
+                in_specs=[stream, anchor, anchor] + mask_specs_t +
+                         [stream, stream_col, stream_col],
+                out_specs=(anchor, anchor),
+                scratch_shapes=[pltpu.VMEM((block, d), jnp.float32),
+                                pltpu.VMEM((block, d), jnp.float32)]),
             out_shape=(jax.ShapeDtypeStruct(k.shape, k.dtype),
                        jax.ShapeDtypeStruct(v.shape, v.dtype)),
             interpret=interpret,

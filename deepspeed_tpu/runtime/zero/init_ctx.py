@@ -40,7 +40,12 @@ def _threshold_from_config(ds_config):
         return zero_cfg.get(
             "stage3_param_persistence_threshold",
             zero_cfg.get("param_persistence_threshold", 100000))
-    return getattr(ds_config, "zero_param_persistence_threshold", 100000)
+    # DeepSpeedConfig object: the parsed value lives on its zero_config
+    zc = getattr(ds_config, "zero_config", None)
+    if zc is not None and getattr(zc, "param_persistence_threshold",
+                                  None) is not None:
+        return zc.param_persistence_threshold
+    return 100000
 
 
 class Init:

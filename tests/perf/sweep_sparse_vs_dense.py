@@ -21,7 +21,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 HEADS, DHEAD = 16, 64
 BATCH = 2
-REPS = 6
+REPS = 12
 
 
 def timed_scan(step_fn, init, reps=REPS):
@@ -55,7 +55,7 @@ def main():
         "timing": "fwd+bwd (grad wrt q,k,v), scan-amortized, ms/layer",
     }, "rows": []}
 
-    for seq in (2048, 4096, 8192, 16384):
+    for seq in (2048, 4096, 8192, 16384, 32768):
         rng = np.random.RandomState(0)
         x = jnp.asarray(rng.randn(BATCH, seq, HEADS, DHEAD) * 0.1,
                         jnp.bfloat16)
@@ -75,42 +75,56 @@ def main():
         cfg = FixedSparsityConfig(num_heads=HEADS, block=block,
                                   num_local_blocks=4, num_global_blocks=1,
                                   attention="unidirectional")
-        layout = cfg.make_layout(seq)
-        density = float(np.asarray(layout).mean())
-        row["sparse_density"] = round(density, 4)
-        sparse = make_block_sparse_attention(np.asarray(layout), block,
-                                             causal=True)
+        layout = np.asarray(cfg.make_layout(seq))
+        # pure sliding-window (8 blocks = 1024 tokens lookback): the
+        # truly LINEAR layout — the fixed mode's global columns keep its
+        # active count growing with position (still ~quadratic overall)
+        nb = seq // block
+        win = np.zeros((1, nb, nb), np.int64)
+        for qi in range(nb):
+            win[0, qi, max(0, qi - 7):qi + 1] = 1
+        win = np.repeat(win, HEADS, axis=0)
 
-        def sparse_step(t):
-            def loss(q):
-                qh = q.transpose(0, 2, 1, 3)    # (b,h,s,d): kernel layout
-                out = sparse(qh, qh, qh, None, None)
-                return out.astype(jnp.float32).sum()
-            g = jax.grad(loss)(t)
-            return g.astype(t.dtype)
+        for name, lay in (("sparse", layout), ("window", win)):
+            density = float(lay.mean())
+            row[name + "_density"] = round(density, 4)
+            attn = make_block_sparse_attention(lay, block, causal=True)
 
-        try:
-            row["sparse_ms"] = round(timed_scan(sparse_step, x), 1)
-        except Exception as err:  # noqa: BLE001
-            row["sparse_ms"] = "failed: " + str(err)[:80]
+            def sparse_step(t, attn=attn):
+                def loss(q):
+                    qh = q.transpose(0, 2, 1, 3)   # (b,h,s,d) kernel layout
+                    out = attn(qh, qh, qh, None, None)
+                    return out.astype(jnp.float32).sum()
+                g = jax.grad(loss)(t)
+                return g.astype(t.dtype)
 
-        if isinstance(row.get("dense_ms"), float) and \
-                isinstance(row.get("sparse_ms"), float):
-            row["speedup_dense_over_sparse"] = round(
-                row["sparse_ms"] / row["dense_ms"], 2)
+            try:
+                row[name + "_ms"] = round(timed_scan(sparse_step, x), 1)
+            except Exception as err:  # noqa: BLE001
+                row[name + "_ms"] = "failed: " + str(err)[:80]
+
+        for name in ("sparse", "window"):
+            if isinstance(row.get("dense_ms"), float) and \
+                    isinstance(row.get(name + "_ms"), float) and \
+                    row["dense_ms"] > 0:
+                row[name + "_vs_dense"] = round(
+                    row[name + "_ms"] / row["dense_ms"], 2)
         results["rows"].append(row)
         print(json.dumps(row), flush=True)
 
-    wins = [r for r in results["rows"]
-            if isinstance(r.get("sparse_ms"), float)
-            and isinstance(r.get("dense_ms"), float)
-            and r["sparse_ms"] < r["dense_ms"]]
-    results["crossover"] = (min(w["seq"] for w in wins) if wins else
-                            "none up to 16384 at this layout")
+    for name in ("sparse", "window"):
+        wins = [r for r in results["rows"]
+                if isinstance(r.get(name + "_ms"), float)
+                and isinstance(r.get("dense_ms"), float)
+                and r[name + "_ms"] < r["dense_ms"]]
+        results[name + "_crossover"] = (
+            min(w["seq"] for w in wins) if wins else
+            "none at tested lengths")
     path = os.path.join(os.path.dirname(__file__), "SPARSE_VS_DENSE.json")
     with open(path, "w") as f:
         json.dump(results, f, indent=2)
-    print(json.dumps({"crossover": results["crossover"]}))
+    print(json.dumps({k: results[k] for k in
+                      ("sparse_crossover", "window_crossover")}))
 
 
 if __name__ == "__main__":

@@ -298,3 +298,17 @@ def test_fused_attn_under_remat_matches():
     for a, b_ in zip(g_plain, g_remat):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=1e-5, atol=1e-5)
+
+
+def test_auto_blocks_by_width():
+    """Width-aware block defaults: measured-fast at GPT-2-medium width,
+    shrinking backward blocks at xl width where (256, 512) overflows the
+    16M scoped-vmem budget."""
+    from deepspeed_tpu.ops.transformer.flash_attention import (
+        auto_blocks, auto_fwd_blocks)
+    assert auto_blocks(768) == (256, 512)
+    assert auto_blocks(1024) == (256, 512)
+    assert auto_blocks(1280) == (256, 256)
+    assert auto_blocks(1600) == (128, 256)
+    assert auto_fwd_blocks(1024) == (256, 512)
+    assert auto_fwd_blocks(1600) == (256, 256)

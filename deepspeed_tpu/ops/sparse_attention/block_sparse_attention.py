@@ -12,8 +12,13 @@ index list driving the inner loop (the analogue of sdd_segment's lut).
 The layout is a numpy (num_heads, nb, nb) 0/1 matrix from
 sparsity_config.py. Per (head, q-block) we precompute the active
 k-block indices (and the transpose for the dk/dv pass) as scalar-prefetch
-arrays; the kernel fori_loops over exactly the active blocks, so FLOPs
-and HBM traffic scale with layout density, not seq^2.
+arrays; the grid's innermost dimension walks the index list, so MXU work
+and k/v HBM traffic scale with the active blocks. CAVEAT: the grid pads
+every row to the layout's MAX row population — skewed layouts (a global
+row/column that attends everything, as in bslongformer/bigbird) make
+max_n ~ nb, so the masked-off slots still burn grid steps (no compute,
+but a redundant DMA each). Uniform-population layouts (sliding window,
+fixed local) pay nothing.
 
 Masks (key-padding and attention) and relative position bias are folded
 into additive f32 biases; they participate in forward/recompute but do

@@ -47,6 +47,8 @@ def main():
     from deepspeed_tpu.ops.transformer import flash_attention as fa
     from deepspeed_tpu.ops.sparse_attention import (
         FixedSparsityConfig, make_block_sparse_attention)
+    from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+        causal_sliding_window_layout)
 
     results = {"config": {
         "batch": BATCH, "heads": HEADS, "d_head": DHEAD,
@@ -80,10 +82,7 @@ def main():
         # truly LINEAR layout — the fixed mode's global columns keep its
         # active count growing with position (still ~quadratic overall)
         nb = seq // block
-        win = np.zeros((1, nb, nb), np.int64)
-        for qi in range(nb):
-            win[0, qi, max(0, qi - 7):qi + 1] = 1
-        win = np.repeat(win, HEADS, axis=0)
+        win = causal_sliding_window_layout(HEADS, nb, 8)
 
         for name, lay in (("sparse", layout), ("window", win)):
             density = float(lay.mean())

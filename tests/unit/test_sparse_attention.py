@@ -331,3 +331,16 @@ def test_per_head_different_layouts_match_reference():
                                  -1e30), axis=-1), v).sum())(q)
     np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
                                rtol=2e-3, atol=2e-3)
+
+
+def test_causal_sliding_window_layout():
+    from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+        causal_sliding_window_layout)
+    lay = causal_sliding_window_layout(2, 6, 3)
+    assert lay.shape == (2, 6, 6)
+    # row 4 attends blocks 2..4 only
+    assert lay[0, 4].tolist() == [0, 0, 1, 1, 1, 0]
+    # constant active count once past the ramp-in
+    assert (lay[0].sum(-1)[2:] == 3).all()
+    # strictly causal
+    assert not np.triu(lay[0], 1).any()

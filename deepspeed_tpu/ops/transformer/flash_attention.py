@@ -561,6 +561,11 @@ def _bwd_packed(q, k, v, bias, o, do, lse, sm_scale, causal, block_q,
     s_kp = k.shape[1]
     num_k_blocks = s_kp // block_k
     num_q_blocks = pl.cdiv(s, block_q)
+    # NOTE: a whole-K/V-resident backward (mirroring the resident forward)
+    # was tried and cannot compile at GPT-2 widths — the pipeline double-
+    # buffers the constant-index whole operands, so K+V (4M at s1024 x
+    # hd1024 bf16) plus whole q/do in the dk/dv pass overflow the 16M
+    # scoped-vmem budget; the split streaming kernels below stand.
 
     # delta_i = sum_d do*o per head: (b, s, h) fp32 (XLA fuses this)
     delta = (do.astype(jnp.float32).reshape(b, s, num_heads, d)

@@ -219,6 +219,107 @@ def squad_loss(params, input_ids, token_type_ids, attention_mask,
                   + ce(end_logits, end_positions))
 
 
+def profile_spec(config, batch_size, seq=None, seed=0, head="pretrain"):
+    """Module-tree spec for the per-module flops profiler
+    (profiling/flops_profiler: profile_module_tree/format_module_profile —
+    the reference's per-module aggregated table, profiler.py:515-677).
+    Each node prices one forward sub-function via XLA cost_analysis using
+    plain-jnp math (cost_analysis cannot see inside a pallas custom call,
+    and the dense math IS the flop count). ``head`` picks the priced output
+    head: 'pretrain' (mlm + pooler/nsp) or 'squad' (span logits)."""
+    s, d, v, L = (seq or config.max_seq_len, config.d_model,
+                  config.vocab_size, config.n_layers)
+    di = config.d_intermediate
+    h = config.n_heads
+    dt = jnp.bfloat16
+    rng = np.random.RandomState(seed)
+    norm = lambda *shape: jnp.asarray(rng.randn(*shape) * 0.02, dt)
+    x = jax.ShapeDtypeStruct((batch_size, s, d), dt)
+    ids = jax.ShapeDtypeStruct((batch_size, s), jnp.int32)
+
+    wte = norm(v, d)
+    wpe = norm(config.max_seq_len, d)
+    wtt = norm(config.type_vocab_size, d)
+    ln = {"scale": jnp.ones((d,), dt), "bias": jnp.zeros((d,), dt)}
+    qkv_w, qkv_b = norm(d, 3 * d), jnp.zeros((3 * d,), dt)
+    proj_w, proj_b = norm(d, d), jnp.zeros((d,), dt)
+    inter_w, inter_b = norm(d, di), jnp.zeros((di,), dt)
+    out_w, out_b = norm(di, d), jnp.zeros((d,), dt)
+
+    def _ln(xv):
+        mu = xv.mean(-1, keepdims=True)
+        var = ((xv - mu) ** 2).mean(-1, keepdims=True)
+        return (xv - mu) * jax.lax.rsqrt(var + config.layer_norm_eps) \
+            * ln["scale"] + ln["bias"]
+
+    def embed(idv):
+        xe = jnp.take(wte, idv, axis=0) + wpe[None, :s] + wtt[0][None, None]
+        return _ln(xe)
+
+    def attn(xv):
+        lnx = _ln(xv)
+        qkv = lnx @ qkv_w + qkv_b
+        q, k, vv = jnp.split(qkv, 3, axis=-1)
+        split = lambda t: t.reshape(batch_size, s, h, d // h) \
+            .transpose(0, 2, 1, 3)
+        q, k, vv = split(q), split(k), split(vv)
+        p = jax.nn.softmax(
+            jnp.einsum("bhqd,bhkd->bhqk", q, k) / ((d // h) ** 0.5), -1)
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", p, vv) \
+            .transpose(0, 2, 1, 3).reshape(batch_size, s, d)
+        return xv + ctx @ proj_w + proj_b
+
+    def mlp(xv):
+        lnx = _ln(xv)
+        return xv + jax.nn.gelu(lnx @ inter_w + inter_b,
+                                approximate=True) @ out_w + out_b
+
+    def layer_fn(xv):
+        return mlp(attn(xv))
+
+    def mlm_head(xv, idv):
+        hh = jax.nn.gelu(_ln(xv @ proj_w + proj_b), approximate=True)
+        logits = (hh @ wte.T).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(logp, idv[..., None], axis=-1).mean()
+
+    def pooler_nsp(xv):
+        pooled = jnp.tanh(xv[:, 0] @ proj_w + proj_b)
+        return jax.nn.log_softmax(
+            (pooled @ norm(d, 2)).astype(jnp.float32), -1).mean()
+
+    def squad_head(xv):
+        return (xv @ norm(d, 2)).astype(jnp.float32).mean()
+
+    attn_params = 4 * d * d + 6 * d
+    mlp_params = 2 * d * di + di + 3 * d
+    head_children = (
+        [{"name": "mlm_head", "fn": mlm_head, "args": (x, ids),
+          "params": d * d + d + 2 * d + v},
+         {"name": "pooler+nsp", "fn": pooler_nsp, "args": (x,),
+          "params": d * d + d + 2 * d + 2}]
+        if head == "pretrain" else
+        [{"name": "squad_head", "fn": squad_head, "args": (x,),
+          "params": 2 * d + 2}])
+    return {
+        "name": "bert(fwd, b={} s={})".format(batch_size, s),
+        "params": num_params(config),
+        "children": [
+            {"name": "embedding", "fn": embed, "args": (ids,),
+             "params": (v + config.max_seq_len + config.type_vocab_size) * d
+             + 2 * d},
+            {"name": "layer", "fn": layer_fn, "args": (x,),
+             "count": L, "params": attn_params + mlp_params,
+             "children": [
+                 {"name": "attention", "fn": attn, "args": (x,),
+                  "params": attn_params},
+                 {"name": "mlp", "fn": mlp, "args": (x,),
+                  "params": mlp_params},
+             ]},
+        ] + head_children,
+    }
+
+
 def make_bert_model(config=None, size="bert_base", seed=0, **overrides):
     """Pretraining (MLM+NSP) Model for the engine."""
     from ..runtime.model import Model
@@ -235,6 +336,8 @@ def make_bert_model(config=None, size="bert_base", seed=0, **overrides):
     model = Model(apply_fn, params, partition_spec_fn=partition_spec_fn,
                   name="bert")
     model.config = config
+    model.profile_spec_fn = lambda batch_size, seq=None: profile_spec(
+        config, batch_size, seq=seq)
     return model
 
 
@@ -260,6 +363,8 @@ def make_bert_squad_model(config=None, size="bert_base", seed=0, **overrides):
     model = Model(apply_fn, params, partition_spec_fn=partition_spec_fn,
                   name="bert_squad")
     model.config = config
+    model.profile_spec_fn = lambda batch_size, seq=None: profile_spec(
+        config, batch_size, seq=seq, head="squad")
     return model
 
 

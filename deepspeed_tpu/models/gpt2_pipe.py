@@ -10,7 +10,7 @@ import jax
 import jax.numpy as jnp
 
 from ..runtime.pipe import PipelineModule, LayerSpec, TiedLayerSpec
-from .gpt2 import GPT2Config, _block, config_for
+from .gpt2 import GPT2Config, _block, config_for, profile_spec
 
 
 class EmbeddingLayer:
@@ -120,4 +120,9 @@ def make_gpt2_pipeline(config=None, size="gpt2_small", num_stages=2,
         loss_fn=lm_loss_fn, num_dp=num_dp, num_mp=num_mp,
         activation_checkpoint_interval=activation_checkpoint_interval)
     net.config = config
+    # the pipeline runs the SAME arithmetic as the dense model, so the
+    # per-module flops table reuses gpt2.profile_spec (PipelineEngine
+    # forwards this onto its wrapped Model for the profiler)
+    net.profile_spec_fn = lambda batch_size, seq=None: profile_spec(
+        config, batch_size, seq=seq)
     return net

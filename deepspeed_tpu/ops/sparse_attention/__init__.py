@@ -3,7 +3,9 @@ deepspeed/ops/sparse_attention/__init__.py)."""
 from .sparsity_config import (SparsityConfig, DenseSparsityConfig,
                               FixedSparsityConfig, VariableSparsityConfig,
                               BigBirdSparsityConfig,
-                              BSLongformerSparsityConfig)
+                              BSLongformerSparsityConfig,
+                              SlidingWindowSparsityConfig,
+                              causal_sliding_window_layout)
 from .block_sparse_attention import (make_block_sparse_attention,
                                      build_block_index)
 from .sparse_self_attention import SparseSelfAttention, BertSparseSelfAttention

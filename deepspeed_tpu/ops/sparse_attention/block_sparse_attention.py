@@ -2,23 +2,24 @@
 
 Reference parity: deepspeed/ops/sparse_attention/matmul.py (Triton SDD/DSD
 block-sparse matmuls), softmax.py (block-sparse softmax) and
-csrc/sparse_attention/utils.cpp (sdd_segment load balancing). The
+csrc/sparse_attention/utils.cpp:117-119 (sdd_segment load balancing). The
 reference composes three Triton ops (QK^T -> masked softmax -> .V) that
 materialize block-sparse score tensors in HBM; on TPU the whole pipeline
 is one Pallas kernel with online softmax, so scores never leave VMEM and
 the layout's "which blocks exist" metadata becomes a trace-time static
-index list driving the inner loop (the analogue of sdd_segment's lut).
+index list driving the grid (the analogue of sdd_segment's lut).
 
 The layout is a numpy (num_heads, nb, nb) 0/1 matrix from
-sparsity_config.py. Per (head, q-block) we precompute the active
-k-block indices (and the transpose for the dk/dv pass) as scalar-prefetch
-arrays; the grid's innermost dimension walks the index list, so MXU work
-and k/v HBM traffic scale with the active blocks. CAVEAT: the grid pads
-every row to the layout's MAX row population — skewed layouts (a global
-row/column that attends everything, as in bslongformer/bigbird) make
-max_n ~ nb, so the masked-off slots still burn grid steps (no compute,
-but a redundant DMA each). Uniform-population layouts (sliding window,
-fixed local) pay nothing.
+sparsity_config.py. Load balancing: the active (q-block, k-block) pairs
+are FLATTENED into one grid dimension, sorted by q-block so each row's
+pairs are contiguous — the online-softmax scratch initializes at a row
+run's first pair and flushes at its last (run boundaries read from the
+scalar-prefetch arrays). Grid steps (and k/v DMAs) therefore equal the
+ACTIVE pair count exactly; skewed layouts (a global row/column attending
+everything, as in bslongformer/bigbird/fixed) cost their true work, not
+rows x max-row-population as the round-2 padded grid did. Rows with no
+active blocks get one masked dummy pair so their output block still
+initializes (zero out, NEG_INF lse).
 
 Masks (key-padding and attention) and relative position bias are folded
 into additive f32 biases; they participate in forward/recompute but do
@@ -38,7 +39,10 @@ NEG_INF = -1e30
 
 def build_block_index(layout):
     """Per (head, q-block) active k-block index lists, padded to the max
-    row population. Returns (counts[H, nb], indices[H, nb, max_n])."""
+    row population. Returns (counts[H, nb], indices[H, nb, max_n]).
+
+    Kept for API/diagnostic use (density stats, tests); the kernels run on
+    ``build_pair_index``'s balanced flat lists."""
     layout = np.asarray(layout)
     heads, nbq, nbk = layout.shape
     counts = layout.sum(axis=-1).astype(np.int32)
@@ -51,30 +55,78 @@ def build_block_index(layout):
     return counts, indices
 
 
-def _attn_fwd_kernel(nact_ref, idx_ref, q_ref, k_ref, v_ref, kpm_ref,
-                     bias_ref, o_ref, lse_ref, acc_s, m_s, l_s, *, sm_scale,
-                     block, causal, has_kpm, has_bias, max_n, shared):
-    """Grid (batch, heads, q-block, active-slot): the ACTIVE k/v blocks are
-    STREAMED by prefetch-dependent BlockSpec index maps (idx_ref drives the
-    DMA), so VMEM holds one (block, d) k/v pair at a time — sequence length
-    is HBM-bound, not VMEM-bound (whole-K/V residency OOM'd at seq 8k).
-    Online-softmax state is carried in scratch across the slot dim. Dots
-    run in the input dtype (full-rate MXU for bf16) with fp32 accumulation.
-    """
-    h = 0 if shared else pl.program_id(1)
-    qi = pl.program_id(2)
-    j = pl.program_id(3)
-    ki = idx_ref[h, qi, j]
+def build_pair_index(layout):
+    """Flatten each head's active (row-block, col-block) pairs, sorted by
+    row — the load-balanced work list (sdd_segment analogue). Empty rows
+    contribute one MASKED dummy pair so every output block is still
+    visited/initialized. Heads with fewer pairs pad with masked repeats of
+    their last pair (repeating the row keeps run boundaries intact).
 
-    @pl.when(j == 0)
+    Returns (rows[H, P], cols[H, P], valid[H, P]) int32 arrays.
+    """
+    layout = np.asarray(layout)
+    heads, nbq, nbk = layout.shape
+    per_head = []
+    for h in range(heads):
+        pairs = []
+        for qi in range(nbq):
+            active = np.nonzero(layout[h, qi])[0]
+            if len(active) == 0:
+                pairs.append((qi, 0, 0))
+            else:
+                pairs.extend((qi, int(ki), 1) for ki in active)
+        per_head.append(pairs)
+    P = max(len(p) for p in per_head)
+    rows = np.zeros((heads, P), dtype=np.int32)
+    cols = np.zeros((heads, P), dtype=np.int32)
+    valid = np.zeros((heads, P), dtype=np.int32)
+    for h, pairs in enumerate(per_head):
+        arr = np.asarray(pairs, dtype=np.int32)
+        n = len(pairs)
+        rows[h, :n], cols[h, :n], valid[h, :n] = arr.T
+        if n < P:
+            rows[h, n:] = arr[-1, 0]
+            cols[h, n:] = arr[-1, 1]
+    return rows, cols, valid
+
+
+def _run_bounds(rows_ref, h, p, npairs):
+    """Is this pair the first/last of its row run? Read from the sorted
+    prefetch array — no extra metadata needed."""
+    qi = rows_ref[h, p]
+    prev_differs = rows_ref[h, jnp.maximum(p - 1, 0)] != qi
+    next_differs = rows_ref[h, jnp.minimum(p + 1, npairs - 1)] != qi
+    first = jnp.logical_or(p == 0, prev_differs)
+    last = jnp.logical_or(p == npairs - 1, next_differs)
+    return first, last
+
+
+def _attn_fwd_kernel(rows_ref, cols_ref, valid_ref, q_ref, k_ref, v_ref,
+                     kpm_ref, bias_ref, o_ref, lse_ref, acc_s, m_s, l_s, *,
+                     sm_scale, block, causal, has_kpm, has_bias, npairs,
+                     shared):
+    """Grid (batch, heads, active-pair): q stays resident across a row run
+    (its BlockSpec index changes only when the row does); each step DMAs
+    exactly one ACTIVE k/v block via the prefetch-driven index maps, so
+    VMEM holds one (block, d) k/v pair at a time and total DMA equals the
+    active-pair count. Online-softmax state is carried in scratch across
+    the run. Dots run in the input dtype (full-rate MXU for bf16) with
+    fp32 accumulation."""
+    h = 0 if shared else pl.program_id(1)
+    p = pl.program_id(2)
+    qi = rows_ref[h, p]
+    ki = cols_ref[h, p]
+    first, last = _run_bounds(rows_ref, h, p, npairs)
+
+    @pl.when(first)
     def _init():
         acc_s[:] = jnp.zeros_like(acc_s)
         m_s[:] = jnp.full_like(m_s, NEG_INF)
         l_s[:] = jnp.zeros_like(l_s)
 
-    @pl.when(j < nact_ref[h, qi])
+    @pl.when(valid_ref[h, p] > 0)
     def _accumulate():
-        q = q_ref[0, 0]                                     # (B, d)
+        q = q_ref[0, 0]                                     # (B, d) resident
         k_blk = k_ref[0, 0]                                 # (B, d) streamed
         v_blk = v_ref[0, 0]
         s = jax.lax.dot_general(
@@ -93,15 +145,15 @@ def _attn_fwd_kernel(nact_ref, idx_ref, q_ref, k_ref, v_ref, kpm_ref,
         m_new = jnp.maximum(m_old, jnp.max(s, axis=-1, keepdims=True))
         # Rows where every score so far is masked (m_new still NEG_INF)
         # must not resolve exp(NEG_INF - NEG_INF) to 1.
-        p = jnp.where(m_new <= NEG_INF, 0.0, jnp.exp(s - m_new))
+        p_ = jnp.where(m_new <= NEG_INF, 0.0, jnp.exp(s - m_new))
         corr = jnp.exp(m_old - m_new)
-        l_s[:] = l_s[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        l_s[:] = l_s[:] * corr + jnp.sum(p_, axis=-1, keepdims=True)
         m_s[:] = m_new
         acc_s[:] = acc_s[:] * corr + jax.lax.dot_general(
-            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            p_.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(j == max_n - 1)
+    @pl.when(last)
     def _flush():
         l = l_s[:]
         l_safe = jnp.where(l == 0.0, 1.0, l)
@@ -110,19 +162,21 @@ def _attn_fwd_kernel(nact_ref, idx_ref, q_ref, k_ref, v_ref, kpm_ref,
                                   m_s[:] + jnp.log(l_safe))
 
 
-def _attn_dq_kernel(nact_ref, idx_ref, q_ref, k_ref, v_ref, kpm_ref, bias_ref,
-                    do_ref, lse_ref, delta_ref, dq_ref, dq_s, *, sm_scale,
-                    block, causal, has_kpm, has_bias, max_n, shared):
+def _attn_dq_kernel(rows_ref, cols_ref, valid_ref, q_ref, k_ref, v_ref,
+                    kpm_ref, bias_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                    dq_s, *, sm_scale, block, causal, has_kpm, has_bias,
+                    npairs, shared):
     h = 0 if shared else pl.program_id(1)
-    qi = pl.program_id(2)
-    j = pl.program_id(3)
-    ki = idx_ref[h, qi, j]
+    p = pl.program_id(2)
+    qi = rows_ref[h, p]
+    ki = cols_ref[h, p]
+    first, last = _run_bounds(rows_ref, h, p, npairs)
 
-    @pl.when(j == 0)
+    @pl.when(first)
     def _init():
         dq_s[:] = jnp.zeros_like(dq_s)
 
-    @pl.when(j < nact_ref[h, qi])
+    @pl.when(valid_ref[h, p] > 0)
     def _accumulate():
         q = q_ref[0, 0]
         do = do_ref[0, 0]
@@ -143,38 +197,39 @@ def _attn_dq_kernel(nact_ref, idx_ref, q_ref, k_ref, v_ref, kpm_ref, bias_ref,
             k_iota = jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
             s = jnp.where(q_pos >= ki * block + k_iota, s, NEG_INF)
         # Rows with no surviving score (lse == NEG_INF) contribute nothing.
-        p = jnp.where(lse <= NEG_INF, 0.0, jnp.exp(s - lse))
+        p_ = jnp.where(lse <= NEG_INF, 0.0, jnp.exp(s - lse))
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta) * sm_scale).astype(k_blk.dtype)
+        ds = (p_ * (dp - delta) * sm_scale).astype(k_blk.dtype)
         dq_s[:] = dq_s[:] + jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(j == max_n - 1)
+    @pl.when(last)
     def _flush():
         dq_ref[0, 0] = dq_s[:].astype(dq_ref.dtype)
 
 
-def _attn_dkdv_kernel(nact_ref, idx_ref, q_ref, k_ref, v_ref, kpm_ref,
-                      bias_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                      dk_s, dv_s, *, sm_scale, block, causal, has_kpm,
-                      has_bias, max_n, shared):
-    """Transposed walk: k/v (and the kpm columns) stay resident per
-    (head, k-block) while the ACTIVE q/do/lse/delta blocks stream in via
-    the transposed index list."""
+def _attn_dkdv_kernel(rows_ref, cols_ref, valid_ref, q_ref, k_ref, v_ref,
+                      kpm_ref, bias_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                      dv_ref, dk_s, dv_s, *, sm_scale, block, causal,
+                      has_kpm, has_bias, npairs, shared):
+    """Transposed walk: the pair list comes from the TRANSPOSED layout
+    (sorted by k-block), so k/v (and the kpm columns) stay resident per
+    k-block run while the ACTIVE q/do/lse/delta blocks stream in."""
     h = 0 if shared else pl.program_id(1)
-    ki = pl.program_id(2)
-    j = pl.program_id(3)
-    qi = idx_ref[h, ki, j]
+    p = pl.program_id(2)
+    ki = rows_ref[h, p]
+    qi = cols_ref[h, p]
+    first, last = _run_bounds(rows_ref, h, p, npairs)
 
-    @pl.when(j == 0)
+    @pl.when(first)
     def _init():
         dk_s[:] = jnp.zeros_like(dk_s)
         dv_s[:] = jnp.zeros_like(dv_s)
 
-    @pl.when(j < nact_ref[h, ki])
+    @pl.when(valid_ref[h, p] > 0)
     def _accumulate():
         k_blk = k_ref[0, 0]                                 # resident
         v_blk = v_ref[0, 0]
@@ -194,19 +249,19 @@ def _attn_dkdv_kernel(nact_ref, idx_ref, q_ref, k_ref, v_ref, kpm_ref,
                 jnp.int32, (block, block), 1)
             q_iota = jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
             s = jnp.where(qi * block + q_iota >= k_pos, s, NEG_INF)
-        p = jnp.where(lse_blk <= NEG_INF, 0.0, jnp.exp(s - lse_blk))
+        p_ = jnp.where(lse_blk <= NEG_INF, 0.0, jnp.exp(s - lse_blk))
         dv_s[:] = dv_s[:] + jax.lax.dot_general(
-            p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
+            p_.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do_blk, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta_blk) * sm_scale).astype(q_blk.dtype)
+        ds = (p_ * (dp - delta_blk) * sm_scale).astype(q_blk.dtype)
         dk_s[:] = dk_s[:] + jax.lax.dot_general(
             ds, q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(j == max_n - 1)
+    @pl.when(last)
     def _flush():
         dk_ref[0, 0] = dk_s[:].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_s[:].astype(dv_ref.dtype)
@@ -227,43 +282,46 @@ def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
     layout = np.asarray(layout)
     heads, nb, _ = layout.shape
     seq = nb * block
-    # The prefetch index lists live in SMEM (~1M): collapse them to ONE
-    # copy when every head shares the layout (different_layout_per_head
-    # False, the default) — at seq 16k the per-head transposed list alone
-    # is 16*128*128 int32 = 1M and OOMs SMEM.
+    # The prefetch index lists live in SMEM: collapse them to ONE copy
+    # when every head shares the layout (different_layout_per_head False,
+    # the default).
     shared = bool((layout == layout[:1]).all())
     idx_layout = layout[:1] if shared else layout
-    nact_f, idx_f = build_block_index(idx_layout)
-    nact_b, idx_b = build_block_index(idx_layout.transpose(0, 2, 1))
-    max_f = int(idx_f.shape[-1])
-    max_b = int(idx_b.shape[-1])
+    rows_f, cols_f, valid_f = build_pair_index(idx_layout)
+    rows_b, cols_b, valid_b = build_pair_index(idx_layout.transpose(0, 2, 1))
+    np_f = int(rows_f.shape[-1])
+    np_b = int(rows_b.shape[-1])
 
     def _specs(batch_d):
-        """Grid (batch, head, row-block, active-slot). ``anchor`` blocks
-        keep their index while the slot dim varies (pallas holds them
-        resident); ``stream`` blocks follow the scalar-prefetch index list
-        — the pipeline DMAs exactly the active block for each slot, so
-        VMEM never holds whole-sequence operands (the former whole-K/V
-        residency OOM'd scoped vmem at seq 8k)."""
+        """Grid (batch, head, active-pair). ``anchor`` blocks follow the
+        pair's ROW index — constant across a row run, so pallas holds them
+        resident and re-DMAs only at run boundaries; ``stream`` blocks
+        follow the COLUMN index — the pipeline DMAs exactly the active
+        block for each pair, so VMEM never holds whole-sequence operands
+        and total traffic equals the active-pair count."""
         hsel = (lambda h: 0) if shared else (lambda h: h)
-        anchor = pl.BlockSpec((1, 1, block, batch_d),
-                              lambda b, h, i, j, n, ix: (b, h, i, 0))
+        anchor = pl.BlockSpec(
+            (1, 1, block, batch_d),
+            lambda b, h, p, rw, cl, va: (b, h, rw[hsel(h), p], 0))
         stream = pl.BlockSpec(
             (1, 1, block, batch_d),
-            lambda b, h, i, j, n, ix: (b, h, ix[hsel(h), i, j], 0))
-        anchor_col = pl.BlockSpec((1, 1, block, 1),
-                                  lambda b, h, i, j, n, ix: (b, h, i, 0))
+            lambda b, h, p, rw, cl, va: (b, h, cl[hsel(h), p], 0))
+        anchor_col = pl.BlockSpec(
+            (1, 1, block, 1),
+            lambda b, h, p, rw, cl, va: (b, h, rw[hsel(h), p], 0))
         stream_col = pl.BlockSpec(
             (1, 1, block, 1),
-            lambda b, h, i, j, n, ix: (b, h, ix[hsel(h), i, j], 0))
+            lambda b, h, p, rw, cl, va: (b, h, cl[hsel(h), p], 0))
         kpm_stream = pl.BlockSpec(
-            (1, block), lambda b, h, i, j, n, ix: (b, ix[hsel(h), i, j]))
-        kpm_anchor = pl.BlockSpec((1, block),
-                                  lambda b, h, i, j, n, ix: (b, i))
+            (1, block), lambda b, h, p, rw, cl, va: (b, cl[hsel(h), p]))
+        kpm_anchor = pl.BlockSpec(
+            (1, block), lambda b, h, p, rw, cl, va: (b, rw[hsel(h), p]))
         bias_fwd = pl.BlockSpec(
-            (block, block), lambda b, h, i, j, n, ix: (i, ix[hsel(h), i, j]))
+            (block, block),
+            lambda b, h, p, rw, cl, va: (rw[hsel(h), p], cl[hsel(h), p]))
         bias_bwd = pl.BlockSpec(
-            (block, block), lambda b, h, i, j, n, ix: (ix[hsel(h), i, j], i))
+            (block, block),
+            lambda b, h, p, rw, cl, va: (cl[hsel(h), p], rw[hsel(h), p]))
         return (anchor, stream, anchor_col, stream_col, kpm_stream,
                 kpm_anchor, bias_fwd, bias_bwd)
 
@@ -286,13 +344,13 @@ def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
         ops = [q, k, v] + _mask_ops(kpm, bias)
         kernel = functools.partial(
             _kernel_shim, _attn_fwd_kernel, has_kpm, has_bias,
-            sm_scale=scale, block=block, causal=causal, max_n=max_f,
+            sm_scale=scale, block=block, causal=causal, npairs=np_f,
             shared=shared)
         out, lse = pl.pallas_call(
             kernel,
             grid_spec=pltpu.PrefetchScalarGridSpec(
-                num_scalar_prefetch=2,
-                grid=(batch, heads, nb, max_f),
+                num_scalar_prefetch=3,
+                grid=(batch, heads, np_f),
                 in_specs=in_specs,
                 out_specs=(anchor, anchor_col),
                 scratch_shapes=[pltpu.VMEM((block, d), jnp.float32),
@@ -301,7 +359,8 @@ def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
             out_shape=(jax.ShapeDtypeStruct(q.shape, q.dtype),
                        jax.ShapeDtypeStruct((batch, h, s, 1), jnp.float32)),
             interpret=interpret,
-        )(jnp.asarray(nact_f), jnp.asarray(idx_f), *ops)
+        )(jnp.asarray(rows_f), jnp.asarray(cols_f), jnp.asarray(valid_f),
+          *ops)
         return out, lse
 
     def _bwd(q, k, v, kpm, bias, out, lse, do):
@@ -317,35 +376,35 @@ def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
         mask_ops = _mask_ops(kpm, bias)
         dq_kernel = functools.partial(
             _kernel_shim, _attn_dq_kernel, has_kpm, has_bias,
-            sm_scale=scale, block=block, causal=causal, max_n=max_f,
+            sm_scale=scale, block=block, causal=causal, npairs=np_f,
             shared=shared)
         dq = pl.pallas_call(
             dq_kernel,
             grid_spec=pltpu.PrefetchScalarGridSpec(
-                num_scalar_prefetch=2,
-                grid=(batch, heads, nb, max_f),
+                num_scalar_prefetch=3,
+                grid=(batch, heads, np_f),
                 in_specs=[anchor, stream, stream] + mask_specs +
                          [anchor, anchor_col, anchor_col],
                 out_specs=anchor,
                 scratch_shapes=[pltpu.VMEM((block, d), jnp.float32)]),
             out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
             interpret=interpret,
-        )(jnp.asarray(nact_f), jnp.asarray(idx_f), q, k, v, *mask_ops, do,
-          lse, delta)
+        )(jnp.asarray(rows_f), jnp.asarray(cols_f), jnp.asarray(valid_f),
+          q, k, v, *mask_ops, do, lse, delta)
 
-        # dk/dv pass walks the transposed layout: k/v anchored per
-        # k-block, q/do/lse/delta streamed by the transposed index list.
+        # dk/dv pass walks the transposed pair list: k/v anchored per
+        # k-block run, q/do/lse/delta streamed.
         mask_specs_t = ([kpm_anchor] if has_kpm else []) + \
                        ([bias_bwd] if has_bias else [])
         dkdv_kernel = functools.partial(
             _kernel_shim, _attn_dkdv_kernel, has_kpm, has_bias,
-            sm_scale=scale, block=block, causal=causal, max_n=max_b,
+            sm_scale=scale, block=block, causal=causal, npairs=np_b,
             shared=shared)
         dk, dv = pl.pallas_call(
             dkdv_kernel,
             grid_spec=pltpu.PrefetchScalarGridSpec(
-                num_scalar_prefetch=2,
-                grid=(batch, heads, nb, max_b),
+                num_scalar_prefetch=3,
+                grid=(batch, heads, np_b),
                 in_specs=[stream, anchor, anchor] + mask_specs_t +
                          [stream, stream_col, stream_col],
                 out_specs=(anchor, anchor),
@@ -354,8 +413,8 @@ def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
             out_shape=(jax.ShapeDtypeStruct(k.shape, k.dtype),
                        jax.ShapeDtypeStruct(v.shape, v.dtype)),
             interpret=interpret,
-        )(jnp.asarray(nact_b), jnp.asarray(idx_b), q, k, v, *mask_ops, do,
-          lse, delta)
+        )(jnp.asarray(rows_b), jnp.asarray(cols_b), jnp.asarray(valid_b),
+          q, k, v, *mask_ops, do, lse, delta)
         return dq, dk, dv
 
     @jax.custom_vjp
@@ -378,8 +437,8 @@ def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
     return attn
 
 
-def _kernel_shim(kernel, has_kpm, has_bias, nact_ref, idx_ref, *refs,
-                 **params):
+def _kernel_shim(kernel, has_kpm, has_bias, rows_ref, cols_ref, valid_ref,
+                 *refs, **params):
     """Re-inserts None placeholders for absent mask operands so each kernel
     keeps one signature."""
     refs = list(refs)
@@ -387,5 +446,5 @@ def _kernel_shim(kernel, has_kpm, has_bias, nact_ref, idx_ref, *refs,
     rest = refs[3:]
     kpm_ref = rest.pop(0) if has_kpm else None
     bias_ref = rest.pop(0) if has_bias else None
-    kernel(nact_ref, idx_ref, q_ref, k_ref, v_ref, kpm_ref, bias_ref, *rest,
-           has_kpm=has_kpm, has_bias=has_bias, **params)
+    kernel(rows_ref, cols_ref, valid_ref, q_ref, k_ref, v_ref, kpm_ref,
+           bias_ref, *rest, has_kpm=has_kpm, has_bias=has_bias, **params)

@@ -39,7 +39,12 @@ class SparseSelfAttention:
         self.key_padding_mask_mode = key_padding_mask_mode
         self.attn_mask_mode = attn_mask_mode
         self.max_seq_length = max_seq_length
-        self.causal = causal
+        # layouts that are causal by construction (sliding_window) force
+        # intra-block causal masking — a bidirectional softmax over a
+        # causal block layout would silently attend padding-future keys
+        # inside the diagonal blocks
+        self.causal = causal or getattr(self.sparsity_config,
+                                        "requires_causal", False)
         self.interpret = interpret
         self.master_layout = self.sparsity_config.make_layout(max_seq_length)
         self._kernels = {}

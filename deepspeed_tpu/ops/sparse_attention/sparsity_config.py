@@ -29,7 +29,8 @@ def sparsity_config_from_dict(config, num_heads):
     classes = {"dense": DenseSparsityConfig, "fixed": FixedSparsityConfig,
                "variable": VariableSparsityConfig,
                "bigbird": BigBirdSparsityConfig,
-               "bslongformer": BSLongformerSparsityConfig}
+               "bslongformer": BSLongformerSparsityConfig,
+               "sliding_window": SlidingWindowSparsityConfig}
     if mode not in classes:
         raise NotImplementedError(
             f"Given sparsity mode, {mode}, has not been implemented yet!")
@@ -357,6 +358,41 @@ class BSLongformerSparsityConfig(SparsityConfig):
         for h in range(self.num_layout_heads):
             layout[h][self._head_mask(num_blocks)] = 1
         return self.check_and_propagate_first_head_layout(layout)
+
+
+class SlidingWindowSparsityConfig(SparsityConfig):
+    """Pure causal sliding window — the TPU-extension layout
+    (``causal_sliding_window_layout``) as a first-class, ds_config-reachable
+    SparsityConfig: ``{"sparse_attention": {"mode": "sliding_window", ...}}``.
+
+    Every query block attends its previous ``num_sliding_window_blocks``
+    blocks (itself included), so active blocks per row are CONSTANT and
+    attention cost is linear in sequence length. This is the only shipped
+    layout measured FASTER than dense flash attention on TPU
+    (tests/perf/SPARSE_VS_DENSE.json: 3.1x at seq 32768, crossover 16384);
+    the reference modes' global rows/columns grow per-row work with
+    position. The layout is causal by construction, so
+    :class:`SparseSelfAttention` forces intra-block causal masking for it
+    (``requires_causal``).
+    """
+
+    requires_causal = True
+
+    def __init__(self, num_heads, block=16, different_layout_per_head=False,
+                 num_sliding_window_blocks=3):
+        super().__init__(num_heads, block, different_layout_per_head)
+        if num_sliding_window_blocks < 1:
+            raise ValueError(
+                f"num_sliding_window_blocks "
+                f"({num_sliding_window_blocks}) must be >= 1")
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+
+    def make_layout(self, seq_len):
+        self.setup_layout(seq_len)  # validates divisibility
+        num_blocks = seq_len // self.block
+        return causal_sliding_window_layout(
+            self.num_heads, num_blocks,
+            min(self.num_sliding_window_blocks, num_blocks))
 
 
 def causal_sliding_window_layout(num_heads, num_blocks, window_blocks):

@@ -16,6 +16,7 @@ follows the standard flash decomposition (dq accumulated across the k loop;
 dk/dv accumulated in VMEM scratch across the sequential TPU grid).
 """
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -549,10 +550,157 @@ def _fwd_packed(q, k, v, bias, sm_scale, causal, block_q, block_k,
     return out, lse
 
 
+def _bwd_fused_kernel_packed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                             bias_ref, dq_hbm, dk_ref, dv_ref, dk_acc,
+                             dv_acc, dq_vmem, sem_rd, sem_wr, *, sm_scale,
+                             block_q, block_k, num_q_blocks, causal,
+                             seq_len, num_heads, d_head):
+    """Single-pass packed backward: grid (b, k blocks, q blocks). One walk
+    of the (q, k) block pairs computes ALL of dq/dk/dv — 5 dots per pair
+    vs the split kernels' 7 (each split pass re-derives s = qk^T and
+    dp = do v^T). dk/dv accumulate in fp32 scratch across the inner q
+    dimension exactly like the split dk/dv kernel; dq — whose accumulation
+    runs across the OUTER k dimension — lives in an fp32 HBM output and is
+    read-modified-written per step by explicit DMAs. The in-step
+    ``wait()`` on the write-back makes the cross-step accumulation
+    well-defined on the sequential TPU grid (the BlockSpec pipeline offers
+    no such guarantee for revisited blocks, which is why round 2 split the
+    kernels); the blocking transfers are ~1 MB against ~ms of MXU work
+    per step."""
+    bi = pl.program_id(0)
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    k_base = ki * block_k
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    live = (qi + 1) * block_q > k_base if causal else True
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = k_base + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = k_pos < seq_len
+    if causal:
+        mask = jnp.logical_and(mask, q_pos >= k_pos)
+
+    dq_slice = dq_hbm.at[bi, pl.ds(qi * block_q, block_q)]
+
+    @pl.when(live)
+    def _compute():
+        # causality keeps ki == 0 live for every row, so the first visit
+        # of each dq block is always at ki == 0: zero-init there, read the
+        # running sum back otherwise
+        @pl.when(ki == 0)
+        def _zero():
+            dq_vmem[:] = jnp.zeros_like(dq_vmem)
+
+        @pl.when(ki > 0)
+        def _read():
+            cp = pltpu.make_async_copy(dq_slice, dq_vmem, sem_rd)
+            cp.start()
+            cp.wait()
+
+        for hi in range(num_heads):
+            sl = slice(hi * d_head, (hi + 1) * d_head)
+            q = q_ref[0][:, sl]
+            do = do_ref[0][:, sl]
+            k_blk = k_ref[0][:, sl]
+            p, ds = _bwd_head_terms(
+                q, k_blk, v_ref[0][:, sl], do,
+                lse_ref[0][:, hi:hi + 1], delta_ref[0][:, hi:hi + 1],
+                mask, sm_scale, bias_ref[0])
+            dq_vmem[:, sl] = dq_vmem[:, sl] + jax.lax.dot_general(
+                ds, k_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dv_acc[:, sl] = dv_acc[:, sl] + jax.lax.dot_general(
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dk_acc[:, sl] = dk_acc[:, sl] + jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        cp = pltpu.make_async_copy(dq_vmem, dq_slice, sem_wr)
+        cp.start()
+        cp.wait()
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _flush():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_fused_packed(q, k, v, bias, o, do, lse, sm_scale, causal, block_q,
+                      block_k, interpret, num_heads):
+    """Driver for the single-pass fused backward. Returns (dq, dk, dv)
+    numerically identical to _bwd_packed (same _bwd_head_terms math)."""
+    b, s, hd = q.shape
+    d = hd // num_heads
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    k, v = _pad_kv(k, v, block_k)
+    s_kp = k.shape[1]
+    num_k_blocks = s_kp // block_k
+
+    delta = (do.astype(jnp.float32).reshape(b, s, num_heads, d)
+             * o.astype(jnp.float32).reshape(b, s, num_heads, d)).sum(-1)
+
+    pad_q = (-s) % block_q
+    if pad_q:
+        pad3 = lambda t: jnp.pad(t, ((0, 0), (0, pad_q), (0, 0)))
+        q_p, do_p, lse_p, delta_p = (pad3(q), pad3(do), pad3(lse),
+                                     pad3(delta))
+    else:
+        q_p, do_p, lse_p, delta_p = q, do, lse, delta
+    s_qp = q_p.shape[1]
+    nqb = s_qp // block_q
+
+    q_blk = pl.BlockSpec((1, block_q, hd), lambda bi, ki, qi: (bi, qi, 0))
+    kv_blk = pl.BlockSpec((1, block_k, hd), lambda bi, ki, qi: (bi, ki, 0))
+    lse_blk = pl.BlockSpec((1, block_q, num_heads),
+                           lambda bi, ki, qi: (bi, qi, 0))
+    bias_blk = pl.BlockSpec((1, 1, block_k), lambda bi, ki, qi: (bi, 0, ki))
+
+    dq_f32, dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_fused_kernel_packed, sm_scale=sm_scale, block_q=block_q,
+            block_k=block_k, num_q_blocks=nqb, causal=causal, seq_len=s,
+            num_heads=num_heads, d_head=d),
+        grid=(b, num_k_blocks, nqb),
+        in_specs=[q_blk, kv_blk, kv_blk, q_blk, lse_blk, lse_blk, bias_blk],
+        out_specs=(pl.BlockSpec(memory_space=pltpu.ANY), kv_blk, kv_blk),
+        out_shape=(jax.ShapeDtypeStruct((b, s_qp, hd), jnp.float32),
+                   jax.ShapeDtypeStruct((b, s_kp, hd), q.dtype),
+                   jax.ShapeDtypeStruct((b, s_kp, hd), q.dtype)),
+        scratch_shapes=[pltpu.VMEM((block_k, hd), jnp.float32),
+                        pltpu.VMEM((block_k, hd), jnp.float32),
+                        pltpu.VMEM((block_q, hd), jnp.float32),
+                        pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA],
+        interpret=interpret,
+    )(q_p, k, v, do_p, lse_p, delta_p, bias)
+    return dq_f32[:, :s].astype(q.dtype), dk[:, :s], dv[:, :s]
+
+
 def _bwd_packed(q, k, v, bias, o, do, lse, sm_scale, causal, block_q,
                 block_k, interpret, num_heads):
-    """Two pallas calls (dq; then dk/dv over k-blocks) — see the kernels
-    for why the backward is split. ``bias`` as in _fwd_packed."""
+    """Packed backward dispatcher: the single-pass fused kernel where it
+    fits (hd <= 1280 — one walk of the block pairs, 5 dots each), the
+    split dq + dk/dv pair beyond. ``bias`` as in _fwd_packed."""
+    if _use_fused_bwd(q.shape[-1]):
+        return _bwd_fused_packed(q, k, v, bias, o, do, lse, sm_scale,
+                                 causal, block_q, block_k, interpret,
+                                 num_heads)
+    return _bwd_split_packed(q, k, v, bias, o, do, lse, sm_scale, causal,
+                             block_q, block_k, interpret, num_heads)
+
+
+def _bwd_split_packed(q, k, v, bias, o, do, lse, sm_scale, causal, block_q,
+                      block_k, interpret, num_heads):
+    """Two pallas calls (dq; then dk/dv over k-blocks) — the fallback for
+    widths whose fused working set overflows scoped vmem."""
     b, s, hd = q.shape
     d = hd // num_heads
     block_q = min(block_q, s)
@@ -635,13 +783,31 @@ DEFAULT_BLOCK_PACKED = 256
 DEFAULT_BLOCK_PACKED_K = 512
 
 
+# The single-pass FUSED backward (5 dots/pair vs the split kernels' 7)
+# carries a larger VMEM working set (k/v + dk/dv scratch + the dq RMW
+# buffer), so its width ceiling is lower: measured compile limit is
+# hd = 1280; gpt2-xl (1600) falls back to the split kernels.
+# DS_FLASH_FUSED_BWD=0 forces the split path everywhere.
+FUSED_BWD = os.environ.get("DS_FLASH_FUSED_BWD", "1") != "0"
+FUSED_BWD_MAX_WIDTH = 1280
+
+
+def _use_fused_bwd(hd):
+    return FUSED_BWD and hd <= FUSED_BWD_MAX_WIDTH
+
+
 def auto_blocks(hd):
     """BACKWARD (block_q, block_k) for the packed kernels by activation
-    width h*d. The bwd kernels hold q/do (Bq, hd) and k/v (Bk, hd) slabs
-    double-buffered plus a (Bq or Bk, hd) fp32 scratch in the 16M
+    width h*d, keyed to the path _bwd_packed will take. Fused (one walk
+    computes dq/dk/dv): (256, 256) measures fastest to GPT-2-medium width
+    (8.3 vs the split path's 9.6 ms at the bench shape), (128, 256) at
+    hd 1280. Split: the bwd kernels hold q/do (Bq, hd) and k/v (Bk, hd)
+    slabs double-buffered plus a (Bq or Bk, hd) fp32 scratch in the 16M
     scoped-vmem budget; (256, 512) measures fastest up to GPT-2-medium
     width but overflows by ~1M at gpt2-xl's hd=1600, so blocks shrink as
     the width grows."""
+    if _use_fused_bwd(hd):
+        return (256, 256) if hd <= 1024 else (128, 256)
     if hd <= 1024:
         return DEFAULT_BLOCK_PACKED, DEFAULT_BLOCK_PACKED_K
     if hd <= 1280:
@@ -724,12 +890,15 @@ def flash_attention_bshd(q, k, v, sm_scale=None, causal=True,
     b, s, h, d = q.shape
     # None block args resolve by width so EVERY caller (GPT-2, the BERT
     # encoder layer, module_inject'ed models) stays inside scoped vmem.
-    # Explicit fwd blocks still win and (as before) flow to the bwd
-    # unless bwd blocks are ALSO explicit — sweep harnesses rely on that.
+    # Explicit FWD blocks do NOT flow into the backward: the bwd kernels'
+    # working set is larger, so a caller tuning only the forward (e.g.
+    # block_q=512) would silently push the bwd past the 16M scoped-vmem
+    # budget auto_blocks exists to respect. Sweep the bwd with the
+    # explicit bwd_block_* args (tests/perf/sweep_flash_bwd_blocks.py).
     fq, fk = auto_fwd_blocks(h * d)
     bq_auto, bk_auto = auto_blocks(h * d)
-    bwd_block_q = bwd_block_q or block_q or bq_auto
-    bwd_block_k = bwd_block_k or block_k or bk_auto
+    bwd_block_q = bwd_block_q or bq_auto
+    bwd_block_k = bwd_block_k or bk_auto
     block_q = block_q or fq
     block_k = block_k or fk
     if mask_bias is None:
@@ -771,13 +940,13 @@ def fused_ln_qkv_attention(x, ln_scale, ln_bias, qkv_w, qkv_b, num_heads,
                            bwd_block_q=None, bwd_block_k=None):
     """x: (b, s, d_model) -> attention context (b, s, d_model), causal,
     sm_scale fixed at 1/sqrt(d_head). None block args resolve by width
-    (auto_fwd_blocks / auto_blocks); explicit fwd blocks flow to the bwd
-    unless bwd blocks are also explicit."""
+    (auto_fwd_blocks / auto_blocks); explicit fwd blocks do NOT flow into
+    the bwd (its vmem budget is tighter — pass bwd_block_* to tune it)."""
     hd = x.shape[-1]
     fq, fk = auto_fwd_blocks(hd)
     bq_auto, bk_auto = auto_blocks(hd)
-    bwd_block_q = bwd_block_q or block_q or bq_auto
-    bwd_block_k = bwd_block_k or block_k or bk_auto
+    bwd_block_q = bwd_block_q or bq_auto
+    bwd_block_k = bwd_block_k or bk_auto
     return _fused_lnqkv_core(x, ln_scale, ln_bias, qkv_w, qkv_b, num_heads,
                              eps, causal, block_q or fq, block_k or fk,
                              interpret, bwd_block_q, bwd_block_k)
@@ -800,8 +969,12 @@ def _fused_lnqkv_attn_fwd(x, ln_scale, ln_bias, qkv_w, qkv_b, num_heads,
     b, s, hd = x.shape
     d = hd // num_heads
     q, k, v = _lnqkv(x, ln_scale, ln_bias, qkv_w, qkv_b, eps)
-    bias = jnp.zeros((b, 1, ((s + block_k - 1) // block_k) * block_k),
-                     jnp.float32)
+    # the kernels clamp block_k to min(block_k, s); pad the (zero) bias at
+    # the SAME clamped grain or its lane count falls out of step with the
+    # padded k length for s < block_k (matters the day a key-padding mask
+    # is threaded through this op)
+    bk = min(block_k, s)
+    bias = jnp.zeros((b, 1, ((s + bk - 1) // bk) * bk), jnp.float32)
     out, lse = _fwd_packed(q, k, v, bias, 1.0 / (d ** 0.5), causal,
                            block_q, block_k, interpret, num_heads)
     return out, (x, ln_scale, ln_bias, qkv_w, qkv_b, out, lse)
@@ -815,9 +988,8 @@ def _fused_lnqkv_attn_bwd(num_heads, eps, causal, block_q, block_k,
     (q, k, v), lnqkv_vjp = jax.vjp(
         lambda x_, s_, b_, w_, bb_: _lnqkv(x_, s_, b_, w_, bb_, eps),
         x, ln_scale, ln_bias, qkv_w, qkv_b)
-    bias = jnp.zeros(
-        (b, 1, ((s + bwd_block_k - 1) // bwd_block_k) * bwd_block_k),
-        jnp.float32)
+    bbk = min(bwd_block_k, s)
+    bias = jnp.zeros((b, 1, ((s + bbk - 1) // bbk) * bbk), jnp.float32)
     dq, dk, dv = _bwd_packed(q, k, v, bias, out, do, lse,
                              1.0 / (d ** 0.5), causal, bwd_block_q,
                              bwd_block_k, interpret, num_heads)

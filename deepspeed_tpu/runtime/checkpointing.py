@@ -33,6 +33,77 @@ def tree_to_numpy(tree):
     return jax.tree_util.tree_map(to_np, tree)
 
 
+def shard_key(index):
+    """Serializable key for a shard's tuple-of-slices index."""
+    return tuple((s.start, s.stop, s.step) for s in index)
+
+
+def key_to_index(key):
+    return tuple(slice(a, b, c) for a, b, c in key)
+
+
+def _is_full_cover(key, shape):
+    return all((a in (None, 0)) and (b is None or b == dim) and
+               c in (None, 1)
+               for (a, b, c), dim in zip(key, shape)) or len(key) == 0
+
+
+def shard_lists_of_tree(tree, write_replicated):
+    """Per-leaf ``(global_shape, [(key, np.array), ...])`` entries of this
+    process's unique addressable shards, in tree_flatten order — the
+    device-state analogue of the offload path's host shard files
+    (reference per-rank zero_pp_rank files, engine.py:1350-1377). Shapes
+    ride along so reassembly needs no template (the saved layout may
+    differ from the loading engine's, e.g. pipeline re-partitioning).
+    Fully-replicated leaves are written only when ``write_replicated``
+    (process 0), so N processes don't store N copies."""
+    import jax.numpy as jnp
+    flat, _ = jax.tree_util.tree_flatten(tree)
+    out = []
+    for leaf in flat:
+        entries, seen = [], set()
+        arr = leaf if isinstance(leaf, jax.Array) else jnp.asarray(leaf)
+        for sh in arr.addressable_shards:
+            key = shard_key(sh.index)
+            if key in seen:
+                continue
+            seen.add(key)
+            if _is_full_cover(key, arr.shape) and not write_replicated:
+                continue
+            entries.append((key, np.asarray(sh.data)))
+        out.append((tuple(arr.shape), entries))
+    return out
+
+
+def assemble_shard_lists(per_file_lists, what="leaf"):
+    """Reassemble full numpy leaves from every process's shard lists
+    (each: the output of ``shard_lists_of_tree`` loaded from one zero
+    file). Raises if the union of shards does not cover a leaf
+    (checkpoint written with an incomplete process set)."""
+    n_leaves = len(per_file_lists[0])
+    out = []
+    for i in range(n_leaves):
+        shape = tuple(per_file_lists[0][i][0])
+        buf = np.zeros(shape, np.float32)
+        seen, covered = set(), 0
+        for lists in per_file_lists:
+            for key, data in lists[i][1]:
+                key = tuple(map(tuple, key))
+                if key in seen:
+                    continue
+                seen.add(key)
+                buf[key_to_index(key)] = data
+                covered += int(np.prod(np.shape(data)))
+        if covered != int(np.prod(shape)):
+            raise RuntimeError(
+                "zero shard files cover {}/{} elements of {} {} — "
+                "checkpoint is missing per-rank files; resume with the "
+                "layout it was saved under".format(
+                    covered, int(np.prod(shape)), what, i))
+        out.append(buf)
+    return out
+
+
 def save_state_dict(path, state_dict):
     os.makedirs(os.path.dirname(path), exist_ok=True)
     with open(path, "wb") as f:

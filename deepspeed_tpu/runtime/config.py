@@ -155,6 +155,8 @@ def get_sparse_attention(param_dict):
         return get_sparse_bigbird_config(sparsity)
     elif mode == SPARSE_BSLONGFORMER_MODE:
         return get_sparse_bslongformer_config(sparsity)
+    elif mode == SPARSE_SLIDING_WINDOW_MODE:
+        return get_sparse_sliding_window_config(sparsity)
     else:
         raise NotImplementedError(
             "Given sparsity mode, {}, has not been implemented yet!".format(mode))
@@ -237,6 +239,17 @@ def get_sparse_bigbird_config(sparsity):
         SPARSE_NUM_GLOBAL_BLOCKS:
             get_scalar_param(sparsity, SPARSE_NUM_GLOBAL_BLOCKS,
                              SPARSE_NUM_GLOBAL_BLOCKS_DEFAULT),
+    }
+
+
+def get_sparse_sliding_window_config(sparsity):
+    return {
+        SPARSE_MODE: SPARSE_SLIDING_WINDOW_MODE,
+        SPARSE_BLOCK:
+            get_scalar_param(sparsity, SPARSE_BLOCK, SPARSE_BLOCK_DEFAULT),
+        SPARSE_NUM_SLIDING_WINDOW_BLOCKS:
+            get_scalar_param(sparsity, SPARSE_NUM_SLIDING_WINDOW_BLOCKS,
+                             SPARSE_NUM_SLIDING_WINDOW_BLOCKS_DEFAULT),
     }
 
 

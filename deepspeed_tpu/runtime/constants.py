@@ -136,6 +136,10 @@ SPARSE_FIXED_MODE = "fixed"
 SPARSE_VARIABLE_MODE = "variable"
 SPARSE_BIGBIRD_MODE = "bigbird"
 SPARSE_BSLONGFORMER_MODE = "bslongformer"
+# TPU extension: the constant-work-per-row causal window layout — the only
+# layout measured FASTER than dense flash attention on TPU at long seq
+# (tests/perf/SPARSE_VS_DENSE.json: 3.1x at 32k, crossover 16k)
+SPARSE_SLIDING_WINDOW_MODE = "sliding_window"
 SPARSE_MODE = "mode"
 SPARSE_MODE_DEFAULT = SPARSE_FIXED_MODE
 SPARSE_BLOCK = "block"

@@ -51,14 +51,10 @@ BACKWARD_MICRO_TIMER = "backward_microstep"
 STEP_MICRO_TIMER = "step_microstep"
 
 
-def _shard_key(index):
-    """Hashable (and checkpoint-serializable) key for a shard's index
-    tuple-of-slices."""
-    return tuple((s.start, s.stop, s.step) for s in index)
-
-
-def _key_to_index(key):
-    return tuple(slice(a, b, c) for a, b, c in key)
+# the checkpoint-format-defining helpers live with the serialization code;
+# aliased here for the engine's many call sites
+_shard_key = ckpt.shard_key
+_key_to_index = ckpt.key_to_index
 
 
 def _unique_shard_indices(arr):
@@ -739,13 +735,29 @@ class DeepSpeedEngine:
         return check
 
     def _host_apply_step(self):
-        """ZeRO-Offload optimizer step, shard-wise (reference
-        stage2.py:780-908 + csrc/adam/cpu_adam.cpp): each process D2Hs only
-        its ADDRESSABLE acc_grad shards, runs the host Adam on its host
-        master/moment shards, H2Ds the updated shards and reshards to the
-        param layout on device (the all-gather of updated partitions rides
-        ICI, not PCIe). Overflow/grad-norm are global jitted reductions so
-        every process agrees without owning every gradient."""
+        """ZeRO-Offload optimizer step, shard-wise and OVERLAPPED
+        (reference stage2.py:283-286, 780-908 + csrc/adam/cpu_adam.cpp):
+        each process D2Hs only its ADDRESSABLE acc_grad shards, runs the
+        host Adam on its host master/moment shards, H2Ds the updated
+        shards and reshards to the param layout on device (the all-gather
+        of updated partitions rides ICI, not PCIe).
+
+        Transfer/compute overlap — the reference's dedicated
+        streams + pinned buffers become a three-stage shard pipeline:
+
+          1. every grad shard's D2H is issued ASYNC up front
+             (``copy_to_host_async``), so transfers stream while the
+             overflow check round-trips and while earlier shards step;
+          2. while the host Adam crunches shard j, a background thread
+             blocks on shard j+1's fetch (both sides drop the GIL —
+             native Adam in OpenMP, fetch in the runtime);
+          3. each leaf's updated shards H2D as soon as that leaf
+             finishes (``device_put`` dispatches async), so uploads ride
+             behind the remaining leaves' Adam; one jitted reshard at the
+             end turns the grad-layout shards into param layout.
+
+        Overflow/grad-norm are global jitted reductions so every process
+        agrees without owning every gradient."""
         hyper = self._hyper()
         scaler = self.state["scaler"]
         cur_scale = float(scaler.cur_scale)
@@ -755,6 +767,19 @@ class DeepSpeedEngine:
         check = self._get_jit("offload_check", self._offload_check_fn)
         finite, sumsq = check(self.state["acc_grads"],
                               np.float32(inv_scale))
+        hs = self.host_state
+        flat_acc = hs["treedef"].flatten_up_to(self.state["acc_grads"])
+        # stage 1: start EVERY shard's D2H now — they stream behind the
+        # (round-trip) overflow fetch below and the host Adam loop. A
+        # plugin without async copy disables the prefetch permanently
+        # (not one raise per leaf per step).
+        if getattr(self, "_async_d2h", True):
+            try:
+                for g_arr in flat_acc:
+                    for sh in g_arr.addressable_shards:
+                        sh.data.copy_to_host_async()
+            except Exception:  # noqa: BLE001
+                self._async_d2h = False
         # a sumsq that overflowed despite finite elements is an overflow
         # too: clipping against an inf norm would silently zero the update
         overflow = (not bool(finite)) or not np.isfinite(float(sumsq))
@@ -766,7 +791,6 @@ class DeepSpeedEngine:
             if clip > 0 and grad_norm > clip:
                 coef *= clip / (grad_norm + 1e-6)
 
-            hs = self.host_state
             hs["step"] += 1
             step = hs["step"]
             beta1, beta2 = hyper["beta1"], hyper["beta2"]
@@ -774,38 +798,63 @@ class DeepSpeedEngine:
             bc1 = 1.0 - beta1 ** step if bias_correction else 1.0
             bc2 = 1.0 - beta2 ** step if bias_correction else 1.0
             adam_w = 1 if getattr(self.optimizer, "adam_w_mode", True) else 0
-
-            flat_acc = hs["treedef"].flatten_up_to(self.state["acc_grads"])
             lib = self._offload_lib()
-            for g_arr, shards in zip(flat_acc, hs["shard_leaves"]):
+
+            # flat work list over (leaf, shard) for the fetch pipeline
+            work = []
+            for i, (g_arr, shards) in enumerate(zip(flat_acc,
+                                                    hs["shard_leaves"])):
                 local = {_shard_key(sh.index): sh.data
                          for sh in g_arr.addressable_shards}
-                for idx, p, m, v in shards:
-                    # D2H of this shard only; writable copy for in-place ops
-                    g = np.array(local[_shard_key(idx)], dtype=np.float32)
-                    g *= coef  # unscale (+clip) in place on the host copy
-                    if lib is not None:
-                        lib.ds_cpu_adam_step(
-                            p.ctypes.data, g.ctypes.data, m.ctypes.data,
-                            v.ctypes.data, p.size, hyper["lr"], beta1, beta2,
-                            hyper["eps"], hyper["weight_decay"],
-                            bc1, bc2, adam_w)
-                    else:
-                        if not adam_w and hyper["weight_decay"]:
-                            # classic-L2 mode folds decay into the gradient
-                            # (matches csrc/cpu_adam.cpp adam_w_mode=0)
-                            g += hyper["weight_decay"] * p
-                        np.multiply(m, beta1, out=m)
-                        m += (1.0 - beta1) * g
-                        np.multiply(v, beta2, out=v)
-                        v += (1.0 - beta2) * np.square(g)
-                        update = (m / bc1) / (np.sqrt(v / bc2) +
-                                              hyper["eps"])
-                        if adam_w:
-                            update += hyper["weight_decay"] * p
-                        p -= hyper["lr"] * update
+                for tup in shards:
+                    work.append((i, tup, local[_shard_key(tup[0])]))
+            left_in_leaf = [len(s) for s in hs["shard_leaves"]]
+            flat_params = [None] * len(flat_acc)
 
-            self.state["params"] = self._host_shards_to_params(flat_acc)
+            def fetch(item):
+                # writable fp32 copy for the in-place host Adam
+                return np.array(item[2], dtype=np.float32)
+
+            pool = self._offload_fetch_pool()
+            nxt = pool.submit(fetch, work[0]) if work else None
+            for j, item in enumerate(work):
+                g = nxt.result()
+                nxt = pool.submit(fetch, work[j + 1]) \
+                    if j + 1 < len(work) else None
+                g *= coef  # unscale (+clip) in place on the host copy
+                i, (idx, p, m, v), _ = item
+                if lib is not None:
+                    lib.ds_cpu_adam_step(
+                        p.ctypes.data, g.ctypes.data, m.ctypes.data,
+                        v.ctypes.data, p.size, hyper["lr"], beta1, beta2,
+                        hyper["eps"], hyper["weight_decay"],
+                        bc1, bc2, adam_w)
+                else:
+                    if not adam_w and hyper["weight_decay"]:
+                        # classic-L2 mode folds decay into the gradient
+                        # (matches csrc/cpu_adam.cpp adam_w_mode=0)
+                        g += hyper["weight_decay"] * p
+                    np.multiply(m, beta1, out=m)
+                    m += (1.0 - beta1) * g
+                    np.multiply(v, beta2, out=v)
+                    v += (1.0 - beta2) * np.square(g)
+                    update = (m / bc1) / (np.sqrt(v / bc2) + hyper["eps"])
+                    if adam_w:
+                        update += hyper["weight_decay"] * p
+                    p -= hyper["lr"] * update
+                # stage 3: the moment a leaf's last shard steps, launch its
+                # H2D — uploads overlap the remaining leaves' Adam
+                left_in_leaf[i] -= 1
+                if left_in_leaf[i] == 0:
+                    flat_params[i] = self._leaf_shards_to_device(
+                        flat_acc[i], hs["shard_leaves"][i])
+
+            grad_layout = hs["treedef"].unflatten(flat_params)
+            reshard = self._get_jit(
+                "offload_reshard",
+                lambda: lambda t: t,
+                out_shardings=hs["param_shardings"])
+            self.state["params"] = reshard(grad_layout)
 
         self.state["acc_grads"] = jax.tree_util.tree_map(
             jnp.zeros_like, self.state["acc_grads"])
@@ -813,30 +862,26 @@ class DeepSpeedEngine:
         return {"overflow": overflow, "grad_norm": grad_norm,
                 "loss_scale": cur_scale}
 
-    def _host_shards_to_params(self, flat_acc):
-        """Updated host master shards -> compute params: per leaf, build a
-        grad-sharded global device array from the local shards (shard-wise
-        H2D, compute dtype), then one jitted reshard to the param layout —
-        the cross-process all-gather happens on device."""
-        hs = self.host_state
+    def _offload_fetch_pool(self):
+        from concurrent.futures import ThreadPoolExecutor
+        if getattr(self, "_offload_pool", None) is None:
+            self._offload_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="offload-fetch")
+        return self._offload_pool
+
+    def _leaf_shards_to_device(self, g_arr, shards):
+        """One leaf's updated host master shards -> a grad-layout global
+        device array (per-shard async H2D in compute dtype)."""
         cdtype = np.dtype(self.compute_dtype)
-        flat_params = []
-        for g_arr, shards in zip(flat_acc, hs["shard_leaves"]):
-            by_key = {_shard_key(idx): p for idx, p, _, _ in shards}
-            sharding = g_arr.sharding
-            dev_map = sharding.addressable_devices_indices_map(g_arr.shape)
-            singles = [
-                jax.device_put(np.ascontiguousarray(
-                    by_key[_shard_key(idx)].astype(cdtype)), dev)
-                for dev, idx in dev_map.items()]
-            flat_params.append(jax.make_array_from_single_device_arrays(
-                g_arr.shape, sharding, singles))
-        grad_layout = hs["treedef"].unflatten(flat_params)
-        reshard = self._get_jit(
-            "offload_reshard",
-            lambda: lambda t: t,
-            out_shardings=hs["param_shardings"])
-        return reshard(grad_layout)
+        by_key = {_shard_key(idx): p for idx, p, _, _ in shards}
+        sharding = g_arr.sharding
+        dev_map = sharding.addressable_devices_indices_map(g_arr.shape)
+        singles = [
+            jax.device_put(np.ascontiguousarray(
+                by_key[_shard_key(idx)].astype(cdtype)), dev)
+            for dev, idx in dev_map.items()]
+        return jax.make_array_from_single_device_arrays(
+            g_arr.shape, sharding, singles)
 
     def _host_to_device(self, p_np, sharding):
         """Host fp32 leaf -> sharded compute-dtype device array WITHOUT
@@ -1234,13 +1279,18 @@ class DeepSpeedEngine:
         # files below carry the state instead
         offload_sharded = (self.host_state is not None
                            and jax.process_count() > 1)
+        # device-state ZeRO: master/opt go ONLY into per-process zero shard
+        # files (reference zero_pp_rank layout, engine.py:1350-1377) — the
+        # model file carries neither, so nothing funnels the full optimizer
+        # tree through rank 0 and nothing is stored twice
+        zero_sharded = self.host_state is None and self.zero_optimization()
         sd = {
             "module": ckpt.tree_to_numpy(self.state["params"]),
-            "optimizer": None if offload_sharded
+            "optimizer": None if (offload_sharded or zero_sharded)
                 else ckpt.tree_to_numpy(self._opt_state_view()),
             "master": ckpt.tree_to_numpy(self.get_master_params())
                 if ((self.mixed_precision or self.host_state is not None)
-                    and not offload_sharded)
+                    and not offload_sharded and not zero_sharded)
                 else None,
             "scaler": ckpt.tree_to_numpy(
                 {"cur_scale": self.state["scaler"].cur_scale,
@@ -1275,16 +1325,128 @@ class DeepSpeedEngine:
                     for shards in self.host_state["shard_leaves"]],
                 "offload_step": self.host_state["step"],
             })
-        elif is_writer and self.zero_optimization():
-            # Optimizer shards file kept separate for layout parity.
-            zpath = ckpt.zero_ckpt_name(save_dir, tag, dp_rank=0)
+        elif zero_sharded:
+            # EVERY process writes its addressable master/opt shards to its
+            # own zero file; keys serialize the shard index so load
+            # re-slots them exactly — and, because every shard carries its
+            # index into the FULL leaf, any process set can reassemble the
+            # gathered tree, keeping elastic resharding on load
+            zpath = ckpt.zero_ckpt_name(save_dir, tag,
+                                        dp_rank=jax.process_index())
             ckpt.save_state_dict(zpath, {
-                "optimizer_state_dict": sd["optimizer"],
-                "master": sd["master"],
+                "device_shards": self._device_zero_shard_payload(is_writer),
             })
         if is_writer and save_latest:
             ckpt.save_latest(save_dir, tag)
+        if jax.process_count() > 1:
+            # a process must not proceed to (and possibly load) a
+            # checkpoint other writers haven't finished (reference
+            # barriers around checkpoint IO, engine.py:1610)
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(
+                "save_checkpoint:{}".format(tag))
         return True
+
+    def _device_zero_shard_payload(self, is_writer):
+        """This process's addressable master/opt shards (device-state ZeRO
+        save; reference per-rank zero files, engine.py:1350-1377)."""
+        payload = {
+            "master": ckpt.shard_lists_of_tree(self.state["master"],
+                                               is_writer)
+            if self.mixed_precision else None,
+            "opt": {
+                key: (np.asarray(val) if key == "step"
+                      else ckpt.shard_lists_of_tree(val, is_writer))
+                for key, val in self.state["opt"].items()
+            },
+        }
+        return payload
+
+    def _zero_shard_paths(self, load_dir, tag):
+        import glob
+        pattern = os.path.join(
+            load_dir, str(tag), "zero_pp_rank_*_mp_rank_00_optim_states.pt")
+        return sorted(glob.glob(pattern))
+
+    def _load_device_zero_state(self, load_dir, tag, sd,
+                                load_optimizer_states):
+        """Reassemble master/opt from per-process zero shard files into the
+        gathered ``sd`` slots, so the normal (elastic, plan-agnostic)
+        placement code runs unchanged. Understands both the device-state
+        layout (``device_shards``) and, for cross-engine resume, the
+        offload layout (``offload_shards``: (key, master, m, v) per
+        acc-grad leaf)."""
+        paths = self._zero_shard_paths(load_dir, tag)
+        if not paths:
+            if load_optimizer_states:
+                # a ZeRO checkpoint with neither gathered state nor shard
+                # files would otherwise silently resume with zeroed
+                # moments (round-2 ADVICE)
+                logger.warning(
+                    "checkpoint %s/%s carries no optimizer state (no "
+                    "gathered tree, no zero shard files) — optimizer "
+                    "state starts fresh", load_dir, tag)
+            return
+        payloads = [ckpt.load_state_dict(p) for p in paths]
+
+        if "offload_shards" in payloads[0]:
+            # offload-written checkpoint loaded into a device-state engine:
+            # entries are (key, master, exp_avg, exp_avg_sq) per leaf;
+            # leaves are param-shaped, so the SAVED module tree supplies
+            # shapes/structure
+            module_flat, module_def = jax.tree_util.tree_flatten(
+                sd["module"])
+
+            def per_file(field):
+                return [[(np.shape(module_flat[i]),
+                          [(e[0], e[field]) for e in shards])
+                         for i, shards in enumerate(p["offload_shards"])]
+                        for p in payloads]
+
+            master = ckpt.assemble_shard_lists(per_file(1), "master")
+            sd["master"] = jax.tree_util.tree_unflatten(module_def, master)
+            if load_optimizer_states:
+                ea = ckpt.assemble_shard_lists(per_file(2), "exp_avg")
+                ev = ckpt.assemble_shard_lists(per_file(3), "exp_avg_sq")
+                sd["optimizer"] = {
+                    "step": int(payloads[0]["offload_step"]),
+                    "exp_avg": jax.tree_util.tree_unflatten(module_def, ea),
+                    "exp_avg_sq": jax.tree_util.tree_unflatten(module_def,
+                                                               ev),
+                }
+            return
+
+        device = [p["device_shards"] for p in payloads]
+        _, params_def = jax.tree_util.tree_flatten(self.state["params"])
+        mixed = self.mixed_precision or self.host_state is not None
+        if device[0].get("master") is not None and mixed:
+            master = ckpt.assemble_shard_lists(
+                [d["master"] for d in device], "master")
+            sd["master"] = jax.tree_util.tree_unflatten(params_def, master)
+        if load_optimizer_states:
+            # opt subtree structure comes from the live state; an OFFLOAD
+            # engine loading a device checkpoint has opt=None (moments live
+            # on host) — its Adam moments are params-structured
+            live_opt = self.state.get("opt")
+            keys = (live_opt.keys() if live_opt is not None
+                    else device[0]["opt"].keys())
+            opt = {}
+            for key in keys:
+                if key not in device[0]["opt"]:
+                    logger.warning(
+                        "zero shard files carry no '%s' optimizer state "
+                        "(saved under a different optimizer) — it starts "
+                        "fresh", key)
+                    continue
+                if key == "step":
+                    opt["step"] = np.asarray(device[0]["opt"]["step"])
+                    continue
+                tmpl_def = (jax.tree_util.tree_flatten(live_opt[key])[1]
+                            if live_opt is not None else params_def)
+                leaves = ckpt.assemble_shard_lists(
+                    [d["opt"][key] for d in device], "opt/" + key)
+                opt[key] = jax.tree_util.tree_unflatten(tmpl_def, leaves)
+            sd["optimizer"] = opt
 
     def _load_host_state(self, load_dir, tag, sd, load_optimizer_states,
                          load_from_fp32_weights):
@@ -1303,6 +1465,13 @@ class DeepSpeedEngine:
         zsd = None
         if os.path.isfile(zpath):
             zsd = ckpt.load_state_dict(zpath)
+        if zsd is not None and "device_shards" in zsd:
+            # device-state ZeRO checkpoint loaded into an OFFLOAD engine:
+            # reassemble the gathered trees from every process's shard
+            # file, then restore through the gathered path below
+            self._load_device_zero_state(load_dir, tag, sd,
+                                         load_optimizer_states)
+            zsd = None
         sharded_only = sd.get("master") is None and \
             sd.get("optimizer") is None
         if zsd is not None and "offload_shards" in zsd:
@@ -1419,6 +1588,13 @@ class DeepSpeedEngine:
             return None, None
         sd = ckpt.load_state_dict(path)
         sd = self._adapt_state_dict(sd)
+
+        if self.host_state is None and sd.get("optimizer") is None:
+            # ZeRO-sharded checkpoint: reassemble gathered trees from the
+            # per-process zero files before the plan-agnostic placement
+            self._load_device_zero_state(load_dir, tag, sd,
+                                         load_optimizer_states)
+            sd = self._adapt_state_dict(sd)
 
         plan = self.zero_plan
         param_sh = plan.tree_shardings(self.state["params"], "param")

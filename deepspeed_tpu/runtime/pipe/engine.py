@@ -2,18 +2,31 @@
 
 Reference parity: deepspeed/runtime/pipe/engine.py (PipelineEngine :45,
 train_batch :244, instruction interpreter :1135). The torch reference runs a
-per-process instruction loop with explicit sends; here the whole GPipe
-fill/drain schedule is a ``lax.fori_loop`` inside ``shard_map`` over the
-``pipe`` mesh axis:
+per-process instruction loop with explicit sends; here the 1F1B schedule is
+compiled into dense cycle->microbatch tables
+(schedule.uniform_train_schedule_tables) that drive ONE ``lax.fori_loop``
+inside ``shard_map`` over the ``pipe`` mesh axis:
 
   * each pipe rank holds its stage's stacked block params (leading stage dim
     sharded on ``pipe``);
-  * activations move to the next stage with ``ppermute`` (p2p.py);
+  * every cycle runs a masked ForwardPass phase then a masked BackwardPass
+    phase on EVERY stage (bubble cycles are masked out) — structural
+    uniformity that one-program SPMD collectives require; see
+    schedule.UniformTrainSchedule for why the reference's staggered
+    TrainSchedule cannot execute as a single XLA program;
+  * activations ride one hop per cycle with ``ppermute`` (p2p.py) and
+    gradients one hop back — the reference's SendActivation/RecvActivation
+    and SendGrad/RecvGrad instructions;
+  * the backward is hand-seeded ``jax.vjp`` per microbatch: the stage
+    forward is RECOMPUTED from a saved stage input (full remat), so the
+    only per-microbatch live state is one stage-input buffer of
+    min(2*stages - 1, micro_batches) slots — the schedule's
+    ``num_pipe_buffers`` memory bound, flat in micro_batches, which a
+    whole-loop ``jax.grad`` (residuals for every step) cannot hit;
   * the embedding/head ("hoisted" pre/post layers) run replicated across
-    pipe ranks, masked to the ranks whose step needs them;
-  * backward is ``jax.grad`` straight through the loop — XLA transposes the
-    ppermutes into the reverse schedule (the reference's SendGrad/RecvGrad
-    instructions) with remat on each stage body.
+    pipe ranks inside the first/last stage's schedule branches; tied-weight
+    gradients from both ends meet in the final psum over the pipe axis
+    (the reference's ReduceTiedGrads).
 
 Loss aggregation across stages/DP (reference _aggregate_total_loss :388) is
 a masked psum over the pipe axis.
@@ -30,6 +43,7 @@ from ..engine import DeepSpeedEngine
 from ..model import Model
 from . import p2p
 from .module import PipelineModule
+from .schedule import uniform_train_schedule_tables
 
 
 class PipelineError(Exception):
@@ -58,6 +72,10 @@ class PipelineEngine(DeepSpeedEngine):
             params=model.params,
             partition_spec_fn=_pipe_partition_spec_fn(model),
             name="pipeline")
+        # the flops profiler's per-module table reads the spec off the
+        # engine's Model; forward the PipelineModule's if it ships one
+        if hasattr(model, "profile_spec_fn"):
+            wrapped.profile_spec_fn = model.profile_spec_fn
         kwargs.setdefault("mpu", grid)
         super().__init__(args=args, model=wrapped, **kwargs)
         self.num_stages = model.num_stages
@@ -93,141 +111,329 @@ class PipelineEngine(DeepSpeedEngine):
         return apply_fn
 
     # -------------------------------------------------------------- pipeline
-    def _pipeline_forward_fn(self, train=True):
-        """``train=False`` builds the forward-only variant for eval_batch
-        (reference InferenceSchedule, schedule.py:129-179): same fill/drain
-        pipe loop and stage memory partitioning, but no rng threading into
-        the stage bodies (dropout off)."""
+    def _stage_closures(self, params, inputs_stack, labels_stack):
+        """Shared pieces of the eval/train shard_map bodies: the f32->bf16
+        boundary cast for hoisted params, per-microbatch embedding/head
+        closures, and the boundary specs. Hoisted params cross the
+        shard_map boundary in f32 (their grads psum over the pipe axis;
+        bf16 psum trips an XLA-CPU bug) and compute in bf16 inside."""
+        module = self.pipe_module
+        compute_dtype = self.compute_dtype
+
+        other = {k: params[k] for k in ("tied", "pre", "post")}
+        other = jax.tree_util.tree_map(
+            lambda t: t.astype(jnp.float32)
+            if t.dtype == compute_dtype and compute_dtype != jnp.float32
+            else t, other)
+
+        def cast_all(other_params):
+            return jax.tree_util.tree_map(
+                lambda t: t.astype(compute_dtype)
+                if t.dtype == jnp.float32 and compute_dtype != jnp.float32
+                else t, dict(other_params))
+
+        def pick(stack, m):
+            return jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, m, axis=0, keepdims=False), stack)
+
+        def embed_of(params_all, inputs, m):
+            return module.apply_pre(params_all, pick(inputs, m))
+
+        def head_loss(params_all, y, labels, m):
+            out = module.apply_post(params_all, y)
+            if module.loss_fn is not None:
+                return module.loss_fn(out, pick(labels, m)) \
+                    .astype(jnp.float32)
+            return jnp.mean(out).astype(jnp.float32)
+
+        body_spec = jax.tree_util.tree_map(
+            lambda _: P(PIPE_AXIS), params["body"])
+        other_spec = jax.tree_util.tree_map(lambda _: P(), other)
+        batch_spec = jax.tree_util.tree_map(lambda _: P(), inputs_stack)
+        labels_spec = jax.tree_util.tree_map(lambda _: P(), labels_stack)
+        return (other, cast_all, embed_of, head_loss,
+                body_spec, other_spec, batch_spec, labels_spec)
+
+    def _pipeline_eval_fn(self):
+        """Forward-only fill/drain loop for eval_batch (reference
+        InferenceSchedule, schedule.py:129-179): M + S - 1 steps, the
+        embedding streams in at the first stage's step and the head + loss
+        run at the last stage's step — nothing M-sized is materialized, so
+        eval keeps the pipeline's memory partitioning. Dropout is off (no
+        rng reaches the stage bodies)."""
         module = self.pipe_module
         num_stages = self.num_stages
         M = self.micro_batches
         mesh = self.mesh
-
-        compute_dtype = self.compute_dtype
-
-        # per-stage REAL layer counts (ragged partitions pad to the deepest
-        # stage; the padded slots are skipped by depth inside the stage scan)
         stage_depths = jnp.asarray(module.stage_depths, jnp.int32)
 
-        def pipeline_losses(params, inputs_stack, labels_stack, rng):
-            """(M, ...) microbatch stacks -> (M,) per-microbatch losses."""
+        def eval_loss(params, inputs_stack, labels_stack):
+            (other, cast_all, embed_of, head_loss, body_spec, other_spec,
+             batch_spec, labels_spec) = self._stage_closures(
+                params, inputs_stack, labels_stack)
 
-            def shard_fn(body_params, depths, other_params, inputs, labels,
-                         rng):
-                # body_params leaves: (1, layers_per_stage, ...) local stage
+            def shard_fn(body_params, depths, other_params, inputs, labels):
                 local_body = jax.tree_util.tree_map(
                     lambda t: t[0], body_params)
                 depth = depths[0]
                 stage = jax.lax.axis_index(PIPE_AXIS)
-                total_steps = M + num_stages - 1
+                is_first = stage == 0
+                is_last = stage == num_stages - 1
+                params_all = cast_all(other_params)
 
-                # Hoisted params cross the shard_map boundary in f32 (their
-                # grad psums over the pipe axis; bf16 psum in the loop
-                # transpose trips an XLA-CPU bug) and compute in bf16 here.
-                params_all = jax.tree_util.tree_map(
-                    lambda t: t.astype(compute_dtype)
-                    if t.dtype == jnp.float32 and compute_dtype != jnp.float32
-                    else t, dict(other_params))
-
-                # Hoist the embedding out of the pipe loop: all M microbatch
-                # embeddings are computed once up front (the loop runs
-                # M+S-1 steps, and its grad transpose would re-run whatever
-                # sits inside per step).
-                embeds = jax.lax.map(
-                    lambda x_m: module.apply_pre(params_all, x_m), inputs)
+                x_shape = jax.eval_shape(
+                    lambda: embed_of(params_all, inputs, jnp.int32(0)))
+                zeros_x = jax.tree_util.tree_map(
+                    lambda sd: jnp.zeros(sd.shape, sd.dtype), x_shape)
 
                 def body(t, carry):
-                    recv, ys = carry
+                    recv, loss_sum = carry
                     m = t - stage
                     m_c = jnp.clip(m, 0, M - 1)
-                    x_first = jax.tree_util.tree_map(
-                        lambda e: jax.lax.dynamic_index_in_dim(
-                            e, m_c, axis=0, keepdims=False), embeds)
-                    x = jnp.where(stage == 0, x_first, recv)
-                    step_rng = (jax.random.fold_in(rng, t * num_stages + stage)
-                                if train else None)
-                    y = module.apply_body_stage(local_body, x, rng=step_rng,
-                                                depth=depth)
-                    # last stage stores y for microbatch m when valid; the
-                    # output head + loss run ONCE over the M collected
-                    # outputs after the loop, not per pipeline step.
-                    is_last = stage == num_stages - 1
                     valid = jnp.logical_and(m >= 0, m < M)
-                    write = jnp.logical_and(is_last, valid)
-                    prev = jax.lax.dynamic_index_in_dim(
-                        ys, m_c, axis=0, keepdims=False)
-                    ys = jax.lax.dynamic_update_index_in_dim(
-                        ys, jnp.where(write, y, prev), m_c, axis=0)
+                    x = jax.lax.cond(
+                        is_first,
+                        lambda: embed_of(params_all, inputs, m_c),
+                        lambda: recv)
+                    y = module.apply_body_stage(local_body, x, rng=None,
+                                                depth=depth)
+                    loss_m = jax.lax.cond(
+                        jnp.logical_and(is_last, valid),
+                        lambda: head_loss(params_all, y, labels, m_c),
+                        lambda: jnp.float32(0.0))
                     recv_next = p2p.send_forward(y, num_stages, PIPE_AXIS)
-                    return (recv_next, ys)
+                    return (recv_next, loss_sum + loss_m)
 
-                x0 = jax.tree_util.tree_map(lambda e: e[0], embeds)
-                recv0 = jnp.zeros_like(x0)
-                ys0 = jnp.zeros((M,) + x0.shape, x0.dtype)
-                _, ys = jax.lax.fori_loop(0, total_steps, body, (recv0, ys0))
-
-                def loss_of(args):
-                    y, lbl = args
-                    out = module.apply_post(params_all, y)
-                    if module.loss_fn is not None:
-                        return module.loss_fn(out, lbl)
-                    return jnp.mean(out)
-
-                losses = jax.lax.map(loss_of, (ys, labels)) \
-                    .astype(jnp.float32)
-                # broadcast last stage's losses to every pipe rank; the mask
-                # also zeroes the garbage ys on non-last ranks out of the
-                # gradient (reference _aggregate_total_loss)
-                is_last = (jax.lax.axis_index(PIPE_AXIS) ==
-                           num_stages - 1).astype(losses.dtype)
-                losses = jax.lax.psum(losses * is_last, PIPE_AXIS)
-                return losses
-
-            body_leaves_spec = jax.tree_util.tree_map(
-                lambda _: P(PIPE_AXIS), params["body"])
-            other = {k: params[k] for k in ("tied", "pre", "post")}
-            other = jax.tree_util.tree_map(
-                lambda t: t.astype(jnp.float32)
-                if t.dtype == compute_dtype and compute_dtype != jnp.float32
-                else t, other)
-            other_spec = jax.tree_util.tree_map(lambda _: P(), other)
-            in_spec_batch = jax.tree_util.tree_map(lambda _: P(), inputs_stack)
-            in_spec_labels = jax.tree_util.tree_map(lambda _: P(), labels_stack)
+                _, loss_sum = jax.lax.fori_loop(
+                    0, M + num_stages - 1, body, (zeros_x, jnp.float32(0.0)))
+                # only the last stage accumulated anything; psum broadcasts
+                return jax.lax.psum(loss_sum, PIPE_AXIS) / M
 
             return jax.shard_map(
                 shard_fn, mesh=mesh,
-                in_specs=(body_leaves_spec, P(PIPE_AXIS), other_spec,
-                          in_spec_batch, in_spec_labels, P()),
+                in_specs=(body_spec, P(PIPE_AXIS), other_spec,
+                          batch_spec, labels_spec),
                 out_specs=P(),
                 axis_names={PIPE_AXIS},
                 check_vma=False,
-            )(params["body"], stage_depths, other, inputs_stack,
-              labels_stack, rng)
+            )(params["body"], stage_depths, other, inputs_stack, labels_stack)
 
-        return pipeline_losses
+        return eval_loss
+
+    def _pipeline_train_fn(self):
+        """1F1B training executor driven by UniformTrainSchedule's tables.
+
+        One fori_loop of M + 2(S-1) cycles. Every cycle is structurally
+        IDENTICAL on every stage — a (maybe-masked) forward phase, then a
+        (maybe-masked) backward phase — because under one-program SPMD the
+        auto-partitioned collectives inside the stage body (TP all-reduces,
+        resharding permutes) must execute in the same order on every
+        device; stage-divergent lax.cond/switch around them deadlocks (see
+        UniformTrainSchedule). Per cycle this stage reads its schedule row:
+
+          ForwardPass m: x = embedding (stage 0) or the activation
+            ppermuted in last cycle; run the stage body; save x in slot
+            m % W of the stage-input buffer (W = min(2S-1, M) slots — the
+            schedule's num_pipe_buffers bound, flat in micro_batches).
+          BackwardPass m: re-run the stage forward from the saved input
+            under jax.vjp (full remat — residuals live only within this
+            cycle), seed with the loss gradient (last stage: head + loss
+            vjp, which also yields the head/tied grads) or the grad
+            ppermuted in last cycle, and accumulate f32 param grads
+            (masked adds — bubble cycles contribute zero). Stage 0 also
+            transposes the embedding (tied/pre grads).
+
+        Only rank-CONSTANT conds remain (is_first embedding, is_last
+        head+loss): the same ranks take the same branch every cycle, and
+        the hoisted layers' collectives are group-local (vocab-parallel
+        psums, data-axis reductions), so no device ever waits on a
+        collective another device skipped. Every cycle ends with one
+        forward ppermute (activations) and one backward ppermute (input
+        grads), sequenced by an optimization_barrier. Per-microbatch
+        loss-grad seed is cur_scale / M, matching the whole-batch
+        ``scale * mean(losses)`` of the classic engine path.
+        """
+        module = self.pipe_module
+        num_stages = self.num_stages
+        M = self.micro_batches
+        mesh = self.mesh
+        stage_depths = jnp.asarray(module.stage_depths, jnp.int32)
+
+        fwd_tab, bwd_tab = uniform_train_schedule_tables(M, num_stages)
+        T = fwd_tab.shape[1]
+        W = max(1, min(2 * num_stages - 1, M))
+        fwd_tab = jnp.asarray(fwd_tab)
+        bwd_tab = jnp.asarray(bwd_tab)
+
+        def manual_grads(params, inputs_stack, labels_stack, rng, scale):
+            (other, cast_all, embed_of, head_loss, body_spec, other_spec,
+             batch_spec, labels_spec) = self._stage_closures(
+                params, inputs_stack, labels_stack)
+
+            def shard_fn(body_params, depths, fwd_row, bwd_row, other_params,
+                         inputs, labels, rng, scale):
+                local_body = jax.tree_util.tree_map(
+                    lambda t: t[0], body_params)
+                depth = depths[0]
+                fwd_row = fwd_row[0]
+                bwd_row = bwd_row[0]
+                stage = jax.lax.axis_index(PIPE_AXIS)
+                is_first = stage == 0
+                is_last = stage == num_stages - 1
+                params_all = cast_all(other_params)
+                seed = (scale / M).astype(jnp.float32)
+
+                def stage_fwd(bp, x, m):
+                    # rng keyed by (microbatch, stage) so the backward's
+                    # recompute replays the forward's dropout exactly
+                    step_rng = jax.random.fold_in(rng, m * num_stages + stage)
+                    return module.apply_body_stage(bp, x, rng=step_rng,
+                                                   depth=depth)
+
+                x_shape = jax.eval_shape(
+                    lambda: embed_of(params_all, inputs, jnp.int32(0)))
+                zeros_x = jax.tree_util.tree_map(
+                    lambda sd: jnp.zeros(sd.shape, sd.dtype), x_shape)
+                zeros_other = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, p.dtype), params_all)
+
+                carry0 = (
+                    zeros_x,                                   # recv_f
+                    zeros_x,                                   # recv_b
+                    jax.tree_util.tree_map(
+                        lambda z: jnp.zeros((W,) + z.shape, z.dtype),
+                        zeros_x),                              # x_buf
+                    jax.tree_util.tree_map(
+                        lambda p: jnp.zeros(p.shape, jnp.float32),
+                        local_body),                           # body_g
+                    jax.tree_util.tree_map(
+                        lambda p: jnp.zeros(p.shape, jnp.float32),
+                        params_all),                           # other_g
+                    jnp.float32(0.0),                          # loss_sum
+                )
+
+                def masked_add(acc, delta, mask):
+                    # where, not multiply: garbage from masked-out bubble
+                    # cycles may be non-finite and 0 * inf = nan
+                    return jax.tree_util.tree_map(
+                        lambda g, d: g + jnp.where(mask,
+                                                   d.astype(jnp.float32),
+                                                   jnp.zeros_like(g)),
+                        acc, delta)
+
+                def body(k, carry):
+                    recv_f, recv_b, x_buf, body_g, other_g, loss_sum = carry
+
+                    # ---- forward phase ----
+                    m_f = fwd_row[k]
+                    v_f = m_f >= 0
+                    mf = jnp.clip(m_f, 0, M - 1)
+                    x = jax.lax.cond(
+                        is_first,
+                        lambda: embed_of(params_all, inputs, mf),
+                        lambda: recv_f)
+                    y = stage_fwd(local_body, x, mf)
+                    slot_f = jnp.mod(mf, W)
+                    x_buf = jax.tree_util.tree_map(
+                        lambda buf, xv: jax.lax.dynamic_update_index_in_dim(
+                            buf,
+                            jnp.where(v_f, xv,
+                                      jax.lax.dynamic_index_in_dim(
+                                          buf, slot_f, axis=0,
+                                          keepdims=False)),
+                            slot_f, axis=0), x_buf, x)
+                    recv_f_next = p2p.send_forward(y, num_stages, PIPE_AXIS)
+
+                    # ---- backward phase ----
+                    m_b = bwd_row[k]
+                    v_b = m_b >= 0
+                    mb = jnp.clip(m_b, 0, M - 1)
+                    slot_b = jnp.mod(mb, W)
+                    x_saved = jax.tree_util.tree_map(
+                        lambda buf: jax.lax.dynamic_index_in_dim(
+                            buf, slot_b, axis=0, keepdims=False), x_buf)
+                    y_b, stage_vjp = jax.vjp(
+                        lambda bp, xv: stage_fwd(bp, xv, mb),
+                        local_body, x_saved)
+
+                    def seed_from_loss():
+                        loss_m, head_vjp = jax.vjp(
+                            lambda pa, yv: head_loss(pa, yv, labels, mb),
+                            params_all, y_b)
+                        d_pall, dy = head_vjp(seed)
+                        return loss_m, d_pall, dy
+
+                    loss_m, d_head, dy = jax.lax.cond(
+                        is_last, seed_from_loss,
+                        lambda: (jnp.float32(0.0), zeros_other, recv_b))
+                    d_body, dx = stage_vjp(dy)
+
+                    d_pre = jax.lax.cond(
+                        is_first,
+                        lambda: jax.vjp(
+                            lambda pa: embed_of(pa, inputs, mb),
+                            params_all)[1](dx)[0],
+                        lambda: zeros_other)
+
+                    body_g = masked_add(body_g, d_body, v_b)
+                    other_g = masked_add(
+                        masked_add(other_g, d_head, v_b), d_pre, v_b)
+                    loss_sum = loss_sum + jnp.where(v_b, loss_m, 0.0)
+
+                    # sequence the two permutes (no data dependency
+                    # otherwise): devices entering them in racing orders
+                    # deadlock XLA:CPU's in-process collective rendezvous;
+                    # on TPU this just orders two small ICI transfers
+                    dx, _ = jax.lax.optimization_barrier((dx, recv_f_next))
+                    recv_b_next = p2p.send_backward(dx, num_stages,
+                                                    PIPE_AXIS)
+                    return (recv_f_next, recv_b_next, x_buf, body_g,
+                            other_g, loss_sum)
+
+                carry = jax.lax.fori_loop(0, T, body, carry0)
+                _, _, _, body_g, other_g, loss_sum = carry
+
+                # only the last stage accumulated losses; tied/pre/post grads
+                # from both pipe ends meet here (ReduceTiedGrads)
+                mean_loss = jax.lax.psum(loss_sum, PIPE_AXIS) / M
+                other_g = jax.lax.psum(other_g, PIPE_AXIS)
+                body_g = jax.tree_util.tree_map(lambda g: g[None], body_g)
+                return mean_loss, body_g, other_g
+
+            mean_loss, body_g, other_g = jax.shard_map(
+                shard_fn, mesh=mesh,
+                in_specs=(body_spec, P(PIPE_AXIS), P(PIPE_AXIS),
+                          P(PIPE_AXIS), other_spec, batch_spec, labels_spec,
+                          P(), P()),
+                out_specs=(P(),
+                           jax.tree_util.tree_map(
+                               lambda _: P(PIPE_AXIS), body_spec),
+                           jax.tree_util.tree_map(lambda _: P(), other)),
+                axis_names={PIPE_AXIS},
+                check_vma=False,
+            )(params["body"], stage_depths, fwd_tab, bwd_tab, other,
+              inputs_stack, labels_stack, rng, scale)
+            grads = dict(other_g)
+            grads["body"] = body_g
+            return mean_loss, grads
+
+        return manual_grads
 
     def _pipe_grads_fn(self):
-        """Forward+backward through the pipe loop, accumulating into
+        """Forward+backward through the 1F1B loop, accumulating into
         acc_grads (shared by the fused one-jit step and the ZeRO-Offload
         split, where the optimizer step runs on host)."""
-        pipeline_losses = self._pipeline_forward_fn()
+        manual_grads = self._pipeline_train_fn()
         plan = self.zero_plan
 
         def micros(state, stacked_batch, rng):
             inputs_stack, labels_stack = stacked_batch
-
-            def loss_fn(compute_params):
-                losses = pipeline_losses(compute_params, inputs_stack,
-                                         labels_stack, rng)
-                mean_loss = jnp.mean(losses)
-                scaled = mean_loss * state["scaler"].cur_scale
-                return scaled, mean_loss
-
-            (_, mean_loss), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(state["params"])
+            mean_loss, grads = manual_grads(
+                state["params"], inputs_stack, labels_stack, rng,
+                state["scaler"].cur_scale)
             acc = jax.tree_util.tree_map(
-                lambda a, g: a + g.astype(jnp.float32), state["acc_grads"],
-                grads)
+                lambda a, g: a + g, state["acc_grads"], grads)
             new_state = dict(state)
             new_state["acc_grads"] = plan.constrain(acc, "grad")
             return new_state, mean_loss
@@ -301,22 +507,8 @@ class PipelineEngine(DeepSpeedEngine):
             batch = self._stack_microbatches(data_iter)
         batch = self._to_device_stacked(batch)
         inputs_stack, labels_stack = batch
-
-        def build():
-            pipeline_losses = self._pipeline_forward_fn(train=False)
-
-            def eval_fn(params, inputs_stack, labels_stack, rng):
-                losses = pipeline_losses(params, inputs_stack, labels_stack,
-                                         rng)
-                return jnp.mean(losses)
-
-            return eval_fn
-
-        fn = self._get_jit("pipe_eval", build)
-        # rng operand kept for a stable pipeline_losses signature; unused
-        # when train=False
-        return fn(self.state["params"], inputs_stack, labels_stack,
-                  jax.random.PRNGKey(0))
+        fn = self._get_jit("pipe_eval", self._pipeline_eval_fn)
+        return fn(self.state["params"], inputs_stack, labels_stack)
 
     def is_gradient_accumulation_boundary(self):
         return True

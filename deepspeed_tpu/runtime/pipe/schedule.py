@@ -3,11 +3,17 @@
 Reference parity: deepspeed/runtime/pipe/schedule.py (PipeSchedule ABC :6,
 TrainSchedule :182, InferenceSchedule :129, instruction vocabulary
 :336-474). The schedule layer is backend-agnostic logic: a generator of
-per-step instruction lists per stage. On TPU the fused shard_map executor
-(pipe/engine.py) realizes the same fill/steady/drain dataflow inside one
-XLA program; these classes remain the spec (and drive tests + the
-future manual-backward executor).
+per-step instruction lists per stage. On TPU the schedule DRIVES the SPMD
+executor: ``uniform_train_schedule_tables`` compiles UniformTrainSchedule
+— the collective-uniform 1F1B variant (see its docstring for why the
+reference's staggered TrainSchedule cannot run as one SPMD program) —
+into dense cycle->microbatch tables that the shard_map loop in
+pipe/engine.py indexes each step (the torch reference interprets its
+stream imperatively, one process per stage). TrainSchedule itself is kept
+as the reference-parity spec for tests.
 """
+import numpy as np
+
 from ..utils import call_to_str
 
 
@@ -241,6 +247,95 @@ class TrainSchedule(PipeSchedule):
         """min(S - stage + 1, M) buffers (reference :243-247)."""
         buffers = min(self.stages - self.stage_id + 1, self.micro_batches)
         return max(2, buffers)
+
+
+class UniformTrainSchedule(PipeSchedule):
+    """Collective-uniform 1F1B schedule: the one the TPU executor runs.
+
+    TrainSchedule's even/odd stagger has different stages running different
+    phases at the same half-step. A per-process interpreter (the torch
+    reference) handles that trivially; a ONE-program SPMD executor cannot —
+    branching some ranks into ForwardPass while others take BackwardPass
+    wraps data-dependent branches around the auto-partitioned collectives
+    inside the stage body (TP all-reduces, resharding permutes), and XLA
+    collectives deadlock unless every device executes the same collective
+    sequence. So the executed schedule makes every cycle structurally
+    identical on every stage: one (maybe-masked) ForwardPass phase, then
+    one (maybe-masked) BackwardPass phase —
+
+        forward  of microbatch m on stage s at cycle m + s
+        backward of microbatch m on stage s at cycle m + 2(S-1) - s
+
+    M + 2(S-1) cycles total. The memory property that makes 1F1B matter is
+    kept: in-flight forward activations per stage are capped at
+    min(2(S - stage_id) - 1, M) — ``num_pipe_buffers`` — independent of
+    micro_batches (reference TrainSchedule bound: min(S - stage_id + 1, M),
+    schedule.py:243-247). The price vs the staggered reference is bubble
+    2(S-1)/M instead of (S-1)/M — the SPMD-uniformity tax, paid in compile-
+    time-known idle cycles rather than deadlocks.
+    """
+
+    def steps(self):
+        fwd, bwd = uniform_train_schedule_tables(self.micro_batches,
+                                                 self.stages)
+        for k in range(fwd.shape[1]):
+            cmds = []
+            m_f = int(fwd[self.stage_id, k])
+            m_b = int(bwd[self.stage_id, k])
+            if m_f >= 0:
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(self._buffer_idx(m_f)))
+                else:
+                    cmds.append(RecvActivation(self._buffer_idx(m_f)))
+                cmds.append(ForwardPass(self._buffer_idx(m_f)))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(self._buffer_idx(m_f)))
+            if m_b >= 0:
+                if not self.is_last_stage:
+                    cmds.append(RecvGrad(self._buffer_idx(m_b)))
+                cmds.append(BackwardPass(self._buffer_idx(m_b)))
+                if not self.is_first_stage:
+                    cmds.append(SendGrad(self._buffer_idx(m_b)))
+            if k == fwd.shape[1] - 1:
+                cmds.append(ReduceTiedGrads())
+                cmds.append(ReduceGrads())
+                cmds.append(OptimizerStep())
+            yield cmds
+
+    def _buffer_idx(self, micro_batch_id):
+        assert self._valid_micro_batch(micro_batch_id)
+        return micro_batch_id % self.num_pipe_buffers()
+
+    def num_pipe_buffers(self):
+        """Stage-input slots the executor's recompute buffer needs: a
+        forward saved at cycle m + s is consumed at cycle m + 2(S-1) - s,
+        so at most 2(S - s) - 1 microbatches are in flight."""
+        return max(1, min(2 * (self.stages - self.stage_id) - 1,
+                          self.micro_batches))
+
+
+def uniform_train_schedule_tables(micro_batches, stages):
+    """Dense (stages, C) cycle->microbatch tables for UniformTrainSchedule.
+
+    ``fwd[s, k]`` / ``bwd[s, k]`` hold the microbatch stage ``s`` forwards /
+    backwards at cycle ``k`` (-1 = bubble). The 1F1B executor
+    (pipe/engine.py) ships each stage its row and indexes it per loop step —
+    this function IS the schedule the SPMD program runs.
+
+    The tables satisfy the executor's ppermute alignment: stage s+1's
+    forward of m lands exactly one cycle after stage s's (activations ride
+    one hop per cycle), and stage s-1's backward of m one cycle after stage
+    s's (grads likewise); tests/unit/test_pipe_schedule.py asserts this and
+    the in-flight bound.
+    """
+    C = micro_batches + 2 * (stages - 1)
+    cycles = np.arange(C, dtype=np.int64)[None, :]
+    stage = np.arange(stages, dtype=np.int64)[:, None]
+    fwd = cycles - stage
+    bwd = cycles - (2 * (stages - 1) - stage)
+    fwd = np.where((fwd >= 0) & (fwd < micro_batches), fwd, -1)
+    bwd = np.where((bwd >= 0) & (bwd < micro_batches), bwd, -1)
+    return fwd.astype(np.int32), bwd.astype(np.int32)
 
 
 class DataParallelSchedule(PipeSchedule):

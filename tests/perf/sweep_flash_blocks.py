@@ -63,8 +63,11 @@ def main():
             return fa.flash_attention_bshd(t, t, t, block_q=bq, block_k=bk)
 
         def grad_step(t, bq=bq, bk=bk):
+            # pass bwd blocks explicitly: fwd blocks no longer flow into
+            # the backward (the bwd defaults to auto_blocks otherwise)
             g = jax.grad(lambda q: fa.flash_attention_bshd(
-                q, q, q, block_q=bq, block_k=bk)
+                q, q, q, block_q=bq, block_k=bk,
+                bwd_block_q=bq, bwd_block_k=bk)
                 .astype(jnp.float32).sum())(t)
             return g.astype(t.dtype)
 

@@ -301,14 +301,49 @@ def test_fused_attn_under_remat_matches():
 
 
 def test_auto_blocks_by_width():
-    """Width-aware block defaults: measured-fast at GPT-2-medium width,
-    shrinking backward blocks at xl width where (256, 512) overflows the
-    16M scoped-vmem budget."""
-    from deepspeed_tpu.ops.transformer.flash_attention import (
-        auto_blocks, auto_fwd_blocks)
-    assert auto_blocks(768) == (256, 512)
-    assert auto_blocks(1024) == (256, 512)
-    assert auto_blocks(1280) == (256, 256)
-    assert auto_blocks(1600) == (128, 256)
-    assert auto_fwd_blocks(1024) == (256, 512)
-    assert auto_fwd_blocks(1600) == (256, 256)
+    """Width-aware block defaults, keyed to the backward path taken: the
+    fused single-pass kernel (hd <= 1280) wants (256, 256)-class blocks;
+    past its vmem ceiling the split kernels keep their measured sizes."""
+    from deepspeed_tpu.ops.transformer import flash_attention as fa
+    assert fa._use_fused_bwd(1024) and fa._use_fused_bwd(1280)
+    assert not fa._use_fused_bwd(1600)
+    assert fa.auto_blocks(768) == (256, 256)
+    assert fa.auto_blocks(1024) == (256, 256)
+    assert fa.auto_blocks(1280) == (128, 256)
+    assert fa.auto_blocks(1600) == (128, 256)   # split fallback
+    assert fa.auto_fwd_blocks(1024) == (256, 512)
+    assert fa.auto_fwd_blocks(1600) == (256, 256)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_fused_bwd_matches_split(causal):
+    """The single-pass fused backward (one walk, 5 dots/pair, dq via
+    explicit-DMA HBM accumulation) is numerically identical to the split
+    dq + dk/dv kernels — including ragged seq (q-padding) and both mask
+    polarities."""
+    from deepspeed_tpu.ops.transformer import flash_attention as fa
+    rng = np.random.RandomState(0)
+    b, s, h, d = 2, 192, 4, 32
+    hd = h * d
+    mk = lambda: jnp.asarray(rng.randn(b, s, hd) * 0.3, jnp.float32)
+    q, k, v, do = mk(), mk(), mk(), mk()
+    bias = jnp.zeros((b, 1, 128), jnp.float32)
+    scale = 1.0 / d ** 0.5
+    out, lse = fa._fwd_packed(q, k, v, bias, scale, causal, 128, 128,
+                              True, h)
+    ref = fa._bwd_split_packed(q, k, v, bias, out, do, lse, scale, causal,
+                               128, 128, True, h)
+    got = fa._bwd_fused_packed(q, k, v, bias, out, do, lse, scale, causal,
+                               128, 128, True, h)
+    for name, a, g in zip(("dq", "dk", "dv"), ref, got):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(g),
+                                   atol=2e-4, rtol=2e-4, err_msg=name)
+
+
+def test_bwd_packed_dispatches_fused():
+    """_bwd_packed routes narrow widths to the fused kernel and wide ones
+    to the split pair (gpt2-xl class)."""
+    from deepspeed_tpu.ops.transformer import flash_attention as fa
+    assert fa.FUSED_BWD, "fused backward should be the default"
+    assert fa._use_fused_bwd(16 * 64)
+    assert not fa._use_fused_bwd(25 * 64)

@@ -109,6 +109,69 @@ def test_per_module_table_for_gpt2():
     assert "smaller module(s) not shown" in top1
 
 
+def test_per_module_table_for_bert():
+    """BERT ships a profile spec too (VERDICT r2: attribution was
+    GPT-2-only): every module appears with nonzero flops and params roll
+    up to the analytic count."""
+    from deepspeed_tpu.models import bert
+    from deepspeed_tpu.profiling.flops_profiler.profiler import (
+        profile_module_tree, format_module_profile)
+
+    cfg = bert.config_for("bert_base", max_seq_len=64, n_layers=2,
+                          vocab_size=512, d_model=64, n_heads=2,
+                          d_intermediate=256, remat=False)
+    spec = bert.profile_spec(cfg, batch_size=2)
+    tree = profile_module_tree(spec)
+    names = {c.name: c for c in tree.children}
+    assert set(names) == {"embedding", "layer", "mlm_head", "pooler+nsp"}
+    layer = names["layer"]
+    assert layer.count == 2 and layer.flops > 0
+    sub = {c.name: c for c in layer.children}
+    assert sub["attention"].flops > 0 and sub["mlp"].flops > 0
+    assert tree.total_params == bert.num_params(cfg)
+    table = format_module_profile(tree, module_depth=-1, top_modules=10)
+    assert "layer (x2)" in table and "mlm_head" in table
+
+    # the squad engine's spec prices the span head instead
+    squad = bert.profile_spec(cfg, batch_size=2, head="squad")
+    squad_tree = profile_module_tree(squad)
+    kids = {c.name for c in squad_tree.children}
+    assert "squad_head" in kids and "mlm_head" not in kids
+
+
+def test_bert_engine_ships_profile_spec():
+    from deepspeed_tpu.models import bert
+    cfg = bert.config_for("bert_base", max_seq_len=64, n_layers=2,
+                          vocab_size=512, d_model=64, n_heads=2,
+                          d_intermediate=256, remat=False)
+    model = bert.make_bert_model(config=cfg)
+    spec = model.profile_spec_fn(2, seq=32)
+    assert spec["name"].startswith("bert")
+    assert any(c["name"] == "layer" for c in spec["children"])
+
+
+def test_pipeline_engine_forwards_profile_spec():
+    """The PipelineEngine's wrapped Model exposes the PipelineModule's
+    profile spec, so pipelined GPT-2 configs get the per-module table."""
+    import deepspeed_tpu as deepspeed
+    from deepspeed_tpu.models import gpt2, gpt2_pipe
+    cfg = gpt2.GPT2Config(vocab_size=128, max_seq_len=32, n_layers=2,
+                          n_heads=2, d_model=32, use_flash_attention=False,
+                          remat=False)
+    net = gpt2_pipe.make_gpt2_pipeline(config=cfg, num_stages=2, num_dp=4,
+                                       activation_checkpoint_interval=0)
+    engine, _, _, _ = deepspeed.initialize(model=net, config_params={
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 1000,
+    })
+    spec_fn = getattr(engine.model, "profile_spec_fn", None)
+    assert spec_fn is not None
+    spec = spec_fn(2)
+    assert spec["name"].startswith("gpt2")
+
+
 def test_engine_prints_module_table(caplog):
     """The engine's flops_profiler config prints the per-module table for
     models that ship a profile spec."""

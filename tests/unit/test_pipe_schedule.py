@@ -89,6 +89,65 @@ def test_send_recv_pairing():
     assert sends[-1] == 0 and recvs[0] == 0
 
 
+@pytest.mark.parametrize("M,S", [(4, 2), (8, 4), (3, 3), (1, 2), (16, 4)])
+def test_uniform_train_tables_alignment(M, S):
+    """The executed 1F1B tables satisfy the SPMD executor's contract:
+    activations/grads ride exactly one ppermute hop per cycle, every
+    microbatch forwards then backwards exactly once per stage, and
+    in-flight activations per stage stay at the num_pipe_buffers bound —
+    independent of micro_batches."""
+    import numpy as np
+    fwd, bwd = sch.uniform_train_schedule_tables(M, S)
+    C = M + 2 * (S - 1)
+    assert fwd.shape == bwd.shape == (S, C)
+
+    def cycle_of(tab, s, m):
+        (idx,) = np.where(tab[s] == m)
+        assert idx.size == 1
+        return int(idx[0])
+
+    for s in range(S):
+        for m in range(M):
+            tf, tb = cycle_of(fwd, s, m), cycle_of(bwd, s, m)
+            assert tf <= tb
+            if s + 1 < S:
+                # activation sent at stage s's fwd lands one cycle later
+                assert cycle_of(fwd, s + 1, m) == tf + 1
+                # grad sent at stage s+1's bwd lands one cycle later
+                assert cycle_of(bwd, s, m) == cycle_of(bwd, s + 1, m) + 1
+        # in-flight bound: #(forwarded, not yet backwarded) microbatches
+        bound = sch.UniformTrainSchedule(
+            micro_batches=M, stages=S, stage_id=s).num_pipe_buffers()
+        for k in range(C):
+            in_flight = sum(
+                1 for m in range(M)
+                if cycle_of(fwd, s, m) <= k < cycle_of(bwd, s, m))
+            assert in_flight <= bound
+        assert bound <= min(2 * S - 1, M) or M == 0
+
+
+def test_uniform_train_schedule_steps_match_tables():
+    """The instruction-stream view and the dense tables are the same
+    schedule (the executor indexes the tables; tests read the stream)."""
+    M, S = 5, 3
+    fwd, bwd = sch.uniform_train_schedule_tables(M, S)
+    for sid in range(S):
+        s = sch.UniformTrainSchedule(micro_batches=M, stages=S, stage_id=sid)
+        steps = _cmds_of(s)
+        assert len(steps) == fwd.shape[1]
+        W = s.num_pipe_buffers()
+        for k, cmds in enumerate(steps):
+            fwd_bufs = [c.buffer_id for c in cmds
+                        if isinstance(c, sch.ForwardPass)]
+            bwd_bufs = [c.buffer_id for c in cmds
+                        if isinstance(c, sch.BackwardPass)]
+            assert fwd_bufs == ([fwd[sid, k] % W] if fwd[sid, k] >= 0 else [])
+            assert bwd_bufs == ([bwd[sid, k] % W] if bwd[sid, k] >= 0 else [])
+        # tail instructions close the batch like the reference TrainSchedule
+        assert any(isinstance(c, sch.ReduceTiedGrads) for c in steps[-1])
+        assert any(isinstance(c, sch.OptimizerStep) for c in steps[-1])
+
+
 def test_instruction_repr_and_eq():
     a = sch.ForwardPass(3)
     b = sch.ForwardPass(3)

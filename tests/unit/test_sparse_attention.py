@@ -229,6 +229,85 @@ def test_kernel_with_masks():
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
 
+def test_pair_index_balanced_worklist():
+    """build_pair_index flattens exactly the active pairs (the sdd_segment
+    analogue): grid work equals layout.sum(), rows stay contiguous, empty
+    rows get one masked dummy so their output block is still visited."""
+    from deepspeed_tpu.ops.sparse_attention.block_sparse_attention import (
+        build_pair_index)
+    layout = np.zeros((1, 4, 4), np.int64)
+    layout[0, 0, :] = 1          # global row: 4 actives
+    layout[0, 2, 1:3] = 1        # 2 actives
+    layout[0, 3, 3] = 1          # 1 active
+    # row 1 empty
+    rows, cols, valid = build_pair_index(layout)
+    assert valid.sum() == layout.sum()              # no padded work
+    assert rows.shape[-1] == int(layout.sum()) + 1  # + one dummy (row 1)
+    real = [(r, c) for r, c, v in zip(rows[0], cols[0], valid[0]) if v]
+    assert real == [(0, 0), (0, 1), (0, 2), (0, 3), (2, 1), (2, 2), (3, 3)]
+    # every q-row appears (dummy included), and rows are sorted/contiguous
+    assert set(rows[0].tolist()) == {0, 1, 2, 3}
+    assert (np.diff(rows[0]) >= 0).all()
+
+
+def test_pair_index_per_head_padding():
+    from deepspeed_tpu.ops.sparse_attention.block_sparse_attention import (
+        build_pair_index)
+    layout = np.zeros((2, 3, 3), np.int64)
+    layout[0] = np.eye(3, dtype=np.int64)           # 3 pairs
+    layout[1, :, :] = 1                             # 9 pairs
+    rows, cols, valid = build_pair_index(layout)
+    assert rows.shape == (2, 9)
+    assert valid[0].sum() == 3 and valid[1].sum() == 9
+    # head-0 pads repeat its last real pair (keeps run bounds intact)
+    assert (rows[0, 3:] == rows[0, 2]).all()
+    assert (valid[0, 3:] == 0).all()
+
+
+def test_sliding_window_layout_and_class():
+    from deepspeed_tpu.ops.sparse_attention import SlidingWindowSparsityConfig
+    cfg = SlidingWindowSparsityConfig(num_heads=2, block=16,
+                                      num_sliding_window_blocks=3)
+    layout = cfg.make_layout(16 * 6)
+    assert layout.shape == (2, 6, 6)
+    # causal by construction: nothing above the diagonal
+    assert np.triu(layout[0], 1).sum() == 0
+    # each row attends exactly its previous min(window, row+1) blocks
+    for r in range(6):
+        assert layout[0, r].sum() == min(3, r + 1)
+        assert layout[0, r, max(0, r - 2):r + 1].all()
+    assert cfg.requires_causal
+
+
+def test_sliding_window_end_to_end_from_ds_config():
+    """ds_config dict -> DeepSpeedConfig -> sparsity_config_from_dict ->
+    SparseSelfAttention, numerically matched against dense attention with
+    the same window mask — the full blessed path for the measured-fastest
+    sparse mode."""
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    from deepspeed_tpu.ops.sparse_attention import sparsity_config_from_dict
+    import jax as _jax
+    world = _jax.device_count()
+    heads, block, seq, d = 2, 16, 96, 16
+    cfg = DeepSpeedConfig(None, param_dict={
+        "train_batch_size": world,
+        "sparse_attention": {"mode": "sliding_window", "block": block,
+                             "num_sliding_window_blocks": 2},
+    })
+    sparsity = sparsity_config_from_dict(cfg.sparse_attention, heads)
+    module = SparseSelfAttention(sparsity, max_seq_length=seq * 2,
+                                 interpret=True)
+    # the module picked up intra-block causality from the layout class
+    assert module.causal
+    rng = np.random.RandomState(11)
+    q, k, v = (jnp.asarray(rng.randn(1, heads, seq, d), jnp.float32)
+               for _ in range(3))
+    out = module(q, k, v)
+    ref = _dense_reference(q, k, v, sparsity.make_layout(seq), block,
+                           causal=True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
 # --- module API -------------------------------------------------------------
 
 def test_sparse_self_attention_module():

@@ -90,6 +90,52 @@ def build_pair_index(layout):
     return rows, cols, valid
 
 
+def build_group_index(layout, pack):
+    """``build_pair_index`` with each row's active k-blocks packed into
+    groups of ``pack`` — one grid step processes ``pack`` k/v blocks, so
+    the per-step pipeline overhead (DMA issue, scalar work, softmax-state
+    update) amortizes over ``pack`` blocks' worth of MXU work. Group
+    slots past a row's population repeat the row's last real column with
+    ``valid`` 0 (in-bounds DMA, masked out of the math); empty rows get
+    one all-invalid group so their output block still initializes.
+
+    Returns (rows[H, P], cols[H, P, pack], valid[H, P, pack]) int32.
+    """
+    layout = np.asarray(layout)
+    heads, nbq, nbk = layout.shape
+    per_head = []
+    for h in range(heads):
+        groups = []
+        for qi in range(nbq):
+            active = np.nonzero(layout[h, qi])[0]
+            if len(active) == 0:
+                groups.append((qi, [0] * pack, [0] * pack))
+                continue
+            for s0 in range(0, len(active), pack):
+                chunk = active[s0:s0 + pack].tolist()
+                val = [1] * len(chunk)
+                while len(chunk) < pack:
+                    chunk.append(chunk[-1])
+                    val.append(0)
+                groups.append((qi, chunk, val))
+        per_head.append(groups)
+    P = max(len(g) for g in per_head)
+    rows = np.zeros((heads, P), dtype=np.int32)
+    cols = np.zeros((heads, P, pack), dtype=np.int32)
+    valid = np.zeros((heads, P, pack), dtype=np.int32)
+    for h, groups in enumerate(per_head):
+        for p, (qi, cs, vs) in enumerate(groups):
+            rows[h, p] = qi
+            cols[h, p] = cs
+            valid[h, p] = vs
+        # pad heads with fewer groups: repeat the last group, all-invalid
+        # (repeating the row keeps run boundaries intact)
+        for p in range(len(groups), P):
+            rows[h, p] = rows[h, len(groups) - 1]
+            cols[h, p] = cols[h, len(groups) - 1]
+    return rows, cols, valid
+
+
 def _run_bounds(rows_ref, h, p, npairs):
     """Is this pair the first/last of its row run? Read from the sorted
     prefetch array — no extra metadata needed."""
@@ -101,21 +147,50 @@ def _run_bounds(rows_ref, h, p, npairs):
     return first, last
 
 
-def _attn_fwd_kernel(rows_ref, cols_ref, valid_ref, q_ref, k_ref, v_ref,
-                     kpm_ref, bias_ref, o_ref, lse_ref, acc_s, m_s, l_s, *,
+def _group_scores(q, k_refs, kpm_refs, bias_refs, cols_ref, valid_ref, h, p,
+                  qi, *, sm_scale, block, causal, has_kpm, has_bias):
+    """Scores for one packed group: (B, G*B) f32, masked slots NEG_INF.
+    One dot per sub-block (the MXU pipelines them); masks fold in as
+    additive biases exactly like the single-pair kernels did."""
+    parts = []
+    pack = len(k_refs)
+    for j, k_ref in enumerate(k_refs):
+        ki = cols_ref[h, p * pack + j]
+        s = jax.lax.dot_general(
+            q, k_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if has_kpm:
+            s = s + kpm_refs[j][0][None, :]
+        if has_bias:
+            s = s + bias_refs[j][...]
+        keep = valid_ref[h, p * pack + j] > 0
+        if causal:
+            q_pos = qi * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 0)
+            k_iota = jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+            keep = jnp.logical_and(keep, q_pos >= ki * block + k_iota)
+        parts.append(jnp.where(keep, s, NEG_INF))
+    return jnp.concatenate(parts, axis=-1) if len(parts) > 1 else parts[0]
+
+
+def _attn_fwd_kernel(rows_ref, cols_ref, valid_ref, q_ref, k_refs, v_refs,
+                     kpm_refs, bias_refs, o_ref, lse_ref, acc_s, m_s, l_s, *,
                      sm_scale, block, causal, has_kpm, has_bias, npairs,
                      shared):
-    """Grid (batch, heads, active-pair): q stays resident across a row run
-    (its BlockSpec index changes only when the row does); each step DMAs
-    exactly one ACTIVE k/v block via the prefetch-driven index maps, so
-    VMEM holds one (block, d) k/v pair at a time and total DMA equals the
-    active-pair count. Online-softmax state is carried in scratch across
-    the run. Dots run in the input dtype (full-rate MXU for bf16) with
-    fp32 accumulation."""
+    """Grid (batch, heads, group): q stays resident across a row run (its
+    BlockSpec index changes only when the row does); each step DMAs the
+    group's ``pack`` ACTIVE k/v blocks via the prefetch-driven index maps,
+    so VMEM holds ``pack`` (B, d) k/v tiles at a time and total DMA equals
+    the active-pair count. Packing amortizes the per-step pipeline
+    overhead and runs ONE online-softmax update per group (over the
+    concatenated (B, pack*B) scores) instead of one per pair. An
+    all-invalid group (dummy for an empty/padded row) degenerates to
+    p_ = 0, corr = 1 — a structural no-op, so no branch is needed.
+    Dots run in the input dtype (full-rate MXU for bf16) with fp32
+    accumulation."""
     h = 0 if shared else pl.program_id(1)
     p = pl.program_id(2)
     qi = rows_ref[h, p]
-    ki = cols_ref[h, p]
     first, last = _run_bounds(rows_ref, h, p, npairs)
 
     @pl.when(first)
@@ -124,34 +199,24 @@ def _attn_fwd_kernel(rows_ref, cols_ref, valid_ref, q_ref, k_ref, v_ref,
         m_s[:] = jnp.full_like(m_s, NEG_INF)
         l_s[:] = jnp.zeros_like(l_s)
 
-    @pl.when(valid_ref[h, p] > 0)
-    def _accumulate():
-        q = q_ref[0, 0]                                     # (B, d) resident
-        k_blk = k_ref[0, 0]                                 # (B, d) streamed
+    s = _group_scores(q_ref[0, 0], k_refs, kpm_refs, bias_refs, cols_ref,
+                      valid_ref, h, p, qi, sm_scale=sm_scale, block=block,
+                      causal=causal, has_kpm=has_kpm, has_bias=has_bias)
+    m_old = m_s[:]
+    m_new = jnp.maximum(m_old, jnp.max(s, axis=-1, keepdims=True))
+    # Rows where every score so far is masked (m_new still NEG_INF)
+    # must not resolve exp(NEG_INF - NEG_INF) to 1.
+    p_ = jnp.where(m_new <= NEG_INF, 0.0, jnp.exp(s - m_new))
+    corr = jnp.exp(m_old - m_new)
+    l_s[:] = l_s[:] * corr + jnp.sum(p_, axis=-1, keepdims=True)
+    m_s[:] = m_new
+    acc = acc_s[:] * corr
+    for j, v_ref in enumerate(v_refs):
         v_blk = v_ref[0, 0]
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale
-        if has_kpm:
-            s = s + kpm_ref[0][None, :]
-        if has_bias:
-            s = s + bias_ref[...]
-        if causal:
-            q_pos = qi * block + jax.lax.broadcasted_iota(
-                jnp.int32, (block, block), 0)
-            k_iota = jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
-            s = jnp.where(q_pos >= ki * block + k_iota, s, NEG_INF)
-        m_old = m_s[:]
-        m_new = jnp.maximum(m_old, jnp.max(s, axis=-1, keepdims=True))
-        # Rows where every score so far is masked (m_new still NEG_INF)
-        # must not resolve exp(NEG_INF - NEG_INF) to 1.
-        p_ = jnp.where(m_new <= NEG_INF, 0.0, jnp.exp(s - m_new))
-        corr = jnp.exp(m_old - m_new)
-        l_s[:] = l_s[:] * corr + jnp.sum(p_, axis=-1, keepdims=True)
-        m_s[:] = m_new
-        acc_s[:] = acc_s[:] * corr + jax.lax.dot_general(
-            p_.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        acc = acc + jax.lax.dot_general(
+            p_[:, j * block:(j + 1) * block].astype(v_blk.dtype), v_blk,
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    acc_s[:] = acc
 
     @pl.when(last)
     def _flush():
@@ -162,66 +227,59 @@ def _attn_fwd_kernel(rows_ref, cols_ref, valid_ref, q_ref, k_ref, v_ref,
                                   m_s[:] + jnp.log(l_safe))
 
 
-def _attn_dq_kernel(rows_ref, cols_ref, valid_ref, q_ref, k_ref, v_ref,
-                    kpm_ref, bias_ref, do_ref, lse_ref, delta_ref, dq_ref,
+def _attn_dq_kernel(rows_ref, cols_ref, valid_ref, q_ref, k_refs, v_refs,
+                    kpm_refs, bias_refs, do_ref, lse_ref, delta_ref, dq_ref,
                     dq_s, *, sm_scale, block, causal, has_kpm, has_bias,
                     npairs, shared):
     h = 0 if shared else pl.program_id(1)
     p = pl.program_id(2)
     qi = rows_ref[h, p]
-    ki = cols_ref[h, p]
     first, last = _run_bounds(rows_ref, h, p, npairs)
 
     @pl.when(first)
     def _init():
         dq_s[:] = jnp.zeros_like(dq_s)
 
-    @pl.when(valid_ref[h, p] > 0)
-    def _accumulate():
-        q = q_ref[0, 0]
-        do = do_ref[0, 0]
-        lse = lse_ref[0, 0]
-        delta = delta_ref[0, 0]
-        k_blk = k_ref[0, 0]                                 # streamed
-        v_blk = v_ref[0, 0]
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale
-        if has_kpm:
-            s = s + kpm_ref[0][None, :]
-        if has_bias:
-            s = s + bias_ref[...]
-        if causal:
-            q_pos = qi * block + jax.lax.broadcasted_iota(
-                jnp.int32, (block, block), 0)
-            k_iota = jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
-            s = jnp.where(q_pos >= ki * block + k_iota, s, NEG_INF)
-        # Rows with no surviving score (lse == NEG_INF) contribute nothing.
-        p_ = jnp.where(lse <= NEG_INF, 0.0, jnp.exp(s - lse))
+    q = q_ref[0, 0]
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+    s = _group_scores(q, k_refs, kpm_refs, bias_refs, cols_ref, valid_ref,
+                      h, p, qi, sm_scale=sm_scale, block=block,
+                      causal=causal, has_kpm=has_kpm, has_bias=has_bias)
+    # Rows with no surviving score (lse == NEG_INF) contribute nothing;
+    # masked slots have s = NEG_INF so their p_ is exactly 0.
+    p_ = jnp.where(lse <= NEG_INF, 0.0, jnp.exp(s - lse))
+    dq_acc = dq_s[:]
+    for j, (k_ref, v_ref) in enumerate(zip(k_refs, v_refs)):
+        k_blk = k_ref[0, 0]
         dp = jax.lax.dot_general(
-            do, v_blk, (((1,), (1,)), ((), ())),
+            do, v_ref[0, 0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = (p_ * (dp - delta) * sm_scale).astype(k_blk.dtype)
-        dq_s[:] = dq_s[:] + jax.lax.dot_general(
+        ds = (p_[:, j * block:(j + 1) * block] * (dp - delta)
+              * sm_scale).astype(k_blk.dtype)
+        dq_acc = dq_acc + jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+    dq_s[:] = dq_acc
 
     @pl.when(last)
     def _flush():
         dq_ref[0, 0] = dq_s[:].astype(dq_ref.dtype)
 
 
-def _attn_dkdv_kernel(rows_ref, cols_ref, valid_ref, q_ref, k_ref, v_ref,
-                      kpm_ref, bias_ref, do_ref, lse_ref, delta_ref, dk_ref,
-                      dv_ref, dk_s, dv_s, *, sm_scale, block, causal,
-                      has_kpm, has_bias, npairs, shared):
-    """Transposed walk: the pair list comes from the TRANSPOSED layout
+def _attn_dkdv_kernel(rows_ref, cols_ref, valid_ref, q_refs, k_ref, v_ref,
+                      kpm_ref, bias_refs, do_refs, lse_refs, delta_refs,
+                      dk_ref, dv_ref, dk_s, dv_s, *, sm_scale, block,
+                      causal, has_kpm, has_bias, npairs, shared):
+    """Transposed walk: the group list comes from the TRANSPOSED layout
     (sorted by k-block), so k/v (and the kpm columns) stay resident per
-    k-block run while the ACTIVE q/do/lse/delta blocks stream in."""
+    k-block run while the group's ACTIVE q/do/lse/delta blocks stream in
+    (``pack`` of each per step). A masked slot's scores are NEG_INF, so
+    its p_ is exactly 0 — invalid/padded slots drop out of both dots."""
     h = 0 if shared else pl.program_id(1)
     p = pl.program_id(2)
     ki = rows_ref[h, p]
-    qi = cols_ref[h, p]
     first, last = _run_bounds(rows_ref, h, p, npairs)
 
     @pl.when(first)
@@ -229,37 +287,44 @@ def _attn_dkdv_kernel(rows_ref, cols_ref, valid_ref, q_ref, k_ref, v_ref,
         dk_s[:] = jnp.zeros_like(dk_s)
         dv_s[:] = jnp.zeros_like(dv_s)
 
-    @pl.when(valid_ref[h, p] > 0)
-    def _accumulate():
-        k_blk = k_ref[0, 0]                                 # resident
-        v_blk = v_ref[0, 0]
+    k_blk = k_ref[0, 0]                                     # resident
+    v_blk = v_ref[0, 0]
+    dk_acc = dk_s[:]
+    dv_acc = dv_s[:]
+    pack = len(q_refs)
+    for j, q_ref in enumerate(q_refs):
+        qi = cols_ref[h, p * pack + j]
         q_blk = q_ref[0, 0]                                 # streamed
-        do_blk = do_ref[0, 0]
-        lse_blk = lse_ref[0, 0]
-        delta_blk = delta_ref[0, 0]
+        do_blk = do_refs[j][0, 0]
+        lse_blk = lse_refs[j][0, 0]
+        delta_blk = delta_refs[j][0, 0]
         s = jax.lax.dot_general(
             q_blk, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
         if has_kpm:
             s = s + kpm_ref[0][None, :]
         if has_bias:
-            s = s + bias_ref[...]
+            s = s + bias_refs[j][...]
+        keep = valid_ref[h, p * pack + j] > 0
         if causal:
             k_pos = ki * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 1)
             q_iota = jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
-            s = jnp.where(qi * block + q_iota >= k_pos, s, NEG_INF)
+            keep = jnp.logical_and(keep, qi * block + q_iota >= k_pos)
+        s = jnp.where(keep, s, NEG_INF)
         p_ = jnp.where(lse_blk <= NEG_INF, 0.0, jnp.exp(s - lse_blk))
-        dv_s[:] = dv_s[:] + jax.lax.dot_general(
+        dv_acc = dv_acc + jax.lax.dot_general(
             p_.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do_blk, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = (p_ * (dp - delta_blk) * sm_scale).astype(q_blk.dtype)
-        dk_s[:] = dk_s[:] + jax.lax.dot_general(
+        dk_acc = dk_acc + jax.lax.dot_general(
             ds, q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+    dk_s[:] = dk_acc
+    dv_s[:] = dv_acc
 
     @pl.when(last)
     def _flush():
@@ -267,9 +332,12 @@ def _attn_dkdv_kernel(rows_ref, cols_ref, valid_ref, q_ref, k_ref, v_ref,
         dv_ref[0, 0] = dv_s[:].astype(dv_ref.dtype)
 
 
+DEFAULT_PACK_WIDTH = 512
+
+
 def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
                                 has_kpm=False, has_bias=False,
-                                interpret=False):
+                                interpret=False, pack=None):
     """Build a jittable ``attn(q, k, v, kpm, bias) -> out`` for a fixed
     layout.
 
@@ -278,52 +346,89 @@ def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
     key bias, ``bias`` an additive (seq, seq) f32 score bias (attn mask +
     relative position embedding); pass None for each unless the matching
     ``has_*`` flag is set. Gradients flow to q/k/v only.
+
+    ``pack`` = k/v blocks per grid step (default: 512 tokens' worth).
+    The grid runs one step per GROUP of ``pack`` active blocks, so the
+    per-step pipeline overhead — the measured bound at block 128, where
+    per-pair stepping leaves the MXU ~10x under-utilized — amortizes
+    without coarsening the LAYOUT granularity the way a bigger block
+    would (a 256-token block doubles a global column's density; a pack
+    of 2x128 does not).
     """
     layout = np.asarray(layout)
     heads, nb, _ = layout.shape
     seq = nb * block
+    if pack is None:
+        pack = max(1, DEFAULT_PACK_WIDTH // block)
+    pack = min(pack, nb)
     # The prefetch index lists live in SMEM: collapse them to ONE copy
     # when every head shares the layout (different_layout_per_head False,
     # the default).
     shared = bool((layout == layout[:1]).all())
     idx_layout = layout[:1] if shared else layout
-    rows_f, cols_f, valid_f = build_pair_index(idx_layout)
-    rows_b, cols_b, valid_b = build_pair_index(idx_layout.transpose(0, 2, 1))
+    rows_f, cols_f, valid_f = build_group_index(idx_layout, pack)
+    rows_b, cols_b, valid_b = build_group_index(
+        idx_layout.transpose(0, 2, 1), pack)
     np_f = int(rows_f.shape[-1])
     np_b = int(rows_b.shape[-1])
+    # SMEM prefetch arrays must stay 2D: a 3D (H, P, pack) int32 array
+    # pads its minor dim to the 128-lane tile, inflating SMEM ~32x —
+    # measured as a compiler crash at fixed-layout seq 32k (P ~ 2176).
+    # Fold the pack dim: slot j of group p lives at [h, p * pack + j].
+    cols_f = cols_f.reshape(cols_f.shape[0], -1)
+    valid_f = valid_f.reshape(valid_f.shape[0], -1)
+    cols_b = cols_b.reshape(cols_b.shape[0], -1)
+    valid_b = valid_b.reshape(valid_b.shape[0], -1)
 
     def _specs(batch_d):
-        """Grid (batch, head, active-pair). ``anchor`` blocks follow the
-        pair's ROW index — constant across a row run, so pallas holds them
-        resident and re-DMAs only at run boundaries; ``stream`` blocks
-        follow the COLUMN index — the pipeline DMAs exactly the active
-        block for each pair, so VMEM never holds whole-sequence operands
-        and total traffic equals the active-pair count."""
+        """Grid (batch, head, group). ``anchor`` blocks follow the
+        group's ROW index — constant across a row run, so pallas holds
+        them resident and re-DMAs only at run boundaries; ``stream_j``
+        blocks follow the group's j-th COLUMN index — the pipeline DMAs
+        exactly the group's active blocks each step, so VMEM never holds
+        whole-sequence operands and total traffic equals the active-pair
+        count (plus the few masked pad slots)."""
         hsel = (lambda h: 0) if shared else (lambda h: h)
         anchor = pl.BlockSpec(
             (1, 1, block, batch_d),
             lambda b, h, p, rw, cl, va: (b, h, rw[hsel(h), p], 0))
-        stream = pl.BlockSpec(
-            (1, 1, block, batch_d),
-            lambda b, h, p, rw, cl, va: (b, h, cl[hsel(h), p], 0))
         anchor_col = pl.BlockSpec(
             (1, 1, block, 1),
             lambda b, h, p, rw, cl, va: (b, h, rw[hsel(h), p], 0))
-        stream_col = pl.BlockSpec(
-            (1, 1, block, 1),
-            lambda b, h, p, rw, cl, va: (b, h, cl[hsel(h), p], 0))
-        kpm_stream = pl.BlockSpec(
-            (1, block), lambda b, h, p, rw, cl, va: (b, cl[hsel(h), p]))
         kpm_anchor = pl.BlockSpec(
             (1, block), lambda b, h, p, rw, cl, va: (b, rw[hsel(h), p]))
-        bias_fwd = pl.BlockSpec(
-            (block, block),
-            lambda b, h, p, rw, cl, va: (rw[hsel(h), p], cl[hsel(h), p]))
-        bias_bwd = pl.BlockSpec(
-            (block, block),
-            lambda b, h, p, rw, cl, va: (cl[hsel(h), p], rw[hsel(h), p]))
-        return (anchor, stream, anchor_col, stream_col, kpm_stream,
-                kpm_anchor, bias_fwd, bias_bwd)
+
+        def stream(j):
+            return pl.BlockSpec(
+                (1, 1, block, batch_d),
+                lambda b, h, p, rw, cl, va: (b, h, cl[hsel(h),
+                                                      p * pack + j], 0))
+
+        def stream_col(j):
+            return pl.BlockSpec(
+                (1, 1, block, 1),
+                lambda b, h, p, rw, cl, va: (b, h, cl[hsel(h),
+                                                      p * pack + j], 0))
+
+        def kpm_stream(j):
+            return pl.BlockSpec(
+                (1, block),
+                lambda b, h, p, rw, cl, va: (b, cl[hsel(h), p * pack + j]))
+
+        def bias_fwd(j):
+            return pl.BlockSpec(
+                (block, block),
+                lambda b, h, p, rw, cl, va: (rw[hsel(h), p],
+                                             cl[hsel(h), p * pack + j]))
+
+        def bias_bwd(j):
+            return pl.BlockSpec(
+                (block, block),
+                lambda b, h, p, rw, cl, va: (cl[hsel(h), p * pack + j],
+                                             rw[hsel(h), p]))
+
+        return (anchor, anchor_col, kpm_anchor, stream, stream_col,
+                kpm_stream, bias_fwd, bias_bwd)
 
     def _mask_ops(kpm, bias):
         ops = []
@@ -337,13 +442,17 @@ def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
         batch, h, s, d = q.shape
         assert h == heads and s == seq, (q.shape, layout.shape, block)
         scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
-        (anchor, stream, anchor_col, _, kpm_s, _, bias_s, _) = _specs(d)
-        in_specs = [anchor, stream, stream] + \
-                   ([kpm_s] if has_kpm else []) + \
-                   ([bias_s] if has_bias else [])
-        ops = [q, k, v] + _mask_ops(kpm, bias)
+        (anchor, anchor_col, _, stream, _, kpm_stream, bias_fwd,
+         _) = _specs(d)
+        js = range(pack)
+        in_specs = [anchor] \
+            + [stream(j) for j in js] + [stream(j) for j in js] \
+            + ([kpm_stream(j) for j in js] if has_kpm else []) \
+            + ([bias_fwd(j) for j in js] if has_bias else [])
+        ops = [q] + [k] * pack + [v] * pack \
+            + [m for m in _mask_ops(kpm, bias) for _ in js]
         kernel = functools.partial(
-            _kernel_shim, _attn_fwd_kernel, has_kpm, has_bias,
+            _fwd_shim, has_kpm, has_bias, pack,
             sm_scale=scale, block=block, causal=causal, npairs=np_f,
             shared=shared)
         out, lse = pl.pallas_call(
@@ -368,14 +477,15 @@ def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
         scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
         delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                         axis=-1, keepdims=True)
-        (anchor, stream, anchor_col, stream_col, kpm_stream, kpm_anchor,
+        (anchor, anchor_col, kpm_anchor, stream, stream_col, kpm_stream,
          bias_fwd, bias_bwd) = _specs(d)
+        js = range(pack)
 
-        mask_specs = ([kpm_stream] if has_kpm else []) + \
-                     ([bias_fwd] if has_bias else [])
-        mask_ops = _mask_ops(kpm, bias)
+        mask_specs = ([kpm_stream(j) for j in js] if has_kpm else []) + \
+                     ([bias_fwd(j) for j in js] if has_bias else [])
+        mask_ops = [m for m in _mask_ops(kpm, bias) for _ in js]
         dq_kernel = functools.partial(
-            _kernel_shim, _attn_dq_kernel, has_kpm, has_bias,
+            _dq_shim, has_kpm, has_bias, pack,
             sm_scale=scale, block=block, causal=causal, npairs=np_f,
             shared=shared)
         dq = pl.pallas_call(
@@ -383,21 +493,24 @@ def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
             grid_spec=pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=3,
                 grid=(batch, heads, np_f),
-                in_specs=[anchor, stream, stream] + mask_specs +
-                         [anchor, anchor_col, anchor_col],
+                in_specs=[anchor] + [stream(j) for j in js]
+                         + [stream(j) for j in js] + mask_specs
+                         + [anchor, anchor_col, anchor_col],
                 out_specs=anchor,
                 scratch_shapes=[pltpu.VMEM((block, d), jnp.float32)]),
             out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
             interpret=interpret,
         )(jnp.asarray(rows_f), jnp.asarray(cols_f), jnp.asarray(valid_f),
-          q, k, v, *mask_ops, do, lse, delta)
+          q, *([k] * pack), *([v] * pack), *mask_ops, do, lse, delta)
 
-        # dk/dv pass walks the transposed pair list: k/v anchored per
-        # k-block run, q/do/lse/delta streamed.
+        # dk/dv pass walks the transposed group list: k/v anchored per
+        # k-block run, q/do/lse/delta streamed (pack of each per step).
         mask_specs_t = ([kpm_anchor] if has_kpm else []) + \
-                       ([bias_bwd] if has_bias else [])
+                       ([bias_bwd(j) for j in js] if has_bias else [])
+        mask_ops_t = ([jnp.asarray(kpm, jnp.float32)] if has_kpm else []) \
+            + ([jnp.asarray(bias, jnp.float32)] * pack if has_bias else [])
         dkdv_kernel = functools.partial(
-            _kernel_shim, _attn_dkdv_kernel, has_kpm, has_bias,
+            _dkdv_shim, has_kpm, has_bias, pack,
             sm_scale=scale, block=block, causal=causal, npairs=np_b,
             shared=shared)
         dk, dv = pl.pallas_call(
@@ -405,8 +518,10 @@ def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
             grid_spec=pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=3,
                 grid=(batch, heads, np_b),
-                in_specs=[stream, anchor, anchor] + mask_specs_t +
-                         [stream, stream_col, stream_col],
+                in_specs=[stream(j) for j in js] + [anchor, anchor]
+                         + mask_specs_t + [stream(j) for j in js]
+                         + [stream_col(j) for j in js]
+                         + [stream_col(j) for j in js],
                 out_specs=(anchor, anchor),
                 scratch_shapes=[pltpu.VMEM((block, d), jnp.float32),
                                 pltpu.VMEM((block, d), jnp.float32)]),
@@ -414,7 +529,8 @@ def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
                        jax.ShapeDtypeStruct(v.shape, v.dtype)),
             interpret=interpret,
         )(jnp.asarray(rows_b), jnp.asarray(cols_b), jnp.asarray(valid_b),
-          q, k, v, *mask_ops, do, lse, delta)
+          *([q] * pack), k, v, *mask_ops_t, *([do] * pack),
+          *([lse] * pack), *([delta] * pack))
         return dq, dk, dv
 
     @jax.custom_vjp
@@ -437,14 +553,49 @@ def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
     return attn
 
 
-def _kernel_shim(kernel, has_kpm, has_bias, rows_ref, cols_ref, valid_ref,
-                 *refs, **params):
-    """Re-inserts None placeholders for absent mask operands so each kernel
-    keeps one signature."""
+def _take(refs, n):
+    return refs[:n], refs[n:]
+
+
+def _fwd_shim(has_kpm, has_bias, pack, rows_ref, cols_ref, valid_ref,
+              *refs, **params):
+    """Slices the flat ref list into the grouped operand tuples and
+    re-inserts None placeholders for absent mask operands."""
     refs = list(refs)
-    q_ref, k_ref, v_ref = refs[:3]
-    rest = refs[3:]
-    kpm_ref = rest.pop(0) if has_kpm else None
-    bias_ref = rest.pop(0) if has_bias else None
-    kernel(rows_ref, cols_ref, valid_ref, q_ref, k_ref, v_ref, kpm_ref,
-           bias_ref, *rest, has_kpm=has_kpm, has_bias=has_bias, **params)
+    q_ref = refs[0]
+    k_refs, rest = _take(refs[1:], pack)
+    v_refs, rest = _take(rest, pack)
+    kpm_refs, rest = _take(rest, pack) if has_kpm else (None, rest)
+    bias_refs, rest = _take(rest, pack) if has_bias else (None, rest)
+    _attn_fwd_kernel(rows_ref, cols_ref, valid_ref, q_ref, k_refs, v_refs,
+                     kpm_refs, bias_refs, *rest, has_kpm=has_kpm,
+                     has_bias=has_bias, **params)
+
+
+def _dq_shim(has_kpm, has_bias, pack, rows_ref, cols_ref, valid_ref,
+             *refs, **params):
+    refs = list(refs)
+    q_ref = refs[0]
+    k_refs, rest = _take(refs[1:], pack)
+    v_refs, rest = _take(rest, pack)
+    kpm_refs, rest = _take(rest, pack) if has_kpm else (None, rest)
+    bias_refs, rest = _take(rest, pack) if has_bias else (None, rest)
+    _attn_dq_kernel(rows_ref, cols_ref, valid_ref, q_ref, k_refs, v_refs,
+                    kpm_refs, bias_refs, *rest, has_kpm=has_kpm,
+                    has_bias=has_bias, **params)
+
+
+def _dkdv_shim(has_kpm, has_bias, pack, rows_ref, cols_ref, valid_ref,
+               *refs, **params):
+    refs = list(refs)
+    q_refs, rest = _take(refs, pack)
+    k_ref, v_ref = rest[:2]
+    rest = rest[2:]
+    kpm_ref, rest = (rest[0], rest[1:]) if has_kpm else (None, rest)
+    bias_refs, rest = _take(rest, pack) if has_bias else (None, rest)
+    do_refs, rest = _take(rest, pack)
+    lse_refs, rest = _take(rest, pack)
+    delta_refs, rest = _take(rest, pack)
+    _attn_dkdv_kernel(rows_ref, cols_ref, valid_ref, q_refs, k_ref, v_ref,
+                      kpm_ref, bias_refs, do_refs, lse_refs, delta_refs,
+                      *rest, has_kpm=has_kpm, has_bias=has_bias, **params)

@@ -19,6 +19,9 @@ def main():
     parser.add_argument("--mb", type=int, default=48)
     parser.add_argument("--steps", type=int, default=10)
     parser.add_argument("--chunk", type=int, default=128)
+    parser.add_argument("--policy", default="full",
+                        choices=["full", "dots", "none"],
+                        help="remat policy (none = remat off)")
     args = parser.parse_args()
 
     import jax
@@ -26,7 +29,10 @@ def main():
     from deepspeed_tpu.models import gpt2
 
     seq = 1024
-    cfg = gpt2.config_for("gpt2_medium", max_seq_len=seq, remat=True,
+    cfg = gpt2.config_for("gpt2_medium", max_seq_len=seq,
+                          remat=args.policy != "none",
+                          remat_policy=("full" if args.policy == "none"
+                                        else args.policy),
                           loss_chunk=args.chunk)
     model = gpt2.make_gpt2_model(config=cfg)
     ds_config = {
@@ -54,7 +60,8 @@ def main():
     toks = args.mb * seq * args.steps / dt
     n = gpt2.num_params(cfg)
     fpt = 6.0 * n + 12.0 * cfg.n_layers * cfg.d_model * seq
-    print(json.dumps({"mb": args.mb, "tokens_per_sec": round(toks, 1),
+    print(json.dumps({"mb": args.mb, "policy": args.policy,
+                      "tokens_per_sec": round(toks, 1),
                       "mfu": round(toks * fpt / 197e12, 4)}))
 
 

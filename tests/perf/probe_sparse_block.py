@@ -1,0 +1,77 @@
+"""Probe: block-sparse kernel block-size scaling (fixed layout).
+
+The balanced grid runs one (block, d) k/v block per step; per-step
+overhead (DMA issue, scalar work) is ~flat, so larger blocks amortize
+it. Times fwd+bwd at block 128 vs 256 for the fixed + bigbird layouts.
+
+    python tests/perf/probe_sparse_block.py [--seq 16384]
+"""
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+# calibrated timer shared with the sweep (a hardcoded roundtrip constant
+# drifts run to run and can go negative at short sequence lengths)
+from sweep_sparse_vs_dense import timed_scan  # noqa: E402
+
+HEADS, DHEAD = 16, 64
+BATCH = 2
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--seq", type=int, default=16384)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.sparse_attention import (
+        BigBirdSparsityConfig, FixedSparsityConfig,
+        make_block_sparse_attention)
+
+    seq = args.seq
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(BATCH, seq, HEADS, DHEAD) * 0.1, jnp.bfloat16)
+
+    # same effective pattern at both granularities: ~512-token local
+    # window + one global stripe per fixed window
+    cases = []
+    for block, nloc in ((128, 4), (256, 2), (512, 1)):
+        cases.append(("fixed_b{}".format(block), block, FixedSparsityConfig(
+            num_heads=HEADS, block=block, num_local_blocks=nloc,
+            num_global_blocks=1, attention="unidirectional")))
+    for block, nwin in ((128, 3), (256, 3)):
+        cases.append(("bigbird_b{}".format(block), block,
+                      BigBirdSparsityConfig(
+                          num_heads=HEADS, block=block, num_random_blocks=2,
+                          num_sliding_window_blocks=nwin, num_global_blocks=1,
+                          seed=0)))
+
+    for name, block, cfg in cases:
+        lay = np.asarray(cfg.make_layout(seq))
+        attn = make_block_sparse_attention(lay, block, causal=True)
+
+        def step(t, attn=attn):
+            def loss(q):
+                qh = q.transpose(0, 2, 1, 3)
+                return attn(qh, qh, qh, None, None) \
+                    .astype(jnp.float32).sum()
+            return jax.grad(loss)(t).astype(t.dtype)
+
+        try:
+            ms = round(timed_scan(step, x), 1)
+        except Exception as err:  # noqa: BLE001
+            ms = "failed: " + str(err)[:100]
+        print(json.dumps({"case": name, "seq": seq, "block": block,
+                          "density": round(float(lay.mean()), 4),
+                          "ms": ms}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

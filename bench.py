@@ -13,11 +13,12 @@ import time
 import numpy as np
 
 
-# Peak bf16 TFLOPS per chip by device kind.
+# Peak bf16 TFLOPS per chip by device kind (public cloud.google.com/tpu
+# specs; v2/v3 per-chip = 2 cores).
 PEAK_TFLOPS = {
-    "TPU v2": 22.5, "TPU v3": 61.0, "TPU v4": 137.5,  # bf16 per chip
-    "TPU v5 lite": 197.0, "TPU v5e": 197.0, "TPU v5": 229.5,
-    "TPU v5p": 229.5, "TPU v6 lite": 459.0, "TPU v6e": 459.0,
+    "TPU v2": 45.0, "TPU v3": 123.0, "TPU v4": 275.0,
+    "TPU v5 lite": 197.0, "TPU v5e": 197.0, "TPU v5": 459.0,
+    "TPU v5p": 459.0, "TPU v6 lite": 918.0, "TPU v6e": 918.0,
     "cpu": 0.1,
 }
 

@@ -11,15 +11,23 @@ index list driving the grid (the analogue of sdd_segment's lut).
 
 The layout is a numpy (num_heads, nb, nb) 0/1 matrix from
 sparsity_config.py. Load balancing: the active (q-block, k-block) pairs
-are FLATTENED into one grid dimension, sorted by q-block so each row's
-pairs are contiguous — the online-softmax scratch initializes at a row
-run's first pair and flushes at its last (run boundaries read from the
-scalar-prefetch arrays). Grid steps (and k/v DMAs) therefore equal the
-ACTIVE pair count exactly; skewed layouts (a global row/column attending
-everything, as in bslongformer/bigbird/fixed) cost their true work, not
+are FLATTENED and sorted by q-block so each row's pairs are contiguous,
+then PACKED into groups of ``pack`` (default 512 tokens' worth) — one
+grid step DMAs the group's k/v blocks through per-slot index maps and
+runs a single online-softmax update over the concatenated scores, so
+the per-step pipeline overhead (the bound at block 128, where per-pair
+stepping left the MXU ~10x under-utilized) amortizes across the group.
+The online-softmax scratch initializes at a row run's first group and
+flushes at its last (run boundaries read from the scalar-prefetch
+arrays). Total k/v DMA equals the active-pair count (plus a few masked
+pad slots); skewed layouts (a global row/column attending everything,
+as in bslongformer/bigbird/fixed) cost their true work, not
 rows x max-row-population as the round-2 padded grid did. Rows with no
-active blocks get one masked dummy pair so their output block still
-initializes (zero out, NEG_INF lse).
+active blocks get one all-masked group so their output block still
+initializes (zero out, NEG_INF lse). Scalar-prefetch arrays stay 2D
+(slot j of group p at [h, p*pack+j]) — a 3D (H, P, pack) SMEM array
+pads its minor dim to the 128-lane tile and OOMs the compiler once
+P reaches ~2k.
 
 Masks (key-padding and attention) and relative position bias are folded
 into additive f32 biases; they participate in forward/recompute but do
@@ -42,7 +50,7 @@ def build_block_index(layout):
     row population. Returns (counts[H, nb], indices[H, nb, max_n]).
 
     Kept for API/diagnostic use (density stats, tests); the kernels run on
-    ``build_pair_index``'s balanced flat lists."""
+    ``build_group_index``'s packed group lists."""
     layout = np.asarray(layout)
     heads, nbq, nbk = layout.shape
     counts = layout.sum(axis=-1).astype(np.int32)
@@ -452,7 +460,7 @@ def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
         ops = [q] + [k] * pack + [v] * pack \
             + [m for m in _mask_ops(kpm, bias) for _ in js]
         kernel = functools.partial(
-            _fwd_shim, has_kpm, has_bias, pack,
+            _row_walk_shim, _attn_fwd_kernel, has_kpm, has_bias, pack,
             sm_scale=scale, block=block, causal=causal, npairs=np_f,
             shared=shared)
         out, lse = pl.pallas_call(
@@ -485,7 +493,7 @@ def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
                      ([bias_fwd(j) for j in js] if has_bias else [])
         mask_ops = [m for m in _mask_ops(kpm, bias) for _ in js]
         dq_kernel = functools.partial(
-            _dq_shim, has_kpm, has_bias, pack,
+            _row_walk_shim, _attn_dq_kernel, has_kpm, has_bias, pack,
             sm_scale=scale, block=block, causal=causal, npairs=np_f,
             shared=shared)
         dq = pl.pallas_call(
@@ -557,32 +565,20 @@ def _take(refs, n):
     return refs[:n], refs[n:]
 
 
-def _fwd_shim(has_kpm, has_bias, pack, rows_ref, cols_ref, valid_ref,
-              *refs, **params):
-    """Slices the flat ref list into the grouped operand tuples and
-    re-inserts None placeholders for absent mask operands."""
+def _row_walk_shim(kernel, has_kpm, has_bias, pack, rows_ref, cols_ref,
+                   valid_ref, *refs, **params):
+    """Shared fwd/dq shim (both walk row-sorted groups with identical
+    operand packing): slices the flat ref list into the grouped operand
+    tuples and re-inserts None placeholders for absent mask operands."""
     refs = list(refs)
     q_ref = refs[0]
     k_refs, rest = _take(refs[1:], pack)
     v_refs, rest = _take(rest, pack)
     kpm_refs, rest = _take(rest, pack) if has_kpm else (None, rest)
     bias_refs, rest = _take(rest, pack) if has_bias else (None, rest)
-    _attn_fwd_kernel(rows_ref, cols_ref, valid_ref, q_ref, k_refs, v_refs,
-                     kpm_refs, bias_refs, *rest, has_kpm=has_kpm,
-                     has_bias=has_bias, **params)
-
-
-def _dq_shim(has_kpm, has_bias, pack, rows_ref, cols_ref, valid_ref,
-             *refs, **params):
-    refs = list(refs)
-    q_ref = refs[0]
-    k_refs, rest = _take(refs[1:], pack)
-    v_refs, rest = _take(rest, pack)
-    kpm_refs, rest = _take(rest, pack) if has_kpm else (None, rest)
-    bias_refs, rest = _take(rest, pack) if has_bias else (None, rest)
-    _attn_dq_kernel(rows_ref, cols_ref, valid_ref, q_ref, k_refs, v_refs,
-                    kpm_refs, bias_refs, *rest, has_kpm=has_kpm,
-                    has_bias=has_bias, **params)
+    kernel(rows_ref, cols_ref, valid_ref, q_ref, k_refs, v_refs,
+           kpm_refs, bias_refs, *rest, has_kpm=has_kpm,
+           has_bias=has_bias, **params)
 
 
 def _dkdv_shim(has_kpm, has_bias, pack, rows_ref, cols_ref, valid_ref,

@@ -10,7 +10,7 @@ so the tokens/s printed here is a LOWER bound for the offload path.
 
     python tests/perf/bench_gpt2_xl.py [--mb 8] [--steps 2]
 
-Writes tests/perf/BENCH_XL_r02.json.
+Writes tests/perf/BENCH_XL_r03.json.
 """
 import argparse
 import json
@@ -85,7 +85,7 @@ def main():
                       "faster, so this is a lower bound",
         },
     }
-    path = os.path.join(os.path.dirname(__file__), "BENCH_XL_r02.json")
+    path = os.path.join(os.path.dirname(__file__), "BENCH_XL_r03.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=2)
     print(json.dumps(out), flush=True)

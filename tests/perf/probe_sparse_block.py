@@ -1,8 +1,11 @@
-"""Probe: block-sparse kernel block-size scaling (fixed layout).
+"""Probe: block-sparse LAYOUT-granularity trade-off (fixed + bigbird).
 
-The balanced grid runs one (block, d) k/v block per step; per-step
-overhead (DMA issue, scalar work) is ~flat, so larger blocks amortize
-it. Times fwd+bwd at block 128 vs 256 for the fixed + bigbird layouts.
+With pack-grouping the kernel already amortizes per-step overhead at
+block 128 (each grid step runs 512 tokens' worth of k/v blocks), so
+this probe measures the remaining trade: a coarser layout block raises
+per-dot MXU efficiency but inflates the layout's density (a global
+column doubles its token width with the block). Historically it also
+diagnosed the pre-pack kernel's flat per-step overhead.
 
     python tests/perf/probe_sparse_block.py [--seq 16384]
 """

@@ -423,3 +423,66 @@ def test_causal_sliding_window_layout():
     assert (lay[0].sum(-1)[2:] == 3).all()
     # strictly causal
     assert not np.triu(lay[0], 1).any()
+
+
+def test_build_group_index_packs_rows():
+    """build_group_index chunks each row's active columns into packs of
+    G, pads partial groups with repeats marked invalid, and gives empty
+    rows one all-invalid group (the kernel's per-step worklist)."""
+    from deepspeed_tpu.ops.sparse_attention.block_sparse_attention import (
+        build_group_index)
+    layout = np.zeros((1, 4, 4), np.int64)
+    layout[0, 0, :] = 1          # 4 actives -> 2 groups of 2
+    layout[0, 2, 1:3] = 1        # 2 actives -> 1 full group
+    layout[0, 3, 3] = 1          # 1 active  -> 1 group, 1 pad slot
+    # row 1 empty               -> 1 all-invalid group
+    rows, cols, valid = build_group_index(layout, 2)
+    assert rows.shape == (1, 5) and cols.shape == (1, 5, 2)
+    assert valid.sum() == layout.sum()          # pads carry no work
+    assert rows[0].tolist() == [0, 0, 1, 2, 3]  # sorted, runs contiguous
+    assert cols[0, 0].tolist() == [0, 1] and cols[0, 1].tolist() == [2, 3]
+    assert valid[0, 2].tolist() == [0, 0]       # empty row: all masked
+    assert cols[0, 4].tolist() == [3, 3]        # pad repeats last real col
+    assert valid[0, 4].tolist() == [1, 0]
+
+
+def test_build_group_index_head_padding():
+    from deepspeed_tpu.ops.sparse_attention.block_sparse_attention import (
+        build_group_index)
+    layout = np.zeros((2, 3, 3), np.int64)
+    layout[0] = np.eye(3, dtype=np.int64)       # 3 groups (pack 2)
+    layout[1, :, :] = 1                         # 6 groups
+    rows, cols, valid = build_group_index(layout, 2)
+    assert rows.shape == (2, 6)
+    assert valid[0].sum() == 3 and valid[1].sum() == 9
+    # head-0's pad groups repeat its last row, all-invalid
+    assert (rows[0, 3:] == rows[0, 2]).all()
+    assert (valid[0, 3:] == 0).all()
+
+
+def test_pack_sizes_agree_with_reference():
+    """The same layout must produce identical attention at every pack
+    (pack is a pure execution-shape knob)."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.sparse_attention import (
+        FixedSparsityConfig, make_block_sparse_attention)
+    rng = np.random.RandomState(3)
+    H, S, D, block = 2, 128, 16, 16
+    cfg = FixedSparsityConfig(num_heads=H, block=block, num_local_blocks=2,
+                              num_global_blocks=1,
+                              attention="unidirectional")
+    layout = np.asarray(cfg.make_layout(S))
+    q = jnp.asarray(rng.randn(1, H, S, D) * 0.3, jnp.float32)
+    outs = []
+    grads = []
+    for pack in (1, 2, 4):
+        attn = make_block_sparse_attention(layout, block, causal=True,
+                                           interpret=True, pack=pack)
+        outs.append(np.asarray(attn(q, q, q, None, None)))
+        grads.append(np.asarray(jax.grad(
+            lambda t, a=attn: a(t, t, t, None, None).sum())(q)))
+    for o in outs[1:]:
+        np.testing.assert_allclose(o, outs[0], rtol=1e-5, atol=1e-5)
+    for g in grads[1:]:
+        np.testing.assert_allclose(g, grads[0], rtol=1e-4, atol=1e-4)

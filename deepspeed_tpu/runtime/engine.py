@@ -73,6 +73,11 @@ class DeepSpeedEngine:
     """Wraps a model to provide distributed data-parallel (+ZeRO) training on
     a TPU mesh with the DeepSpeed train API."""
 
+    # ZeRO-Offload D2H prefetch depth (shards in flight ahead of the host
+    # Adam); each in-flight copy pins a device staging buffer, so this
+    # bounds the extra HBM the overlapped step may use.
+    _D2H_WINDOW = 4
+
     def __init__(self, args=None, model=None, optimizer=None,
                  model_parameters=None, training_data=None, lr_scheduler=None,
                  mpu=None, dist_init_required=None, collate_fn=None,
@@ -769,15 +774,29 @@ class DeepSpeedEngine:
                               np.float32(inv_scale))
         hs = self.host_state
         flat_acc = hs["treedef"].flatten_up_to(self.state["acc_grads"])
-        # stage 1: start EVERY shard's D2H now — they stream behind the
-        # (round-trip) overflow fetch below and the host Adam loop. A
-        # plugin without async copy disables the prefetch permanently
-        # (not one raise per leaf per step).
+        # flat work list over (leaf, shard) for the fetch pipeline —
+        # built from the HOST shard registry so replicated leaves dedupe
+        # to one entry (the same order the Adam loop consumes)
+        work = []
+        for i, (g_arr, shards) in enumerate(zip(flat_acc,
+                                                hs["shard_leaves"])):
+            local = {_shard_key(sh.index): sh.data
+                     for sh in g_arr.addressable_shards}
+            for tup in shards:
+                work.append((i, tup, local[_shard_key(tup[0])]))
+        # stage 1: kick off a BOUNDED window of shard D2Hs (in work-list
+        # order) so transfers stream behind the (round-trip) overflow
+        # fetch below; the work loop tops the window up one shard ahead
+        # of the host Adam. An unbounded warm-up (every shard at once)
+        # pins a device staging buffer per shard — at 1.5B that is ~an
+        # extra full gradient copy of HBM and OOMs the chip that the
+        # serial round-2 step fit on. A plugin without async copy
+        # disables the prefetch permanently (not one raise per leaf per
+        # step).
         if getattr(self, "_async_d2h", True):
             try:
-                for g_arr in flat_acc:
-                    for sh in g_arr.addressable_shards:
-                        sh.data.copy_to_host_async()
+                for item in work[:self._D2H_WINDOW]:
+                    item[2].copy_to_host_async()
             except Exception:  # noqa: BLE001
                 self._async_d2h = False
         # a sumsq that overflowed despite finite elements is an overflow
@@ -800,14 +819,6 @@ class DeepSpeedEngine:
             adam_w = 1 if getattr(self.optimizer, "adam_w_mode", True) else 0
             lib = self._offload_lib()
 
-            # flat work list over (leaf, shard) for the fetch pipeline
-            work = []
-            for i, (g_arr, shards) in enumerate(zip(flat_acc,
-                                                    hs["shard_leaves"])):
-                local = {_shard_key(sh.index): sh.data
-                         for sh in g_arr.addressable_shards}
-                for tup in shards:
-                    work.append((i, tup, local[_shard_key(tup[0])]))
             left_in_leaf = [len(s) for s in hs["shard_leaves"]]
             flat_params = [None] * len(flat_acc)
 
@@ -821,6 +832,13 @@ class DeepSpeedEngine:
                 g = nxt.result()
                 nxt = pool.submit(fetch, work[j + 1]) \
                     if j + 1 < len(work) else None
+                # top the bounded D2H window up one shard ahead
+                if getattr(self, "_async_d2h", True) \
+                        and j + self._D2H_WINDOW < len(work):
+                    try:
+                        work[j + self._D2H_WINDOW][2].copy_to_host_async()
+                    except Exception:  # noqa: BLE001
+                        self._async_d2h = False
                 g *= coef  # unscale (+clip) in place on the host copy
                 i, (idx, p, m, v), _ = item
                 if lib is not None:

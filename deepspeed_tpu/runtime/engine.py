@@ -822,13 +822,56 @@ class DeepSpeedEngine:
             left_in_leaf = [len(s) for s in hs["shard_leaves"]]
             flat_params = [None] * len(flat_acc)
 
+            # Release the engine's references so device memory frees as
+            # the loop consumes it — at 1.5B the resting fp32 acc_grads
+            # (6.2 GB) + bf16 params (3.1 GB) plus the step's uploads
+            # and reshard output exceed one v5e's HBM if everything is
+            # held to the end. The params' updated values come from the
+            # host master (params are dead the moment the micros ran);
+            # each acc leaf is dead once its last shard's fetch landed.
+            acc_specs = [(a.shape, a.dtype) for a in flat_acc]
+            acc_shardings = [a.sharding for a in flat_acc]
+            self.state["params"] = None
+            self.state["acc_grads"] = None
+
             def fetch(item):
                 # writable fp32 copy for the in-place host Adam
                 return np.array(item[2], dtype=np.float32)
 
-            pool = self._offload_fetch_pool()
-            nxt = pool.submit(fetch, work[0]) if work else None
-            for j, item in enumerate(work):
+            try:
+                self._offload_update_loop(
+                    work, flat_acc, flat_params, left_in_leaf, fetch,
+                    coef, hyper, bc1, bc2, adam_w, lib, acc_specs,
+                    acc_shardings, hs)
+            except BaseException:
+                # a mid-step failure (e.g. OOM in a leaf H2D) must not
+                # strand the engine with None pytrees: the host masters
+                # hold the authoritative values, so rebuild params from
+                # them (best effort — skip if even that allocation
+                # fails) so the run can still checkpoint or retry
+                try:
+                    self._restore_params_from_host(acc_specs,
+                                                   acc_shardings, hs)
+                except Exception:  # noqa: BLE001
+                    pass
+                raise
+            self._finish_offload_step(flat_params, acc_specs,
+                                      acc_shardings, hs)
+        else:
+            self.state["acc_grads"] = jax.tree_util.tree_map(
+                jnp.zeros_like, self.state["acc_grads"])
+        self.state["scaler"] = ls.update_scale(scaler, overflow)
+        return {"overflow": overflow, "grad_norm": grad_norm,
+                "loss_scale": cur_scale}
+
+    def _offload_update_loop(self, work, flat_acc, flat_params,
+                             left_in_leaf, fetch, coef, hyper, bc1, bc2,
+                             adam_w, lib, acc_specs, acc_shardings, hs):
+        """The shard-pipelined host Adam (see _host_apply_step)."""
+        beta1, beta2 = hyper["beta1"], hyper["beta2"]
+        pool = self._offload_fetch_pool()
+        nxt = pool.submit(fetch, work[0]) if work else None
+        for j, item in enumerate(work):
                 g = nxt.result()
                 nxt = pool.submit(fetch, work[j + 1]) \
                     if j + 1 < len(work) else None
@@ -861,24 +904,47 @@ class DeepSpeedEngine:
                         update += hyper["weight_decay"] * p
                     p -= hyper["lr"] * update
                 # stage 3: the moment a leaf's last shard steps, launch its
-                # H2D — uploads overlap the remaining leaves' Adam
+                # H2D — uploads overlap the remaining leaves' Adam; drop
+                # the consumed grad references so their buffers free
+                work[j] = None
                 left_in_leaf[i] -= 1
                 if left_in_leaf[i] == 0:
                     flat_params[i] = self._leaf_shards_to_device(
-                        flat_acc[i], hs["shard_leaves"][i])
+                        acc_specs[i][0], acc_shardings[i],
+                        hs["shard_leaves"][i])
+                    flat_acc[i] = None
 
-            grad_layout = hs["treedef"].unflatten(flat_params)
-            reshard = self._get_jit(
-                "offload_reshard",
-                lambda: lambda t: t,
-                out_shardings=hs["param_shardings"])
-            self.state["params"] = reshard(grad_layout)
+    def _finish_offload_step(self, flat_params, acc_specs, acc_shardings,
+                             hs):
+        """Reshard the uploaded grad-layout leaves into the param layout
+        and re-zero the accumulators on device."""
+        grad_layout = hs["treedef"].unflatten(flat_params)
+        reshard = self._get_jit(
+            "offload_reshard",
+            lambda: lambda t: t,
+            out_shardings=hs["param_shardings"])
+        self.state["params"] = reshard(grad_layout)
+        del grad_layout
+        # fresh zero accumulators, allocated ON DEVICE from the saved
+        # specs (a host-side zeros + device_put would push the full
+        # fp32 gradient over the wire every step)
+        zeros_fn = self._get_jit(
+            "acc_zeros",
+            lambda: (lambda: tuple(jnp.zeros(s, d)
+                                   for s, d in acc_specs)),
+            out_shardings=tuple(acc_shardings))
+        self.state["acc_grads"] = hs["treedef"].unflatten(
+            list(zeros_fn()))
 
-        self.state["acc_grads"] = jax.tree_util.tree_map(
-            jnp.zeros_like, self.state["acc_grads"])
-        self.state["scaler"] = ls.update_scale(scaler, overflow)
-        return {"overflow": overflow, "grad_norm": grad_norm,
-                "loss_scale": cur_scale}
+    def _restore_params_from_host(self, acc_specs, acc_shardings, hs):
+        """Disaster path: rebuild device params + zero accumulators from
+        the host master shards after a failed overlapped step."""
+        flat_params = [
+            self._leaf_shards_to_device(spec[0], sh, shards)
+            for spec, sh, shards in zip(acc_specs, acc_shardings,
+                                        hs["shard_leaves"])]
+        self._finish_offload_step(flat_params, acc_specs, acc_shardings,
+                                  hs)
 
     def _offload_fetch_pool(self):
         from concurrent.futures import ThreadPoolExecutor
@@ -887,19 +953,20 @@ class DeepSpeedEngine:
                 max_workers=1, thread_name_prefix="offload-fetch")
         return self._offload_pool
 
-    def _leaf_shards_to_device(self, g_arr, shards):
+    def _leaf_shards_to_device(self, shape, sharding, shards):
         """One leaf's updated host master shards -> a grad-layout global
-        device array (per-shard async H2D in compute dtype)."""
+        device array (per-shard async H2D in compute dtype). Takes the
+        leaf's (shape, sharding) spec rather than the grad array so the
+        caller can free the gradient buffer first."""
         cdtype = np.dtype(self.compute_dtype)
         by_key = {_shard_key(idx): p for idx, p, _, _ in shards}
-        sharding = g_arr.sharding
-        dev_map = sharding.addressable_devices_indices_map(g_arr.shape)
+        dev_map = sharding.addressable_devices_indices_map(shape)
         singles = [
             jax.device_put(np.ascontiguousarray(
                 by_key[_shard_key(idx)].astype(cdtype)), dev)
             for dev, idx in dev_map.items()]
         return jax.make_array_from_single_device_arrays(
-            g_arr.shape, sharding, singles)
+            shape, sharding, singles)
 
     def _host_to_device(self, p_np, sharding):
         """Host fp32 leaf -> sharded compute-dtype device array WITHOUT

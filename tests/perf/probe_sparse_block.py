@@ -1,7 +1,7 @@
 """Probe: block-sparse LAYOUT-granularity trade-off (fixed + bigbird).
 
 With pack-grouping the kernel already amortizes per-step overhead at
-block 128 (each grid step runs 512 tokens' worth of k/v blocks), so
+block 128 (each grid step runs 1024 tokens' worth of k/v blocks), so
 this probe measures the remaining trade: a coarser layout block raises
 per-dot MXU efficiency but inflates the layout's density (a global
 column doubles its token width with the block). Historically it also

@@ -1,0 +1,75 @@
+"""Probe: the remaining reference layouts (variable, bslongformer) vs
+dense flash at seq 16384 — completes the measured layout matrix
+(fixed/bigbird/sliding_window live in sweep_sparse_vs_dense.py).
+Writes tests/perf/LAYOUT_MATRIX_16K.json.
+
+    python tests/perf/probe_layout_matrix.py
+"""
+import json
+import os
+import sys
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import numpy as np, jax, jax.numpy as jnp
+from sweep_sparse_vs_dense import timed_scan
+from deepspeed_tpu.ops.transformer import flash_attention as fa
+from deepspeed_tpu.ops.sparse_attention import make_block_sparse_attention
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    VariableSparsityConfig, BSLongformerSparsityConfig)
+HEADS, DHEAD, BATCH, seq, block = 16, 64, 2, 16384, 128
+rng = np.random.RandomState(0)
+x = jnp.asarray(rng.randn(BATCH, seq, HEADS, DHEAD)*0.1, jnp.bfloat16)
+
+rows = []
+
+
+def emit(row):
+    rows.append(row)
+    print(json.dumps(row), flush=True)
+
+
+def dense_step(t):
+    g = jax.grad(lambda q: fa.flash_attention_bshd(q, q, q)
+                 .astype(jnp.float32).sum())(t)
+    return g.astype(t.dtype)
+
+
+dense_ms = round(timed_scan(dense_step, x), 2)
+emit({"layout": "dense flash", "ms": dense_ms})
+
+cases = [
+    ("variable", VariableSparsityConfig(
+        num_heads=HEADS, block=block, num_random_blocks=0,
+        local_window_blocks=[4], global_block_indices=[0],
+        attention="unidirectional")),
+    ("bslongformer", BSLongformerSparsityConfig(
+        num_heads=HEADS, block=block, num_sliding_window_blocks=3,
+        global_block_indices=[0])),
+]
+for name, cfg in cases:
+    lay = np.asarray(cfg.make_layout(seq))
+    attn = make_block_sparse_attention(lay, block, causal=(name != "bslongformer"))
+    def step(t, attn=attn):
+        def loss(q):
+            qh = q.transpose(0, 2, 1, 3)
+            return attn(qh, qh, qh, None, None).astype(jnp.float32).sum()
+        return jax.grad(loss)(t).astype(t.dtype)
+    try:
+        ms = round(timed_scan(step, x), 2)
+    except Exception as e:
+        ms = "failed: " + str(e)[:90]
+    row = {"layout": name, "density": round(float(lay.mean()), 4),
+           "ms": ms}
+    if isinstance(ms, float) and dense_ms:
+        row["vs_dense"] = round(ms / dense_ms, 2)
+    emit(row)
+
+out = {"config": {"batch": BATCH, "heads": HEADS, "d_head": DHEAD,
+                  "seq": seq, "block": block,
+                  "timing": "fwd+bwd (grad wrt q,k,v), scan-amortized, ms/layer, one v5e"},
+       "rows": rows}
+path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "LAYOUT_MATRIX_16K.json")
+with open(path, "w") as f:
+    json.dump(out, f, indent=2)

@@ -686,15 +686,61 @@ def _bwd_fused_packed(q, k, v, bias, o, do, lse, sm_scale, causal, block_q,
 
 def _bwd_packed(q, k, v, bias, o, do, lse, sm_scale, causal, block_q,
                 block_k, interpret, num_heads):
-    """Packed backward dispatcher: the single-pass fused kernel where it
-    fits (hd <= 1280 — one walk of the block pairs, 5 dots each), the
-    split dq + dk/dv pair beyond. ``bias`` as in _fwd_packed."""
-    if _use_fused_bwd(q.shape[-1]):
+    """Packed backward dispatcher: the single-pass fused kernel where one
+    call fits (hd <= 1280 — one walk of the block pairs, 5 dots each);
+    per-HEAD-GROUP fused calls for wider models (attention is independent
+    per head, so the packed width slices cleanly); the split dq + dk/dv
+    pair only when fusion is disabled or a single head overflows the cap.
+    ``bias`` as in _fwd_packed."""
+    hd = q.shape[-1]
+    if _use_fused_bwd(hd):
         return _bwd_fused_packed(q, k, v, bias, o, do, lse, sm_scale,
                                  causal, block_q, block_k, interpret,
                                  num_heads)
+    if FUSED_BWD:
+        groups = _head_groups(num_heads, hd // num_heads)
+        if groups is not None and len(groups) > 1:
+            return _bwd_fused_grouped(q, k, v, bias, o, do, lse, sm_scale,
+                                      causal, block_q, block_k, interpret,
+                                      num_heads, groups)
     return _bwd_split_packed(q, k, v, bias, o, do, lse, sm_scale, causal,
                              block_q, block_k, interpret, num_heads)
+
+
+def _bwd_fused_grouped(q, k, v, bias, o, do, lse, sm_scale, causal,
+                       block_q, block_k, interpret, num_heads, groups):
+    """Fused backward for widths past the single-call cap: run the fused
+    kernel once per contiguous head group (independent math per head —
+    softmax, lse and delta never mix heads), then concatenate dq/dk/dv on
+    the packed minor dim. Each group is a standalone (b, s, group_width)
+    array, so the kernels see whole minor dims (no sub-lane blocking) and
+    keep the fat blocks of the narrow-width path. ``bias`` is per-KEY,
+    shared by every head, so it passes through unsliced."""
+    d = q.shape[-1] // num_heads
+    dqs, dks, dvs = [], [], []
+    for start, n in groups:
+        # The fused kernel's dq HBM read-modify-write DMA needs the minor
+        # dim 128-lane aligned; pad the group with zero FAKE heads up to
+        # alignment. Zero q/k/v/do make every fake-head term exactly zero
+        # (dv = p^T·0, ds = p·(0−0), dq/dk = 0·k / 0·q), so numerics are
+        # untouched — the cost is the fake heads' dots on zeros (~4% for
+        # gpt2-xl's 13-head group).
+        n_p = _padded_heads(n, d)
+        pad_w = (n_p - n) * d
+        cs = slice(start * d, (start + n) * d)
+        hs = slice(start, start + n)
+        padw = lambda t: jnp.pad(t[:, :, cs], ((0, 0), (0, 0), (0, pad_w)))
+        padh = lambda t: jnp.pad(t[:, :, hs],
+                                 ((0, 0), (0, 0), (0, n_p - n)))
+        dq_g, dk_g, dv_g = _bwd_fused_packed(
+            padw(q), padw(k), padw(v), bias, padw(o), padw(do),
+            padh(lse), sm_scale, causal, block_q, block_k, interpret, n_p)
+        gw = n * d
+        dqs.append(dq_g[:, :, :gw])
+        dks.append(dk_g[:, :, :gw])
+        dvs.append(dv_g[:, :, :gw])
+    cat = lambda ts: jnp.concatenate(ts, axis=-1)
+    return cat(dqs), cat(dks), cat(dvs)
 
 
 def _bwd_split_packed(q, k, v, bias, o, do, lse, sm_scale, causal, block_q,
@@ -785,29 +831,84 @@ DEFAULT_BLOCK_PACKED_K = 512
 
 # The single-pass FUSED backward (5 dots/pair vs the split kernels' 7)
 # carries a larger VMEM working set (k/v + dk/dv scratch + the dq RMW
-# buffer), so its width ceiling is lower: measured compile limit is
-# hd = 1280; gpt2-xl (1600) falls back to the split kernels.
-# DS_FLASH_FUSED_BWD=0 forces the split path everywhere.
+# buffer), so a single kernel call caps out at hd = 1280 (measured
+# compile limit). Wider models do NOT fall back to the split kernels:
+# attention is independent per head, so _bwd_packed slices the packed
+# width into head GROUPS of <= FUSED_GROUP_TARGET and runs the fused
+# kernel per group — gpt2-xl (25 heads x 64 = 1600) runs as two groups
+# (13 + 12 heads, widths 832/768) with the fat (256, 256) blocks the
+# <=1024 path earns. The group slices cost one extra HBM read+write of
+# q/k/v/do (~0.2 ms at the xl bench shape) against the 5-vs-7-dot win
+# over the whole block-pair walk. DS_FLASH_FUSED_BWD=0 forces the split
+# path everywhere.
 FUSED_BWD = os.environ.get("DS_FLASH_FUSED_BWD", "1") != "0"
 FUSED_BWD_MAX_WIDTH = 1280
+FUSED_GROUP_TARGET = 1024
 
 
 def _use_fused_bwd(hd):
     return FUSED_BWD and hd <= FUSED_BWD_MAX_WIDTH
 
 
-def auto_blocks(hd):
+def _padded_heads(n, d_head):
+    """Smallest head count >= n whose packed width is 128-lane aligned
+    (the fused kernel's dq DMA slices need it; the extra heads are zero
+    FAKE heads, see _bwd_fused_grouped)."""
+    n_p = n
+    while (n_p * d_head) % 128:
+        n_p += 1
+    return n_p
+
+
+def _head_groups(num_heads, d_head):
+    """Partition heads into the fewest contiguous groups whose packed
+    width — AFTER 128-lane alignment padding — fits the single-call
+    fused backward, balanced to within one head. Sizing on the unpadded
+    width would overshoot: e.g. 18 heads of d=112 split as 9+9 (1008
+    each) pads to 16 heads = 1792 > the 1280 cap. Returns
+    [(start_head, n_heads), ...], or None when no feasible grouping
+    exists (single padded head wider than the cap)."""
+    hd = num_heads * d_head
+    if hd <= FUSED_BWD_MAX_WIDTH:
+        return [(0, num_heads)]
+    if _padded_heads(1, d_head) * d_head > FUSED_BWD_MAX_WIDTH:
+        return None
+    for n_groups in range(-(-hd // FUSED_GROUP_TARGET), num_heads + 1):
+        base, rem = divmod(num_heads, n_groups)
+        sizes = [base + (1 if gi < rem else 0) for gi in range(n_groups)]
+        if max(_padded_heads(n, d_head) * d_head for n in sizes) \
+                <= FUSED_BWD_MAX_WIDTH:
+            groups, start = [], 0
+            for n in sizes:
+                groups.append((start, n))
+                start += n
+            return groups
+    return None
+
+
+def auto_blocks(hd, num_heads=None):
     """BACKWARD (block_q, block_k) for the packed kernels by activation
     width h*d, keyed to the path _bwd_packed will take. Fused (one walk
     computes dq/dk/dv): (256, 256) measures fastest to GPT-2-medium width
     (8.3 vs the split path's 9.6 ms at the bench shape), (128, 256) at
-    hd 1280. Split: the bwd kernels hold q/do (Bq, hd) and k/v (Bk, hd)
-    slabs double-buffered plus a (Bq or Bk, hd) fp32 scratch in the 16M
-    scoped-vmem budget; (256, 512) measures fastest up to GPT-2-medium
-    width but overflows by ~1M at gpt2-xl's hd=1600, so blocks shrink as
-    the width grows."""
+    hd 1280. Wider widths run the fused kernel per HEAD GROUP of width
+    <= FUSED_GROUP_TARGET, so they get the fat (256, 256) blocks of the
+    <=1024 case. Split fallback: the bwd kernels hold q/do (Bq, hd) and
+    k/v (Bk, hd) slabs double-buffered plus a (Bq or Bk, hd) fp32 scratch
+    in the 16M scoped-vmem budget; (256, 512) measures fastest up to
+    GPT-2-medium width but overflows by ~1M at gpt2-xl's hd=1600, so
+    split blocks shrink as the width grows."""
     if _use_fused_bwd(hd):
         return (256, 256) if hd <= 1024 else (128, 256)
+    if FUSED_BWD and num_heads is not None:
+        d_head = hd // num_heads
+        groups = _head_groups(num_heads, d_head)
+        if groups is not None:
+            # block choice keys on the PADDED width the kernel really
+            # runs at (e.g. 20 heads of d=80 split 10+10 is 800 wide on
+            # paper but pads to 1280, where (256, 256) overflows vmem)
+            gw = max(_padded_heads(n, d_head) for _, n in groups) * d_head
+            return (256, 256) if gw <= 1024 else (128, 256)
     if hd <= 1024:
         return DEFAULT_BLOCK_PACKED, DEFAULT_BLOCK_PACKED_K
     if hd <= 1280:
@@ -896,7 +997,7 @@ def flash_attention_bshd(q, k, v, sm_scale=None, causal=True,
     # budget auto_blocks exists to respect. Sweep the bwd with the
     # explicit bwd_block_* args (tests/perf/sweep_flash_bwd_blocks.py).
     fq, fk = auto_fwd_blocks(h * d)
-    bq_auto, bk_auto = auto_blocks(h * d)
+    bq_auto, bk_auto = auto_blocks(h * d, num_heads=h)
     bwd_block_q = bwd_block_q or bq_auto
     bwd_block_k = bwd_block_k or bk_auto
     block_q = block_q or fq
@@ -944,7 +1045,7 @@ def fused_ln_qkv_attention(x, ln_scale, ln_bias, qkv_w, qkv_b, num_heads,
     the bwd (its vmem budget is tighter — pass bwd_block_* to tune it)."""
     hd = x.shape[-1]
     fq, fk = auto_fwd_blocks(hd)
-    bq_auto, bk_auto = auto_blocks(hd)
+    bq_auto, bk_auto = auto_blocks(hd, num_heads=num_heads)
     bwd_block_q = bwd_block_q or bq_auto
     bwd_block_k = bwd_block_k or bk_auto
     return _fused_lnqkv_core(x, ln_scale, ln_bias, qkv_w, qkv_b, num_heads,

@@ -848,13 +848,20 @@ class DeepSpeedEngine:
                 # strand the engine with None pytrees: the host masters
                 # hold the authoritative values, so rebuild params from
                 # them (best effort — skip if even that allocation
-                # fails) so the run can still checkpoint or retry
+                # fails) so the run can still checkpoint or retry.
+                # The masters are now PARTIALLY stepped (some leaves ran
+                # Adam, some did not) and hs["step"] stayed incremented:
+                # record the torn step so a checkpoint taken after the
+                # re-raise carries the fact instead of silently looking
+                # whole (a resumed run should re-run the step's data).
+                hs["torn_step"] = hs["step"]
                 try:
                     self._restore_params_from_host(acc_specs,
                                                    acc_shardings, hs)
                 except Exception:  # noqa: BLE001
                     pass
                 raise
+            hs.pop("torn_step", None)
             self._finish_offload_step(flat_params, acc_specs,
                                       acc_shardings, hs)
         else:
@@ -927,9 +934,11 @@ class DeepSpeedEngine:
         del grad_layout
         # fresh zero accumulators, allocated ON DEVICE from the saved
         # specs (a host-side zeros + device_put would push the full
-        # fp32 gradient over the wire every step)
+        # fp32 gradient over the wire every step); the cache key carries
+        # the specs so a shape/sharding change across steps can never
+        # silently replay a stale-shaped closure
         zeros_fn = self._get_jit(
-            "acc_zeros",
+            "acc_zeros:%x" % (hash(tuple(acc_specs)) & 0xffffffff),
             lambda: (lambda: tuple(jnp.zeros(s, d)
                                    for s, d in acc_specs)),
             out_shardings=tuple(acc_shardings))
@@ -1347,12 +1356,24 @@ class DeepSpeedEngine:
             self.global_steps)
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
-                        save_latest=True):
+                        save_latest=True, async_save=False):
         """Save model+optimizer+scheduler+counters
-        (reference engine.py:1569-1685)."""
+        (reference engine.py:1569-1685).
+
+        Every file write is atomic (tmp + fsync + rename) and ``latest``
+        moves only after every shard file of the tag has landed — a crash
+        at any point leaves ``latest`` naming a complete checkpoint.
+        ``async_save``: pickle+write runs on a serial background thread
+        (device state is still gathered synchronously, so training may
+        continue mutating it); single-process only — multi-process saves
+        need the inter-file barrier and stay synchronous."""
         tag = self._get_ckpt_tag(tag)
         self._validate_tag(tag)
         client_state = client_state or {}
+        async_save = async_save and jax.process_count() == 1
+        # at most one save in flight: surface any prior async failure
+        # here rather than silently dropping it
+        self._drain_ckpt_writes()
 
         is_writer = jax.process_index() == 0
         # bf16/static-scale runs only fetch the overflow flag at print
@@ -1391,12 +1412,20 @@ class DeepSpeedEngine:
             "dp_world_size": self.dp_world_size,
             "mp_world_size": self.mp_world_size,
         }
+        if self.host_state is not None and "torn_step" in self.host_state:
+            # a failed overlapped offload step left the host masters
+            # PARTIALLY stepped (see _host_apply_step's disaster path);
+            # surface it so a resumed run knows the optimizer step was
+            # torn rather than trusting the checkpoint as whole
+            sd["torn_offload_step"] = self.host_state["torn_step"]
         sd.update(client_state)
 
+        futures = []
         if is_writer:
             path = ckpt.model_ckpt_name(save_dir, tag,
                                         mp_rank=0)
-            ckpt.save_state_dict(path, sd)
+            futures.append(ckpt.save_state_dict(path, sd,
+                                                async_save=async_save))
             logger.info("Saved checkpoint: {}".format(path))
         if offload_sharded:
             # EVERY process writes its own zero file with its host shards
@@ -1404,12 +1433,12 @@ class DeepSpeedEngine:
             # index so load re-slots them exactly
             zpath = ckpt.zero_ckpt_name(save_dir, tag,
                                         dp_rank=jax.process_index())
-            ckpt.save_state_dict(zpath, {
+            futures.append(ckpt.save_state_dict(zpath, {
                 "offload_shards": [
                     [(_shard_key(idx), p, m, v) for idx, p, m, v in shards]
                     for shards in self.host_state["shard_leaves"]],
                 "offload_step": self.host_state["step"],
-            })
+            }, async_save=async_save))
         elif zero_sharded:
             # EVERY process writes its addressable master/opt shards to its
             # own zero file; keys serialize the shard index so load
@@ -1418,19 +1447,48 @@ class DeepSpeedEngine:
             # gathered tree, keeping elastic resharding on load
             zpath = ckpt.zero_ckpt_name(save_dir, tag,
                                         dp_rank=jax.process_index())
-            ckpt.save_state_dict(zpath, {
+            futures.append(ckpt.save_state_dict(zpath, {
                 "device_shards": self._device_zero_shard_payload(is_writer),
-            })
+            }, async_save=async_save))
+        if jax.process_count() > 1:
+            # EVERY process's files must land before `latest` moves: a
+            # crash after the pointer update may otherwise leave `latest`
+            # naming a checkpoint whose zero shards never finished
+            # (reference barriers around checkpoint IO, engine.py:1610)
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(
+                "save_checkpoint_files:{}".format(tag))
         if is_writer and save_latest:
-            ckpt.save_latest(save_dir, tag)
+            # async ordering holds because the writer pool is serial: the
+            # latest update queues strictly after this process's writes
+            # (and multi-process saves are forced synchronous above)
+            futures.append(ckpt.save_latest(save_dir, tag,
+                                            async_save=async_save))
+        self._ckpt_futures = [f for f in futures if f is not None]
         if jax.process_count() > 1:
             # a process must not proceed to (and possibly load) a
-            # checkpoint other writers haven't finished (reference
-            # barriers around checkpoint IO, engine.py:1610)
+            # checkpoint other writers haven't finished
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices(
                 "save_checkpoint:{}".format(tag))
         return True
+
+    def _drain_ckpt_writes(self):
+        """Block on any in-flight async checkpoint writes (re-raising the
+        first background failure). Called before the next save, before a
+        load, and available to callers that need the files on disk NOW.
+        The list is cleared FIRST so one failed write raises once, not on
+        every subsequent save/load forever."""
+        futs = getattr(self, "_ckpt_futures", ())
+        self._ckpt_futures = []
+        first_err = None
+        for fut in futs:  # serial pool: results arrive in submit order
+            try:
+                fut.result()
+            except BaseException as err:  # noqa: BLE001
+                first_err = first_err or err
+        if first_err is not None:
+            raise first_err
 
     def _device_zero_shard_payload(self, is_writer):
         """This process's addressable master/opt shards (device-state ZeRO
@@ -1657,6 +1715,7 @@ class DeepSpeedEngine:
         fp32 shards (exact resume) vs recast from the fp16/bf16 params
         (reference stage2.py:1741-1763 toggle).
         """
+        self._drain_ckpt_writes()
         if tag is None:
             tag = ckpt.read_latest(load_dir)
             if tag is None:
@@ -1673,6 +1732,14 @@ class DeepSpeedEngine:
             return None, None
         sd = ckpt.load_state_dict(path)
         sd = self._adapt_state_dict(sd)
+
+        if sd.get("torn_offload_step") is not None:
+            logger.warning(
+                "Checkpoint {} was written after a FAILED overlapped "
+                "offload step (torn optimizer step {}): some master "
+                "shards stepped, some did not. Resume is usable but the "
+                "step's batch should be re-run; loss may blip.".format(
+                    path, sd["torn_offload_step"]))
 
         if self.host_state is None and sd.get("optimizer") is None:
             # ZeRO-sharded checkpoint: reassemble gathered trees from the

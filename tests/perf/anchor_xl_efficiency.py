@@ -1,0 +1,171 @@
+"""Executed-flop efficiency at gpt2-xl WIDTH (d_model 1600), real chip.
+
+The v5p-64 north-star projection (analyze_v5p64.py) needs an efficiency
+anchor measured at the 1.5B model's real width — round 3 anchored it at
+the bench width (1024), where the fused flash backward applied but the
+xl model then fell back to the split kernels (VERDICT r3 weak #1; the
+grouped-fused backward now covers 1600 too, see flash_attention.py).
+A full 1.5B step cannot run un-offloaded in 16 GB, but its per-token
+compute is width-shaped, not depth-shaped: this measures truncated
+gpt2-xl-width stacks on the real chip and splits the efficiency into
+
+  - eff_layers: per-LAYER rate from a least-squares fit of stack-grad
+    time over several depths (remat, fused LN+QKV flash attention —
+    executed flops = 8/6 x model flops). The fit separates the
+    depth-independent intercept (embedding gather + its scatter-add
+    backward, final LN, loss readout — ~40% of a 2-layer measurement)
+    from the slope the 48-layer projection actually scales with, and
+  - eff_head: the chunked LM-head/CE add-on (lm_loss minus the stack).
+
+    python tests/perf/anchor_xl_efficiency.py [--mb 8] [--layers 1 2 4 8]
+
+Writes tests/perf/XL_WIDTH_ANCHOR.json (read by analyze_v5p64.py).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+SEQ = 1024
+V5E_PEAK = 197e12
+REMAT_FACTOR = 8.0 / 6.0
+
+
+def _force(out):
+    import jax
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    return float(leaf.ravel()[0])
+
+
+def timed_grad(loss_fn, params, ids, reps=8, outer=3):
+    """Per-step ms for jax.grad(loss_fn), with the reps INSIDE one jit
+    call (chained through a param update) so the ~110 ms axon-tunnel
+    dispatch latency is amortized away."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    grad = jax.grad(loss_fn)
+
+    @jax.jit
+    def loop(p, ids):
+        def body(_, p):
+            g = grad(p, ids)
+            return jax.tree_util.tree_map(
+                lambda x, gx: x + jnp.asarray(1e-6, x.dtype)
+                * gx.astype(x.dtype), p, g)
+        return lax.fori_loop(0, reps, body, p)
+
+    _force(loop(params, ids))
+    best = None
+    for _ in range(outer):
+        t0 = time.time()
+        _force(loop(params, ids))
+        dt = (time.time() - t0) * 1e3 / reps
+        best = dt if best is None else min(best, dt)
+    return round(best, 2)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mb", type=int, default=8)
+    parser.add_argument("--layers", type=int, nargs="+",
+                        default=[1, 2, 4, 8])
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models import gpt2
+
+    rng = np.random.RandomState(0)
+    tokens = args.mb * SEQ
+    depths, stack_ms, head_ms = [], [], []
+    d = h = V = None
+    for L in args.layers:
+        cfg = gpt2.config_for("gpt2_xl", n_layers=L, max_seq_len=SEQ,
+                              remat=True, loss_chunk=128)
+        d, h, V = cfg.d_model, cfg.n_heads, cfg.vocab_size
+        params = jax.tree_util.tree_map(
+            lambda p: jnp.asarray(p, jnp.bfloat16),
+            gpt2.init_params(cfg, 0))
+        ids = jnp.asarray(rng.randint(0, V, size=(args.mb, SEQ)),
+                          jnp.int32)
+
+        def full_loss(p, ids, cfg=cfg):
+            return gpt2.lm_loss(p, ids, ids, cfg, rng=None, train=False)
+
+        def stack_loss(p, ids, cfg=cfg):
+            hid = gpt2.forward_hidden(p, ids, cfg, rng=None, train=False)
+            return hid.astype(jnp.float32).mean()
+
+        t_stack = timed_grad(stack_loss, params, ids)
+        t_full = timed_grad(full_loss, params, ids)
+        depths.append(L)
+        stack_ms.append(t_stack)
+        head_ms.append(max(t_full - t_stack, 1e-3))
+        print(f"L={L}: stack={t_stack} full={t_full}", flush=True)
+
+    # least-squares t_stack = intercept + slope * L
+    Ls = np.asarray(depths, float)
+    ts = np.asarray(stack_ms, float)
+    slope = float(((Ls - Ls.mean()) * (ts - ts.mean())).sum()
+                  / ((Ls - Ls.mean()) ** 2).sum())
+    intercept = float(ts.mean() - slope * Ls.mean())
+    t_head = float(np.median(head_ms))
+
+    # per-token model flops, split the way the projection composes them:
+    # per layer = 6 x block params + attention score/context dots;
+    # head     = the tied (d, V) matmul fwd+bwd (gather-side embedding is
+    # free). Executed flops: layers x 8/6 (full per-block remat re-runs
+    # each forward); the chunked head/CE is not under remat (1x).
+    p_block = 12 * d * d + 13 * d            # qkv/proj/mlp + ln/bias
+    flops_layer_tok = 6.0 * p_block + 12.0 * d * SEQ
+    flops_head_tok = 6.0 * d * V
+    exec_layer = flops_layer_tok * tokens * REMAT_FACTOR
+    exec_head = flops_head_tok * tokens
+
+    eff_layers = exec_layer / (slope * 1e-3 * V5E_PEAK)
+    eff_head = exec_head / (t_head * 1e-3 * V5E_PEAK)
+
+    out = {
+        "config": {"d_model": d, "n_heads": h, "depths": depths,
+                   "seq": SEQ, "micro_batch": args.mb,
+                   "device": jax.devices()[0].device_kind,
+                   "remat": True, "fused_bwd": "grouped (2 head groups)"},
+        "measured_ms": {"stack_grad_by_depth": stack_ms,
+                        "head_ce_by_depth": [round(x, 2) for x in head_ms],
+                        "ms_per_layer_fit": round(slope, 2),
+                        "overhead_ms_fit": round(intercept, 2),
+                        "head_ce_median": round(t_head, 2)},
+        "model_flops_per_token": {
+            "per_layer": round(flops_layer_tok / 1e6, 1),
+            "head": round(flops_head_tok / 1e6, 1), "unit": "MFLOP"},
+        "executed_flop_efficiency": {
+            "layers_width1600": round(eff_layers, 4),
+            "head_width1600": round(min(eff_head, 1.0), 4)},
+        "overhead_ms_per_microstep": round(intercept, 2),
+        "notes": [
+            "executed flops = model x 8/6 for the remat'd block stack, "
+            "1x for the chunked head/CE",
+            "slope/intercept from a least-squares fit over depths: the "
+            "intercept is the depth-independent cost (embedding gather + "
+            "scatter-add backward, final LN, loss readout) a "
+            "shallow-stack measurement would wrongly fold into the "
+            "per-layer rate",
+            "timing loops reps inside one jit call to cancel the axon "
+            "tunnel's ~110 ms dispatch latency",
+        ],
+    }
+    path = os.path.join(os.path.dirname(__file__), "XL_WIDTH_ANCHOR.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

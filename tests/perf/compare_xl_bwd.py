@@ -1,0 +1,104 @@
+"""Flash backward at gpt2-xl width (h*d = 1600): grouped-fused vs split.
+
+The single-pass fused backward caps at hd = 1280 per call; past that
+_bwd_packed runs it per head group (25 heads -> 13 + 12, widths 832/768).
+This times the full grad path (flash_attention_bshd grad wrt q/k/v) under
+both policies on the real chip, at a 1-2-layer-sized batch that fits HBM.
+
+    python tests/perf/compare_xl_bwd.py [--b 8]
+
+Emits JSON {grouped_fused_grad_ms, split_grad_ms, speedup, ...}.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def _force(x):
+    import jax
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    return float(leaf.ravel()[0])
+
+
+def timed_inner(step, q, k, v, reps=10, outer=3):
+    """Amortize the ~110 ms axon-tunnel dispatch latency: run ``step``
+    ``reps`` times INSIDE one jit call, chained through a data dependency,
+    and report per-rep wall time."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    @jax.jit
+    def loop(q, k, v):
+        def body(_, carry):
+            q, k, v = carry
+            dq, dk, dv = step(q, k, v)
+            eps = jnp.bfloat16(1e-6)
+            return (q + eps * dq.astype(q.dtype),
+                    k + eps * dk.astype(k.dtype),
+                    v + eps * dv.astype(v.dtype))
+        return lax.fori_loop(0, reps, body, (q, k, v))
+
+    _force(loop(q, k, v))
+    best = None
+    for _ in range(outer):
+        t0 = time.time()
+        _force(loop(q, k, v))
+        dt = (time.time() - t0) * 1e3 / reps
+        best = dt if best is None else min(best, dt)
+    return round(best, 2)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--b", type=int, default=8)
+    parser.add_argument("--s", type=int, default=1024)
+    parser.add_argument("--h", type=int, default=25)
+    parser.add_argument("--d", type=int, default=64)
+    args = parser.parse_args()
+    b, s, h, d = args.b, args.s, args.h, args.d
+
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.transformer import flash_attention as fa
+
+    rng = np.random.RandomState(0)
+    mk = lambda: jnp.asarray(rng.randn(b, s, h, d) * 0.1, jnp.bfloat16)
+    q, k, v = mk(), mk(), mk()
+    rows = {"shape": {"b": b, "s": s, "h": h, "d": d, "hd": h * d},
+            "device": jax.devices()[0].device_kind}
+
+    def loss(q, k, v):
+        return fa.flash_attention_bshd(q, k, v).astype(jnp.float32).sum()
+
+    grad = jax.grad(loss, argnums=(0, 1, 2))
+
+    # grouped fused (the default dispatch at hd > 1280)
+    assert fa.FUSED_BWD
+    groups = fa._head_groups(h, d)
+    rows["groups"] = groups
+    rows["grouped_auto_blocks"] = fa.auto_blocks(h * d, num_heads=h)
+    rows["grouped_fused_grad_ms"] = timed_inner(grad, q, k, v)
+
+    # split fallback (DS_FLASH_FUSED_BWD=0 policy), same auto blocks as
+    # the pre-grouping dispatch used at this width
+    fa.FUSED_BWD = False
+    try:
+        rows["split_auto_blocks"] = fa.auto_blocks(h * d, num_heads=h)
+        rows["split_grad_ms"] = timed_inner(grad, q, k, v)
+    finally:
+        fa.FUSED_BWD = True
+
+    rows["speedup_grad"] = round(
+        rows["split_grad_ms"] / rows["grouped_fused_grad_ms"], 3)
+    print(json.dumps(rows))
+
+
+if __name__ == "__main__":
+    main()

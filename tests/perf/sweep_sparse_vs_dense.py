@@ -80,6 +80,13 @@ def main():
         "sparse": "fixed, block 128, 4 local blocks + 1 global, "
                   "unidirectional",
         "timing": "fwd+bwd (grad wrt q,k,v), scan-amortized, ms/layer",
+        "bigbird_note": "bigbird (a bidirectional-class layout in the "
+                        "reference) is run with causal=True: its "
+                        "above-diagonal active blocks are fetched and "
+                        "computed but fully masked, so the row is "
+                        "COST-faithful for the layout while the math is "
+                        "causal, and its reported density overstates "
+                        "useful (unmasked) work",
     }, "rows": []}
 
     for seq in (2048, 4096, 8192, 16384, 32768):

@@ -216,3 +216,71 @@ def test_save_without_scheduler_load_with_none(tmp_path):
     e2.load_checkpoint(save_dir, load_optimizer_states=False,
                        load_lr_scheduler_states=False)
     assert e2.global_steps == 2
+
+
+def test_atomic_save_crash_leaves_latest_consistent(tmp_path, monkeypatch):
+    """Crash-injection (VERDICT r3 #7): kill the writer partway through a
+    later save — after bytes hit the temp file but before the rename —
+    and `latest` must still name the LAST COMPLETE checkpoint, with no
+    truncated .pt file visible at any checkpoint path."""
+    from deepspeed_tpu.runtime import checkpointing as ckpt
+    config = dict(base_config(WORLD))
+    config["zero_optimization"] = {"stage": 2}
+    config["bf16"] = {"enabled": True}
+    dataset = SimpleDataset(64, HIDDEN)
+    save_dir = str(tmp_path / "ckpt")
+
+    engine = make_engine(config)
+    run_steps(engine, dataset, 1)
+    engine.save_checkpoint(save_dir, tag="good")
+    assert ckpt.read_latest(save_dir) == "good"
+
+    real_dump = ckpt.pickle.dump
+    calls = {"n": 0}
+
+    def dying_dump(obj, f, protocol=None):
+        real_dump(obj, f, protocol=protocol)  # bytes land in the tmp file
+        calls["n"] += 1
+        raise RuntimeError("injected crash mid-save")
+
+    monkeypatch.setattr(ckpt.pickle, "dump", dying_dump)
+    run_steps(engine, dataset, 1, offset=1)
+    with pytest.raises(RuntimeError, match="injected crash"):
+        engine.save_checkpoint(save_dir, tag="torn")
+    monkeypatch.setattr(ckpt.pickle, "dump", real_dump)
+    assert calls["n"] == 1
+
+    # latest still names the complete checkpoint; the torn tag has no
+    # visible .pt files (only a .tmp remnant at most)
+    assert ckpt.read_latest(save_dir) == "good"
+    torn_dir = os.path.join(save_dir, "torn")
+    if os.path.isdir(torn_dir):
+        assert not [p for p in os.listdir(torn_dir)
+                    if p.endswith(".pt")], os.listdir(torn_dir)
+
+    # and the checkpoint latest names actually loads
+    e2 = make_engine(config, seed=7)
+    path, _ = e2.load_checkpoint(save_dir)
+    assert path is not None and "good" in path
+
+
+def test_async_save_round_trips(tmp_path):
+    """async_save=True: writes land on the background thread, drain on
+    the next load, and resume exactly like a synchronous save."""
+    config = dict(base_config(WORLD))
+    dataset = SimpleDataset(64, HIDDEN)
+    save_dir = str(tmp_path / "ckpt")
+
+    e1 = make_engine(config)
+    run_steps(e1, dataset, 2)
+    e1.save_checkpoint(save_dir, tag="async", async_save=True)
+    assert e1._ckpt_futures, "async save should leave in-flight futures"
+    trained_more = run_steps(e1, dataset, 2, offset=2)
+    e1._drain_ckpt_writes()
+
+    e2 = make_engine(config, seed=3)
+    path, _ = e2.load_checkpoint(save_dir)
+    assert path is not None
+    resumed = run_steps(e2, dataset, 2, offset=2)
+    np.testing.assert_allclose(np.array(resumed), np.array(trained_more),
+                               rtol=2e-4, atol=1e-5)

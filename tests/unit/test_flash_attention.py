@@ -303,16 +303,41 @@ def test_fused_attn_under_remat_matches():
 def test_auto_blocks_by_width():
     """Width-aware block defaults, keyed to the backward path taken: the
     fused single-pass kernel (hd <= 1280) wants (256, 256)-class blocks;
-    past its vmem ceiling the split kernels keep their measured sizes."""
+    wider widths run fused per head group (width <= 1024 -> fat blocks);
+    the split fallback keeps its measured sizes."""
     from deepspeed_tpu.ops.transformer import flash_attention as fa
     assert fa._use_fused_bwd(1024) and fa._use_fused_bwd(1280)
     assert not fa._use_fused_bwd(1600)
     assert fa.auto_blocks(768) == (256, 256)
     assert fa.auto_blocks(1024) == (256, 256)
     assert fa.auto_blocks(1280) == (128, 256)
-    assert fa.auto_blocks(1600) == (128, 256)   # split fallback
+    assert fa.auto_blocks(1600) == (128, 256)   # no head info: split fallback
+    # gpt2-xl: 25 heads x 64 -> two fused groups (13+12, widths 832/768)
+    assert fa.auto_blocks(1600, num_heads=25) == (256, 256)
     assert fa.auto_fwd_blocks(1024) == (256, 512)
     assert fa.auto_fwd_blocks(1600) == (256, 256)
+
+
+def test_head_groups_partition():
+    """Grouping covers all heads contiguously, balanced to one head, and
+    every group's packed width fits the single-call fused cap."""
+    from deepspeed_tpu.ops.transformer import flash_attention as fa
+    for h, d in [(16, 64), (25, 64), (20, 80), (32, 128), (12, 64),
+                 (40, 64), (1, 64), (18, 112)]:
+        groups = fa._head_groups(h, d)
+        assert groups is not None
+        assert sum(n for _, n in groups) == h
+        assert groups[0][0] == 0
+        for (s0, n0), (s1, _) in zip(groups, groups[1:]):
+            assert s1 == s0 + n0
+        sizes = [n for _, n in groups]
+        assert max(sizes) - min(sizes) <= 1
+        # the cap must hold for the width the kernel RUNS at (after
+        # 128-lane alignment padding), not the on-paper group width
+        assert max(fa._padded_heads(n, d) for n in sizes) * d \
+            <= fa.FUSED_BWD_MAX_WIDTH
+    # a single head wider than the cap cannot be grouped
+    assert fa._head_groups(1, 2048) is None
 
 
 @pytest.mark.parametrize("causal", [True, False])
@@ -341,9 +366,34 @@ def test_fused_bwd_matches_split(causal):
 
 
 def test_bwd_packed_dispatches_fused():
-    """_bwd_packed routes narrow widths to the fused kernel and wide ones
-    to the split pair (gpt2-xl class)."""
+    """_bwd_packed routes narrow widths to the single fused call; wide
+    ones (gpt2-xl class) go fused-per-head-group, not split."""
     from deepspeed_tpu.ops.transformer import flash_attention as fa
     assert fa.FUSED_BWD, "fused backward should be the default"
     assert fa._use_fused_bwd(16 * 64)
     assert not fa._use_fused_bwd(25 * 64)
+    assert len(fa._head_groups(25, 64)) == 2
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_grouped_fused_bwd_matches_split(causal):
+    """gpt2-xl-width backward (25 heads x 64 = 1600 > single-call cap):
+    the per-head-group fused path is numerically identical to the split
+    kernels, including the ragged q tail."""
+    from deepspeed_tpu.ops.transformer import flash_attention as fa
+    rng = np.random.RandomState(3)
+    b, s, h, d = 1, 160, 25, 64
+    hd = h * d
+    mk = lambda: jnp.asarray(rng.randn(b, s, hd) * 0.2, jnp.float32)
+    q, k, v, do = mk(), mk(), mk(), mk()
+    bias = jnp.zeros((b, 1, 256), jnp.float32)
+    scale = 1.0 / d ** 0.5
+    out, lse = fa._fwd_packed(q, k, v, bias, scale, causal, 128, 128,
+                              True, h)
+    ref = fa._bwd_split_packed(q, k, v, bias, out, do, lse, scale, causal,
+                               128, 128, True, h)
+    got = fa._bwd_packed(q, k, v, bias, out, do, lse, scale, causal,
+                         128, 128, True, h)
+    for name, a, g in zip(("dq", "dk", "dv"), ref, got):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(g),
+                                   atol=2e-4, rtol=2e-4, err_msg=name)

@@ -165,6 +165,25 @@ def save_state_dict(path, state_dict, async_save=False):
     return None
 
 
+def save_latest_after(save_dir, tag, shard_futures):
+    """Queue an async ``latest`` update that runs ONLY if every earlier
+    queued shard write succeeded. The writer pool is serial, so by the
+    time this task runs the shard futures are resolved; a failed one
+    means ``latest`` must keep naming the previous complete checkpoint."""
+    shard_futures = tuple(f for f in shard_futures if f is not None)
+
+    def _update():
+        for fut in shard_futures:
+            err = fut.exception()
+            if err is not None:
+                raise RuntimeError(
+                    "latest pointer NOT updated: an earlier checkpoint "
+                    "shard write failed") from err
+        save_latest(save_dir, tag)
+
+    return _write_pool().submit(_update)
+
+
 def load_state_dict(path):
     with open(path, "rb") as f:
         return pickle.load(f)

@@ -1438,6 +1438,11 @@ class DeepSpeedEngine:
                     [(_shard_key(idx), p, m, v) for idx, p, m, v in shards]
                     for shards in self.host_state["shard_leaves"]],
                 "offload_step": self.host_state["step"],
+                # a torn step is RANK-LOCAL (one process's update loop
+                # failed); persist it in this rank's own zero file so a
+                # multi-process resume sees it even when the writer rank
+                # was healthy
+                "torn_step": self.host_state.get("torn_step"),
             }, async_save=async_save))
         elif zero_sharded:
             # EVERY process writes its addressable master/opt shards to its
@@ -1459,11 +1464,15 @@ class DeepSpeedEngine:
             multihost_utils.sync_global_devices(
                 "save_checkpoint_files:{}".format(tag))
         if is_writer and save_latest:
-            # async ordering holds because the writer pool is serial: the
-            # latest update queues strictly after this process's writes
-            # (and multi-process saves are forced synchronous above)
-            futures.append(ckpt.save_latest(save_dir, tag,
-                                            async_save=async_save))
+            if async_save:
+                # the serial pool guarantees the latest task runs after
+                # this process's shard writes; save_latest_after also
+                # REFUSES the update if any of them failed, so `latest`
+                # can never name a tag with a missing shard
+                futures.append(ckpt.save_latest_after(
+                    save_dir, tag, futures))
+            else:
+                ckpt.save_latest(save_dir, tag)
         self._ckpt_futures = [f for f in futures if f is not None]
         if jax.process_count() > 1:
             # a process must not proceed to (and possibly load) a
@@ -1608,6 +1617,13 @@ class DeepSpeedEngine:
         zsd = None
         if os.path.isfile(zpath):
             zsd = ckpt.load_state_dict(zpath)
+        if zsd is not None and zsd.get("torn_step") is not None:
+            logger.warning(
+                "Zero shard file {} records a TORN offload step ({}): "
+                "this rank's masters were partially stepped when the "
+                "checkpoint was written. Resume is usable but re-run the "
+                "step's batch; loss may blip.".format(
+                    zpath, zsd["torn_step"]))
         if zsd is not None and "device_shards" in zsd:
             # device-state ZeRO checkpoint loaded into an OFFLOAD engine:
             # reassemble the gathered trees from every process's shard

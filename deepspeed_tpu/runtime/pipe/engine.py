@@ -78,6 +78,27 @@ class PipelineEngine(DeepSpeedEngine):
             wrapped.profile_spec_fn = model.profile_spec_fn
         kwargs.setdefault("mpu", grid)
         super().__init__(args=args, model=wrapped, **kwargs)
+        # Certified-combination guard (docs/_tutorials/parallelism.md).
+        # ZeRO >= 2 re-lays gradients/params out on the data axis; under
+        # PP x TP those GSPMD resharding collectives interleave with the
+        # pipe loop's ppermutes in rank-divergent order and the program
+        # DEADLOCKS at runtime (measured: collective-permute rendezvous
+        # 4/8, XLA:CPU and TPU alike) — reject at build time instead.
+        # Reference analogue: deepspeed/runtime/pipe/engine.py:57-58,
+        # engine.py:148-150 reject elasticity/ZeRO>1 with pipelines.
+        if self.zero_optimization_stage() >= 2 and self.mp_world_size > 1:
+            raise PipelineError(
+                "ZeRO stage {} with pipeline + tensor parallelism is not "
+                "a certified combination (the stage>=2 data-axis "
+                "resharding deadlocks against the pipe loop's collectives "
+                "under one-program SPMD). Use ZeRO stage 1 with PP x TP, "
+                "or drop tensor parallelism for ZeRO stage 2/3 under PP. "
+                "See docs/_tutorials/parallelism.md for the support "
+                "matrix.".format(self.zero_optimization_stage()))
+        if self.elasticity_enabled():
+            raise PipelineError(
+                "Elasticity is not supported with pipeline parallelism "
+                "(reference restriction, pipe/engine.py:57-58)")
         self.num_stages = model.num_stages
         self.micro_batches = self.gradient_accumulation_steps()
         log_dist("PipelineEngine: stages={} micro_batches={} mesh={}".format(

@@ -261,3 +261,47 @@ def test_pipeline_with_cpu_offload():
         losses.append(float(engine.train_batch(batch=(x, y))))
     assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
     assert engine.host_state["step"] == 40
+
+
+def test_uncertified_combos_rejected():
+    """The support-matrix guard (docs/_tutorials/parallelism.md): ZeRO
+    stage >= 2 with PP x TP deadlocks at runtime under one-program SPMD,
+    so PipelineEngine must reject it at construction — loudly, with a
+    pointer to the matrix."""
+    import deepspeed_tpu as deepspeed
+    from deepspeed_tpu.models import gpt2, gpt2_pipe
+    from deepspeed_tpu.runtime.pipe.engine import PipelineError
+
+    cfg = gpt2.GPT2Config(vocab_size=512, max_seq_len=64, n_layers=2,
+                          n_heads=4, d_model=64, use_flash_attention=False,
+                          remat=False)
+    for stage in (2, 3):
+        net = gpt2_pipe.make_gpt2_pipeline(
+            config=cfg, num_stages=2, num_dp=2, num_mp=2,
+            activation_checkpoint_interval=0)
+        with pytest.raises(PipelineError, match="not .*certified"):
+            deepspeed.initialize(model=net, config_params={
+                "train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 2,
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": stage},
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+                "steps_per_print": 10 ** 9})
+
+    # elasticity x PP: reference restriction, rejected the same way
+    net = gpt2_pipe.make_gpt2_pipeline(
+        config=cfg, num_stages=2, num_dp=4, num_mp=1,
+        activation_checkpoint_interval=0)
+    with pytest.raises(PipelineError, match="[Ee]lasticity"):
+        deepspeed.initialize(model=net, config_params={
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2,
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 1},
+            "elasticity": {"enabled": True, "max_train_batch_size": 64,
+                           "ignore_non_elastic_batch_info": True,
+                           "micro_batch_sizes": [2],
+                           "min_gpus": 1, "max_gpus": 8,
+                           "min_time": 20, "version": 0.1},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+            "steps_per_print": 10 ** 9})

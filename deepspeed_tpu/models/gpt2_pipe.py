@@ -97,12 +97,13 @@ def lm_loss_fn(logits, labels):
 
 def make_gpt2_pipeline(config=None, size="gpt2_small", num_stages=2,
                        num_dp=None, num_mp=None, topology=None,
-                       activation_checkpoint_interval=1, **overrides):
+                       activation_checkpoint_interval=1,
+                       num_virtual_stages=1, **overrides):
     if config is None:
         config = config_for(size, **overrides)
-    assert config.n_layers >= num_stages, \
-        "num_stages ({}) exceeds n_layers ({})".format(num_stages,
-                                                       config.n_layers)
+    assert config.n_layers >= num_stages * num_virtual_stages, \
+        "num_stages*num_virtual_stages ({}) exceeds n_layers ({})".format(
+            num_stages * num_virtual_stages, config.n_layers)
     # n_layers need not divide num_stages: PipelineModule partitions
     # raggedly (stage depths differ by at most one for uniform weights)
     # and pads each stage's stack to the deepest one
@@ -118,7 +119,8 @@ def make_gpt2_pipeline(config=None, size="gpt2_small", num_stages=2,
     net = PipelineModule(
         layers=layers, num_stages=num_stages, topology=topology,
         loss_fn=lm_loss_fn, num_dp=num_dp, num_mp=num_mp,
-        activation_checkpoint_interval=activation_checkpoint_interval)
+        activation_checkpoint_interval=activation_checkpoint_interval,
+        num_virtual_stages=num_virtual_stages)
     net.config = config
     # the pipeline runs the SAME arithmetic as the dense model, so the
     # per-module flops table reuses gpt2.profile_spec (PipelineEngine

@@ -3,8 +3,9 @@
 Reference parity: deepspeed/runtime/pipe/engine.py (PipelineEngine :45,
 train_batch :244, instruction interpreter :1135). The torch reference runs a
 per-process instruction loop with explicit sends; here the 1F1B schedule is
-compiled into dense cycle->microbatch tables
-(schedule.uniform_train_schedule_tables) that drive ONE ``lax.fori_loop``
+compiled into dense cycle->microbatch(+chunk) tables
+(schedule.interleaved_train_schedule_tables) that drive three phase-split
+``lax.fori_loop``s (warmup fwd-only / steady fwd+bwd / drain bwd-only)
 inside ``shard_map`` over the ``pipe`` mesh axis:
 
   * each pipe rank holds its stage's stacked block params (leading stage dim
@@ -43,7 +44,7 @@ from ..engine import DeepSpeedEngine
 from ..model import Model
 from . import p2p
 from .module import PipelineModule
-from .schedule import uniform_train_schedule_tables
+from .schedule import interleaved_train_schedule_tables
 
 
 class PipelineError(Exception):
@@ -176,28 +177,61 @@ class PipelineEngine(DeepSpeedEngine):
         return (other, cast_all, embed_of, head_loss,
                 body_spec, other_spec, batch_spec, labels_spec)
 
+    def _pipe_tables(self):
+        """Schedule tables + phase boundaries for this engine's (M, S, v)."""
+        module = self.pipe_module
+        v = getattr(module, "num_virtual", 1)
+        tabs = interleaved_train_schedule_tables(self.micro_batches,
+                                                 self.num_stages, v)
+        return v, tabs
+
+    def _depths_2d(self):
+        """(S, v) int32 real-depth table (module keeps (S,) at v=1)."""
+        module = self.pipe_module
+        d = np.asarray(module.stage_depths, np.int32)
+        if d.ndim == 1:
+            d = d[:, None]
+        return d
+
+    @staticmethod
+    def _chunked(local_body, v_from_module):
+        """Normalize this rank's body params to a leading chunk dim:
+        (L, ...) -> (1, L, ...) at v=1; already (v, Lc, ...) otherwise."""
+        if v_from_module == 1:
+            return jax.tree_util.tree_map(lambda t: t[None], local_body)
+        return local_body
+
     def _pipeline_eval_fn(self):
         """Forward-only fill/drain loop for eval_batch (reference
-        InferenceSchedule, schedule.py:129-179): M + S - 1 steps, the
-        embedding streams in at the first stage's step and the head + loss
-        run at the last stage's step — nothing M-sized is materialized, so
-        eval keeps the pipeline's memory partitioning. Dropout is off (no
-        rng reaches the stage bodies)."""
+        InferenceSchedule, schedule.py:129-179): the embedding streams in
+        at the first virtual stage's cycles and the head + loss run at the
+        last virtual stage's — nothing M-sized is materialized, so eval
+        keeps the pipeline's memory partitioning. Interleaved models walk
+        the same forward tables as training (chunk hops wrap S-1 -> 0).
+        Dropout is off (no rng reaches the stage bodies)."""
         module = self.pipe_module
         num_stages = self.num_stages
         M = self.micro_batches
         mesh = self.mesh
-        stage_depths = jnp.asarray(module.stage_depths, jnp.int32)
+        v, tabs = self._pipe_tables()
+        fwd_m = jnp.asarray(tabs["fwd_m"])
+        fwd_c = jnp.asarray(tabs["fwd_c"])
+        SE = tabs["steady_end"]
+        depths_2d = jnp.asarray(self._depths_2d())
 
         def eval_loss(params, inputs_stack, labels_stack):
             (other, cast_all, embed_of, head_loss, body_spec, other_spec,
              batch_spec, labels_spec) = self._stage_closures(
                 params, inputs_stack, labels_stack)
 
-            def shard_fn(body_params, depths, other_params, inputs, labels):
-                local_body = jax.tree_util.tree_map(
-                    lambda t: t[0], body_params)
-                depth = depths[0]
+            def shard_fn(body_params, depths, fm_row, fc_row, other_params,
+                         inputs, labels):
+                local_body = self._chunked(
+                    jax.tree_util.tree_map(lambda t: t[0], body_params),
+                    v)
+                depths_row = depths[0]                      # (v,)
+                fm_row = fm_row[0]
+                fc_row = fc_row[0]
                 stage = jax.lax.axis_index(PIPE_AXIS)
                 is_first = stage == 0
                 is_last = stage == num_stages - 1
@@ -208,50 +242,72 @@ class PipelineEngine(DeepSpeedEngine):
                 zeros_x = jax.tree_util.tree_map(
                     lambda sd: jnp.zeros(sd.shape, sd.dtype), x_shape)
 
-                def body(t, carry):
+                def pick_chunk(c):
+                    return jax.tree_util.tree_map(
+                        lambda t: jax.lax.dynamic_index_in_dim(
+                            t, c, axis=0, keepdims=False), local_body)
+
+                def body(k, carry):
                     recv, loss_sum = carry
-                    m = t - stage
-                    m_c = jnp.clip(m, 0, M - 1)
-                    valid = jnp.logical_and(m >= 0, m < M)
+                    m_f = fm_row[k]
+                    c_f = fc_row[k]
+                    valid = m_f >= 0
+                    mf = jnp.clip(m_f, 0, M - 1)
+                    cf = jnp.clip(c_f, 0, v - 1)
                     x = jax.lax.cond(
-                        is_first,
-                        lambda: embed_of(params_all, inputs, m_c),
+                        jnp.logical_and(is_first, cf == 0),
+                        lambda: embed_of(params_all, inputs, mf),
                         lambda: recv)
-                    y = module.apply_body_stage(local_body, x, rng=None,
-                                                depth=depth)
+                    y = module.apply_body_stage(
+                        pick_chunk(cf), x, rng=None,
+                        depth=jax.lax.dynamic_index_in_dim(
+                            depths_row, cf, keepdims=False))
                     loss_m = jax.lax.cond(
-                        jnp.logical_and(is_last, valid),
-                        lambda: head_loss(params_all, y, labels, m_c),
+                        jnp.logical_and(
+                            jnp.logical_and(is_last, cf == v - 1), valid),
+                        lambda: head_loss(params_all, y, labels, mf),
                         lambda: jnp.float32(0.0))
-                    recv_next = p2p.send_forward(y, num_stages, PIPE_AXIS)
+                    send_f = (p2p.send_forward_wrap if v > 1
+                              else p2p.send_forward)
+                    recv_next = send_f(y, num_stages, PIPE_AXIS)
                     return (recv_next, loss_sum + loss_m)
 
                 _, loss_sum = jax.lax.fori_loop(
-                    0, M + num_stages - 1, body, (zeros_x, jnp.float32(0.0)))
+                    0, SE, body, (zeros_x, jnp.float32(0.0)))
                 # only the last stage accumulated anything; psum broadcasts
                 return jax.lax.psum(loss_sum, PIPE_AXIS) / M
 
             return jax.shard_map(
                 shard_fn, mesh=mesh,
-                in_specs=(body_spec, P(PIPE_AXIS), other_spec,
-                          batch_spec, labels_spec),
+                in_specs=(body_spec, P(PIPE_AXIS), P(PIPE_AXIS),
+                          P(PIPE_AXIS), other_spec, batch_spec,
+                          labels_spec),
                 out_specs=P(),
                 axis_names={PIPE_AXIS},
                 check_vma=False,
-            )(params["body"], stage_depths, other, inputs_stack, labels_stack)
+            )(params["body"], depths_2d, fwd_m, fwd_c, other,
+              inputs_stack, labels_stack)
 
         return eval_loss
 
     def _pipeline_train_fn(self):
-        """1F1B training executor driven by UniformTrainSchedule's tables.
+        """1F1B training executor driven by the interleaved schedule
+        tables (schedule.interleaved_train_schedule_tables).
 
-        One fori_loop of M + 2(S-1) cycles. Every cycle is structurally
-        IDENTICAL on every stage — a (maybe-masked) forward phase, then a
-        (maybe-masked) backward phase — because under one-program SPMD the
-        auto-partitioned collectives inside the stage body (TP all-reduces,
-        resharding permutes) must execute in the same order on every
-        device; stage-divergent lax.cond/switch around them deadlocks (see
-        UniformTrainSchedule). Per cycle this stage reads its schedule row:
+        THREE fori_loops — warmup (forward phases only), steady
+        (forward + backward), drain (backward only). Within a loop every
+        cycle is structurally IDENTICAL on every stage, because under
+        one-program SPMD the auto-partitioned collectives inside the
+        stage body (TP all-reduces, resharding permutes) must execute in
+        the same order on every device; stage-divergent lax.cond/switch
+        around them deadlocks. Uniformity does NOT bind across cycles,
+        so the warmup/drain cycles simply omit the dead phase — that is
+        where the executed bubble drops to the reference's (S-1)/M at
+        v=1 and to (S-1)/(vM) with v>1 virtual chunks per rank
+        (Megatron interleaving; each rank's body params carry a leading
+        chunk dim, selected per cycle from the chunk tables, and
+        activations/grads ppermute with wraparound S-1 <-> 0 at chunk
+        boundaries). Per cycle this stage reads its schedule row:
 
           ForwardPass m: x = embedding (stage 0) or the activation
             ppermuted in last cycle; run the stage body; save x in slot
@@ -279,38 +335,54 @@ class PipelineEngine(DeepSpeedEngine):
         num_stages = self.num_stages
         M = self.micro_batches
         mesh = self.mesh
-        stage_depths = jnp.asarray(module.stage_depths, jnp.int32)
+        v, tabs = self._pipe_tables()
+        depths_2d = jnp.asarray(self._depths_2d())
 
-        fwd_tab, bwd_tab = uniform_train_schedule_tables(M, num_stages)
-        T = fwd_tab.shape[1]
-        W = max(1, min(2 * num_stages - 1, M))
-        fwd_tab = jnp.asarray(fwd_tab)
-        bwd_tab = jnp.asarray(bwd_tab)
+        T = tabs["total_cycles"]
+        WE = tabs["warmup_end"]                 # first cycle with a bwd
+        SE = tabs["steady_end"]                 # one past last fwd cycle
+        W = tabs["buffer_slots"]
+        fwd_m = jnp.asarray(tabs["fwd_m"])
+        fwd_c = jnp.asarray(tabs["fwd_c"])
+        bwd_m = jnp.asarray(tabs["bwd_m"])
+        bwd_c = jnp.asarray(tabs["bwd_c"])
 
         def manual_grads(params, inputs_stack, labels_stack, rng, scale):
             (other, cast_all, embed_of, head_loss, body_spec, other_spec,
              batch_spec, labels_spec) = self._stage_closures(
                 params, inputs_stack, labels_stack)
 
-            def shard_fn(body_params, depths, fwd_row, bwd_row, other_params,
-                         inputs, labels, rng, scale):
-                local_body = jax.tree_util.tree_map(
-                    lambda t: t[0], body_params)
-                depth = depths[0]
-                fwd_row = fwd_row[0]
-                bwd_row = bwd_row[0]
+            def shard_fn(body_params, depths, fm_row, fc_row, bm_row,
+                         bc_row, other_params, inputs, labels, rng, scale):
+                local_body = self._chunked(
+                    jax.tree_util.tree_map(lambda t: t[0], body_params),
+                    v)
+                depths_row = depths[0]                     # (v,)
+                fm_row = fm_row[0]
+                fc_row = fc_row[0]
+                bm_row = bm_row[0]
+                bc_row = bc_row[0]
                 stage = jax.lax.axis_index(PIPE_AXIS)
                 is_first = stage == 0
                 is_last = stage == num_stages - 1
                 params_all = cast_all(other_params)
                 seed = (scale / M).astype(jnp.float32)
 
-                def stage_fwd(bp, x, m):
-                    # rng keyed by (microbatch, stage) so the backward's
-                    # recompute replays the forward's dropout exactly
-                    step_rng = jax.random.fold_in(rng, m * num_stages + stage)
-                    return module.apply_body_stage(bp, x, rng=step_rng,
-                                                   depth=depth)
+                def pick_chunk(c):
+                    return jax.tree_util.tree_map(
+                        lambda t: jax.lax.dynamic_index_in_dim(
+                            t, c, axis=0, keepdims=False), local_body)
+
+                def stage_fwd(bp, x, m, c):
+                    # rng keyed by (microbatch, VIRTUAL stage) so the
+                    # backward's recompute replays the forward's dropout
+                    # exactly; v=1 reduces to m*S + stage (round-3 key)
+                    step_rng = jax.random.fold_in(
+                        rng, (m * v + c) * num_stages + stage)
+                    return module.apply_body_stage(
+                        bp, x, rng=step_rng,
+                        depth=jax.lax.dynamic_index_in_dim(
+                            depths_row, c, keepdims=False))
 
                 x_shape = jax.eval_shape(
                     lambda: embed_of(params_all, inputs, jnp.int32(0)))
@@ -323,7 +395,7 @@ class PipelineEngine(DeepSpeedEngine):
                     zeros_x,                                   # recv_f
                     zeros_x,                                   # recv_b
                     jax.tree_util.tree_map(
-                        lambda z: jnp.zeros((W,) + z.shape, z.dtype),
+                        lambda z: jnp.zeros((v, W) + z.shape, z.dtype),
                         zeros_x),                              # x_buf
                     jax.tree_util.tree_map(
                         lambda p: jnp.zeros(p.shape, jnp.float32),
@@ -343,40 +415,54 @@ class PipelineEngine(DeepSpeedEngine):
                                                    jnp.zeros_like(g)),
                         acc, delta)
 
-                def body(k, carry):
-                    recv_f, recv_b, x_buf, body_g, other_g, loss_sum = carry
+                def buf_get(buf, c, slot):
+                    inner = jax.lax.dynamic_index_in_dim(
+                        buf, c, axis=0, keepdims=False)
+                    return jax.lax.dynamic_index_in_dim(
+                        inner, slot, axis=0, keepdims=False)
 
-                    # ---- forward phase ----
-                    m_f = fwd_row[k]
+                def buf_set(buf, c, slot, val):
+                    inner = jax.lax.dynamic_index_in_dim(
+                        buf, c, axis=0, keepdims=False)
+                    inner = jax.lax.dynamic_update_index_in_dim(
+                        inner, val, slot, axis=0)
+                    return jax.lax.dynamic_update_index_in_dim(
+                        buf, inner, c, axis=0)
+
+                def fwd_phase(k, recv_f, x_buf):
+                    m_f = fm_row[k]
                     v_f = m_f >= 0
                     mf = jnp.clip(m_f, 0, M - 1)
+                    cf = jnp.clip(fc_row[k], 0, v - 1)
                     x = jax.lax.cond(
-                        is_first,
+                        jnp.logical_and(is_first, cf == 0),
                         lambda: embed_of(params_all, inputs, mf),
                         lambda: recv_f)
-                    y = stage_fwd(local_body, x, mf)
+                    y = stage_fwd(pick_chunk(cf), x, mf, cf)
                     slot_f = jnp.mod(mf, W)
                     x_buf = jax.tree_util.tree_map(
-                        lambda buf, xv: jax.lax.dynamic_update_index_in_dim(
-                            buf,
+                        lambda buf, xv: buf_set(
+                            buf, cf, slot_f,
                             jnp.where(v_f, xv,
-                                      jax.lax.dynamic_index_in_dim(
-                                          buf, slot_f, axis=0,
-                                          keepdims=False)),
-                            slot_f, axis=0), x_buf, x)
-                    recv_f_next = p2p.send_forward(y, num_stages, PIPE_AXIS)
+                                      buf_get(buf, cf, slot_f))),
+                        x_buf, x)
+                    send_f = (p2p.send_forward_wrap if v > 1
+                              else p2p.send_forward)
+                    recv_f_next = send_f(y, num_stages, PIPE_AXIS)
+                    return recv_f_next, x_buf
 
-                    # ---- backward phase ----
-                    m_b = bwd_row[k]
+                def bwd_core(k, recv_b, x_buf, body_g, other_g, loss_sum):
+                    m_b = bm_row[k]
                     v_b = m_b >= 0
                     mb = jnp.clip(m_b, 0, M - 1)
+                    cb = jnp.clip(bc_row[k], 0, v - 1)
                     slot_b = jnp.mod(mb, W)
                     x_saved = jax.tree_util.tree_map(
-                        lambda buf: jax.lax.dynamic_index_in_dim(
-                            buf, slot_b, axis=0, keepdims=False), x_buf)
+                        lambda buf: buf_get(buf, cb, slot_b), x_buf)
+                    chunk_params = pick_chunk(cb)
                     y_b, stage_vjp = jax.vjp(
-                        lambda bp, xv: stage_fwd(bp, xv, mb),
-                        local_body, x_saved)
+                        lambda bp, xv: stage_fwd(bp, xv, mb, cb),
+                        chunk_params, x_saved)
 
                     def seed_from_loss():
                         loss_m, head_vjp = jax.vjp(
@@ -386,46 +472,91 @@ class PipelineEngine(DeepSpeedEngine):
                         return loss_m, d_pall, dy
 
                     loss_m, d_head, dy = jax.lax.cond(
-                        is_last, seed_from_loss,
+                        jnp.logical_and(is_last, cb == v - 1),
+                        seed_from_loss,
                         lambda: (jnp.float32(0.0), zeros_other, recv_b))
-                    d_body, dx = stage_vjp(dy)
+                    d_chunk, dx = stage_vjp(dy)
 
                     d_pre = jax.lax.cond(
-                        is_first,
+                        jnp.logical_and(is_first, cb == 0),
                         lambda: jax.vjp(
                             lambda pa: embed_of(pa, inputs, mb),
                             params_all)[1](dx)[0],
                         lambda: zeros_other)
 
-                    body_g = masked_add(body_g, d_body, v_b)
+                    # accumulate this chunk's grads at index cb (masked)
+                    body_g = jax.tree_util.tree_map(
+                        lambda bg, d: jax.lax.dynamic_update_index_in_dim(
+                            bg,
+                            jax.lax.dynamic_index_in_dim(
+                                bg, cb, axis=0, keepdims=False)
+                            + jnp.where(v_b, d.astype(jnp.float32), 0.0),
+                            cb, axis=0),
+                        body_g, d_chunk)
                     other_g = masked_add(
                         masked_add(other_g, d_head, v_b), d_pre, v_b)
                     loss_sum = loss_sum + jnp.where(v_b, loss_m, 0.0)
+                    return dx, body_g, other_g, loss_sum
 
+                # --- three compile-time phases (the bubble shrinker):
+                # warmup cycles run NO backward phase and drain cycles NO
+                # forward phase, so their collectives/compute never
+                # execute. Collective uniformity only binds ACROSS RANKS
+                # within a cycle — each loop body is still identical on
+                # every rank. Per-rank idle drops from 2(S-1) full cycles
+                # to 2(S-1) half-cycles at v=1 (reference 1F1B parity)
+                # and (S-1)/(vM) bubble at v>1 (beats the reference).
+                def warmup_body(k, carry):
+                    recv_f, recv_b, x_buf, body_g, other_g, loss_sum = carry
+                    recv_f, x_buf = fwd_phase(k, recv_f, x_buf)
+                    return (recv_f, recv_b, x_buf, body_g, other_g,
+                            loss_sum)
+
+                def steady_body(k, carry):
+                    recv_f, recv_b, x_buf, body_g, other_g, loss_sum = carry
+                    recv_f_next, x_buf = fwd_phase(k, recv_f, x_buf)
+                    dx, body_g, other_g, loss_sum = bwd_core(
+                        k, recv_b, x_buf, body_g, other_g, loss_sum)
                     # sequence the two permutes (no data dependency
                     # otherwise): devices entering them in racing orders
                     # deadlock XLA:CPU's in-process collective rendezvous;
                     # on TPU this just orders two small ICI transfers
                     dx, _ = jax.lax.optimization_barrier((dx, recv_f_next))
-                    recv_b_next = p2p.send_backward(dx, num_stages,
-                                                    PIPE_AXIS)
+                    send_b = (p2p.send_backward_wrap if v > 1
+                              else p2p.send_backward)
+                    recv_b_next = send_b(dx, num_stages, PIPE_AXIS)
                     return (recv_f_next, recv_b_next, x_buf, body_g,
                             other_g, loss_sum)
 
-                carry = jax.lax.fori_loop(0, T, body, carry0)
+                def drain_body(k, carry):
+                    recv_f, recv_b, x_buf, body_g, other_g, loss_sum = carry
+                    dx, body_g, other_g, loss_sum = bwd_core(
+                        k, recv_b, x_buf, body_g, other_g, loss_sum)
+                    send_b = (p2p.send_backward_wrap if v > 1
+                              else p2p.send_backward)
+                    recv_b_next = send_b(dx, num_stages, PIPE_AXIS)
+                    return (recv_f, recv_b_next, x_buf, body_g, other_g,
+                            loss_sum)
+
+                carry = jax.lax.fori_loop(0, WE, warmup_body, carry0)
+                carry = jax.lax.fori_loop(WE, SE, steady_body, carry)
+                carry = jax.lax.fori_loop(SE, T, drain_body, carry)
                 _, _, _, body_g, other_g, loss_sum = carry
 
                 # only the last stage accumulated losses; tied/pre/post grads
                 # from both pipe ends meet here (ReduceTiedGrads)
                 mean_loss = jax.lax.psum(loss_sum, PIPE_AXIS) / M
                 other_g = jax.lax.psum(other_g, PIPE_AXIS)
+                if v == 1:
+                    body_g = jax.tree_util.tree_map(lambda g: g[0], body_g)
                 body_g = jax.tree_util.tree_map(lambda g: g[None], body_g)
                 return mean_loss, body_g, other_g
 
             mean_loss, body_g, other_g = jax.shard_map(
                 shard_fn, mesh=mesh,
                 in_specs=(body_spec, P(PIPE_AXIS), P(PIPE_AXIS),
-                          P(PIPE_AXIS), other_spec, batch_spec, labels_spec,
+                          P(PIPE_AXIS), P(PIPE_AXIS), P(PIPE_AXIS),
+                          other_spec, batch_spec, labels_spec,
                           P(), P()),
                 out_specs=(P(),
                            jax.tree_util.tree_map(
@@ -433,8 +564,8 @@ class PipelineEngine(DeepSpeedEngine):
                            jax.tree_util.tree_map(lambda _: P(), other)),
                 axis_names={PIPE_AXIS},
                 check_vma=False,
-            )(params["body"], stage_depths, fwd_tab, bwd_tab, other,
-              inputs_stack, labels_stack, rng, scale)
+            )(params["body"], depths_2d, fwd_m, fwd_c, bwd_m, bwd_c,
+              other, inputs_stack, labels_stack, rng, scale)
             grads = dict(other_g)
             grads["body"] = body_g
             return mean_loss, grads
@@ -548,6 +679,7 @@ class PipelineEngine(DeepSpeedEngine):
         client_state["pipe_layout"] = {
             "parts": list(self.pipe_module.parts),
             "layers_per_stage": self.pipe_module.layers_per_stage,
+            "num_virtual": getattr(self.pipe_module, "num_virtual", 1),
         }
         ok = super().save_checkpoint(save_dir, tag=tag,
                                      client_state=client_state,
@@ -558,19 +690,27 @@ class PipelineEngine(DeepSpeedEngine):
         body = ckpt.tree_to_numpy(self.state["params"]["body"])
         module = self.pipe_module
         for layer_id in range(len(module.body_layers)):
-            s, l = self._global_to_slot(module, layer_id)
-            layer_tree = jax.tree_util.tree_map(lambda x: x[s][l], body)
+            idx = self._global_to_slot(module, layer_id)
+            layer_tree = jax.tree_util.tree_map(
+                lambda x: x[idx], body)
             ckpt.save_state_dict(
                 ckpt.layer_ckpt_name(save_dir, tag, layer_id), layer_tree)
         return ok
 
     @staticmethod
     def _global_to_slot(module, layer_id):
-        """Global body-layer id -> (stage, slot) under the module's parts."""
+        """Global body-layer id -> stack index under the module's parts:
+        (stage, slot) at v=1, (stage, chunk, slot) with interleaving
+        (virtual stage j = chunk*S + stage owns [parts[j], parts[j+1]))."""
         parts = module.parts
-        for s in range(module.num_stages):
-            if parts[s] <= layer_id < parts[s + 1]:
-                return s, layer_id - parts[s]
+        v = getattr(module, "num_virtual", 1)
+        S = module.num_stages
+        for j in range(S * v):
+            if parts[j] <= layer_id < parts[j + 1]:
+                slot = layer_id - parts[j]
+                if v == 1:
+                    return (j, slot)
+                return (j % S, j // S, slot)
         raise IndexError(layer_id)
 
     def _adapt_state_dict(self, sd):
@@ -583,6 +723,8 @@ class PipelineEngine(DeepSpeedEngine):
         key (equal-stage era) fall back to the pure reshape."""
         module = self.pipe_module
         S, L = module.num_stages, module.layers_per_stage
+        v = getattr(module, "num_virtual", 1)
+        new_lead = (S, L) if v == 1 else (S, v, L)
         old = sd.get("pipe_layout")
 
         def restack(leaf):
@@ -591,24 +733,33 @@ class PipelineEngine(DeepSpeedEngine):
             if old is not None:
                 o_parts = list(old["parts"])
                 o_L = int(old["layers_per_stage"])
-                o_S = len(o_parts) - 1
-                if (leaf.shape[0], leaf.shape[1]) != (o_S, o_L):
+                o_v = int(old.get("num_virtual", 1))
+                o_S = (len(o_parts) - 1) // o_v
+                o_lead = (o_S, o_L) if o_v == 1 else (o_S, o_v, o_L)
+                if tuple(leaf.shape[:len(o_lead)]) != o_lead:
                     return leaf
-                # unpad to the global layer list...
-                layers = [leaf[s, i - o_parts[s]]
-                          for s in range(o_S)
-                          for i in range(o_parts[s], o_parts[s + 1])]
+                # unpad to the global layer list (virtual stage j =
+                # c*S + r lives at [r] / [r, c])...
+                layers = []
+                for j in range(o_S * o_v):
+                    r, c = j % o_S, j // o_S
+                    sl = leaf[r] if o_v == 1 else leaf[r, c]
+                    for i in range(o_parts[j], o_parts[j + 1]):
+                        layers.append(sl[i - o_parts[j]])
                 if len(layers) != module.parts[-1]:
                     return leaf
-                # ...and re-pad under the new parts (padded slots repeat the
-                # stage's first layer, matching _init_params)
+                # ...and re-pad under the new parts (padded slots repeat
+                # the stage's first layer, matching _init_params)
                 slots = []
-                for s in range(S):
-                    stage = layers[module.parts[s]:module.parts[s + 1]]
-                    stage = stage + [stage[0]] * (L - len(stage))
-                    slots.extend(stage)
-                return np.stack(slots).reshape((S, L) + leaf.shape[2:])
-            if leaf.shape[0] * leaf.shape[1] == S * L and \
+                for r in range(S):
+                    for c in range(v):
+                        j = c * S + r
+                        stage = layers[module.parts[j]:module.parts[j + 1]]
+                        stage = stage + [stage[0]] * (L - len(stage))
+                        slots.extend(stage)
+                return np.stack(slots).reshape(new_lead + leaf.shape[
+                    len(o_lead):])
+            if v == 1 and leaf.shape[0] * leaf.shape[1] == S * L and \
                     (leaf.shape[0], leaf.shape[1]) != (S, L):
                 return leaf.reshape((S, L) + leaf.shape[2:])
             return leaf

@@ -97,12 +97,19 @@ class PipelineModule:
 
     def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
                  seed_layers=False, base_seed=1234, partition_method="parameters",
-                 activation_checkpoint_interval=0, num_dp=None, num_mp=None):
+                 activation_checkpoint_interval=0, num_dp=None, num_mp=None,
+                 num_virtual_stages=1):
         self.loss_fn = loss_fn
         self.partition_method = partition_method
         self.activation_checkpoint_interval = activation_checkpoint_interval
         self.seed_layers = seed_layers
         self.base_seed = base_seed
+        # Interleaved scheduling (Megatron virtual stages): each pipe rank
+        # owns num_virtual_stages non-contiguous layer chunks; virtual
+        # stage j = chunk*S + rank. The executor's bubble shrinks to
+        # (S-1)/(vM) — see schedule.interleaved_train_schedule_tables.
+        assert num_virtual_stages >= 1
+        self.num_virtual = int(num_virtual_stages)
 
         if topology is None:
             assert num_stages is not None, \
@@ -228,20 +235,44 @@ class PipelineModule:
         # pads every stage to the deepest one; apply_body_stage() skips the
         # padded slots by depth, so ragged partitions execute correctly
         # while keeping the one-program SPMD pipeline.
+        n_virtual = self.num_stages * self.num_virtual
+        assert len(self.body_layers) >= n_virtual, \
+            "pipelined body of {} layers is shallower than {} virtual " \
+            "stages ({} stages x {} chunks)".format(
+                len(self.body_layers), n_virtual, self.num_stages,
+                self.num_virtual)
         if self.partition_method == "parameters":
             weights = [self._layer_weight(e) for e in self.body_layers]
-            self.parts = partition_balanced(weights, self.num_stages)
+            self.parts = partition_balanced(weights, n_virtual)
+            if min(self.parts[j + 1] - self.parts[j]
+                   for j in range(n_virtual)) < 1:
+                # balanced-by-weight can leave a tail stage empty when
+                # layers barely exceed the stage count (max load is the
+                # same either way); every stage must own >= 1 layer for
+                # the executor, so fall back to the uniform split
+                logger.warning(
+                    "parameter-balanced partition left an empty stage "
+                    "(parts={}); using uniform split".format(self.parts))
+                self.parts = partition_uniform(len(self.body_layers),
+                                               n_virtual)
         else:
-            self.parts = partition_uniform(len(self.body_layers),
-                                           self.num_stages)
-        self.stage_depths = np.array(
-            [self.parts[s + 1] - self.parts[s]
-             for s in range(self.num_stages)], dtype=np.int32)
-        assert int(self.stage_depths.min()) >= 1, \
+            self.parts = partition_uniform(len(self.body_layers), n_virtual)
+        # stage_depths[s, c] = real layers of virtual stage c*S + s;
+        # v=1 keeps the historical (S,) shape
+        depths = np.array(
+            [self.parts[j + 1] - self.parts[j] for j in range(n_virtual)],
+            dtype=np.int32)
+        assert int(depths.min()) >= 1, \
             "partitioning produced an empty stage: parts={}".format(self.parts)
+        if self.num_virtual == 1:
+            self.stage_depths = depths
+        else:
+            # virtual stage j = c*S + s -> [s, c]
+            self.stage_depths = depths.reshape(
+                self.num_virtual, self.num_stages).T.copy()
         # max depth = stacked slot count; equal partitions keep the old
         # meaning (body/num_stages) exactly
-        self.layers_per_stage = int(self.stage_depths.max())
+        self.layers_per_stage = int(depths.max())
 
     def _init_params(self):
         """Init: tied + pre/post params as plain trees; body params stacked
@@ -277,21 +308,33 @@ class PipelineModule:
             else:
                 key, sub = jax.random.split(key)
             body_param_list.append(init_entry(e, sub))
-        # stack: (num_stages, layers_per_stage, *param_shape). Ragged
-        # partitions pad each stage to the deepest one; padded slots hold a
-        # COPY of the stage's first real layer (not zeros) so any layer's
-        # apply stays finite on them — apply_body_stage discards their
-        # outputs by depth, and the discarding select zeroes their grads.
-        slot_params = []
-        for s in range(self.num_stages):
-            start, stop = self.parts[s], self.parts[s + 1]
+        # stack: (num_stages, layers_per_stage, *param_shape) — or, with
+        # interleaving, (num_stages, num_virtual, layers_per_stage, ...)
+        # where element [s, c] is virtual stage c*S + s. Ragged
+        # partitions pad each (virtual) stage to the deepest one; padded
+        # slots hold a COPY of the stage's first real layer (not zeros)
+        # so any layer's apply stays finite on them — apply_body_stage
+        # discards their outputs by depth, and the discarding select
+        # zeroes their grads.
+        def virtual_slice(j):
+            start, stop = self.parts[j], self.parts[j + 1]
             stage = body_param_list[start:stop]
-            stage += [stage[0]] * (self.layers_per_stage - len(stage))
-            slot_params.extend(stage)
+            return stage + [stage[0]] * (self.layers_per_stage - len(stage))
+
+        slot_params = []
+        if self.num_virtual == 1:
+            lead = (self.num_stages, self.layers_per_stage)
+            for s in range(self.num_stages):
+                slot_params.extend(virtual_slice(s))
+        else:
+            lead = (self.num_stages, self.num_virtual,
+                    self.layers_per_stage)
+            for s in range(self.num_stages):
+                for c in range(self.num_virtual):
+                    slot_params.extend(virtual_slice(c * self.num_stages + s))
         stacked = jax.tree_util.tree_map(
             lambda *leaves: jnp.stack(leaves).reshape(
-                (self.num_stages, self.layers_per_stage) + leaves[0].shape),
-            *slot_params)
+                lead + leaves[0].shape), *slot_params)
         self.body_params = stacked
 
         self.params = {
@@ -426,12 +469,20 @@ class PipelineModule:
 
     def apply_sequential(self, params, x, **kwargs):
         """Reference semantics of forward(): run everything in order
-        (used for correctness tests and single-stage fallback)."""
+        (used for correctness tests and single-stage fallback). Virtual
+        stages run in GLOBAL order j = 0..vS-1 (chunk j//S on rank j%S)."""
         x = self.apply_pre(params, x, **kwargs)
-        for s in range(self.num_stages):
-            x = self.apply_body_stage(
-                jax.tree_util.tree_map(lambda t: t[s], params["body"]), x,
-                depth=int(self.stage_depths[s]))
+        for j in range(self.num_stages * self.num_virtual):
+            s, c = j % self.num_stages, j // self.num_stages
+            if self.num_virtual == 1:
+                chunk = jax.tree_util.tree_map(lambda t: t[s],
+                                               params["body"])
+                depth = int(self.stage_depths[s])
+            else:
+                chunk = jax.tree_util.tree_map(lambda t: t[s][c],
+                                               params["body"])
+                depth = int(self.stage_depths[s][c])
+            x = self.apply_body_stage(chunk, x, depth=depth)
         x = self.apply_post(params, x, **kwargs)
         return x
 
@@ -447,12 +498,13 @@ class PipelineModule:
         parts = path.split("/", 1)
         head, rest = parts[0], (parts[1] if len(parts) > 1 else "")
         if head == "body":
+            lead = 2 if self.num_virtual == 1 else 3
             proto = self.body_layers[0][2]
             inner = getattr(proto, "partition_spec_fn", None)
-            inner_spec = inner(rest, shape[2:]) if inner else None
+            inner_spec = inner(rest, shape[lead:]) if inner else None
             if inner_spec is None:
-                inner_spec = [None] * (len(shape) - 2)
-            return P(PIPE_AXIS, None, *inner_spec)
+                inner_spec = [None] * (len(shape) - lead)
+            return P(PIPE_AXIS, *([None] * (lead - 1)), *inner_spec)
         if head == "tied":
             key, _, rest2 = rest.partition("/")
             layer = self.tied_keys.get(key)
@@ -472,6 +524,7 @@ class PipelineModule:
     def describe(self):
         return {
             "num_stages": self.num_stages,
+            "num_virtual_stages": self.num_virtual,
             "layers_per_stage": self.layers_per_stage,
             "stage_depths": self.stage_depths.tolist(),
             "pre": len(self.pre_layers),

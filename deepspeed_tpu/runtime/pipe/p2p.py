@@ -26,3 +26,23 @@ def send_forward(x, num_stages, axis_name="pipe"):
 
 def send_backward(x, num_stages, axis_name="pipe"):
     return jax.lax.ppermute(x, axis_name, backward_perm(num_stages))
+
+
+def forward_perm_wrap(num_stages):
+    """stage i -> stage (i+1) % S: the interleaved pipeline's activation
+    hop — the last rank's chunk-c output feeds rank 0's chunk c+1."""
+    return [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+
+def backward_perm_wrap(num_stages):
+    """stage i -> stage (i-1) % S: the interleaved gradient hop (rank 0's
+    chunk-c input grad feeds the last rank's chunk c-1)."""
+    return [(i, (i - 1) % num_stages) for i in range(num_stages)]
+
+
+def send_forward_wrap(x, num_stages, axis_name="pipe"):
+    return jax.lax.ppermute(x, axis_name, forward_perm_wrap(num_stages))
+
+
+def send_backward_wrap(x, num_stages, axis_name="pipe"):
+    return jax.lax.ppermute(x, axis_name, backward_perm_wrap(num_stages))

@@ -250,7 +250,10 @@ class TrainSchedule(PipeSchedule):
 
 
 class UniformTrainSchedule(PipeSchedule):
-    """Collective-uniform 1F1B schedule: the one the TPU executor runs.
+    """Collective-uniform 1F1B schedule (round-3 executor semantics; the
+    executor now runs the phase-split generalization of these tables —
+    see interleaved_train_schedule_tables, whose v=1 microbatch tables
+    are identical).
 
     TrainSchedule's even/odd stagger has different stages running different
     phases at the same half-step. A per-process interpreter (the torch
@@ -336,6 +339,93 @@ def uniform_train_schedule_tables(micro_batches, stages):
     fwd = np.where((fwd >= 0) & (fwd < micro_batches), fwd, -1)
     bwd = np.where((bwd >= 0) & (bwd < micro_batches), bwd, -1)
     return fwd.astype(np.int32), bwd.astype(np.int32)
+
+
+def interleaved_train_schedule_tables(micro_batches, stages, num_chunks=1):
+    """Cycle tables for the (optionally interleaved) collective-uniform
+    1F1B executor, plus its phase boundaries and buffer bound.
+
+    With ``num_chunks`` = v virtual stages per rank (Megatron interleaving,
+    reference analogue: the staggered TrainSchedule is v=1 only), the model
+    is cut into vS virtual stages; virtual stage j = c*S + r (chunk c,
+    rank r). Writing microbatch m = g*S + q:
+
+        forward  of (c, m) on rank r at cycle  g*vS + c*S + q + r
+        backward of (c, m) on rank r at cycle  vS-1 + g*vS + (v-1-c)*S
+                                                + q + (S-1-r)
+
+    Both satisfy the one-hop-per-cycle ppermute alignment (chunk
+    transitions wrap rank S-1 -> 0 forward, 0 -> S-1 backward) and give
+    each rank at most one forward and one backward per cycle. At v=1 they
+    reduce exactly to ``uniform_train_schedule_tables``.
+
+    The executor splits the cycle range into three compile-time phases —
+    cycles before ``warmup_end`` run a forward phase only, cycles in
+    [warmup_end, steady_end) run forward+backward, and the rest run
+    backward only. Structural collective uniformity is only required
+    ACROSS RANKS WITHIN a cycle, so dropping the dead phase from the
+    warmup/drain cycles is legal — and it is where the bubble shrinks:
+    per-rank idle falls from 2(S-1) full cycles (round-3 executor) to
+    2(S-1) HALF-cycles at v=1 (reference 1F1B parity, bubble (S-1)/M)
+    and (2S-2)/v half-cycle equivalents at v>1 — bubble (S-1)/(vM),
+    beating the reference's (S-1)/M from v=2 up.
+
+    Returns a dict: fwd_m/fwd_c/bwd_m/bwd_c ((S, T) int32, -1 = bubble),
+    total_cycles, warmup_end, steady_end, buffer_slots (W: per-(rank,
+    chunk) stage-input slots such that slot = m % W never collides among
+    in-flight microbatches).
+
+    M need not divide by S: the construction stays valid (tables are
+    injective per rank-cycle for any M), the ragged tail just adds
+    bubbles — pick M a multiple of S for the advertised bubble.
+    """
+    M, S, v = micro_batches, stages, num_chunks
+    assert v >= 1 and S >= 1 and M >= 1
+    t_f = np.empty((S, v, M), np.int64)
+    t_b = np.empty((S, v, M), np.int64)
+    g, q = np.arange(M) // S, np.arange(M) % S
+    for r in range(S):
+        for c in range(v):
+            t_f[r, c] = g * v * S + c * S + q + r
+            t_b[r, c] = (v * S - 1 + g * v * S + (v - 1 - c) * S
+                         + q + (S - 1 - r))
+    T = int(t_b.max()) + 1
+    fwd_m = -np.ones((S, T), np.int32)
+    fwd_c = -np.ones((S, T), np.int32)
+    bwd_m = -np.ones((S, T), np.int32)
+    bwd_c = -np.ones((S, T), np.int32)
+    for r in range(S):
+        for c in range(v):
+            for m in range(M):
+                kf, kb = t_f[r, c, m], t_b[r, c, m]
+                assert fwd_m[r, kf] < 0 and bwd_m[r, kb] < 0, \
+                    "schedule collision"
+                fwd_m[r, kf] = m
+                fwd_c[r, kf] = c
+                bwd_m[r, kb] = m
+                bwd_c[r, kb] = c
+    # phase boundaries: the fwd-active and bwd-active cycle windows are
+    # contiguous by construction; warmup = cycles before any backward,
+    # drain = cycles after every forward
+    warmup_end = int(t_b.min())
+    steady_end = int(t_f.max()) + 1
+    assert warmup_end <= steady_end
+    # W: max in-flight microbatches per (rank, chunk), interval closed on
+    # the backward cycle (its buffer read happens AFTER that cycle's
+    # forward phase may have stored a new entry)
+    W = 1
+    for r in range(S):
+        for c in range(v):
+            events = np.zeros(T + 1, np.int64)
+            for m in range(M):
+                events[t_f[r, c, m]] += 1
+                events[t_b[r, c, m] + 1] -= 1
+            W = max(W, int(np.cumsum(events).max()))
+    return {
+        "fwd_m": fwd_m, "fwd_c": fwd_c, "bwd_m": bwd_m, "bwd_c": bwd_c,
+        "total_cycles": T, "warmup_end": warmup_end,
+        "steady_end": steady_end, "buffer_slots": min(W, M),
+    }
 
 
 class DataParallelSchedule(PipeSchedule):

@@ -1,4 +1,5 @@
 """Pipeline schedule logic (mirrors reference test_pipe_schedule.py)."""
+import numpy as np
 import pytest
 
 from deepspeed_tpu.runtime.pipe import schedule as sch
@@ -154,3 +155,110 @@ def test_instruction_repr_and_eq():
     c = sch.ForwardPass(4)
     assert a == b and a != c
     assert "ForwardPass" in repr(a) and "3" in repr(a)
+
+
+class TestInterleavedTables:
+    """interleaved_train_schedule_tables: the generalized (virtual-chunk)
+    tables the phase-split executor runs."""
+
+    def _tabs(self, M, S, v):
+        from deepspeed_tpu.runtime.pipe.schedule import (
+            interleaved_train_schedule_tables)
+        return interleaved_train_schedule_tables(M, S, v)
+
+    @pytest.mark.parametrize("M,S,v", [(8, 4, 1), (8, 4, 2), (8, 2, 4),
+                                       (6, 3, 2), (4, 4, 2), (7, 4, 2),
+                                       (16, 4, 2), (5, 2, 1)])
+    def test_complete_and_unique(self, M, S, v):
+        t = self._tabs(M, S, v)
+        for r in range(S):
+            seen_f, seen_b = set(), set()
+            for k in range(t["total_cycles"]):
+                if t["fwd_m"][r, k] >= 0:
+                    seen_f.add((int(t["fwd_c"][r, k]),
+                                int(t["fwd_m"][r, k])))
+                if t["bwd_m"][r, k] >= 0:
+                    seen_b.add((int(t["bwd_c"][r, k]),
+                                int(t["bwd_m"][r, k])))
+            assert seen_f == {(c, m) for c in range(v) for m in range(M)}
+            assert seen_b == seen_f
+
+    @pytest.mark.parametrize("M,S,v", [(8, 4, 2), (6, 3, 2), (8, 2, 4)])
+    def test_hop_alignment_with_wrap(self, M, S, v):
+        """Virtual stage j+1's forward of m is exactly one cycle after
+        stage j's (chunk transitions wrap S-1 -> 0); gradients mirror."""
+        t = self._tabs(M, S, v)
+
+        def fwd_cycle(j, m):
+            r, c = j % S, j // S
+            ks = [k for k in range(t["total_cycles"])
+                  if t["fwd_m"][r, k] == m and t["fwd_c"][r, k] == c]
+            assert len(ks) == 1
+            return ks[0]
+
+        def bwd_cycle(j, m):
+            r, c = j % S, j // S
+            ks = [k for k in range(t["total_cycles"])
+                  if t["bwd_m"][r, k] == m and t["bwd_c"][r, k] == c]
+            assert len(ks) == 1
+            return ks[0]
+
+        for m in range(M):
+            for j in range(v * S - 1):
+                assert fwd_cycle(j + 1, m) == fwd_cycle(j, m) + 1
+                assert bwd_cycle(j, m) == bwd_cycle(j + 1, m) + 1
+            # 1F1B: the last virtual stage may backward in the same
+            # cycle as its forward (fwd phase runs first), never before
+            assert bwd_cycle(v * S - 1, m) >= fwd_cycle(v * S - 1, m)
+
+    def test_v1_matches_uniform_tables(self):
+        from deepspeed_tpu.runtime.pipe.schedule import (
+            uniform_train_schedule_tables)
+        for M, S in [(8, 4), (5, 2), (3, 3)]:
+            t = self._tabs(M, S, 1)
+            fwd, bwd = uniform_train_schedule_tables(M, S)
+            np.testing.assert_array_equal(t["fwd_m"], fwd)
+            np.testing.assert_array_equal(t["bwd_m"], bwd)
+            assert (t["fwd_c"][t["fwd_m"] >= 0] == 0).all()
+
+    @pytest.mark.parametrize("M,S,v", [(8, 4, 1), (8, 4, 2), (8, 2, 4),
+                                       (16, 4, 2)])
+    def test_phase_windows(self, M, S, v):
+        """warmup cycles have no backward anywhere; drain cycles have no
+        forward; both windows are contiguous."""
+        t = self._tabs(M, S, v)
+        T, we, se = t["total_cycles"], t["warmup_end"], t["steady_end"]
+        assert 0 <= we <= se <= T
+        assert (t["bwd_m"][:, :we] < 0).all()
+        assert (t["fwd_m"][:, se:] < 0).all()
+        has_f = (t["fwd_m"] >= 0).any(axis=0)
+        has_b = (t["bwd_m"] >= 0).any(axis=0)
+        # contiguity: active windows are single runs
+        for flags in (has_f, has_b):
+            idx = np.where(flags)[0]
+            assert (np.diff(idx) == 1).all()
+        # the advertised totals: T = vM + (v+1)S - 2 when S | M
+        if M % S == 0:
+            assert T == v * M + (v + 1) * S - 2
+
+    @pytest.mark.parametrize("M,S,v", [(8, 4, 1), (8, 4, 2), (8, 2, 4),
+                                       (16, 4, 2), (7, 4, 2)])
+    def test_buffer_bound_collision_free(self, M, S, v):
+        """slot = m % W never collides among in-flight microbatches of
+        the same (rank, chunk), counting the backward's read cycle."""
+        t = self._tabs(M, S, v)
+        W = t["buffer_slots"]
+        for r in range(S):
+            live = {}
+            for k in range(t["total_cycles"]):
+                if t["fwd_m"][r, k] >= 0:
+                    c, m = int(t["fwd_c"][r, k]), int(t["fwd_m"][r, k])
+                    slot = (c, m % W)
+                    assert slot not in live, (r, k, slot)
+                    live[slot] = m
+                if t["bwd_m"][r, k] >= 0:
+                    c, m = int(t["bwd_c"][r, k]), int(t["bwd_m"][r, k])
+                    assert live.pop((c, m % W)) == m
+        # v=1 keeps the round-3 bound
+        if v == 1:
+            assert W <= max(1, min(2 * S - 1, M))

@@ -98,7 +98,8 @@ def lm_loss_fn(logits, labels):
 def make_gpt2_pipeline(config=None, size="gpt2_small", num_stages=2,
                        num_dp=None, num_mp=None, topology=None,
                        activation_checkpoint_interval=1,
-                       num_virtual_stages=1, **overrides):
+                       num_virtual_stages=1, save_stage_residuals=False,
+                       **overrides):
     if config is None:
         config = config_for(size, **overrides)
     assert config.n_layers >= num_stages * num_virtual_stages, \
@@ -120,7 +121,8 @@ def make_gpt2_pipeline(config=None, size="gpt2_small", num_stages=2,
         layers=layers, num_stages=num_stages, topology=topology,
         loss_fn=lm_loss_fn, num_dp=num_dp, num_mp=num_mp,
         activation_checkpoint_interval=activation_checkpoint_interval,
-        num_virtual_stages=num_virtual_stages)
+        num_virtual_stages=num_virtual_stages,
+        save_stage_residuals=save_stage_residuals)
     net.config = config
     # the pipeline runs the SAME arithmetic as the dense model, so the
     # per-module flops table reuses gpt2.profile_spec (PipelineEngine

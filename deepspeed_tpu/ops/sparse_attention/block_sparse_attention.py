@@ -173,10 +173,7 @@ def _group_scores(q, k_refs, kpm_refs, bias_refs, cols_ref, valid_ref, h, p,
             s = s + bias_refs[j][...]
         keep = valid_ref[h, p * pack + j] > 0
         if causal:
-            q_pos = qi * block + jax.lax.broadcasted_iota(
-                jnp.int32, (block, block), 0)
-            k_iota = jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
-            keep = jnp.logical_and(keep, q_pos >= ki * block + k_iota)
+            keep = jnp.logical_and(keep, _causal_keep(qi, ki, block))
         parts.append(jnp.where(keep, s, NEG_INF))
     return jnp.concatenate(parts, axis=-1) if len(parts) > 1 else parts[0]
 
@@ -315,10 +312,7 @@ def _attn_dkdv_kernel(rows_ref, cols_ref, valid_ref, q_refs, k_ref, v_ref,
             s = s + bias_refs[j][...]
         keep = valid_ref[h, p * pack + j] > 0
         if causal:
-            k_pos = ki * block + jax.lax.broadcasted_iota(
-                jnp.int32, (block, block), 1)
-            q_iota = jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
-            keep = jnp.logical_and(keep, qi * block + q_iota >= k_pos)
+            keep = jnp.logical_and(keep, _causal_keep(qi, ki, block))
         s = jnp.where(keep, s, NEG_INF)
         p_ = jnp.where(lse_blk <= NEG_INF, 0.0, jnp.exp(s - lse_blk))
         dv_acc = dv_acc + jax.lax.dot_general(
@@ -344,6 +338,211 @@ def _attn_dkdv_kernel(rows_ref, cols_ref, valid_ref, q_refs, k_ref, v_ref,
 # faster than 4 at seq 16k (fixed 72.7 vs 76.8 ms, bigbird 31.2 vs
 # 36.6 — tests/perf/probe_pack8) with ~1 MB of streamed VMEM tiles
 DEFAULT_PACK_WIDTH = 1024
+# the packed-heads kernels stream (block, H*d) tiles (all heads per
+# step), so their VMEM budget caps the pack lower; 512 tokens' worth
+# (pack 4 at block 128) keeps k+v streams ~4 MB double-buffered at
+# H*d = 1024
+DEFAULT_PACK_WIDTH_PACKED = 512
+
+
+def _causal_keep(qi, ki, block):
+    q_pos = qi * block + jax.lax.broadcasted_iota(
+        jnp.int32, (block, block), 0)
+    k_pos = ki * block + jax.lax.broadcasted_iota(
+        jnp.int32, (block, block), 1)
+    return q_pos >= k_pos
+
+
+def _attn_fwd_kernel_pk(rows_ref, cols_ref, valid_ref, q_ref, k_refs,
+                        v_refs, kpm_refs, bias_refs, o_ref, lse_ref, acc_s,
+                        m_s, l_s, *, sm_scale, block, causal, has_kpm,
+                        has_bias, npairs, num_heads, d_head):
+    """PACKED-HEADS forward for SHARED layouts: operands are (block, H*d)
+    slabs (every head's slice of the q row / k group), grid
+    (batch, group). One step runs the whole head loop — H x pack score
+    tiles of MXU work against ONE step's pipeline overhead (DMA issue,
+    scalar reads, state update), which is what the per-head grid lacked:
+    at (b=2, h=16, block=128) its per-step dot was a single (128, 128)
+    tile and the kernel ran at ~1/5 of the dense kernel's per-block
+    throughput (round-3 VERDICT). Mirrors the dense streaming kernel's
+    state layout: acc (block, H*d), m/l (block, H) scratch."""
+    p = pl.program_id(1)
+    pack = len(k_refs)
+    qi = rows_ref[0, p]
+    first, last = _run_bounds(rows_ref, 0, p, npairs)
+
+    @pl.when(first)
+    def _init():
+        acc_s[:] = jnp.zeros_like(acc_s)
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+
+    # per-slot masks are head-independent: compute once, reuse per head
+    keeps = []
+    for j in range(pack):
+        keep = valid_ref[0, p * pack + j] > 0
+        if causal:
+            keep = jnp.logical_and(
+                keep, _causal_keep(qi, cols_ref[0, p * pack + j], block))
+        keeps.append(keep)
+
+    q_all = q_ref[0]
+    for hi in range(num_heads):
+        sl = slice(hi * d_head, (hi + 1) * d_head)
+        parts = []
+        for j, k_ref in enumerate(k_refs):
+            s = jax.lax.dot_general(
+                q_all[:, sl], k_ref[0][:, sl], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * sm_scale
+            if has_kpm:
+                s = s + kpm_refs[j][0][None, :]
+            if has_bias:
+                s = s + bias_refs[j][...]
+            parts.append(jnp.where(keeps[j], s, NEG_INF))
+        s = jnp.concatenate(parts, axis=-1) if pack > 1 else parts[0]
+        m_old = m_s[:, hi:hi + 1]
+        m_new = jnp.maximum(m_old, jnp.max(s, axis=-1, keepdims=True))
+        p_ = jnp.where(m_new <= NEG_INF, 0.0, jnp.exp(s - m_new))
+        corr = jnp.exp(m_old - m_new)
+        l_s[:, hi:hi + 1] = (l_s[:, hi:hi + 1] * corr
+                             + jnp.sum(p_, axis=-1, keepdims=True))
+        m_s[:, hi:hi + 1] = m_new
+        acc = acc_s[:, sl] * corr
+        for j, v_ref in enumerate(v_refs):
+            v_blk = v_ref[0][:, sl]
+            acc = acc + jax.lax.dot_general(
+                p_[:, j * block:(j + 1) * block].astype(v_blk.dtype),
+                v_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        acc_s[:, sl] = acc
+
+    @pl.when(last)
+    def _flush():
+        l = l_s[:]                                          # (block, H)
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        outs = [acc_s[:, hi * d_head:(hi + 1) * d_head]
+                / l_safe[:, hi:hi + 1] for hi in range(num_heads)]
+        o_ref[0] = jnp.concatenate(outs, axis=1).astype(o_ref.dtype)
+        lse_ref[0] = jnp.where(l == 0.0, NEG_INF,
+                               m_s[:] + jnp.log(l_safe))
+
+
+def _attn_dq_kernel_pk(rows_ref, cols_ref, valid_ref, q_ref, k_refs,
+                       v_refs, kpm_refs, bias_refs, do_ref, lse_ref,
+                       delta_ref, dq_ref, dq_s, *, sm_scale, block, causal,
+                       has_kpm, has_bias, npairs, num_heads, d_head):
+    p = pl.program_id(1)
+    pack = len(k_refs)
+    qi = rows_ref[0, p]
+    first, last = _run_bounds(rows_ref, 0, p, npairs)
+
+    @pl.when(first)
+    def _init():
+        dq_s[:] = jnp.zeros_like(dq_s)
+
+    keeps = []
+    for j in range(pack):
+        keep = valid_ref[0, p * pack + j] > 0
+        if causal:
+            keep = jnp.logical_and(
+                keep, _causal_keep(qi, cols_ref[0, p * pack + j], block))
+        keeps.append(keep)
+
+    q_all = q_ref[0]
+    do_all = do_ref[0]
+    for hi in range(num_heads):
+        sl = slice(hi * d_head, (hi + 1) * d_head)
+        lse_h = lse_ref[0][:, hi:hi + 1]
+        delta_h = delta_ref[0][:, hi:hi + 1]
+        dq_acc = dq_s[:, sl]
+        for j, (k_ref, v_ref) in enumerate(zip(k_refs, v_refs)):
+            k_blk = k_ref[0][:, sl]
+            s = jax.lax.dot_general(
+                q_all[:, sl], k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * sm_scale
+            if has_kpm:
+                s = s + kpm_refs[j][0][None, :]
+            if has_bias:
+                s = s + bias_refs[j][...]
+            s = jnp.where(keeps[j], s, NEG_INF)
+            p_ = jnp.where(lse_h <= NEG_INF, 0.0, jnp.exp(s - lse_h))
+            dp = jax.lax.dot_general(
+                do_all[:, sl], v_ref[0][:, sl], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = (p_ * (dp - delta_h) * sm_scale).astype(k_blk.dtype)
+            dq_acc = dq_acc + jax.lax.dot_general(
+                ds, k_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        dq_s[:, sl] = dq_acc
+
+    @pl.when(last)
+    def _flush():
+        dq_ref[0] = dq_s[:].astype(dq_ref.dtype)
+
+
+def _attn_dkdv_kernel_pk(rows_ref, cols_ref, valid_ref, q_refs, k_ref,
+                         v_ref, kpm_ref, bias_refs, do_refs, lse_refs,
+                         delta_refs, dk_ref, dv_ref, dk_s, dv_s, *,
+                         sm_scale, block, causal, has_kpm, has_bias,
+                         npairs, num_heads, d_head):
+    """Transposed walk, packed heads: k/v (block, H*d) anchored per
+    k-block run; q/do (block, H*d) and lse/delta (block, H) streamed."""
+    p = pl.program_id(1)
+    pack = len(q_refs)
+    ki = rows_ref[0, p]
+    first, last = _run_bounds(rows_ref, 0, p, npairs)
+
+    @pl.when(first)
+    def _init():
+        dk_s[:] = jnp.zeros_like(dk_s)
+        dv_s[:] = jnp.zeros_like(dv_s)
+
+    keeps = []
+    for j in range(pack):
+        keep = valid_ref[0, p * pack + j] > 0
+        if causal:
+            # transposed: rows are k-blocks, cols are q-blocks
+            keep = jnp.logical_and(
+                keep, _causal_keep(cols_ref[0, p * pack + j], ki, block))
+        keeps.append(keep)
+
+    for hi in range(num_heads):
+        sl = slice(hi * d_head, (hi + 1) * d_head)
+        k_blk = k_ref[0][:, sl]
+        v_blk = v_ref[0][:, sl]
+        dk_acc = dk_s[:, sl]
+        dv_acc = dv_s[:, sl]
+        for j, q_ref in enumerate(q_refs):
+            q_blk = q_ref[0][:, sl]
+            do_blk = do_refs[j][0][:, sl]
+            lse_h = lse_refs[j][0][:, hi:hi + 1]
+            delta_h = delta_refs[j][0][:, hi:hi + 1]
+            s = jax.lax.dot_general(
+                q_blk, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * sm_scale
+            if has_kpm:
+                s = s + kpm_ref[0][None, :]
+            if has_bias:
+                s = s + bias_refs[j][...]
+            s = jnp.where(keeps[j], s, NEG_INF)
+            p_ = jnp.where(lse_h <= NEG_INF, 0.0, jnp.exp(s - lse_h))
+            dv_acc = dv_acc + jax.lax.dot_general(
+                p_.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(
+                do_blk, v_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = (p_ * (dp - delta_h) * sm_scale).astype(q_blk.dtype)
+            dk_acc = dk_acc + jax.lax.dot_general(
+                ds, q_blk, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        dk_s[:, sl] = dk_acc
+        dv_s[:, sl] = dv_acc
+
+    @pl.when(last)
+    def _flush():
+        dk_ref[0] = dk_s[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[:].astype(dv_ref.dtype)
 
 
 def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
@@ -390,6 +589,183 @@ def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
     valid_f = valid_f.reshape(valid_f.shape[0], -1)
     cols_b = cols_b.reshape(cols_b.shape[0], -1)
     valid_b = valid_b.reshape(valid_b.shape[0], -1)
+
+    # PACKED-HEADS path (shared layouts, the default for fixed/window/
+    # bigbird): operands packed (b, s, H*d) and all heads processed per
+    # grid step — H x pack score tiles of MXU work per step instead of
+    # one, which is where the per-head grid lost ~5x per-block
+    # throughput to dense flash (round-3 VERDICT #4). Its streams carry
+    # the full packed width, so it groups at a lower pack.
+    import os as _os
+    packed_enabled = shared and _os.environ.get(
+        "DS_SPARSE_PACKED", "1") != "0"
+    if packed_enabled:
+        pack_pk = max(1, min(DEFAULT_PACK_WIDTH_PACKED // block, nb))
+        rows_fp, cols_fp, valid_fp = build_group_index(idx_layout, pack_pk)
+        rows_bp, cols_bp, valid_bp = build_group_index(
+            idx_layout.transpose(0, 2, 1), pack_pk)
+        np_fp = int(rows_fp.shape[-1])
+        np_bp = int(rows_bp.shape[-1])
+        cols_fp = cols_fp.reshape(1, -1)
+        valid_fp = valid_fp.reshape(1, -1)
+        cols_bp = cols_bp.reshape(1, -1)
+        valid_bp = valid_bp.reshape(1, -1)
+
+    def _specs_pk(hd):
+        """Grid (batch, group); anchors follow the group row, streams the
+        j-th group column — same residency story as _specs, but every
+        tile carries ALL heads ((block, H*d) / (block, H))."""
+        anchor = pl.BlockSpec(
+            (1, block, hd), lambda b, p, rw, cl, va: (b, rw[0, p], 0))
+        anchor_h = pl.BlockSpec(
+            (1, block, heads), lambda b, p, rw, cl, va: (b, rw[0, p], 0))
+        kpm_anchor = pl.BlockSpec(
+            (1, block), lambda b, p, rw, cl, va: (b, rw[0, p]))
+
+        def stream(j):
+            return pl.BlockSpec(
+                (1, block, hd),
+                lambda b, p, rw, cl, va: (b, cl[0, p * pack_pk + j], 0))
+
+        def stream_h(j):
+            return pl.BlockSpec(
+                (1, block, heads),
+                lambda b, p, rw, cl, va: (b, cl[0, p * pack_pk + j], 0))
+
+        def kpm_stream(j):
+            return pl.BlockSpec(
+                (1, block),
+                lambda b, p, rw, cl, va: (b, cl[0, p * pack_pk + j]))
+
+        def bias_fwd(j):
+            return pl.BlockSpec(
+                (block, block),
+                lambda b, p, rw, cl, va: (rw[0, p],
+                                          cl[0, p * pack_pk + j]))
+
+        def bias_bwd(j):
+            return pl.BlockSpec(
+                (block, block),
+                lambda b, p, rw, cl, va: (cl[0, p * pack_pk + j],
+                                          rw[0, p]))
+
+        return (anchor, anchor_h, kpm_anchor, stream, stream_h,
+                kpm_stream, bias_fwd, bias_bwd)
+
+    def _to_packed(t):
+        b, h, s, d = t.shape
+        return t.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+
+    def _from_packed(t, h):
+        b, s, hd = t.shape
+        return t.reshape(b, s, h, hd // h).transpose(0, 2, 1, 3)
+
+    def _fwd_pk(q, k, v, kpm, bias):
+        batch, h, s, d = q.shape
+        assert h == heads and s == seq, (q.shape, layout.shape, block)
+        hd = h * d
+        scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
+        (anchor, anchor_h, _, stream, _, kpm_stream, bias_fwd,
+         _) = _specs_pk(hd)
+        js = range(pack_pk)
+        in_specs = [anchor] \
+            + [stream(j) for j in js] + [stream(j) for j in js] \
+            + ([kpm_stream(j) for j in js] if has_kpm else []) \
+            + ([bias_fwd(j) for j in js] if has_bias else [])
+        qp, kp, vp = _to_packed(q), _to_packed(k), _to_packed(v)
+        ops = [qp] + [kp] * pack_pk + [vp] * pack_pk \
+            + [m for m in _mask_ops(kpm, bias) for _ in js]
+        kernel = functools.partial(
+            _row_walk_shim, _attn_fwd_kernel_pk, has_kpm, has_bias,
+            pack_pk, sm_scale=scale, block=block, causal=causal,
+            npairs=np_fp, num_heads=h, d_head=d)
+        out, lse = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=3,
+                grid=(batch, np_fp),
+                in_specs=in_specs,
+                out_specs=(anchor, anchor_h),
+                scratch_shapes=[pltpu.VMEM((block, hd), jnp.float32),
+                                pltpu.VMEM((block, heads), jnp.float32),
+                                pltpu.VMEM((block, heads), jnp.float32)]),
+            out_shape=(jax.ShapeDtypeStruct((batch, s, hd), q.dtype),
+                       jax.ShapeDtypeStruct((batch, s, heads),
+                                            jnp.float32)),
+            interpret=interpret,
+        )(jnp.asarray(rows_fp), jnp.asarray(cols_fp),
+          jnp.asarray(valid_fp), *ops)
+        return _from_packed(out, h), lse
+
+    def _bwd_pk(q, k, v, kpm, bias, out, lse, do):
+        batch, h, s, d = q.shape
+        assert h == heads and s == seq, (q.shape, layout.shape, block)
+        hd = h * d
+        scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
+        # delta per head: (b, s, H) f32; lse already (b, s, H)
+        delta = _to_packed(
+            jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)).astype(jnp.float32)
+        (anchor, anchor_h, kpm_anchor, stream, stream_h, kpm_stream,
+         bias_fwd, bias_bwd) = _specs_pk(hd)
+        js = range(pack_pk)
+        qp, kp, vp, dop = (_to_packed(q), _to_packed(k), _to_packed(v),
+                           _to_packed(do))
+
+        mask_specs = ([kpm_stream(j) for j in js] if has_kpm else []) + \
+                     ([bias_fwd(j) for j in js] if has_bias else [])
+        mask_ops = [m for m in _mask_ops(kpm, bias) for _ in js]
+        dq_kernel = functools.partial(
+            _row_walk_shim, _attn_dq_kernel_pk, has_kpm, has_bias,
+            pack_pk, sm_scale=scale, block=block, causal=causal,
+            npairs=np_fp, num_heads=h, d_head=d)
+        dq = pl.pallas_call(
+            dq_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=3,
+                grid=(batch, np_fp),
+                in_specs=[anchor] + [stream(j) for j in js]
+                         + [stream(j) for j in js] + mask_specs
+                         + [anchor, anchor_h, anchor_h],
+                out_specs=anchor,
+                scratch_shapes=[pltpu.VMEM((block, hd), jnp.float32)]),
+            out_shape=jax.ShapeDtypeStruct((batch, s, hd), q.dtype),
+            interpret=interpret,
+        )(jnp.asarray(rows_fp), jnp.asarray(cols_fp),
+          jnp.asarray(valid_fp), qp, *([kp] * pack_pk), *([vp] * pack_pk),
+          *mask_ops, dop, lse, delta)
+
+        mask_specs_t = ([kpm_anchor] if has_kpm else []) + \
+                       ([bias_bwd(j) for j in js] if has_bias else [])
+        mask_ops_t = ([jnp.asarray(kpm, jnp.float32)] if has_kpm
+                      else []) \
+            + ([jnp.asarray(bias, jnp.float32)] * pack_pk
+               if has_bias else [])
+        dkdv_kernel = functools.partial(
+            _dkdv_shim, has_kpm, has_bias, pack_pk,
+            sm_scale=scale, block=block, causal=causal, npairs=np_bp,
+            num_heads=h, d_head=d,
+            kernel=_attn_dkdv_kernel_pk)
+        dk, dv = pl.pallas_call(
+            dkdv_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=3,
+                grid=(batch, np_bp),
+                in_specs=[stream(j) for j in js] + [anchor, anchor]
+                         + mask_specs_t + [stream(j) for j in js]
+                         + [stream_h(j) for j in js]
+                         + [stream_h(j) for j in js],
+                out_specs=(anchor, anchor),
+                scratch_shapes=[pltpu.VMEM((block, hd), jnp.float32),
+                                pltpu.VMEM((block, hd), jnp.float32)]),
+            out_shape=(jax.ShapeDtypeStruct((batch, s, hd), k.dtype),
+                       jax.ShapeDtypeStruct((batch, s, hd), v.dtype)),
+            interpret=interpret,
+        )(jnp.asarray(rows_bp), jnp.asarray(cols_bp),
+          jnp.asarray(valid_bp), *([qp] * pack_pk), kp, vp, *mask_ops_t,
+          *([dop] * pack_pk), *([lse] * pack_pk), *([delta] * pack_pk))
+        return (_from_packed(dq, h), _from_packed(dk, h),
+                _from_packed(dv, h))
 
     def _specs(batch_d):
         """Grid (batch, head, group). ``anchor`` blocks follow the
@@ -544,18 +920,27 @@ def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
           *([lse] * pack), *([delta] * pack))
         return dq, dk, dv
 
+    def _use_packed(d):
+        # packed-heads needs the lane dim (H*d) 128-aligned; the lse
+        # residual layout differs per path, so fwd and bwd dispatch on
+        # the same (deterministic) predicate
+        return packed_enabled and (heads * d) % 128 == 0
+
     @jax.custom_vjp
     def attn(q, k, v, kpm=None, bias=None):
-        out, _ = _fwd(q, k, v, kpm, bias)
+        fwd = _fwd_pk if _use_packed(q.shape[-1]) else _fwd
+        out, _ = fwd(q, k, v, kpm, bias)
         return out
 
     def fwd_rule(q, k, v, kpm=None, bias=None):
-        out, lse = _fwd(q, k, v, kpm, bias)
+        fwd = _fwd_pk if _use_packed(q.shape[-1]) else _fwd
+        out, lse = fwd(q, k, v, kpm, bias)
         return out, (q, k, v, kpm, bias, out, lse)
 
     def bwd_rule(res, do):
         q, k, v, kpm, bias, out, lse = res
-        dq, dk, dv = _bwd(q, k, v, kpm, bias, out, lse, do)
+        bwd = _bwd_pk if _use_packed(q.shape[-1]) else _bwd
+        dq, dk, dv = bwd(q, k, v, kpm, bias, out, lse, do)
         dkpm = jnp.zeros_like(kpm) if kpm is not None else None
         dbias = jnp.zeros_like(bias) if bias is not None else None
         return dq, dk, dv, dkpm, dbias
@@ -585,7 +970,7 @@ def _row_walk_shim(kernel, has_kpm, has_bias, pack, rows_ref, cols_ref,
 
 
 def _dkdv_shim(has_kpm, has_bias, pack, rows_ref, cols_ref, valid_ref,
-               *refs, **params):
+               *refs, kernel=None, **params):
     refs = list(refs)
     q_refs, rest = _take(refs, pack)
     k_ref, v_ref = rest[:2]
@@ -595,6 +980,7 @@ def _dkdv_shim(has_kpm, has_bias, pack, rows_ref, cols_ref, valid_ref,
     do_refs, rest = _take(rest, pack)
     lse_refs, rest = _take(rest, pack)
     delta_refs, rest = _take(rest, pack)
-    _attn_dkdv_kernel(rows_ref, cols_ref, valid_ref, q_refs, k_ref, v_ref,
-                      kpm_ref, bias_refs, do_refs, lse_refs, delta_refs,
-                      *rest, has_kpm=has_kpm, has_bias=has_bias, **params)
+    kernel = kernel or _attn_dkdv_kernel
+    kernel(rows_ref, cols_ref, valid_ref, q_refs, k_ref, v_ref,
+           kpm_ref, bias_refs, do_refs, lse_refs, delta_refs,
+           *rest, has_kpm=has_kpm, has_bias=has_bias, **params)

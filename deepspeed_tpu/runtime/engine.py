@@ -763,12 +763,20 @@ class DeepSpeedEngine:
 
         Overflow/grad-norm are global jitted reductions so every process
         agrees without owning every gradient."""
+        import time as _time
         hyper = self._hyper()
         scaler = self.state["scaler"]
         cur_scale = float(scaler.cur_scale)
         inv_scale = 1.0 / cur_scale
         clip = self.gradient_clipping()
 
+        # per-phase wall clocks (cheap; read via offload_phase_times).
+        # "micros_and_check" includes waiting for the jitted micro steps
+        # to finish — the check's value fetch is the first sync point.
+        phases = {"micros_and_check_s": 0.0, "d2h_wait_s": 0.0,
+                  "host_adam_s": 0.0, "h2d_reshard_s": 0.0}
+        self.offload_phase_times = phases
+        t_phase = _time.time()
         check = self._get_jit("offload_check", self._offload_check_fn)
         finite, sumsq = check(self.state["acc_grads"],
                               np.float32(inv_scale))
@@ -802,6 +810,7 @@ class DeepSpeedEngine:
         # a sumsq that overflowed despite finite elements is an overflow
         # too: clipping against an inf norm would silently zero the update
         overflow = (not bool(finite)) or not np.isfinite(float(sumsq))
+        phases["micros_and_check_s"] = _time.time() - t_phase
 
         grad_norm = 0.0
         if not overflow:
@@ -862,8 +871,17 @@ class DeepSpeedEngine:
                     pass
                 raise
             hs.pop("torn_step", None)
+            t_phase = _time.time()
             self._finish_offload_step(flat_params, acc_specs,
                                       acc_shardings, hs)
+            if os.environ.get("DS_OFFLOAD_PROFILE"):
+                # force the uploads/reshard to COMPLETE so the phase
+                # clock captures the H2D wait (serializes the tail —
+                # profiling only; block_until_ready is a no-op through
+                # the axon tunnel, only a value fetch syncs)
+                leaf = jax.tree_util.tree_leaves(self.state["params"])[0]
+                float(jnp.asarray(leaf).ravel()[0])
+            phases["h2d_reshard_s"] = _time.time() - t_phase
         else:
             self.state["acc_grads"] = jax.tree_util.tree_map(
                 jnp.zeros_like, self.state["acc_grads"])
@@ -875,11 +893,16 @@ class DeepSpeedEngine:
                              left_in_leaf, fetch, coef, hyper, bc1, bc2,
                              adam_w, lib, acc_specs, acc_shardings, hs):
         """The shard-pipelined host Adam (see _host_apply_step)."""
+        import time as _time
+        phases = getattr(self, "offload_phase_times", {})
         beta1, beta2 = hyper["beta1"], hyper["beta2"]
         pool = self._offload_fetch_pool()
         nxt = pool.submit(fetch, work[0]) if work else None
         for j, item in enumerate(work):
+                t0 = _time.time()
                 g = nxt.result()
+                phases["d2h_wait_s"] = phases.get("d2h_wait_s", 0.0) \
+                    + (_time.time() - t0)
                 nxt = pool.submit(fetch, work[j + 1]) \
                     if j + 1 < len(work) else None
                 # top the bounded D2H window up one shard ahead
@@ -889,6 +912,7 @@ class DeepSpeedEngine:
                         work[j + self._D2H_WINDOW][2].copy_to_host_async()
                     except Exception:  # noqa: BLE001
                         self._async_d2h = False
+                t0 = _time.time()
                 g *= coef  # unscale (+clip) in place on the host copy
                 i, (idx, p, m, v), _ = item
                 if lib is not None:
@@ -910,6 +934,8 @@ class DeepSpeedEngine:
                     if adam_w:
                         update += hyper["weight_decay"] * p
                     p -= hyper["lr"] * update
+                phases["host_adam_s"] = phases.get("host_adam_s", 0.0) \
+                    + (_time.time() - t0)
                 # stage 3: the moment a leaf's last shard steps, launch its
                 # H2D — uploads overlap the remaining leaves' Adam; drop
                 # the consumed grad references so their buffers free

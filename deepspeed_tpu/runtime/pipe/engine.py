@@ -391,12 +391,42 @@ class PipelineEngine(DeepSpeedEngine):
                 zeros_other = jax.tree_util.tree_map(
                     lambda p: jnp.zeros(p.shape, p.dtype), params_all)
 
+                # The W-slot ring ("stash") holds what the backward phase
+                # needs per in-flight microbatch. Default: the stage
+                # INPUT (the backward re-runs the stage forward under
+                # jax.vjp — full remat). save_stage_residuals instead
+                # stashes the forward phase's vjp PULLBACK leaves plus
+                # the stage output (for the last stage's loss seed): no
+                # recompute (3F executed, the no-remat floor) at W
+                # buffered copies of interiors + params.
+                save_res = getattr(module, "save_residuals", False)
+                if save_res:
+                    chunk0 = jax.tree_util.tree_map(
+                        lambda t: t[0], local_body)
+                    y_s, vjp_s = jax.eval_shape(
+                        lambda bp, xv: jax.vjp(
+                            lambda b, x2: stage_fwd(b, x2, jnp.int32(0),
+                                                    jnp.int32(0)),
+                            bp, xv),
+                        chunk0, zeros_x)
+                    res_leaves_s, res_treedef = \
+                        jax.tree_util.tree_flatten(vjp_s)
+                    stash0 = (
+                        tuple(jnp.zeros((v, W) + l.shape, l.dtype)
+                              for l in res_leaves_s),
+                        jax.tree_util.tree_map(
+                            lambda sd: jnp.zeros((v, W) + sd.shape,
+                                                 sd.dtype), y_s),
+                    )
+                else:
+                    stash0 = jax.tree_util.tree_map(
+                        lambda z: jnp.zeros((v, W) + z.shape, z.dtype),
+                        zeros_x)
+
                 carry0 = (
                     zeros_x,                                   # recv_f
                     zeros_x,                                   # recv_b
-                    jax.tree_util.tree_map(
-                        lambda z: jnp.zeros((v, W) + z.shape, z.dtype),
-                        zeros_x),                              # x_buf
+                    stash0,                                    # stash
                     jax.tree_util.tree_map(
                         lambda p: jnp.zeros(p.shape, jnp.float32),
                         local_body),                           # body_g
@@ -429,7 +459,22 @@ class PipelineEngine(DeepSpeedEngine):
                     return jax.lax.dynamic_update_index_in_dim(
                         buf, inner, c, axis=0)
 
-                def fwd_phase(k, recv_f, x_buf):
+                def stash_put(stash, c, slot, valid, y, vjp_fn):
+                    def put(buf, val):
+                        return buf_set(buf, c, slot,
+                                       jnp.where(valid, val,
+                                                 buf_get(buf, c, slot)))
+                    if save_res:
+                        res_bufs, y_buf = stash
+                        leaves = jax.tree_util.tree_flatten(vjp_fn)[0]
+                        res_bufs = tuple(
+                            put(buf, leaf)
+                            for buf, leaf in zip(res_bufs, leaves))
+                        y_buf = jax.tree_util.tree_map(put, y_buf, y)
+                        return (res_bufs, y_buf)
+                    return None  # x-input mode handled inline
+
+                def fwd_phase(k, recv_f, stash):
                     m_f = fm_row[k]
                     v_f = m_f >= 0
                     mf = jnp.clip(m_f, 0, M - 1)
@@ -438,31 +483,46 @@ class PipelineEngine(DeepSpeedEngine):
                         jnp.logical_and(is_first, cf == 0),
                         lambda: embed_of(params_all, inputs, mf),
                         lambda: recv_f)
-                    y = stage_fwd(pick_chunk(cf), x, mf, cf)
                     slot_f = jnp.mod(mf, W)
-                    x_buf = jax.tree_util.tree_map(
-                        lambda buf, xv: buf_set(
-                            buf, cf, slot_f,
-                            jnp.where(v_f, xv,
-                                      buf_get(buf, cf, slot_f))),
-                        x_buf, x)
+                    if save_res:
+                        y, vjp_fn = jax.vjp(
+                            lambda bp, xv: stage_fwd(bp, xv, mf, cf),
+                            pick_chunk(cf), x)
+                        stash = stash_put(stash, cf, slot_f, v_f, y,
+                                          vjp_fn)
+                    else:
+                        y = stage_fwd(pick_chunk(cf), x, mf, cf)
+                        stash = jax.tree_util.tree_map(
+                            lambda buf, xv: buf_set(
+                                buf, cf, slot_f,
+                                jnp.where(v_f, xv,
+                                          buf_get(buf, cf, slot_f))),
+                            stash, x)
                     send_f = (p2p.send_forward_wrap if v > 1
                               else p2p.send_forward)
                     recv_f_next = send_f(y, num_stages, PIPE_AXIS)
-                    return recv_f_next, x_buf
+                    return recv_f_next, stash
 
-                def bwd_core(k, recv_b, x_buf, body_g, other_g, loss_sum):
+                def bwd_core(k, recv_b, stash, body_g, other_g, loss_sum):
                     m_b = bm_row[k]
                     v_b = m_b >= 0
                     mb = jnp.clip(m_b, 0, M - 1)
                     cb = jnp.clip(bc_row[k], 0, v - 1)
                     slot_b = jnp.mod(mb, W)
-                    x_saved = jax.tree_util.tree_map(
-                        lambda buf: buf_get(buf, cb, slot_b), x_buf)
-                    chunk_params = pick_chunk(cb)
-                    y_b, stage_vjp = jax.vjp(
-                        lambda bp, xv: stage_fwd(bp, xv, mb, cb),
-                        chunk_params, x_saved)
+                    if save_res:
+                        res_bufs, y_buf = stash
+                        stage_vjp = jax.tree_util.tree_unflatten(
+                            res_treedef,
+                            [buf_get(buf, cb, slot_b) for buf in res_bufs])
+                        y_b = jax.tree_util.tree_map(
+                            lambda buf: buf_get(buf, cb, slot_b), y_buf)
+                    else:
+                        x_saved = jax.tree_util.tree_map(
+                            lambda buf: buf_get(buf, cb, slot_b), stash)
+                        chunk_params = pick_chunk(cb)
+                        y_b, stage_vjp = jax.vjp(
+                            lambda bp, xv: stage_fwd(bp, xv, mb, cb),
+                            chunk_params, x_saved)
 
                     def seed_from_loss():
                         loss_m, head_vjp = jax.vjp(

@@ -98,7 +98,7 @@ class PipelineModule:
     def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
                  seed_layers=False, base_seed=1234, partition_method="parameters",
                  activation_checkpoint_interval=0, num_dp=None, num_mp=None,
-                 num_virtual_stages=1):
+                 num_virtual_stages=1, save_stage_residuals=False):
         self.loss_fn = loss_fn
         self.partition_method = partition_method
         self.activation_checkpoint_interval = activation_checkpoint_interval
@@ -110,6 +110,13 @@ class PipelineModule:
         # (S-1)/(vM) — see schedule.interleaved_train_schedule_tables.
         assert num_virtual_stages >= 1
         self.num_virtual = int(num_virtual_stages)
+        # Opt-in no-recompute backward: the executor buffers each forward
+        # phase's vjp residuals in the W-slot ring instead of re-running
+        # the stage forward in the backward phase — executed flops drop
+        # to the no-remat 3F floor, at W in-flight copies of the stage's
+        # interior residuals AND params. Only for stages that fit HBM
+        # (tests/perf/PP_REMAT_TAX.json quantifies the tradeoff).
+        self.save_residuals = bool(save_stage_residuals)
 
         if topology is None:
             assert num_stages is not None, \

@@ -1,0 +1,225 @@
+"""Quantify the pipeline executor's recompute (remat) tax vs the DP step.
+
+The 1F1B executor's backward re-runs each stage forward from a saved
+input inside ``jax.vjp`` (full remat by design — the W-slot input buffer
+is what keeps per-stage activation memory flat in micro_batches). Per
+stage per microbatch, with model flops F = fwd(1F) + bwd(2F):
+
+  mode                                   executed   tax vs model (3F)
+  DP engine, remat=False                 3F         1.00x
+  DP engine, per-block remat             4F         1.33x
+  PP, activation_checkpoint_interval=0   4F         1.33x  (vjp saves
+                                                    the stage interior
+                                                    for the ACTIVE
+                                                    microbatch only)
+  PP, interval>=1 (per-block ckpt)       5F         1.67x  (NESTED
+                                                    remat: the vjp
+                                                    forward re-runs the
+                                                    stage AND its
+                                                    backward recomputes
+                                                    block interiors)
+  PP, save_stage_residuals=True          3F         1.00x  (fwd-phase
+                                                    vjp residuals
+                                                    buffered in the
+                                                    W-slot ring)
+
+This measures wall time per optimizer step for each mode at an equal
+model/batch on the 8-device CPU mesh (compute-dominated shape so time
+tracks executed flops) and writes tests/perf/PP_REMAT_TAX.json with the
+measured ratios against the analytic ones.
+
+    JAX_PLATFORMS=cpu python tests/perf/pp_remat_tax.py
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import numpy as np  # noqa: E402
+
+
+def timed_steps(run_step, reps=3, warmup=1):
+    for _ in range(warmup):
+        run_step()
+    t0 = time.time()
+    for _ in range(reps):
+        run_step()
+    return (time.time() - t0) / reps * 1e3
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import deepspeed_tpu as deepspeed
+    from deepspeed_tpu.models import gpt2, gpt2_pipe
+
+    D, L, SEQ, HEADS = 128, 4, 128, 4
+    M = 8                                 # microbatches
+    MB = 2                                # per-microbatch batch
+    rng = np.random.RandomState(0)
+
+    def cfg(remat):
+        return gpt2.GPT2Config(vocab_size=1024, max_seq_len=SEQ,
+                               n_layers=L, n_heads=HEADS, d_model=D,
+                               use_flash_attention=False, remat=remat)
+
+    rows = {}
+
+    # ---- DP baselines -------------------------------------------------
+    for name, remat in (("dp_no_remat", False), ("dp_block_remat", True)):
+        net = gpt2.make_gpt2_model(config=cfg(remat))
+        engine, _, _, _ = deepspeed.initialize(model=net, config_params={
+            "train_micro_batch_size_per_gpu": MB,
+            "gradient_accumulation_steps": M,
+            "bf16": {"enabled": True},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+            "steps_per_print": 10 ** 9})
+        ids = rng.randint(0, 1024, size=(MB * 8, SEQ)).astype(np.int32)
+
+        def run(engine=engine, ids=ids):
+            for _ in range(M):
+                loss = engine(ids, ids.copy())
+                engine.backward(loss)
+                engine.step()
+            return float(loss)
+
+        rows[name] = round(timed_steps(run), 1)
+        print(name, rows[name], flush=True)
+
+    # ---- pipeline modes ----------------------------------------------
+    def pipe_mode(name, interval, save_residuals=False):
+        net = gpt2_pipe.make_gpt2_pipeline(
+            config=cfg(False), num_stages=2, num_dp=4, num_mp=1,
+            activation_checkpoint_interval=interval,
+            save_stage_residuals=save_residuals)
+        engine, _, _, _ = deepspeed.initialize(model=net, config_params={
+            "train_micro_batch_size_per_gpu": MB,
+            "gradient_accumulation_steps": M,
+            "bf16": {"enabled": True},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+            "steps_per_print": 10 ** 9})
+        ids = rng.randint(0, 1024,
+                          size=(M, MB * 4, SEQ)).astype(np.int32)
+
+        def run(engine=engine, ids=ids):
+            return float(engine.train_batch(batch=(ids, ids.copy())))
+
+        rows[name] = round(timed_steps(run), 1)
+        print(name, rows[name], flush=True)
+
+    pipe_mode("pp_block_remat", interval=1)
+    pipe_mode("pp_stage_residuals_transient", interval=0)
+    pipe_mode("pp_saved_residuals", interval=0, save_residuals=True)
+
+    # ---- compile-counted flops (noise-free): XLA's cost_analysis of
+    # each compiled program. Loop bodies are counted ONCE (trip counts
+    # invisible), so absolute numbers are not executed flops — but the
+    # DIFFERENCES between pipeline modes isolate the backward phase's
+    # recompute exactly (same warmup/steady/drain structure, same
+    # forward). The DP grad of ONE microbatch anchors the scale. ----
+    import jax.random as jrandom
+    counted = {}
+
+    def flops_of(compiled):
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        return float(ca["flops"])
+
+    for name, remat in (("dp_grad_1micro_no_remat", False),
+                        ("dp_grad_1micro_block_remat", True)):
+        cfg_ = cfg(remat)
+        import jax.numpy as jnp
+        params = jax.tree_util.tree_map(
+            lambda p: jnp.asarray(p, jnp.bfloat16),
+            gpt2.init_params(cfg_, 0))
+        ids1 = rng.randint(0, 1024, size=(MB * 8, SEQ)).astype(np.int32)
+        grad = jax.jit(jax.grad(
+            lambda p, i: gpt2.lm_loss(p, i, i, cfg_, rng=None,
+                                      train=False)))
+        counted[name] = flops_of(grad.lower(params, ids1).compile())
+
+    def pipe_counted(name, interval, save_residuals=False):
+        net = gpt2_pipe.make_gpt2_pipeline(
+            config=cfg(False), num_stages=2, num_dp=4, num_mp=1,
+            activation_checkpoint_interval=interval,
+            save_stage_residuals=save_residuals)
+        engine, _, _, _ = deepspeed.initialize(model=net, config_params={
+            "train_micro_batch_size_per_gpu": MB,
+            "gradient_accumulation_steps": M,
+            "bf16": {"enabled": True},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+            "steps_per_print": 10 ** 9})
+        ids = rng.randint(0, 1024,
+                          size=(M, MB * 4, SEQ)).astype(np.int32)
+        batch = engine._to_device_stacked((ids, ids.copy()))
+        fn = engine._get_jit("pipe_train", engine._fused_train_fn,
+                             donate_argnums=(0,))
+        lowered = fn.lower(engine.state, batch,
+                           jrandom.PRNGKey(0), engine._hyper())
+        counted[name] = flops_of(lowered.compile())
+
+    pipe_counted("pp_block_remat", interval=1)
+    pipe_counted("pp_stage_residuals_transient", interval=0)
+    pipe_counted("pp_saved_residuals", interval=0, save_residuals=True)
+
+    base = rows["dp_no_remat"]
+    out = {
+        "config": {"d_model": D, "layers": L, "seq": SEQ,
+                   "micro_batches": M, "micro_batch": MB,
+                   "mesh": "8 virtual cpu devices",
+                   "timing": "ms per optimizer step (M microbatches)"},
+        "measured_ms": rows,
+        "measured_ratio_vs_dp_no_remat": {
+            k: round(v / base, 3) for k, v in rows.items()},
+        "compile_counted_gflops": {
+            k: round(v / 1e9, 2) for k, v in counted.items()},
+        "pp_bwd_phase_recompute_gflops": {
+            # steady+drain each contain one bwd phase (counted once per
+            # loop): block-remat minus saved-residuals = 2x the per-
+            # cycle recompute flops the nested remat pays
+            "block_vs_saved": round(
+                (counted["pp_block_remat"]
+                 - counted["pp_saved_residuals"]) / 1e9, 2),
+            "transient_vs_saved": round(
+                (counted["pp_stage_residuals_transient"]
+                 - counted["pp_saved_residuals"]) / 1e9, 2),
+        },
+        "analytic_executed_flops_ratio": {
+            "dp_no_remat": 1.0, "dp_block_remat": 4 / 3,
+            "pp_block_remat": 5 / 3,
+            "pp_stage_residuals_transient": 4 / 3,
+            "pp_saved_residuals": 1.0},
+        "notes": [
+            "CPU wall times validate the flops model only where compute "
+            "dominates (the DP-remat ratio lands near 4/3); the PP rows "
+            "are dominated by XLA:CPU's in-process collective-rendezvous "
+            "latency per cycle and the saved-residuals row additionally "
+            "by host-memory buffer RMW — use the compile-counted flops "
+            "for the recompute story and real-TPU runs for wall time",
+            "compile_counted_gflops counts each loop body ONCE (trip "
+            "counts are invisible to cost_analysis); mode DIFFERENCES "
+            "isolate the backward phase's recompute flops",
+            "guidance: pp_block_remat (interval>=1) pays 5F/3F NESTED "
+            "remat and is only right when one stage's single-microbatch "
+            "interior residuals do not fit HBM; interval=0 is the "
+            "default-sane choice (4F, DP-remat parity, transient "
+            "residuals for ONE microbatch); save_stage_residuals=True "
+            "reaches the no-remat 3F floor but buffers W in-flight "
+            "pullbacks (W copies of residuals AND stage params) — only "
+            "for small/shallow stages",
+        ],
+    }
+    path = os.path.join(os.path.dirname(__file__), "PP_REMAT_TAX.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out["measured_ratio_vs_dp_no_remat"]))
+
+
+if __name__ == "__main__":
+    main()

@@ -389,3 +389,26 @@ def test_interleaved_checkpoint_cross_layout(tmp_path):
     assert path is not None
     got = float(e1.eval_batch(batch=(x, y)))
     assert got == pytest.approx(ref, rel=1e-2, abs=1e-3)
+
+
+def test_save_stage_residuals_matches_default():
+    """save_stage_residuals=True (no-recompute backward: fwd-phase vjp
+    pullbacks buffered in the W-slot ring) trains identically to the
+    default recompute backward — with interleaving too."""
+    M = 4
+    for v in (1, 2):
+        losses = {}
+        for save in (False, True):
+            net = PipelineModule(
+                layers=[LayerSpec(TanhLinear, DIM) for _ in range(8)],
+                num_stages=2, loss_fn=mse_loss, num_dp=4,
+                num_virtual_stages=v, save_stage_residuals=save)
+            engine, _, _, _ = deepspeed.initialize(
+                model=net, config_params=pipe_config(gas=M))
+            ls = []
+            for step in range(3):
+                x, y = make_batches(M, 16, seed=step)
+                ls.append(float(engine.train_batch(batch=(x, y))))
+            losses[save] = ls
+        for a, b in zip(losses[False], losses[True]):
+            assert b == pytest.approx(a, rel=2e-2, abs=2e-3), v

@@ -196,12 +196,19 @@ def main():
             "pp_stage_residuals_transient": 4 / 3,
             "pp_saved_residuals": 1.0},
         "notes": [
-            "CPU wall times validate the flops model only where compute "
-            "dominates (the DP-remat ratio lands near 4/3); the PP rows "
-            "are dominated by XLA:CPU's in-process collective-rendezvous "
-            "latency per cycle and the saved-residuals row additionally "
-            "by host-memory buffer RMW — use the compile-counted flops "
-            "for the recompute story and real-TPU runs for wall time",
+            "idle-host CPU wall times validate the flops model where "
+            "compute dominates: dp_block_remat/dp_no_remat = 1.196 vs "
+            "the compile-counted 1.206. The PP rows measure the OTHER "
+            "side of the tradeoff: lower-recompute modes buy their "
+            "flop savings with W-slot buffer traffic (transient mode "
+            "writes full stage interiors per vjp; saved-residuals "
+            "RMWs W pullback copies per cycle), and at this small "
+            "shape that memory traffic outweighs the saved flops — "
+            "the ranking INVERTS (block 1.81x < transient 1.99x < "
+            "saved 2.60x). Pick a mode by which resource binds: "
+            "recompute-heavy (interval>=1) when HBM-limited, "
+            "save_stage_residuals only when the stage's residuals are "
+            "small relative to its compute",
             "compile_counted_gflops counts each loop body ONCE (trip "
             "counts are invisible to cost_analysis); mode DIFFERENCES "
             "isolate the backward phase's recompute flops",

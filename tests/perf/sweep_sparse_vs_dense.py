@@ -89,7 +89,7 @@ def main():
                         "useful (unmasked) work",
     }, "rows": []}
 
-    for seq in (2048, 4096, 8192, 16384, 32768):
+    for seq in (2048, 4096, 8192, 16384, 32768, 65536):
         rng = np.random.RandomState(0)
         x = jnp.asarray(rng.randn(BATCH, seq, HEADS, DHEAD) * 0.1,
                         jnp.bfloat16)

@@ -8,22 +8,25 @@ compiled into dense cycle->microbatch(+chunk) tables
 ``lax.fori_loop``s (warmup fwd-only / steady fwd+bwd / drain bwd-only)
 inside ``shard_map`` over the ``pipe`` mesh axis:
 
-  * each pipe rank holds its stage's stacked block params (leading stage dim
-    sharded on ``pipe``);
-  * every cycle runs a masked ForwardPass phase then a masked BackwardPass
-    phase on EVERY stage (bubble cycles are masked out) — structural
-    uniformity that one-program SPMD collectives require; see
-    schedule.UniformTrainSchedule for why the reference's staggered
-    TrainSchedule cannot execute as a single XLA program;
+  * each pipe rank holds its stage's stacked block params (leading stage
+    dim sharded on ``pipe``; with ``num_virtual_stages`` = v > 1, a
+    (S, v, Lc) stack of Megatron-interleaved chunks selected per cycle);
+  * within a loop, every cycle runs the same (maybe-masked) phases on
+    EVERY stage — structural uniformity that one-program SPMD
+    collectives require (the reference's staggered TrainSchedule cannot
+    execute as a single XLA program); uniformity does NOT bind across
+    cycles, so warmup/drain cycles omit the dead phase entirely —
+    executed bubble (S-1)/M at v=1, (S-1)/(vM) interleaved;
   * activations ride one hop per cycle with ``ppermute`` (p2p.py) and
-    gradients one hop back — the reference's SendActivation/RecvActivation
-    and SendGrad/RecvGrad instructions;
-  * the backward is hand-seeded ``jax.vjp`` per microbatch: the stage
-    forward is RECOMPUTED from a saved stage input (full remat), so the
-    only per-microbatch live state is one stage-input buffer of
-    min(2*stages - 1, micro_batches) slots — the schedule's
-    ``num_pipe_buffers`` memory bound, flat in micro_batches, which a
-    whole-loop ``jax.grad`` (residuals for every step) cannot hit;
+    gradients one hop back (wrapping S-1 <-> 0 at chunk boundaries when
+    interleaved) — the reference's SendActivation/RecvActivation and
+    SendGrad/RecvGrad instructions;
+  * the backward is hand-seeded ``jax.vjp`` per microbatch, replaying
+    the stage from the W-slot ring: by default the saved stage INPUT
+    (full remat; W from the schedule tables, flat in micro_batches —
+    a whole-loop ``jax.grad`` cannot hit that bound), or with
+    ``save_stage_residuals`` the forward phase's buffered vjp pullbacks
+    (no recompute; see docs/_tutorials/pipeline.md for the modes);
   * the embedding/head ("hoisted" pre/post layers) run replicated across
     pipe ranks inside the first/last stage's schedule branches; tied-weight
     gradients from both ends meet in the final psum over the pipe axis
@@ -727,7 +730,7 @@ class PipelineEngine(DeepSpeedEngine):
 
     # ------------------------------------------------------------ checkpoint
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
-                        save_latest=True):
+                        save_latest=True, async_save=False):
         """Engine checkpoint + per-layer body files
         (reference pipe/module.py:536-546: layer_NN-model_00-model_states.pt
         written so stages can be re-partitioned on load). Only REAL layers
@@ -741,20 +744,34 @@ class PipelineEngine(DeepSpeedEngine):
             "layers_per_stage": self.pipe_module.layers_per_stage,
             "num_virtual": getattr(self.pipe_module, "num_virtual", 1),
         }
+        tag = self._get_ckpt_tag(tag)
+        # `latest` must move only after EVERY file of the tag — including
+        # the per-layer body files written below — so the base save runs
+        # with save_latest=False and the pointer updates last (async: a
+        # save_latest_after gated on ALL futures on the serial pool).
         ok = super().save_checkpoint(save_dir, tag=tag,
                                      client_state=client_state,
-                                     save_latest=save_latest)
-        if jax.process_index() != 0:
-            return ok
-        tag = self._get_ckpt_tag(tag)
-        body = ckpt.tree_to_numpy(self.state["params"]["body"])
-        module = self.pipe_module
-        for layer_id in range(len(module.body_layers)):
-            idx = self._global_to_slot(module, layer_id)
-            layer_tree = jax.tree_util.tree_map(
-                lambda x: x[idx], body)
-            ckpt.save_state_dict(
-                ckpt.layer_ckpt_name(save_dir, tag, layer_id), layer_tree)
+                                     save_latest=False,
+                                     async_save=async_save)
+        futures = list(self._ckpt_futures)
+        if jax.process_index() == 0:
+            body = ckpt.tree_to_numpy(self.state["params"]["body"])
+            module = self.pipe_module
+            for layer_id in range(len(module.body_layers)):
+                idx = self._global_to_slot(module, layer_id)
+                layer_tree = jax.tree_util.tree_map(
+                    lambda x: x[idx], body)
+                futures.append(ckpt.save_state_dict(
+                    ckpt.layer_ckpt_name(save_dir, tag, layer_id),
+                    layer_tree,
+                    async_save=async_save and jax.process_count() == 1))
+            if save_latest:
+                if async_save and jax.process_count() == 1:
+                    futures.append(ckpt.save_latest_after(
+                        save_dir, tag, futures))
+                else:
+                    ckpt.save_latest(save_dir, tag)
+        self._ckpt_futures = [f for f in futures if f is not None]
         return ok
 
     @staticmethod

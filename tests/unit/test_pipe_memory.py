@@ -21,7 +21,7 @@ TINY = dict(vocab_size=128, max_seq_len=64, n_layers=4, n_heads=2,
             d_model=64, use_flash_attention=False, remat=False)
 
 
-def _compiled_temp_bytes(gas):
+def _compiled_temp_bytes(gas, num_virtual=1):
     cfg = {
         "train_micro_batch_size_per_gpu": 2,
         "gradient_accumulation_steps": gas,
@@ -31,7 +31,8 @@ def _compiled_temp_bytes(gas):
     }
     net = gpt2_pipe.make_gpt2_pipeline(config=gpt2.GPT2Config(**TINY),
                                        num_stages=2, num_dp=4,
-                                       activation_checkpoint_interval=0)
+                                       activation_checkpoint_interval=0,
+                                       num_virtual_stages=num_virtual)
     engine, _, _, _ = deepspeed.initialize(model=net, config_params=cfg)
     ids = np.zeros((gas, 8, 64), np.int32)
     batch = engine._to_device_stacked((ids, ids.copy()))
@@ -49,4 +50,13 @@ def test_pipeline_memory_flat_in_micro_batches():
     t16 = _compiled_temp_bytes(16)
     # 4x the microbatches must NOT grow activation memory; allow 10% slack
     # for bookkeeping (schedule tables, loop counters)
+    assert t16 <= t4 * 1.10, (t4, t16)
+
+
+def test_interleaved_pipeline_memory_flat_in_micro_batches():
+    """The interleaved executor keeps the 1F1B property too: its ring
+    holds more slots ((v, W) per chunk) but the count is M-independent,
+    so temp memory stays flat as microbatches grow."""
+    t4 = _compiled_temp_bytes(4, num_virtual=2)
+    t16 = _compiled_temp_bytes(16, num_virtual=2)
     assert t16 <= t4 * 1.10, (t4, t16)

@@ -832,16 +832,24 @@ DEFAULT_BLOCK_PACKED_K = 512
 # The single-pass FUSED backward (5 dots/pair vs the split kernels' 7)
 # carries a larger VMEM working set (k/v + dk/dv scratch + the dq RMW
 # buffer), so a single kernel call caps out at hd = 1280 (measured
-# compile limit). Wider models do NOT fall back to the split kernels:
+# compile limit). Wider models need not fall back to the split kernels:
 # attention is independent per head, so _bwd_packed slices the packed
 # width into head GROUPS of <= FUSED_GROUP_TARGET and runs the fused
 # kernel per group — gpt2-xl (25 heads x 64 = 1600) runs as two groups
 # (13 + 12 heads, widths 832/768) with the fat (256, 256) blocks the
-# <=1024 path earns. The group slices cost one extra HBM read+write of
-# q/k/v/do (~0.2 ms at the xl bench shape) against the 5-vs-7-dot win
-# over the whole block-pair walk. DS_FLASH_FUSED_BWD=0 forces the split
-# path everywhere.
-FUSED_BWD = os.environ.get("DS_FLASH_FUSED_BWD", "1") != "0"
+# <=1024 path earns.
+#
+# DEFAULT: SPLIT. The fused path's advantage is ENVIRONMENT-DEPENDENT:
+# an earlier session measured it 1.12x over split at the xl shape (and
+# round 3 measured 8.3 vs 11.1 ms at the bench shape), but the current
+# chip/runtime measures split faster at every probed width and batch
+# (hd 1024 b96: split 41.3 vs fused 44.7 ms; hd 1600 b8: 13.6 vs 15.9
+# — tests/perf/XL_BWD_COMPARE.json) — the fused kernel's explicit-wait
+# dq DMA read-modify-write is the sensitive part. Re-measure on YOUR
+# deployment with tests/perf/compare_xl_bwd.py and opt in with
+# DS_FLASH_FUSED_BWD=1 where it wins; numerics are identical either
+# way (test_fused_bwd_matches_split).
+FUSED_BWD = os.environ.get("DS_FLASH_FUSED_BWD", "0") != "0"
 FUSED_BWD_MAX_WIDTH = 1280
 FUSED_GROUP_TARGET = 1024
 

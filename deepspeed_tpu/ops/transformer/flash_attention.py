@@ -633,10 +633,96 @@ def _bwd_fused_kernel_packed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _bwd_fused_kernel_packed_resident_dq(
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref, dq_ref,
+        dk_ref, dv_ref, dk_acc, dv_acc, *, sm_scale, block_q, block_k,
+        num_q_blocks, causal, seq_len, num_heads, d_head):
+    """Single-pass packed backward with dq RESIDENT in VMEM. Same grid
+    (b, k blocks, q blocks) and 5-dots-per-pair math as the DMA variant
+    above, but dq accumulates into a whole-(s, h*d) fp32 OUTPUT block whose
+    index map ignores (ki, qi) — the standard Pallas accumulator pattern:
+    a revisited output block stays in VMEM across grid steps and is copied
+    out once, when the block index changes (here: at each batch row's last
+    step). The cross-k-walk dq accumulation therefore costs NO DMAs — the
+    DMA variant's per-step blocking read-modify-write waits (~1 MB each
+    way against only ~µs of MXU work per step) were exactly why it
+    measured 0.7-0.9x of the split pair. Feasible when s*h*d*4B fits
+    scoped VMEM next to the block operands (RESIDENT_DQ_MAX_BYTES)."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    k_base = ki * block_k
+
+    @pl.when(jnp.logical_and(ki == 0, qi == 0))
+    def _init_dq():
+        dq_ref[0] = jnp.zeros_like(dq_ref[0])
+
+    @pl.when(qi == 0)
+    def _init_kv():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    live = (qi + 1) * block_q > k_base if causal else True
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = k_base + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = k_pos < seq_len
+    if causal:
+        mask = jnp.logical_and(mask, q_pos >= k_pos)
+
+    rows = pl.ds(qi * block_q, block_q)
+    # Mosaic requires lane-dim store OFFSETS into pipeline output refs to
+    # be provably 128-aligned (scratch refs like dk_acc/dv_acc carry no
+    # such constraint), so dq updates are read-modified-written in chunks
+    # of the fewest heads whose width lands every chunk boundary on a
+    # 128 multiple — 2 heads at d_head 64, 1 (plain per-head) at >= 128.
+    # A whole-width concat instead costs an extra (block_q, hd) fp32
+    # stack temp, which re-overflows scoped VMEM at the bench shape.
+    import math
+    heads_per_chunk = 128 // math.gcd(d_head, 128) if d_head % 128 else 1
+
+    @pl.when(live)
+    def _compute():
+        for c0 in range(0, num_heads, heads_per_chunk):
+            chunk = range(c0, min(c0 + heads_per_chunk, num_heads))
+            dq_upds = []
+            for hi in chunk:
+                sl = slice(hi * d_head, (hi + 1) * d_head)
+                q = q_ref[0][:, sl]
+                do = do_ref[0][:, sl]
+                k_blk = k_ref[0][:, sl]
+                p, ds = _bwd_head_terms(
+                    q, k_blk, v_ref[0][:, sl], do,
+                    lse_ref[0][:, hi:hi + 1], delta_ref[0][:, hi:hi + 1],
+                    mask, sm_scale, bias_ref[0])
+                dq_upds.append(jax.lax.dot_general(
+                    ds, k_blk, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32))
+                dv_acc[:, sl] = dv_acc[:, sl] + jax.lax.dot_general(
+                    p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                dk_acc[:, sl] = dk_acc[:, sl] + jax.lax.dot_general(
+                    ds, q, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            upd = (dq_upds[0] if len(dq_upds) == 1
+                   else jnp.concatenate(dq_upds, axis=1))
+            csl = slice(c0 * d_head, (c0 + len(dq_upds)) * d_head)
+            dq_ref[0, rows, csl] = dq_ref[0, rows, csl] + upd
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _flush():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
 def _bwd_fused_packed(q, k, v, bias, o, do, lse, sm_scale, causal, block_q,
                       block_k, interpret, num_heads):
     """Driver for the single-pass fused backward. Returns (dq, dk, dv)
-    numerically identical to _bwd_packed (same _bwd_head_terms math)."""
+    numerically identical to _bwd_packed (same _bwd_head_terms math).
+    Picks the resident-dq kernel when the whole fp32 dq slab for one batch
+    row fits VMEM (the common case at model context lengths), the DMA
+    read-modify-write variant beyond."""
     b, s, hd = q.shape
     d = hd // num_heads
     block_q = min(block_q, s)
@@ -664,6 +750,27 @@ def _bwd_fused_packed(q, k, v, bias, o, do, lse, sm_scale, causal, block_q,
                            lambda bi, ki, qi: (bi, qi, 0))
     bias_blk = pl.BlockSpec((1, 1, block_k), lambda bi, ki, qi: (bi, 0, ki))
 
+    if _resident_dq_fits(hd, s_qp):
+        dq_f32, dk, dv = pl.pallas_call(
+            functools.partial(
+                _bwd_fused_kernel_packed_resident_dq, sm_scale=sm_scale,
+                block_q=block_q, block_k=block_k, num_q_blocks=nqb,
+                causal=causal, seq_len=s, num_heads=num_heads, d_head=d),
+            grid=(b, num_k_blocks, nqb),
+            in_specs=[q_blk, kv_blk, kv_blk, q_blk, lse_blk, lse_blk,
+                      bias_blk],
+            out_specs=(pl.BlockSpec((1, s_qp, hd),
+                                    lambda bi, ki, qi: (bi, 0, 0)),
+                       kv_blk, kv_blk),
+            out_shape=(jax.ShapeDtypeStruct((b, s_qp, hd), jnp.float32),
+                       jax.ShapeDtypeStruct((b, s_kp, hd), q.dtype),
+                       jax.ShapeDtypeStruct((b, s_kp, hd), q.dtype)),
+            scratch_shapes=[pltpu.VMEM((block_k, hd), jnp.float32),
+                            pltpu.VMEM((block_k, hd), jnp.float32)],
+            interpret=interpret,
+        )(q_p, k, v, do_p, lse_p, delta_p, bias)
+        return dq_f32[:, :s].astype(q.dtype), dk[:, :s], dv[:, :s]
+
     dq_f32, dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_fused_kernel_packed, sm_scale=sm_scale, block_q=block_q,
@@ -686,23 +793,24 @@ def _bwd_fused_packed(q, k, v, bias, o, do, lse, sm_scale, causal, block_q,
 
 def _bwd_packed(q, k, v, bias, o, do, lse, sm_scale, causal, block_q,
                 block_k, interpret, num_heads):
-    """Packed backward dispatcher: the single-pass fused kernel where one
-    call fits (hd <= 1280 — one walk of the block pairs, 5 dots each);
-    per-HEAD-GROUP fused calls for wider models (attention is independent
-    per head, so the packed width slices cleanly); the split dq + dk/dv
-    pair only when fusion is disabled or a single head overflows the cap.
-    ``bias`` as in _fwd_packed."""
+    """Packed backward dispatcher (policy in _fused_plan): the single-pass
+    fused kernel where one call fits (hd <= 1280 — one walk of the block
+    pairs, 5 dots each, dq resident in VMEM); per-HEAD-GROUP fused calls
+    for wider models (attention is independent per head, so the packed
+    width slices cleanly); the split dq + dk/dv pair for long sequences
+    (resident dq slab overflows VMEM) or when forced. ``bias`` as in
+    _fwd_packed."""
     hd = q.shape[-1]
-    if _use_fused_bwd(hd):
+    plan = _fused_plan(hd, num_heads, q.shape[1])
+    if plan == "fused":
         return _bwd_fused_packed(q, k, v, bias, o, do, lse, sm_scale,
                                  causal, block_q, block_k, interpret,
                                  num_heads)
-    if FUSED_BWD:
+    if plan == "grouped":
         groups = _head_groups(num_heads, hd // num_heads)
-        if groups is not None and len(groups) > 1:
-            return _bwd_fused_grouped(q, k, v, bias, o, do, lse, sm_scale,
-                                      causal, block_q, block_k, interpret,
-                                      num_heads, groups)
+        return _bwd_fused_grouped(q, k, v, bias, o, do, lse, sm_scale,
+                                  causal, block_q, block_k, interpret,
+                                  num_heads, groups)
     return _bwd_split_packed(q, k, v, bias, o, do, lse, sm_scale, causal,
                              block_q, block_k, interpret, num_heads)
 
@@ -830,8 +938,8 @@ DEFAULT_BLOCK_PACKED_K = 512
 
 
 # The single-pass FUSED backward (5 dots/pair vs the split kernels' 7)
-# carries a larger VMEM working set (k/v + dk/dv scratch + the dq RMW
-# buffer), so a single kernel call caps out at hd = 1280 (measured
+# carries a larger VMEM working set (k/v + dk/dv scratch + the resident
+# dq slab), so a single kernel call caps out at hd = 1280 (measured
 # compile limit). Wider models need not fall back to the split kernels:
 # attention is independent per head, so _bwd_packed slices the packed
 # width into head GROUPS of <= FUSED_GROUP_TARGET and runs the fused
@@ -839,23 +947,100 @@ DEFAULT_BLOCK_PACKED_K = 512
 # (13 + 12 heads, widths 832/768) with the fat (256, 256) blocks the
 # <=1024 path earns.
 #
-# DEFAULT: SPLIT. The fused path's advantage is ENVIRONMENT-DEPENDENT:
-# an earlier session measured it 1.12x over split at the xl shape (and
-# round 3 measured 8.3 vs 11.1 ms at the bench shape), but the current
-# chip/runtime measures split faster at every probed width and batch
-# (hd 1024 b96: split 41.3 vs fused 44.7 ms; hd 1600 b8: 13.6 vs 15.9
-# — tests/perf/XL_BWD_COMPARE.json) — the fused kernel's explicit-wait
-# dq DMA read-modify-write is the sensitive part. Re-measure on YOUR
-# deployment with tests/perf/compare_xl_bwd.py and opt in with
-# DS_FLASH_FUSED_BWD=1 where it wins; numerics are identical either
-# way (test_fused_bwd_matches_split).
-FUSED_BWD = os.environ.get("DS_FLASH_FUSED_BWD", "0") != "0"
+# DEFAULT: AUTO — the resident-dq fused kernel wherever its fp32 dq slab
+# fits scoped VMEM next to the block operands, the split pair elsewhere.
+# History: round 2 shipped the fused kernel with dq as an HBM
+# read-modify-write behind explicit DMA waits; that variant's advantage
+# was environment-dependent (1.12x over split in one session, 0.7-0.9x
+# in the next — the blocking ~1 MB waits sat on the critical path) and
+# round 4 demoted it to an env flag. The resident-dq rewrite removes the
+# DMAs entirely and beats split at every anchor width on the real chip
+# (1.11x at hd 1024 and 1280, 1.44x at 1600 grouped — min over
+# interleaved rounds, tests/perf/XL_BWD_COMPARE.json), so fusion is the
+# default again, by fit rather than by flag. DS_FLASH_BWD_MODE=fused|
+# split forces a path (fused uses the DMA variant where resident
+# doesn't fit); the legacy
+# DS_FLASH_FUSED_BWD=1/0 maps to fused/split. Numerics are identical on
+# every path (test_fused_bwd_matches_split).
+def _bwd_mode_from_env():
+    mode = os.environ.get("DS_FLASH_BWD_MODE")
+    if mode is not None:                  # the new var wins when both set
+        if mode not in ("auto", "fused", "split"):
+            raise ValueError(
+                f"DS_FLASH_BWD_MODE={mode!r}: want auto|fused|split")
+        return mode
+    legacy = os.environ.get("DS_FLASH_FUSED_BWD")
+    if legacy is not None:
+        return "fused" if legacy != "0" else "split"
+    return "auto"
+
+
+BWD_MODE = _bwd_mode_from_env()
 FUSED_BWD_MAX_WIDTH = 1280
 FUSED_GROUP_TARGET = 1024
+# Budget for the resident-dq fused kernel's whole-(s, hd) fp32 dq block:
+# alongside the double-buffered (256, hd) operand slabs and the dk/dv
+# scratch/outputs, 6 MB keeps hd 1024 comfortable to s 1536 and the
+# grouped widths (<= 1280 after padding) to s 1024 inside the 16 MB
+# scoped-VMEM limit; longer sequences take the split pair (measured
+# faster than the DMA fused variant).
+RESIDENT_DQ_MAX_BYTES = 6 * 2**20
 
 
-def _use_fused_bwd(hd):
-    return FUSED_BWD and hd <= FUSED_BWD_MAX_WIDTH
+def _resident_dq_fits(hd, s_qp):
+    return s_qp * hd * 4 <= RESIDENT_DQ_MAX_BYTES
+
+
+def _resident_blocks(w):
+    """Measured-fastest (block_q, block_k) for the resident-dq kernel by
+    the width the kernel RUNS at (s=1024-class; XL_BWD_COMPARE.json +
+    in-session sweeps): fat (256, 256) blocks fit next to the dq slab to
+    width 896 (the gpt2-xl 13-head group pads there); at 1024 they
+    overflow scoped VMEM by 256K and (128, 256) is the fastest fit; at
+    1280 even that overflows and (256, 128) stands. block_k stays a
+    128-multiple (the bias block's lane dim)."""
+    if w <= 896:
+        return (256, 256)
+    if w <= 1024:
+        return (128, 256)
+    return (256, 128)
+
+
+def _est_s_qp(s):
+    """Conservative padded-q estimate for fit decisions made before the
+    block size is final (candidate fused block_q values are <= 256)."""
+    return -(-s // 256) * 256
+
+
+def _bwd_dispatch(hd, num_heads, s, mode=None):
+    """(plan, run_width) for the packed backward: 'fused' (single call),
+    'grouped' (per-head-group fused calls), or 'split'; run_width is the
+    packed width the fused kernel actually runs at (the 128-lane-padded
+    group width under 'grouped') — the width block sizes must be keyed
+    on. In auto mode the fused family is chosen exactly when every call
+    it would make gets the resident-dq kernel (the DMA variant never
+    wins its bake-off)."""
+    mode = BWD_MODE if mode is None else mode
+    if mode == "split":
+        return "split", hd
+    s_qp = _est_s_qp(s)
+    if hd <= FUSED_BWD_MAX_WIDTH:
+        if _resident_dq_fits(hd, s_qp) or mode == "fused":
+            return "fused", hd
+        return "split", hd
+    d_head = hd // num_heads if num_heads else 0
+    groups = _head_groups(num_heads, d_head) if num_heads else None
+    if groups is None:
+        return "split", hd
+    gw = max(_padded_heads(n, d_head) for _, n in groups) * d_head
+    if _resident_dq_fits(gw, s_qp) or mode == "fused":
+        return "grouped", gw
+    return "split", hd
+
+
+def _fused_plan(hd, num_heads, s, mode=None):
+    """Plan name alone — see _bwd_dispatch."""
+    return _bwd_dispatch(hd, num_heads, s, mode)[0]
 
 
 def _padded_heads(n, d_head):
@@ -894,29 +1079,32 @@ def _head_groups(num_heads, d_head):
     return None
 
 
-def auto_blocks(hd, num_heads=None):
+def auto_blocks(hd, num_heads=None, seq_len=None):
     """BACKWARD (block_q, block_k) for the packed kernels by activation
-    width h*d, keyed to the path _bwd_packed will take. Fused (one walk
+    width h*d, keyed to the path _bwd_packed will take (pass seq_len so
+    the fused-vs-split fit decision matches the dispatcher's; without it
+    the fused family is assumed where width allows). Fused (one walk
     computes dq/dk/dv): (256, 256) measures fastest to GPT-2-medium width
     (8.3 vs the split path's 9.6 ms at the bench shape), (128, 256) at
     hd 1280. Wider widths run the fused kernel per HEAD GROUP of width
     <= FUSED_GROUP_TARGET, so they get the fat (256, 256) blocks of the
-    <=1024 case. Split fallback: the bwd kernels hold q/do (Bq, hd) and
-    k/v (Bk, hd) slabs double-buffered plus a (Bq or Bk, hd) fp32 scratch
-    in the 16M scoped-vmem budget; (256, 512) measures fastest up to
-    GPT-2-medium width but overflows by ~1M at gpt2-xl's hd=1600, so
-    split blocks shrink as the width grows."""
-    if _use_fused_bwd(hd):
-        return (256, 256) if hd <= 1024 else (128, 256)
-    if FUSED_BWD and num_heads is not None:
-        d_head = hd // num_heads
-        groups = _head_groups(num_heads, d_head)
-        if groups is not None:
-            # block choice keys on the PADDED width the kernel really
-            # runs at (e.g. 20 heads of d=80 split 10+10 is 800 wide on
-            # paper but pads to 1280, where (256, 256) overflows vmem)
-            gw = max(_padded_heads(n, d_head) for _, n in groups) * d_head
-            return (256, 256) if gw <= 1024 else (128, 256)
+    <=1024 case — keyed on the PADDED width the kernel really runs at
+    (e.g. 20 heads of d=80 split 10+10 is 800 wide on paper but pads to
+    1280, where (256, 256) overflows vmem). Split fallback: the bwd
+    kernels hold q/do (Bq, hd) and k/v (Bk, hd) slabs double-buffered
+    plus a (Bq or Bk, hd) fp32 scratch in the 16M scoped-vmem budget;
+    (256, 512) measures fastest up to GPT-2-medium width but overflows
+    by ~1M at gpt2-xl's hd=1600, so split blocks shrink as the width
+    grows."""
+    seq_len = seq_len if seq_len else 1024
+    plan, w = _bwd_dispatch(hd, num_heads, seq_len)
+    if plan in ("fused", "grouped"):
+        if _resident_dq_fits(w, _est_s_qp(seq_len)):
+            return _resident_blocks(w)
+        # forced fused past the resident budget -> the explicit-DMA
+        # variant, whose working set has no resident slab: the round-3
+        # tuned blocks stand
+        return (256, 256) if w <= 1024 else (128, 256)
     if hd <= 1024:
         return DEFAULT_BLOCK_PACKED, DEFAULT_BLOCK_PACKED_K
     if hd <= 1280:
@@ -1005,7 +1193,7 @@ def flash_attention_bshd(q, k, v, sm_scale=None, causal=True,
     # budget auto_blocks exists to respect. Sweep the bwd with the
     # explicit bwd_block_* args (tests/perf/sweep_flash_bwd_blocks.py).
     fq, fk = auto_fwd_blocks(h * d)
-    bq_auto, bk_auto = auto_blocks(h * d, num_heads=h)
+    bq_auto, bk_auto = auto_blocks(h * d, num_heads=h, seq_len=s)
     bwd_block_q = bwd_block_q or bq_auto
     bwd_block_k = bwd_block_k or bk_auto
     block_q = block_q or fq
@@ -1053,7 +1241,8 @@ def fused_ln_qkv_attention(x, ln_scale, ln_bias, qkv_w, qkv_b, num_heads,
     the bwd (its vmem budget is tighter — pass bwd_block_* to tune it)."""
     hd = x.shape[-1]
     fq, fk = auto_fwd_blocks(hd)
-    bq_auto, bk_auto = auto_blocks(hd, num_heads=num_heads)
+    bq_auto, bk_auto = auto_blocks(hd, num_heads=num_heads,
+                                   seq_len=x.shape[1])
     bwd_block_q = bwd_block_q or bq_auto
     bwd_block_k = bwd_block_k or bk_auto
     return _fused_lnqkv_core(x, ln_scale, ln_bias, qkv_w, qkv_b, num_heads,

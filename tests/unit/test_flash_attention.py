@@ -301,29 +301,35 @@ def test_fused_attn_under_remat_matches():
 
 
 def test_auto_blocks_by_width(monkeypatch):
-    """Width-aware block defaults, keyed to the backward path taken. The
-    SPLIT kernels are the default (the fused path's advantage measured
-    environment-dependent; DS_FLASH_FUSED_BWD=1 opts in); when fusion is
-    on, the single-pass kernel (hd <= 1280) wants (256, 256)-class
-    blocks and wider widths run fused per head group."""
+    """Width-aware block defaults, keyed to the backward path taken. AUTO
+    mode (the default) runs the resident-dq fused kernel wherever its fp32
+    dq slab fits VMEM — (256, 256)-class blocks, per head group past the
+    single-call cap — and the split pair for long sequences or when
+    forced (DS_FLASH_BWD_MODE=split)."""
     from deepspeed_tpu.ops.transformer import flash_attention as fa
-    # split dispatch (the shipped default; forced so the test holds on a
-    # deployment that opted in via DS_FLASH_FUSED_BWD=1)
-    monkeypatch.setattr(fa, "FUSED_BWD", False)
-    assert not fa._use_fused_bwd(1024)
+    monkeypatch.setattr(fa, "BWD_MODE", "split")
+    assert fa._fused_plan(1024, 16, 1024) == "split"
     assert fa.auto_blocks(1024) == (256, 512)
     assert fa.auto_blocks(1280) == (256, 256)
     assert fa.auto_blocks(1600) == (128, 256)
-    # opted-in fused dispatch
-    monkeypatch.setattr(fa, "FUSED_BWD", True)
-    assert fa._use_fused_bwd(1024) and fa._use_fused_bwd(1280)
-    assert not fa._use_fused_bwd(1600)
-    assert fa.auto_blocks(768) == (256, 256)
-    assert fa.auto_blocks(1024) == (256, 256)
-    assert fa.auto_blocks(1280) == (128, 256)
-    assert fa.auto_blocks(1600) == (128, 256)   # no head info: split fallback
-    # gpt2-xl: 25 heads x 64 -> two fused groups (13+12, widths 832/768)
+    monkeypatch.setattr(fa, "BWD_MODE", "auto")
+    # auto at model context lengths: fused family
+    assert fa._fused_plan(1024, 16, 1024) == "fused"
+    assert fa._fused_plan(1280, 20, 1024) == "fused"
+    assert fa.auto_blocks(768, num_heads=12, seq_len=1024) == (256, 256)
+    assert fa.auto_blocks(1024, num_heads=16, seq_len=1024) == (128, 256)
+    assert fa.auto_blocks(1280, num_heads=20, seq_len=1024) == (256, 128)
+    # gpt2-xl: 25 heads x 64 -> two fused groups (13+12, widths 832/768,
+    # padded 896/768 -> fat blocks)
+    assert fa._fused_plan(1600, 25, 1024) == "grouped"
     assert fa.auto_blocks(1600, num_heads=25) == (256, 256)
+    # 20 heads x 80 groups 10+10 but PADS to 16 heads = width 1280: the
+    # resident kernel there needs (256, 128), not the narrow-group blocks
+    assert fa.auto_blocks(1600, num_heads=20, seq_len=1024) == (256, 128)
+    assert fa.auto_blocks(1600) == (128, 256)   # no head info: split
+    # long sequence: the resident dq slab outgrows VMEM -> split pair
+    assert fa._fused_plan(1024, 16, 4096) == "split"
+    assert fa.auto_blocks(1024, num_heads=16, seq_len=4096) == (256, 512)
     assert fa.auto_fwd_blocks(1024) == (256, 512)
     assert fa.auto_fwd_blocks(1600) == (256, 256)
 
@@ -351,12 +357,16 @@ def test_head_groups_partition():
 
 
 @pytest.mark.parametrize("causal", [True, False])
-def test_fused_bwd_matches_split(causal):
-    """The single-pass fused backward (one walk, 5 dots/pair, dq via
-    explicit-DMA HBM accumulation) is numerically identical to the split
-    dq + dk/dv kernels — including ragged seq (q-padding) and both mask
+@pytest.mark.parametrize("variant", ["resident", "dma"])
+def test_fused_bwd_matches_split(causal, variant, monkeypatch):
+    """Both single-pass fused backwards (one walk, 5 dots/pair) — the
+    default resident-dq kernel and the explicit-DMA HBM-accumulation
+    variant it replaced — are numerically identical to the split
+    dq + dk/dv kernels, including ragged seq (q-padding) and both mask
     polarities."""
     from deepspeed_tpu.ops.transformer import flash_attention as fa
+    if variant == "dma":
+        monkeypatch.setattr(fa, "RESIDENT_DQ_MAX_BYTES", 0)
     rng = np.random.RandomState(0)
     b, s, h, d = 2, 192, 4, 32
     hd = h * d
@@ -375,25 +385,29 @@ def test_fused_bwd_matches_split(causal):
                                    atol=2e-4, rtol=2e-4, err_msg=name)
 
 
-def test_bwd_packed_dispatches_fused(monkeypatch):
-    """With fusion opted in, _bwd_packed routes narrow widths to the
-    single fused call; wide ones (gpt2-xl class) go fused-per-head-group,
-    not split. (Split is the measured-faster DEFAULT on the current
-    chip/runtime — see FUSED_BWD in flash_attention.py.)"""
+def test_bwd_packed_dispatch_plan():
+    """Auto mode routes narrow widths to the single fused call and wide
+    ones (gpt2-xl class) fused-per-head-group; sequences whose resident
+    dq slab overflows VMEM fall back to the split pair. Forced modes
+    override the fit logic."""
     from deepspeed_tpu.ops.transformer import flash_attention as fa
-    monkeypatch.setattr(fa, "FUSED_BWD", True)
-    assert fa._use_fused_bwd(16 * 64)
-    assert not fa._use_fused_bwd(25 * 64)
+    assert fa._fused_plan(16 * 64, 16, 1024, mode="auto") == "fused"
+    assert fa._fused_plan(25 * 64, 25, 1024, mode="auto") == "grouped"
     assert len(fa._head_groups(25, 64)) == 2
+    assert fa._fused_plan(16 * 64, 16, 8192, mode="auto") == "split"
+    assert fa._fused_plan(16 * 64, 16, 8192, mode="fused") == "fused"
+    assert fa._fused_plan(16 * 64, 16, 1024, mode="split") == "split"
+    # resident fit boundary: 6 MB budget / fp32 -> s*hd <= 1.5M elements
+    assert fa._resident_dq_fits(1024, 1536)
+    assert not fa._resident_dq_fits(1024, 2048)
 
 
 @pytest.mark.parametrize("causal", [True, False])
-def test_grouped_fused_bwd_matches_split(causal, monkeypatch):
+def test_grouped_fused_bwd_matches_split(causal):
     """gpt2-xl-width backward (25 heads x 64 = 1600 > single-call cap):
     the per-head-group fused path is numerically identical to the split
     kernels, including the ragged q tail."""
     from deepspeed_tpu.ops.transformer import flash_attention as fa
-    monkeypatch.setattr(fa, "FUSED_BWD", True)
     rng = np.random.RandomState(3)
     b, s, h, d = 1, 160, 25, 64
     hd = h * d

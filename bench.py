@@ -42,22 +42,27 @@ def main():
     warmup = 3 if on_tpu else 1
     # GPT-2 medium (350M): best measured MFU on one v5e chip — d_model
     # 1024 tiles the MXU better than 125M's 768 (sweep:
-    # tests/perf/sweep_gpt2_mfu.py). With the fused-attention remat path
-    # (ctx+lse saved per layer) the HBM sweet spot is micro_batch 24
-    # (0.503 MFU measured; 28 and 16 both lower, 32+ OOMs) —
-    # tests/perf/probe_fused_mb.py. Fall back on compiler OOM.
-    micro_batches = [24, 16, 8] if on_tpu else [2]
+    # tests/perf/sweep_gpt2_mfu.py). bf16 Adam moments + bf16 grad-accum
+    # (lossless at gas=1) free ~2.8 GB of optimizer-state HBM, which
+    # buys REMAT OFF at micro_batch 16-20 — executed flops drop from
+    # 8/6x to 1x model flops and the measured MFU jumps 0.507 -> 0.587
+    # (docs/roofline_gpt2_medium_v5e.md has the full measured grid).
+    # Fallback ladder degrades remat/micro-batch on compiler OOM.
+    # attempts: (micro_batch, remat, bf16_state)
+    attempts = ([(20, False, True), (16, False, True), (24, True, True),
+                 (24, True, False), (16, True, False), (8, True, False)]
+                if on_tpu else [(2, False, False)])
 
-    if on_tpu:
-        cfg = gpt2.config_for("gpt2_medium", max_seq_len=seq, remat=True,
-                              loss_chunk=128)
-    else:
+    if not on_tpu:
         cfg = gpt2.GPT2Config(vocab_size=512, max_seq_len=seq, n_layers=2,
                               n_heads=4, d_model=128,
                               use_flash_attention=False, remat=False)
-    n_params = gpt2.num_params(cfg)
 
-    for micro_batch in micro_batches:
+    for micro_batch, remat, bf16_state in attempts:
+        if on_tpu:
+            cfg = gpt2.config_for("gpt2_medium", max_seq_len=seq,
+                                  remat=remat, loss_chunk=128)
+        n_params = gpt2.num_params(cfg)
         model = gpt2.make_gpt2_model(config=cfg)
         ds_config = {
             "train_micro_batch_size_per_gpu": micro_batch,
@@ -67,6 +72,9 @@ def main():
             "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
             "steps_per_print": 10 ** 9,
         }
+        if bf16_state:
+            ds_config["optimizer"]["params"]["moments_dtype"] = "bf16"
+            ds_config["data_types"] = {"grad_accum_dtype": "bf16"}
         engine, _, _, _ = deepspeed.initialize(model=model,
                                                config_params=ds_config)
 

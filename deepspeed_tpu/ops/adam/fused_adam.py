@@ -14,8 +14,12 @@ import jax
 import jax.numpy as jnp
 
 
-def adam_init(params):
-    zeros = lambda p: jnp.zeros(p.shape, dtype=jnp.float32)
+def adam_init(params, moments_dtype=jnp.float32):
+    """``moments_dtype``: storage dtype of exp_avg/exp_avg_sq. bf16 halves
+    the moment HBM (8N -> 4N bytes) — on a 16 GB chip that buys
+    micro-batch (see docs/roofline_gpt2_medium_v5e.md); the update math
+    always runs in fp32 (moments are cast up, computed, cast back)."""
+    zeros = lambda p: jnp.zeros(p.shape, dtype=moments_dtype)
     return {
         "step": jnp.zeros((), dtype=jnp.int32),
         "exp_avg": jax.tree_util.tree_map(zeros, params),
@@ -40,6 +44,10 @@ def adam_update(grads, state, params, lr, beta1, beta2, eps, weight_decay,
     if use_pallas:
         from .pallas_adam import fused_adam_shard
         def leaf(p, g, m, v):
+            if m.dtype != jnp.float32:      # pallas kernel is fp32-state
+                raise ValueError(
+                    "pallas Adam path requires fp32 moments; "
+                    f"got {m.dtype} (set use_pallas=False)")
             return fused_adam_shard(p, g.astype(jnp.float32), m, v, lr, beta1,
                                     beta2, eps, weight_decay, bc1, bc2,
                                     adam_w_mode)
@@ -49,13 +57,14 @@ def adam_update(grads, state, params, lr, beta1, beta2, eps, weight_decay,
             p32 = p.astype(jnp.float32)
             if not adam_w_mode:
                 g = g + weight_decay * p32
-            m_new = beta1 * m + (1.0 - beta1) * g
-            v_new = beta2 * v + (1.0 - beta2) * (g * g)
+            m_new = beta1 * m.astype(jnp.float32) + (1.0 - beta1) * g
+            v_new = beta2 * v.astype(jnp.float32) + (1.0 - beta2) * (g * g)
             update = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
             if adam_w_mode:
                 update = update + weight_decay * p32
             p_new = p32 - lr * update
-            return p_new.astype(p.dtype), m_new, v_new
+            return (p_new.astype(p.dtype), m_new.astype(m.dtype),
+                    v_new.astype(v.dtype))
 
     flat_p, treedef = jax.tree_util.tree_flatten(params)
     flat_g = treedef.flatten_up_to(grads)
@@ -77,9 +86,12 @@ class FusedAdam:
     name = "adam"
     supports_zero = True
 
+    _DTYPES = {"fp32": jnp.float32, "float32": jnp.float32,
+               "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16}
+
     def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
                  eps=1e-8, adam_w_mode=True, weight_decay=0.0, amsgrad=False,
-                 use_pallas=None, **kwargs):
+                 use_pallas=None, moments_dtype=None, **kwargs):
         if amsgrad:
             raise RuntimeError("FusedAdam does not support the AMSGrad variant.")
         self.lr = lr
@@ -89,9 +101,21 @@ class FusedAdam:
         self.adam_w_mode = adam_w_mode
         self.weight_decay = weight_decay
         self.use_pallas = use_pallas
+        if isinstance(moments_dtype, str):
+            try:
+                moments_dtype = self._DTYPES[moments_dtype.lower()]
+            except KeyError:
+                raise ValueError(
+                    f"moments_dtype={moments_dtype!r}: want one of "
+                    f"{sorted(self._DTYPES)}") from None
+        self.moments_dtype = moments_dtype or jnp.float32
+        if use_pallas and self.moments_dtype != jnp.float32:
+            raise ValueError(
+                "use_pallas=True is incompatible with bf16 moments (the "
+                "pallas Adam kernel is fp32-state); drop one of the two")
 
     def init_state(self, params):
-        return adam_init(params)
+        return adam_init(params, self.moments_dtype)
 
     def hyperparams(self):
         """Traced-scalar hyperparams fed to the jitted step each iteration."""
@@ -104,7 +128,9 @@ class FusedAdam:
         }
 
     def update(self, grads, state, params, lr, beta1, beta2, eps, weight_decay):
-        if self.use_pallas is None:
+        if self.moments_dtype != jnp.float32:
+            use_pallas = False              # pallas kernel is fp32-state
+        elif self.use_pallas is None:
             from ..pallas_utils import default_use_pallas
             use_pallas = default_use_pallas()
         else:

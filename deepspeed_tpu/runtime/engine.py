@@ -434,9 +434,29 @@ class DeepSpeedEngine:
                 plan.tree_shardings(val, "master"))
             for key, val in opt_state.items()
         }
+        acc_dtype = jnp.float32
+        if self._config.grad_accum_dtype == "bf16":
+            if self.zero_cpu_offload():
+                logger.warning(
+                    "data_types.grad_accum_dtype=bf16 ignored: the host "
+                    "offload step consumes fp32 accumulated grads")
+            else:
+                if self.gradient_accumulation_steps() > 1:
+                    logger.warning(
+                        "grad_accum_dtype=bf16 with gradient_accumulation_"
+                        "steps=%d: bf16 summation across micro-steps is "
+                        "lossy (it is exact only at 1 step)",
+                        self.gradient_accumulation_steps())
+                elif self.compute_dtype != jnp.bfloat16:
+                    logger.warning(
+                        "grad_accum_dtype=bf16 truncates %s gradients: "
+                        "storage is lossless only when the compute dtype "
+                        "is bf16 too", jnp.dtype(self.compute_dtype).name)
+                acc_dtype = jnp.bfloat16
         acc_grads = jax.tree_util.tree_map(
-            lambda p, s: jax.device_put(jnp.zeros_like(p), s), params_f32,
-            grad_sh)
+            lambda p, s: jax.device_put(
+                jnp.zeros(p.shape, dtype=acc_dtype), s),
+            params_f32, grad_sh)
 
         self.state = {
             "params": compute_params,
@@ -521,7 +541,7 @@ class DeepSpeedEngine:
             (_, loss), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(state["params"])
             new_acc = jax.tree_util.tree_map(
-                lambda a, g: a + g.astype(jnp.float32), state["acc_grads"],
+                lambda a, g: a + g.astype(a.dtype), state["acc_grads"],
                 grads)
             new_acc = plan.constrain(new_acc, "grad")
             new_state = dict(state)
@@ -542,7 +562,10 @@ class DeepSpeedEngine:
             grads = state["acc_grads"]
             overflow = CheckOverflow.has_overflow(grads)
             inv_scale = 1.0 / scaler.cur_scale
-            grads = jax.tree_util.tree_map(lambda g: g * inv_scale, grads)
+            # accumulation may be stored bf16 (grad_accum_dtype); the
+            # unscale/clip/update math always runs fp32
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32) * inv_scale, grads)
             if clip > 0:
                 grads, grad_norm = clip_grad_norm_(grads, clip)
             else:

@@ -42,7 +42,7 @@ def _force(out):
     return float(leaf.ravel()[0])
 
 
-def timed_grad(loss_fn, params, ids, reps=8, outer=3):
+def timed_grad(loss_fn, params, ids, reps=8, outer=5):
     """Per-step ms for jax.grad(loss_fn), with the reps INSIDE one jit
     call (chained through a param update) so the ~110 ms axon-tunnel
     dispatch latency is amortized away."""
@@ -129,6 +129,11 @@ def main():
     exec_layer = flops_layer_tok * tokens * REMAT_FACTOR
     exec_head = flops_head_tok * tokens
 
+    from deepspeed_tpu.ops.transformer import flash_attention as fa
+    plan, run_w = fa._bwd_dispatch(d, h, SEQ)
+    fused_bwd_desc = (f"{plan} (run width {run_w}, mode {fa.BWD_MODE}, "
+                      "resident-dq kernel)" if plan != "split" else "split")
+
     eff_layers = exec_layer / (slope * 1e-3 * V5E_PEAK)
     eff_head = exec_head / (t_head * 1e-3 * V5E_PEAK)
 
@@ -136,7 +141,7 @@ def main():
         "config": {"d_model": d, "n_heads": h, "depths": depths,
                    "seq": SEQ, "micro_batch": args.mb,
                    "device": jax.devices()[0].device_kind,
-                   "remat": True, "fused_bwd": "grouped (2 head groups)"},
+                   "remat": True, "fused_bwd": fused_bwd_desc},
         "measured_ms": {"stack_grad_by_depth": stack_ms,
                         "head_ce_by_depth": [round(x, 2) for x in head_ms],
                         "ms_per_layer_fit": round(slope, 2),

@@ -22,6 +22,11 @@ def main():
     parser.add_argument("--policy", default="full",
                         choices=["full", "dots", "none"],
                         help="remat policy (none = remat off)")
+    parser.add_argument("--state", default="fp32",
+                        choices=["fp32", "bf16"],
+                        help="bf16 = bf16 Adam moments + bf16 grad accum "
+                             "(the round-5 HBM lever; see "
+                             "docs/roofline_gpt2_medium_v5e.md)")
     args = parser.parse_args()
 
     import jax
@@ -43,6 +48,9 @@ def main():
         "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
         "steps_per_print": 10 ** 9,
     }
+    if args.state == "bf16":
+        ds_config["optimizer"]["params"]["moments_dtype"] = "bf16"
+        ds_config["data_types"] = {"grad_accum_dtype": "bf16"}
     engine, _, _, _ = deepspeed.initialize(model=model,
                                            config_params=ds_config)
     rng = np.random.RandomState(0)
@@ -61,6 +69,7 @@ def main():
     n = gpt2.num_params(cfg)
     fpt = 6.0 * n + 12.0 * cfg.n_layers * cfg.d_model * seq
     print(json.dumps({"mb": args.mb, "policy": args.policy,
+                      "state": args.state,
                       "tokens_per_sec": round(toks, 1),
                       "mfu": round(toks * fpt / 197e12, 4)}))
 

@@ -353,6 +353,35 @@ def _causal_keep(qi, ki, block):
     return q_pos >= k_pos
 
 
+def _keep_wide(keeps, block, axis=1):
+    """Concat per-slot keep masks (scalar or (block, block)) into the
+    step-wide mask: axis 1 for row-anchored walks (wide dim = keys),
+    axis 0 for the transposed dk/dv walk (wide dim = queries)."""
+    cols = []
+    for k in keeps:
+        if getattr(k, "ndim", 0) == 0:
+            k = jnp.broadcast_to(k, (block, block))
+        cols.append(k)
+    return cols[0] if len(cols) == 1 else jnp.concatenate(cols, axis=axis)
+
+
+def _bias_wide(kpm_refs, bias_refs, has_kpm, has_bias, pack):
+    """Per-slot additive score terms -> one (*, pack*block) term for the
+    row-anchored kernels (kpm is per-KEY and streams with the slots)."""
+    if not (has_kpm or has_bias):
+        return None
+    parts = []
+    for j in range(pack):
+        t = None
+        if has_kpm:
+            t = kpm_refs[j][0][None, :]
+        if has_bias:
+            b = bias_refs[j][...]
+            t = b if t is None else t + b
+        parts.append(t)
+    return parts[0] if pack == 1 else jnp.concatenate(parts, axis=1)
+
+
 def _attn_fwd_kernel_pk(rows_ref, cols_ref, valid_ref, q_ref, k_refs,
                         v_refs, kpm_refs, bias_refs, o_ref, lse_ref, acc_s,
                         m_s, l_s, *, sm_scale, block, causal, has_kpm,
@@ -386,20 +415,24 @@ def _attn_fwd_kernel_pk(rows_ref, cols_ref, valid_ref, q_ref, k_refs,
                 keep, _causal_keep(qi, cols_ref[0, p * pack + j], block))
         keeps.append(keep)
 
+    # fat dots per head against the CONCATENATED k/v slabs (see the dq
+    # kernel's concat comment)
+    k_cat = (jnp.concatenate([r[0] for r in k_refs], axis=0)
+             if pack > 1 else k_refs[0][0])
+    v_cat = (jnp.concatenate([r[0] for r in v_refs], axis=0)
+             if pack > 1 else v_refs[0][0])
+    keep_wide = _keep_wide(keeps, block)
+    bias_wide = _bias_wide(kpm_refs, bias_refs, has_kpm, has_bias, pack)
+
     q_all = q_ref[0]
     for hi in range(num_heads):
         sl = slice(hi * d_head, (hi + 1) * d_head)
-        parts = []
-        for j, k_ref in enumerate(k_refs):
-            s = jax.lax.dot_general(
-                q_all[:, sl], k_ref[0][:, sl], (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * sm_scale
-            if has_kpm:
-                s = s + kpm_refs[j][0][None, :]
-            if has_bias:
-                s = s + bias_refs[j][...]
-            parts.append(jnp.where(keeps[j], s, NEG_INF))
-        s = jnp.concatenate(parts, axis=-1) if pack > 1 else parts[0]
+        s = jax.lax.dot_general(
+            q_all[:, sl], k_cat[:, sl], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if bias_wide is not None:
+            s = s + bias_wide
+        s = jnp.where(keep_wide, s, NEG_INF)
         m_old = m_s[:, hi:hi + 1]
         m_new = jnp.maximum(m_old, jnp.max(s, axis=-1, keepdims=True))
         p_ = jnp.where(m_new <= NEG_INF, 0.0, jnp.exp(s - m_new))
@@ -407,14 +440,10 @@ def _attn_fwd_kernel_pk(rows_ref, cols_ref, valid_ref, q_ref, k_refs,
         l_s[:, hi:hi + 1] = (l_s[:, hi:hi + 1] * corr
                              + jnp.sum(p_, axis=-1, keepdims=True))
         m_s[:, hi:hi + 1] = m_new
-        acc = acc_s[:, sl] * corr
-        for j, v_ref in enumerate(v_refs):
-            v_blk = v_ref[0][:, sl]
-            acc = acc + jax.lax.dot_general(
-                p_[:, j * block:(j + 1) * block].astype(v_blk.dtype),
-                v_blk, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-        acc_s[:, sl] = acc
+        v_h = v_cat[:, sl]
+        acc_s[:, sl] = acc_s[:, sl] * corr + jax.lax.dot_general(
+            p_.astype(v_h.dtype), v_h, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(last)
     def _flush():
@@ -448,32 +477,40 @@ def _attn_dq_kernel_pk(rows_ref, cols_ref, valid_ref, q_ref, k_refs,
                 keep, _causal_keep(qi, cols_ref[0, p * pack + j], block))
         keeps.append(keep)
 
+    # One fat dot per head against the CONCATENATED (pack*block, H*d)
+    # k/v slab instead of ``pack`` tiny (block, d)x(d, block) dots: at
+    # d_head 64 / block 128 the per-slot dots are MXU fill/drain-bound
+    # (pack 8 halving the step count barely moved the 16k wall —
+    # the dots, not the steps, were the cost). The concat is a
+    # VMEM-local copy (~1 MB/step at pack 4), paid once for all heads.
+    k_cat = (jnp.concatenate([r[0] for r in k_refs], axis=0)
+             if pack > 1 else k_refs[0][0])
+    v_cat = (jnp.concatenate([r[0] for r in v_refs], axis=0)
+             if pack > 1 else v_refs[0][0])
+    keep_wide = _keep_wide(keeps, block)
+    bias_wide = _bias_wide(kpm_refs, bias_refs, has_kpm, has_bias, pack)
+
     q_all = q_ref[0]
     do_all = do_ref[0]
     for hi in range(num_heads):
         sl = slice(hi * d_head, (hi + 1) * d_head)
         lse_h = lse_ref[0][:, hi:hi + 1]
         delta_h = delta_ref[0][:, hi:hi + 1]
-        dq_acc = dq_s[:, sl]
-        for j, (k_ref, v_ref) in enumerate(zip(k_refs, v_refs)):
-            k_blk = k_ref[0][:, sl]
-            s = jax.lax.dot_general(
-                q_all[:, sl], k_blk, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * sm_scale
-            if has_kpm:
-                s = s + kpm_refs[j][0][None, :]
-            if has_bias:
-                s = s + bias_refs[j][...]
-            s = jnp.where(keeps[j], s, NEG_INF)
-            p_ = jnp.where(lse_h <= NEG_INF, 0.0, jnp.exp(s - lse_h))
-            dp = jax.lax.dot_general(
-                do_all[:, sl], v_ref[0][:, sl], (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            ds = (p_ * (dp - delta_h) * sm_scale).astype(k_blk.dtype)
-            dq_acc = dq_acc + jax.lax.dot_general(
-                ds, k_blk, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-        dq_s[:, sl] = dq_acc
+        k_h = k_cat[:, sl]                       # (pack*block, d)
+        s = jax.lax.dot_general(
+            q_all[:, sl], k_h, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if bias_wide is not None:
+            s = s + bias_wide
+        s = jnp.where(keep_wide, s, NEG_INF)
+        p_ = jnp.where(lse_h <= NEG_INF, 0.0, jnp.exp(s - lse_h))
+        dp = jax.lax.dot_general(
+            do_all[:, sl], v_cat[:, sl], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p_ * (dp - delta_h) * sm_scale).astype(k_h.dtype)
+        dq_s[:, sl] = dq_s[:, sl] + jax.lax.dot_general(
+            ds, k_h, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(last)
     def _flush():
@@ -506,38 +543,49 @@ def _attn_dkdv_kernel_pk(rows_ref, cols_ref, valid_ref, q_refs, k_ref,
                 keep, _causal_keep(cols_ref[0, p * pack + j], ki, block))
         keeps.append(keep)
 
+    # fat dots per head over the CONCATENATED q-side slabs (wide dim =
+    # queries); see the dq kernel's concat comment for why
+    q_cat = (jnp.concatenate([r[0] for r in q_refs], axis=0)
+             if pack > 1 else q_refs[0][0])
+    do_cat = (jnp.concatenate([r[0] for r in do_refs], axis=0)
+              if pack > 1 else do_refs[0][0])
+    lse_cat = (jnp.concatenate([r[0] for r in lse_refs], axis=0)
+               if pack > 1 else lse_refs[0][0])
+    delta_cat = (jnp.concatenate([r[0] for r in delta_refs], axis=0)
+                 if pack > 1 else delta_refs[0][0])
+    keep_wide = _keep_wide(keeps, block, axis=0)
+    if has_bias:
+        bias_wide = jnp.concatenate([bias_refs[j][...] for j in
+                                     range(pack)], axis=0) \
+            if pack > 1 else bias_refs[0][...]
+
     for hi in range(num_heads):
         sl = slice(hi * d_head, (hi + 1) * d_head)
         k_blk = k_ref[0][:, sl]
         v_blk = v_ref[0][:, sl]
-        dk_acc = dk_s[:, sl]
-        dv_acc = dv_s[:, sl]
-        for j, q_ref in enumerate(q_refs):
-            q_blk = q_ref[0][:, sl]
-            do_blk = do_refs[j][0][:, sl]
-            lse_h = lse_refs[j][0][:, hi:hi + 1]
-            delta_h = delta_refs[j][0][:, hi:hi + 1]
-            s = jax.lax.dot_general(
-                q_blk, k_blk, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * sm_scale
-            if has_kpm:
-                s = s + kpm_ref[0][None, :]
-            if has_bias:
-                s = s + bias_refs[j][...]
-            s = jnp.where(keeps[j], s, NEG_INF)
-            p_ = jnp.where(lse_h <= NEG_INF, 0.0, jnp.exp(s - lse_h))
-            dv_acc = dv_acc + jax.lax.dot_general(
-                p_.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            dp = jax.lax.dot_general(
-                do_blk, v_blk, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            ds = (p_ * (dp - delta_h) * sm_scale).astype(q_blk.dtype)
-            dk_acc = dk_acc + jax.lax.dot_general(
-                ds, q_blk, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-        dk_s[:, sl] = dk_acc
-        dv_s[:, sl] = dv_acc
+        q_h = q_cat[:, sl]                       # (pack*block, d)
+        do_h = do_cat[:, sl]
+        lse_h = lse_cat[:, hi:hi + 1]
+        delta_h = delta_cat[:, hi:hi + 1]
+        s = jax.lax.dot_general(
+            q_h, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if has_kpm:
+            s = s + kpm_ref[0][None, :]
+        if has_bias:
+            s = s + bias_wide
+        s = jnp.where(keep_wide, s, NEG_INF)
+        p_ = jnp.where(lse_h <= NEG_INF, 0.0, jnp.exp(s - lse_h))
+        dv_s[:, sl] = dv_s[:, sl] + jax.lax.dot_general(
+            p_.astype(do_h.dtype), do_h, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do_h, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p_ * (dp - delta_h) * sm_scale).astype(q_h.dtype)
+        dk_s[:, sl] = dk_s[:, sl] + jax.lax.dot_general(
+            ds, q_h, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(last)
     def _flush():

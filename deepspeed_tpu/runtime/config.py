@@ -148,6 +148,9 @@ def get_grad_accum_dtype(param_dict):
     with real accumulation (gas>1) bf16 summation is lossy — the engine
     warns. None (default) keeps fp32."""
     sub = param_dict.get("data_types") or {}
+    if not isinstance(sub, dict):
+        raise DeepSpeedConfigError(
+            f"data_types must be a dict, got {type(sub).__name__}")
     val = sub.get("grad_accum_dtype")
     if val is None:
         return None

@@ -356,6 +356,18 @@ class DeepSpeedEngine:
             # process-ways. Single-process is the degenerate one-shard (or
             # all-shards) case of the same machinery.
             #
+            # the bf16-state HBM levers do not apply here: the host step
+            # consumes fp32 numpy shards end to end
+            if self._config.grad_accum_dtype == "bf16":
+                logger.warning(
+                    "data_types.grad_accum_dtype=bf16 ignored: the host "
+                    "offload step consumes fp32 accumulated grads")
+            if getattr(self.optimizer, "moments_dtype", jnp.float32) \
+                    != jnp.float32:
+                logger.warning(
+                    "optimizer moments_dtype=%s ignored under "
+                    "cpu_offload: host shard moments are fp32 numpy",
+                    jnp.dtype(self.optimizer.moments_dtype).name)
             # np.array(copy=True): np.asarray of a jax array is a READ-ONLY
             # view aliasing the runtime's buffer — the in-place host Adam
             # would crash (or scribble on JAX-owned memory via the C ptr)
@@ -436,23 +448,19 @@ class DeepSpeedEngine:
         }
         acc_dtype = jnp.float32
         if self._config.grad_accum_dtype == "bf16":
-            if self.zero_cpu_offload():
+            # (the cpu_offload path warned and returned above)
+            if self.gradient_accumulation_steps() > 1:
                 logger.warning(
-                    "data_types.grad_accum_dtype=bf16 ignored: the host "
-                    "offload step consumes fp32 accumulated grads")
-            else:
-                if self.gradient_accumulation_steps() > 1:
-                    logger.warning(
-                        "grad_accum_dtype=bf16 with gradient_accumulation_"
-                        "steps=%d: bf16 summation across micro-steps is "
-                        "lossy (it is exact only at 1 step)",
-                        self.gradient_accumulation_steps())
-                elif self.compute_dtype != jnp.bfloat16:
-                    logger.warning(
-                        "grad_accum_dtype=bf16 truncates %s gradients: "
-                        "storage is lossless only when the compute dtype "
-                        "is bf16 too", jnp.dtype(self.compute_dtype).name)
-                acc_dtype = jnp.bfloat16
+                    "grad_accum_dtype=bf16 with gradient_accumulation_"
+                    "steps=%d: bf16 summation across micro-steps is "
+                    "lossy (it is exact only at 1 step)",
+                    self.gradient_accumulation_steps())
+            elif self.compute_dtype != jnp.bfloat16:
+                logger.warning(
+                    "grad_accum_dtype=bf16 truncates %s gradients: "
+                    "storage is lossless only when the compute dtype "
+                    "is bf16 too", jnp.dtype(self.compute_dtype).name)
+            acc_dtype = jnp.bfloat16
         acc_grads = jax.tree_util.tree_map(
             lambda p, s: jax.device_put(
                 jnp.zeros(p.shape, dtype=acc_dtype), s),
@@ -796,8 +804,17 @@ class DeepSpeedEngine:
         # per-phase wall clocks (cheap; read via offload_phase_times).
         # "micros_and_check" includes waiting for the jitted micro steps
         # to finish — the check's value fetch is the first sync point.
+        # OVERLAP ACCOUNTING: the shard pipeline overlaps the host Adam
+        # with the next shard's D2H by construction (the pool fetches
+        # shard j+1 while Adam steps shard j), so "d2h_wait_s" is the
+        # RESIDUAL blocking wait after that overlap, not raw transfer
+        # time — host_adam_s is real wall the device transfers could
+        # not hide, and the phases are disjoint and sum to the step
+        # (any residual vs sec_per_step is loop overhead, reported by
+        # bench_gpt2_xl.py as unattributed_s).
         phases = {"micros_and_check_s": 0.0, "d2h_wait_s": 0.0,
-                  "host_adam_s": 0.0, "h2d_reshard_s": 0.0}
+                  "host_adam_s": 0.0, "h2d_dispatch_s": 0.0,
+                  "h2d_reshard_s": 0.0}
         self.offload_phase_times = phases
         t_phase = _time.time()
         check = self._get_jit("offload_check", self._offload_check_fn)
@@ -961,14 +978,22 @@ class DeepSpeedEngine:
                     + (_time.time() - t0)
                 # stage 3: the moment a leaf's last shard steps, launch its
                 # H2D — uploads overlap the remaining leaves' Adam; drop
-                # the consumed grad references so their buffers free
+                # the consumed grad references so their buffers free.
+                # device_put DISPATCH is not free at GB-leaf scale (the
+                # runtime serializes the host buffer before returning),
+                # so it gets its own phase clock — round 4's split left
+                # it untimed and ~19% of the 1.5B step unaccounted.
                 work[j] = None
                 left_in_leaf[i] -= 1
                 if left_in_leaf[i] == 0:
+                    t0 = _time.time()
                     flat_params[i] = self._leaf_shards_to_device(
                         acc_specs[i][0], acc_shardings[i],
                         hs["shard_leaves"][i])
                     flat_acc[i] = None
+                    phases["h2d_dispatch_s"] = \
+                        phases.get("h2d_dispatch_s", 0.0) \
+                        + (_time.time() - t0)
 
     def _finish_offload_step(self, flat_params, acc_specs, acc_shardings,
                              hs):
@@ -984,10 +1009,11 @@ class DeepSpeedEngine:
         # fresh zero accumulators, allocated ON DEVICE from the saved
         # specs (a host-side zeros + device_put would push the full
         # fp32 gradient over the wire every step); the cache key carries
-        # the specs so a shape/sharding change across steps can never
-        # silently replay a stale-shaped closure
+        # the specs VERBATIM (not a truncated hash — a collision across
+        # spec changes would replay a stale-shaped closure) so a
+        # shape/sharding change across steps can never alias
         zeros_fn = self._get_jit(
-            "acc_zeros:%x" % (hash(tuple(acc_specs)) & 0xffffffff),
+            "acc_zeros:%s" % repr(acc_specs),
             lambda: (lambda: tuple(jnp.zeros(s, d)
                                    for s, d in acc_specs)),
             out_shardings=tuple(acc_shardings))

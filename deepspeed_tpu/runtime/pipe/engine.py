@@ -211,6 +211,12 @@ class PipelineEngine(DeepSpeedEngine):
         last virtual stage's — nothing M-sized is materialized, so eval
         keeps the pipeline's memory partitioning. Interleaved models walk
         the same forward tables as training (chunk hops wrap S-1 -> 0).
+        Known overhead at num_virtual_stages > 1: the training tables
+        space forwards for 1F1B interleaving, so eval executes the
+        bubble cycles a packed forward-only schedule would skip — all
+        masked (correctness unaffected), costing up to ~2x eval wall at
+        v=2 on small M. Eval is not a steady-state cost; a packed
+        InferenceSchedule table generator is the fix if it becomes one.
         Dropout is off (no rng reaches the stage bodies)."""
         module = self.pipe_module
         num_stages = self.num_stages
@@ -772,6 +778,14 @@ class PipelineEngine(DeepSpeedEngine):
                 else:
                     ckpt.save_latest(save_dir, tag)
         self._ckpt_futures = [f for f in futures if f is not None]
+        if jax.process_count() > 1:
+            # the base save's barrier ran BEFORE the per-layer files and
+            # the latest update above; without a second barrier a
+            # non-zero rank could proceed (and e.g. load the tag) while
+            # rank 0 is still writing them
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(
+                "pipe_ckpt_layers:" + tag)
         return ok
 
     @staticmethod

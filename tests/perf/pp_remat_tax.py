@@ -28,7 +28,13 @@ model/batch on the 8-device CPU mesh (compute-dominated shape so time
 tracks executed flops) and writes tests/perf/PP_REMAT_TAX.json with the
 measured ratios against the analytic ones.
 
-    JAX_PLATFORMS=cpu python tests/perf/pp_remat_tax.py
+    JAX_PLATFORMS=cpu python tests/perf/pp_remat_tax.py \
+        [--d 128 --seq 128 --layers 4 --m 8 --mb 2 --reps 3]
+
+The round-4 run (d 128, seq 128) found the ranking INVERTED at toy
+shapes (W-slot buffer traffic outweighs saved flops); round 5 adds a
+compute-dominated shape (d 512, seq 512) to locate the crossover. The
+artifact accumulates one entry per shape under "shapes".
 """
 import json
 import os
@@ -53,14 +59,26 @@ def timed_steps(run_step, reps=3, warmup=1):
 
 
 def main():
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--d", type=int, default=128)
+    parser.add_argument("--seq", type=int, default=128)
+    parser.add_argument("--layers", type=int, default=4)
+    parser.add_argument("--m", type=int, default=8)
+    parser.add_argument("--mb", type=int, default=2)
+    parser.add_argument("--reps", type=int, default=3)
+    args = parser.parse_args()
+
     import jax
     jax.config.update("jax_platforms", "cpu")
     import deepspeed_tpu as deepspeed
     from deepspeed_tpu.models import gpt2, gpt2_pipe
 
-    D, L, SEQ, HEADS = 128, 4, 128, 4
-    M = 8                                 # microbatches
-    MB = 2                                # per-microbatch batch
+    D, L, SEQ = args.d, args.layers, args.seq
+    HEADS = max(4, D // 128)
+    M = args.m                            # microbatches
+    MB = args.mb                          # per-microbatch batch
+    REPS = args.reps
     rng = np.random.RandomState(0)
 
     def cfg(remat):
@@ -88,7 +106,7 @@ def main():
                 engine.step()
             return float(loss)
 
-        rows[name] = round(timed_steps(run), 1)
+        rows[name] = round(timed_steps(run, reps=REPS), 1)
         print(name, rows[name], flush=True)
 
     # ---- pipeline modes ----------------------------------------------
@@ -109,7 +127,7 @@ def main():
         def run(engine=engine, ids=ids):
             return float(engine.train_batch(batch=(ids, ids.copy())))
 
-        rows[name] = round(timed_steps(run), 1)
+        rows[name] = round(timed_steps(run, reps=REPS), 1)
         print(name, rows[name], flush=True)
 
     pipe_mode("pp_block_remat", interval=1)
@@ -197,18 +215,20 @@ def main():
             "pp_saved_residuals": 1.0},
         "notes": [
             "idle-host CPU wall times validate the flops model where "
-            "compute dominates: dp_block_remat/dp_no_remat = 1.196 vs "
-            "the compile-counted 1.206. The PP rows measure the OTHER "
-            "side of the tradeoff: lower-recompute modes buy their "
-            "flop savings with W-slot buffer traffic (transient mode "
-            "writes full stage interiors per vjp; saved-residuals "
-            "RMWs W pullback copies per cycle), and at this small "
-            "shape that memory traffic outweighs the saved flops — "
-            "the ranking INVERTS (block 1.81x < transient 1.99x < "
-            "saved 2.60x). Pick a mode by which resource binds: "
-            "recompute-heavy (interval>=1) when HBM-limited, "
-            "save_stage_residuals only when the stage's residuals are "
-            "small relative to its compute",
+            "compute dominates (compare dp_block_remat/dp_no_remat "
+            "against the compile-counted ratio IN THIS ENTRY). The PP "
+            "rows measure the OTHER side of the tradeoff: "
+            "lower-recompute modes buy their flop savings with W-slot "
+            "buffer traffic (transient mode writes full stage "
+            "interiors per vjp; saved-residuals RMWs W pullback copies "
+            "per cycle); where that memory traffic outweighs the "
+            "saved flops the ranking INVERTS — compare the per-shape "
+            "entries to locate the crossover. Pick a mode by which "
+            "resource binds: recompute-heavy (interval>=1) when "
+            "HBM-limited, save_stage_residuals only when the stage's "
+            "residuals are small relative to its compute. CAVEAT: "
+            "these are host-CPU wall clocks — run on an otherwise "
+            "IDLE machine or the ratios inflate",
             "compile_counted_gflops counts each loop body ONCE (trip "
             "counts are invisible to cost_analysis); mode DIFFERENCES "
             "isolate the backward phase's recompute flops",
@@ -223,8 +243,18 @@ def main():
         ],
     }
     path = os.path.join(os.path.dirname(__file__), "PP_REMAT_TAX.json")
+    doc = {"shapes": []}
+    if os.path.exists(path):
+        try:
+            old = json.load(open(path))
+            doc["shapes"] = old.get("shapes") or ([old] if "config" in old
+                                                  else [])
+        except Exception:
+            pass
+    key = lambda e: (e["config"]["d_model"], e["config"]["seq"])
+    doc["shapes"] = [e for e in doc["shapes"] if key(e) != key(out)] + [out]
     with open(path, "w") as f:
-        json.dump(out, f, indent=2)
+        json.dump(doc, f, indent=2)
     print(json.dumps(out["measured_ratio_vs_dp_no_remat"]))
 
 

@@ -56,6 +56,14 @@ class GPT2Config:
     # global mesh (same contract as sp_mesh).
     sparse_embedding_grads: bool = False
     embedding_grad_mesh: object = None
+    # Block-sparse attention: the parsed ds_config "sparse_attention"
+    # dict (mode/block/...), e.g. engine.sparse_attention_config().
+    # When set, _attn_ctx runs the Pallas block-sparse kernels
+    # (ops/sparse_attention) instead of dense flash — the reference's
+    # "10x longer sequences" path (tests/perf/longseq_model.py measures
+    # the model-level capability). Causal; incompatible with
+    # sequence_parallel.
+    sparse_attention: object = None
 
     @property
     def d_head(self):
@@ -166,6 +174,15 @@ def _attn_ctx(x, block, config, train):
 
     from ..ops.transformer.attention import (causal_attention,
                                              causal_attention_fn)
+    if config.sparse_attention:
+        if config.sequence_parallel:
+            raise ValueError(
+                "GPT2Config.sparse_attention is incompatible with "
+                "sequence_parallel — pick one long-sequence strategy")
+        attn = _sparse_attn_fn(config, s)
+        perm = lambda t: t.transpose(0, 2, 1, 3)    # (b,s,h,d)->(b,h,s,d)
+        ctx = perm(attn(perm(q), perm(k), perm(v), None, None))
+        return ctx.reshape(b, s, d)
     if config.sequence_parallel:
         from ..parallel.ring_attention import sequence_parallel_attention
         if config.sp_mesh is None or not hasattr(config.sp_mesh, "shape"):
@@ -209,11 +226,38 @@ def _block(x, block_params, config, rng, train):
     return _block_rest(x, ctx, block_params, config, rng, train)
 
 
+_SPARSE_ATTN_CACHE = {}
+
+
+def _sparse_attn_fn(config, seq):
+    """Cached jittable block-sparse attention for (config, seq): the
+    layout is trace-time static, so one callable per (sparsity config,
+    sequence length) keeps jit cache keys stable across blocks/steps."""
+    key = (tuple(sorted((k, str(v))
+                        for k, v in dict(config.sparse_attention).items())),
+           config.n_heads, seq)
+    fn = _SPARSE_ATTN_CACHE.get(key)
+    if fn is None:
+        import numpy as np
+        from ..ops.sparse_attention import make_block_sparse_attention
+        from ..ops.sparse_attention.sparsity_config import (
+            sparsity_config_from_dict)
+        scfg = sparsity_config_from_dict(dict(config.sparse_attention),
+                                         config.n_heads)
+        layout = np.asarray(scfg.make_layout(seq))
+        fn = make_block_sparse_attention(
+            layout, scfg.block, causal=True,
+            interpret=jax.default_backend() == "cpu")
+        _SPARSE_ATTN_CACHE[key] = fn
+    return fn
+
+
 def _use_fused_attn(config):
     """The fused LN+QKV+flash op applies on the plain TPU flash path (the
-    sequence-parallel impls own their attention; the reference jnp path
-    keeps gradients for CPU tests)."""
+    sequence-parallel and block-sparse impls own their attention; the
+    reference jnp path keeps gradients for CPU tests)."""
     return (config.use_flash_attention and not config.sequence_parallel
+            and not config.sparse_attention
             and jax.default_backend() == "tpu")
 
 

@@ -1281,6 +1281,14 @@ class DeepSpeedEngine:
     def steps_per_print(self):
         return self._config.steps_per_print
 
+    def sparse_attention_config(self):
+        """The parsed ds_config "sparse_attention" dict, or None — the
+        reference engine's accessor (engine.py sparse_attention_config):
+        models consume it to build their sparse attention, e.g.
+        GPT2Config(sparse_attention=engine.sparse_attention_config())
+        or SparseAttentionUtils for BERT."""
+        return self._config.sparse_attention
+
     def zero_optimization(self):
         return self._config.zero_enabled
 

@@ -1,0 +1,151 @@
+"""Model-level long-sequence capability: dense flash vs ds_config sparse.
+
+The reference's sparse-attention headline is MODEL-level — "10x longer
+sequences" (README.md:17,39 + the 2020-09-08 sparse-attention post) —
+while this repo's sparse evidence was kernel sweeps. This trains a
+GPT-2-medium-class model end to end THROUGH the engine + the ds_config
+"sparse_attention" surface (GPT2Config.sparse_attention =
+engine.sparse_attention_config()) on one chip, dense vs sliding-window
+sparse, and records tokens/s + finite losses per sequence length, plus
+the max trainable length per mode.
+
+    python tests/perf/longseq_model.py [--seqs 16384 32768 65536 131072]
+
+Writes tests/perf/LONGSEQ_MODEL.json.
+"""
+import argparse
+import gc
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+SPARSE = {"mode": "sliding_window", "block": 128,
+          "num_sliding_window_blocks": 8}      # 1024-token causal window
+LAYERS = 24
+D_MODEL = 1024
+HEADS = 16
+VOCAB = 50304
+
+
+def run_one(seq, sparse, steps=3):
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu as deepspeed
+    from deepspeed_tpu.models import gpt2
+
+    ds = {"train_micro_batch_size_per_gpu": 1,
+          "gradient_accumulation_steps": 1,
+          "bf16": {"enabled": True},
+          "zero_optimization": {"stage": 2},
+          "optimizer": {"type": "Adam",
+                        "params": {"lr": 1e-4, "moments_dtype": "bf16"}},
+          "data_types": {"grad_accum_dtype": "bf16"},
+          "steps_per_print": 10 ** 9}
+    if sparse:
+        ds["sparse_attention"] = dict(SPARSE)
+    engine = None
+    try:
+        cfg = gpt2.GPT2Config(
+            vocab_size=VOCAB, max_seq_len=seq, n_layers=LAYERS,
+            n_heads=HEADS, d_model=D_MODEL, remat=True, loss_chunk=128,
+            sparse_attention=dict(SPARSE) if sparse else None)
+        engine, _, _, _ = deepspeed.initialize(
+            model=gpt2.make_gpt2_model(config=cfg), config_params=ds)
+        if sparse:
+            # the reference flow: the model consumes the ENGINE's parsed
+            # sparse config — assert the two surfaces agree
+            assert engine.sparse_attention_config() == SPARSE
+            assert cfg.sparse_attention == engine.sparse_attention_config()
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, VOCAB, size=(1, seq)).astype(np.int32)
+        x = jnp.asarray(ids)
+        y = jnp.roll(x, -1, axis=1)
+        # TWO warm steps: the first compiles micro+apply; the SECOND
+        # recompiles micro once more (the donated state's jit-output
+        # layouts differ from the init-time device_put layouts at these
+        # shapes) — timing from step 3 measures the steady state
+        t0 = time.time()
+        losses = [float(_train_step(engine, x, y))]
+        losses.append(float(_train_step(engine, x, y)))
+        compile_s = time.time() - t0
+        t0 = time.time()
+        for _ in range(steps):
+            losses.append(float(_train_step(engine, x, y)))
+        dt = (time.time() - t0) / steps
+        row = {"seq": seq, "mode": "sparse" if sparse else "dense",
+               "fits": True,
+               "tokens_per_sec": round(seq / dt, 1),
+               "sec_per_step": round(dt, 2),
+               "compile_and_first_step_s": round(compile_s, 1),
+               "losses": [round(l, 3) for l in losses],
+               "finite": all(np.isfinite(losses))}
+    except Exception as e:  # noqa: BLE001 — OOM rows are the data
+        msg = str(e)
+        # surface the root-cause line, not the HTTP wrapper
+        for marker in ("Ran out of memory", "RESOURCE_EXHAUSTED",
+                       "exceeded scoped vmem", "MosaicError"):
+            at = msg.find(marker)
+            if at >= 0:
+                msg = msg[at:at + 400]
+                break
+        row = {"seq": seq, "mode": "sparse" if sparse else "dense",
+               "fits": False, "error": msg[:400]}
+    finally:
+        del engine
+        gc.collect()
+        import jax as _jax
+        _jax.clear_caches()
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def _train_step(engine, x, y):
+    loss = engine(x, y)
+    engine.backward(loss)
+    engine.step()
+    return loss
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--seqs", type=int, nargs="+",
+                        default=[16384, 32768, 65536, 131072])
+    parser.add_argument("--steps", type=int, default=3)
+    args = parser.parse_args()
+    import jax
+
+    rows = []
+    for seq in args.seqs:
+        for sparse in (False, True):
+            rows.append(run_one(seq, sparse, steps=args.steps))
+
+    max_fit = {m: max([r["seq"] for r in rows
+                       if r["mode"] == m and r.get("fits")], default=0)
+               for m in ("dense", "sparse")}
+    out = {
+        "config": {"model": f"GPT-2-medium-class ({LAYERS}L x {D_MODEL}, "
+                            f"{HEADS} heads, vocab {VOCAB})",
+                   "micro_batch": 1, "zero_stage": 2,
+                   "state": "bf16 moments + bf16 grad accum",
+                   "sparse": SPARSE,
+                   "device": jax.devices()[0].device_kind,
+                   "path": "engine + ds_config sparse_attention "
+                           "(tests/perf/longseq_model.py)"},
+        "rows": rows,
+        "max_trainable_seq": max_fit,
+        "reference_claim": "'10x longer sequences' "
+                           "(reference README.md:17,39)",
+    }
+    path = os.path.join(os.path.dirname(__file__), "LONGSEQ_MODEL.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps({"max_trainable_seq": max_fit}))
+
+
+if __name__ == "__main__":
+    main()

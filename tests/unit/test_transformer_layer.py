@@ -220,3 +220,22 @@ def test_encoder_activations_follow_param_dtype():
     ids = jnp.zeros((2, 16), jnp.int32)
     hidden = bert.encode(params_bf16, ids, None, None, cfg, None, False)
     assert hidden.dtype == jnp.bfloat16, hidden.dtype
+
+
+def test_stochastic_mode_is_a_pinned_no_op():
+    """Formal closure of the reference's stochastic transformer
+    (op_builder/stochastic_transformer.py, reference transformer.py:95-139):
+    on TPU the determinism-for-speed trade has no distinct kernel to
+    select — XLA owns scheduling/reassociation — so the flag is a LOUD
+    documented no-op. This pins the warning so the config key can never
+    go silently dead."""
+    with pytest.warns(UserWarning,
+                      match="stochastic_mode has no distinct kernel on TPU"):
+        cfg = small_config(stochastic_mode=True)
+    assert cfg.stochastic_mode is True  # accepted + carried, not dropped
+    # and the layer still runs under the flag
+    layer = DeepSpeedTransformerLayer(cfg)
+    params = layer.init_params()
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 8, 64), jnp.float32)
+    out = layer(params, x, train=False)
+    assert np.isfinite(np.asarray(out)).all()

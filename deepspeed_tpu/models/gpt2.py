@@ -226,30 +226,35 @@ def _block(x, block_params, config, rng, train):
     return _block_rest(x, ctx, block_params, config, rng, train)
 
 
-_SPARSE_ATTN_CACHE = {}
+_SPARSE_ATTN_CACHE = {}          # (config key) -> SparseSelfAttention
+_SPARSE_ATTN_CACHE_MAX = 4       # module instances hold layout + packed
+                                 # index arrays (~tens of MB at 64k), so
+                                 # the cache is bounded LRU-style
 
 
 def _sparse_attn_fn(config, seq):
-    """Cached jittable block-sparse attention for (config, seq): the
-    layout is trace-time static, so one callable per (sparsity config,
-    sequence length) keeps jit cache keys stable across blocks/steps."""
+    """Cached block-sparse attention for (config, seq), built on the
+    module-level SparseSelfAttention (one shared implementation of
+    layout construction, seq%block validation, cpu-interpret fallback
+    and per-seq kernel caching). The layout is trace-time static, so a
+    stable module instance per sparsity config keeps jit cache keys
+    stable across blocks/steps."""
+    from ..ops.sparse_attention import SparseSelfAttention
+    from ..ops.sparse_attention.sparsity_config import (
+        sparsity_config_from_dict)
     key = (tuple(sorted((k, str(v))
                         for k, v in dict(config.sparse_attention).items())),
-           config.n_heads, seq)
-    fn = _SPARSE_ATTN_CACHE.get(key)
-    if fn is None:
-        import numpy as np
-        from ..ops.sparse_attention import make_block_sparse_attention
-        from ..ops.sparse_attention.sparsity_config import (
-            sparsity_config_from_dict)
-        scfg = sparsity_config_from_dict(dict(config.sparse_attention),
-                                         config.n_heads)
-        layout = np.asarray(scfg.make_layout(seq))
-        fn = make_block_sparse_attention(
-            layout, scfg.block, causal=True,
-            interpret=jax.default_backend() == "cpu")
-        _SPARSE_ATTN_CACHE[key] = fn
-    return fn
+           config.n_heads)
+    sa = _SPARSE_ATTN_CACHE.pop(key, None)
+    if sa is None or sa.max_seq_length < seq:
+        sa = SparseSelfAttention(
+            sparsity_config=sparsity_config_from_dict(
+                dict(config.sparse_attention), config.n_heads),
+            max_seq_length=seq, causal=True)
+    _SPARSE_ATTN_CACHE[key] = sa                   # re-insert = LRU touch
+    while len(_SPARSE_ATTN_CACHE) > _SPARSE_ATTN_CACHE_MAX:
+        _SPARSE_ATTN_CACHE.pop(next(iter(_SPARSE_ATTN_CACHE)))
+    return sa._kernel(seq, False, False)
 
 
 def _use_fused_attn(config):
@@ -440,7 +445,8 @@ def profile_spec(config, batch_size, seq=None, seed=0):
         # pallas custom call, and the dense math IS the flop count
         import dataclasses
         cfg_ref = dataclasses.replace(config, use_flash_attention=False,
-                                      sequence_parallel=None)
+                                      sequence_parallel=None,
+                                      sparse_attention=None)
         ctx = _attn_ctx(ln1, bp["attn"], cfg_ref, train=False)
         return xv + ctx @ bp["attn"]["proj_kernel"] + bp["attn"]["proj_bias"]
 

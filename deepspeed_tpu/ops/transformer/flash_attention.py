@@ -778,7 +778,7 @@ def _bwd_fused_packed(q, k, v, bias, o, do, lse, sm_scale, causal, block_q,
             num_heads=num_heads, d_head=d),
         grid=(b, num_k_blocks, nqb),
         in_specs=[q_blk, kv_blk, kv_blk, q_blk, lse_blk, lse_blk, bias_blk],
-        out_specs=(pl.BlockSpec(memory_space=pltpu.ANY), kv_blk, kv_blk),
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY), kv_blk, kv_blk),
         out_shape=(jax.ShapeDtypeStruct((b, s_qp, hd), jnp.float32),
                    jax.ShapeDtypeStruct((b, s_kp, hd), q.dtype),
                    jax.ShapeDtypeStruct((b, s_kp, hd), q.dtype)),

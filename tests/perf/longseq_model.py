@@ -84,6 +84,8 @@ def run_one(seq, sparse, steps=3):
                "compile_and_first_step_s": round(compile_s, 1),
                "losses": [round(l, 3) for l in losses],
                "finite": all(np.isfinite(losses))}
+    except AssertionError:
+        raise                # a wiring bug must not publish as an OOM row
     except Exception as e:  # noqa: BLE001 — OOM rows are the data
         msg = str(e)
         # surface the root-cause line, not the HTTP wrapper

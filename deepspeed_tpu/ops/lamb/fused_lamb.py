@@ -10,8 +10,12 @@ import jax
 import jax.numpy as jnp
 
 
-def lamb_init(params):
-    zeros = lambda p: jnp.zeros(p.shape, dtype=jnp.float32)
+def lamb_init(params, moments_dtype=jnp.float32):
+    """``moments_dtype``: storage dtype of exp_avg/exp_avg_sq — bf16
+    halves the moment HBM and its per-step traffic (the update math
+    always runs fp32); same lever as FusedAdam's (see
+    docs/roofline_gpt2_medium_v5e.md)."""
+    zeros = lambda p: jnp.zeros(p.shape, dtype=moments_dtype)
     return {
         "step": jnp.zeros((), dtype=jnp.int32),
         "exp_avg": jax.tree_util.tree_map(zeros, params),
@@ -32,6 +36,10 @@ def lamb_update(grads, state, params, lr, beta1, beta2, eps, weight_decay,
 
     def pallas_leaf(p, g, m, v):
         from .pallas_lamb import fused_lamb_shard
+        if m.dtype != jnp.float32:      # pallas kernel is fp32-state
+            raise ValueError(
+                "pallas LAMB path requires fp32 moments; "
+                f"got {m.dtype} (set use_pallas=False)")
         return fused_lamb_shard(p, g, m, v, lr, beta1, beta2, eps,
                                 weight_decay, bc1, bc2,
                                 max_coeff=max_coeff, min_coeff=min_coeff,
@@ -40,8 +48,8 @@ def lamb_update(grads, state, params, lr, beta1, beta2, eps, weight_decay,
     def leaf(p, g, m, v):
         g = g.astype(jnp.float32)
         p32 = p.astype(jnp.float32)
-        m_new = beta1 * m + (1.0 - beta1) * g
-        v_new = beta2 * v + (1.0 - beta2) * (g * g)
+        m_new = beta1 * m.astype(jnp.float32) + (1.0 - beta1) * g
+        v_new = beta2 * v.astype(jnp.float32) + (1.0 - beta2) * (g * g)
         if eps_inside_sqrt:
             denom = jnp.sqrt(v_new / bc2 + eps)
         else:
@@ -53,7 +61,8 @@ def lamb_update(grads, state, params, lr, beta1, beta2, eps, weight_decay,
             (p_norm > 0) & (u_norm > 0),
             jnp.clip(p_norm / u_norm, min_coeff, max_coeff), 1.0)
         p_new = p32 - lr * trust_ratio * update
-        return p_new.astype(p.dtype), m_new, v_new
+        return (p_new.astype(p.dtype), m_new.astype(m.dtype),
+                v_new.astype(v.dtype))
 
     flat_p, treedef = jax.tree_util.tree_flatten(params)
     flat_g = treedef.flatten_up_to(grads)
@@ -75,13 +84,29 @@ class FusedLamb:
     name = "lamb"
     supports_zero = True
 
+    _DTYPES = {"fp32": jnp.float32, "float32": jnp.float32,
+               "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16}
+
     def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
                  eps=1e-8, eps_inside_sqrt=False, weight_decay=0.0,
                  max_grad_norm=0.0, max_coeff=10.0, min_coeff=0.01,
-                 amsgrad=False, use_pallas=None, **kwargs):
+                 amsgrad=False, use_pallas=None, moments_dtype=None,
+                 **kwargs):
         if amsgrad:
             raise RuntimeError("FusedLamb does not support the AMSGrad variant.")
         self.use_pallas = use_pallas
+        if isinstance(moments_dtype, str):
+            try:
+                moments_dtype = self._DTYPES[moments_dtype.lower()]
+            except KeyError:
+                raise ValueError(
+                    f"moments_dtype={moments_dtype!r}: want one of "
+                    f"{sorted(self._DTYPES)}") from None
+        self.moments_dtype = moments_dtype or jnp.float32
+        if use_pallas and self.moments_dtype != jnp.float32:
+            raise ValueError(
+                "use_pallas=True is incompatible with bf16 moments (the "
+                "pallas LAMB kernel is fp32-state); drop one of the two")
         self.lr = lr
         self.bias_correction = bias_correction
         self.betas = tuple(betas)
@@ -93,7 +118,7 @@ class FusedLamb:
         self.min_coeff = min_coeff
 
     def init_state(self, params):
-        return lamb_init(params)
+        return lamb_init(params, self.moments_dtype)
 
     def hyperparams(self):
         return {
@@ -105,7 +130,9 @@ class FusedLamb:
         }
 
     def update(self, grads, state, params, lr, beta1, beta2, eps, weight_decay):
-        if self.use_pallas is None:
+        if self.moments_dtype != jnp.float32:
+            use_pallas = False          # pallas kernel is fp32-state
+        elif self.use_pallas is None:
             from ..pallas_utils import default_use_pallas
             use_pallas = default_use_pallas()
         else:

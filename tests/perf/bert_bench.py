@@ -15,7 +15,7 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 
-def run(seq, micro_batch, steps=10, warmup=2):
+def run(seq, micro_batch, steps=10, warmup=3, bf16_state=True):
     import jax
     import deepspeed_tpu as deepspeed
     from deepspeed_tpu.models import bert
@@ -29,7 +29,11 @@ def run(seq, micro_batch, steps=10, warmup=2):
         "gradient_accumulation_steps": 1,
         "bf16": {"enabled": True},
         "zero_optimization": {"stage": 2},
-        "optimizer": {"type": "Lamb", "params": {"lr": 2e-3}},
+        "optimizer": {"type": "Lamb", "params": dict(
+            {"lr": 2e-3},
+            **({"moments_dtype": "bf16"} if bf16_state else {}))},
+        **({"data_types": {"grad_accum_dtype": "bf16"}}
+           if bf16_state else {}),
         "steps_per_print": 10 ** 9,
     })
     rs = np.random.RandomState(0)
@@ -63,7 +67,8 @@ def run(seq, micro_batch, steps=10, warmup=2):
 
 
 def main():
-    for seq, mb_ladder in [(128, [256, 128, 64]), (512, [64, 32, 16])]:
+    for seq, mb_ladder in [(128, [384, 320, 256, 128]),
+                           (512, [96, 80, 64, 32])]:
         for mb in mb_ladder:
             try:
                 print(json.dumps(run(seq, mb)), flush=True)

@@ -308,3 +308,22 @@ def test_bf16_moments_update_math_fp32():
     assert n16["exp_avg"]["w"].dtype == jnp.bfloat16
     np.testing.assert_allclose(np.asarray(p16["w"]), np.asarray(p32["w"]),
                                rtol=2e-2, atol=2e-4)
+
+
+def test_lamb_bf16_moments():
+    """FusedLamb carries the same moments_dtype lever as Adam (the
+    round-5 BERT bench rides it): bf16 stored moments, fp32 update
+    math, pallas combo rejected loudly."""
+    from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb, lamb_update
+    import pytest as _pytest
+    opt = FusedLamb(lr=1e-3, moments_dtype="bf16")
+    params = {"w": jnp.ones((8, 8), jnp.float32)}
+    state = opt.init_state(params)
+    assert state["exp_avg"]["w"].dtype == jnp.bfloat16
+    grads = {"w": jnp.full((8, 8), 0.1, jnp.float32)}
+    new_p, new_s = opt.update(grads, state, params, lr=1e-3, beta1=0.9,
+                              beta2=0.999, eps=1e-8, weight_decay=0.0)
+    assert new_s["exp_avg"]["w"].dtype == jnp.bfloat16
+    assert np.isfinite(np.asarray(new_p["w"])).all()
+    with _pytest.raises(ValueError, match="incompatible"):
+        FusedLamb(use_pallas=True, moments_dtype="bf16")

@@ -6,13 +6,69 @@ pointer, ``<dir>/<tag>/mp_rank_XX_model_states.pt`` model file, separate
 round trip. Tensors are stored as numpy inside a pickled dict; sharded
 ``jax.Array``s are gathered to host first (orbax-style async sharded
 checkpointing can replace the transport without changing this layout).
+
+Integrity layer (docs/checkpoint_recovery.md): every file write is atomic
+(tmp + fsync + rename) and returns a ``{"path", "crc32", "bytes"}`` record;
+a tag's LAST content file is ``manifest.json`` listing every file with its
+CRC32 and byte size, so *a tag without a valid manifest is by definition
+incomplete*. ``verify_tag`` re-checks existence/size/CRC before a load,
+``newest_complete_tag`` scans backward to the last good tag when the
+pointed-to one is torn or bit-rotted, and ``prune_checkpoints`` retains the
+newest N tags without ever deleting the tag ``latest`` names (or anything
+newer). All reads/writes retry transient ``OSError`` with exponential
+backoff + jitter (utils/retry.py) — on TPU pods preemption and flaky
+GCS-fuse-style storage are the normal case, not the exception.
 """
+import atexit
+import json
 import os
 import pickle
+import shutil
+import zlib
 
 import numpy as np
 
 import jax
+
+from ..utils.logging import logger
+from ..utils.retry import RetryPolicy, retry_call
+
+MANIFEST_NAME = "manifest.json"
+CHECKPOINT_FORMAT_VERSION = 1
+# verify_tag reason for a tag dir predating the manifest format; callers
+# may choose to load such tags unverified (legacy) instead of rejecting
+NO_MANIFEST = "no manifest"
+
+
+class CheckpointCorruptionError(Exception):
+    """A checkpoint file exists but its contents are torn or bit-rotted
+    (truncated pickle, checksum mismatch). NOT retried: corruption does
+    not heal — the caller should fall back to the last complete tag."""
+
+
+# ----------------------------------------------------------------- IO policy
+_RETRY_POLICY = RetryPolicy()
+
+# installed by utils/fault_injection.inject_faults for crash/bit-rot tests
+_FAULT_INJECTOR = None
+
+
+def set_retry_policy(policy=None, **kwargs):
+    """Configure transient-IO retry behavior for every checkpoint
+    read/write in this process (ds_config ``"checkpoint"`` block; kwargs
+    are RetryPolicy fields, e.g. ``retries=``, ``backoff_seconds=``)."""
+    global _RETRY_POLICY
+    _RETRY_POLICY = policy if policy is not None \
+        else _RETRY_POLICY._replace(**kwargs)
+    return _RETRY_POLICY
+
+
+def _log_io_retry(path):
+    def _on_retry(attempt, exc, delay):
+        logger.warning(
+            "transient checkpoint IO failure on %s (attempt %d: %s) — "
+            "retrying in %.3fs", path, attempt + 1, exc, delay)
+    return _on_retry
 
 
 def tree_to_numpy(tree):
@@ -110,13 +166,32 @@ _WRITE_POOL = None
 def _write_pool():
     """One serial background writer: submissions execute in order, so an
     async ``save_latest`` queued after the shard writes cannot run until
-    they have all landed."""
+    they have all landed. An atexit drain guarantees queued shard writes
+    and the ``latest`` update complete on clean interpreter exit instead
+    of being dropped mid-queue."""
     global _WRITE_POOL
     if _WRITE_POOL is None:
         from concurrent.futures import ThreadPoolExecutor
         _WRITE_POOL = ThreadPoolExecutor(max_workers=1,
                                          thread_name_prefix="ckpt-write")
+        atexit.register(_drain_write_pool_at_exit)
     return _WRITE_POOL
+
+
+def _drain_write_pool_at_exit():
+    pool = _WRITE_POOL
+    if pool is not None:
+        pool.shutdown(wait=True)
+
+
+def wait_pending_writes():
+    """Block until every checkpoint write queued on the background pool so
+    far has executed (success or failure — failures stay recorded on
+    their futures). Engines call this before re-saving a tag so a
+    still-queued write of the same path cannot interleave."""
+    if _WRITE_POOL is None:
+        return
+    _WRITE_POOL.submit(lambda: None).result()
 
 
 def _fsync_dir(dirname):
@@ -130,25 +205,61 @@ def _fsync_dir(dirname):
         os.close(fd)
 
 
+class _CRC32Writer:
+    """File-object shim that CRCs and counts everything written through
+    it, so the integrity record costs no second pass over the bytes."""
+
+    def __init__(self, f):
+        self._f = f
+        self.crc = 0
+        self.size = 0
+
+    def write(self, data):
+        n = self._f.write(data)
+        self.crc = zlib.crc32(data, self.crc)
+        self.size += len(data)
+        return n
+
+    def flush(self):
+        self._f.flush()
+
+    def fileno(self):
+        return self._f.fileno()
+
+
 def _atomic_write_bytes(path, write_fn):
     """tmp + fsync + rename: a crash at ANY point leaves either the old
     complete file or no file — never a truncated one (reference parity
-    gap, round-3 VERDICT weak #6: the 2021 reference pickles in place)."""
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        write_fn(f)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
-    _fsync_dir(os.path.dirname(path) or ".")
+    gap, round-3 VERDICT weak #6: the 2021 reference pickles in place).
+    Transient OSErrors restart the whole attempt (the tmp file is
+    rewritten from scratch). Returns the ``{"path", "crc32", "bytes"}``
+    record the tag manifest is built from."""
+    def _attempt():
+        if _FAULT_INJECTOR is not None:
+            _FAULT_INJECTOR.before_write(path)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as raw:
+            shim = _CRC32Writer(raw)
+            write_fn(shim)
+            raw.flush()
+            os.fsync(raw.fileno())
+        os.replace(tmp, path)
+        _fsync_dir(os.path.dirname(path) or ".")
+        return {"path": path, "crc32": shim.crc, "bytes": shim.size}
+    record = retry_call(_attempt, policy=_RETRY_POLICY,
+                        retry_on=(OSError,), on_retry=_log_io_retry(path))
+    if _FAULT_INJECTOR is not None:
+        _FAULT_INJECTOR.after_write(path)
+    return record
 
 
 def save_state_dict(path, state_dict, async_save=False):
     """Atomically persist ``state_dict`` (device leaves gathered to host
     SYNCHRONOUSLY — callers may mutate or donate them right after this
-    returns). With ``async_save`` the pickle+write runs on the serial
-    background writer and a future is returned; at 1.5B a per-rank shard
-    file is GB-scale and the write otherwise blocks the train loop.
+    returns). Returns the write's integrity record; with ``async_save``
+    the pickle+write runs on the serial background writer and a future of
+    that record is returned instead — at 1.5B a per-rank shard file is
+    GB-scale and the write otherwise blocks the train loop.
     Async COPIES host numpy leaves first: the ZeRO-Offload payload holds
     the live master/moment arrays that the next step's in-place host
     Adam mutates, and pickling them concurrently would tear the file."""
@@ -161,8 +272,7 @@ def save_state_dict(path, state_dict, async_save=False):
     writer = lambda f: pickle.dump(payload, f, protocol=4)
     if async_save:
         return _write_pool().submit(_atomic_write_bytes, path, writer)
-    _atomic_write_bytes(path, writer)
-    return None
+    return _atomic_write_bytes(path, writer)
 
 
 def save_latest_after(save_dir, tag, shard_futures):
@@ -184,9 +294,32 @@ def save_latest_after(save_dir, tag, shard_futures):
     return _write_pool().submit(_update)
 
 
+# truncated/garbled pickle payloads surface as any of these from
+# pickle.load; none of them heal on retry
+_UNPICKLE_ERRORS = (EOFError, pickle.UnpicklingError, ValueError,
+                    IndexError, KeyError, AttributeError, ImportError,
+                    UnicodeDecodeError)
+
+
 def load_state_dict(path):
-    with open(path, "rb") as f:
-        return pickle.load(f)
+    """Unpickle one checkpoint file (transient OSErrors retried). A
+    truncated or bit-rotted payload raises CheckpointCorruptionError
+    naming the file — callers (engine.load_checkpoint) fall back to the
+    newest complete tag instead of crashing on torn state."""
+    def _read():
+        if _FAULT_INJECTOR is not None:
+            _FAULT_INJECTOR.before_read(path)
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    try:
+        return retry_call(_read, policy=_RETRY_POLICY, retry_on=(OSError,),
+                          on_retry=_log_io_retry(path))
+    except _UNPICKLE_ERRORS as err:
+        raise CheckpointCorruptionError(
+            "checkpoint file {} is corrupt ({}: {}) — it was likely "
+            "truncated by a crash or bit-rotted in storage; "
+            "load_checkpoint falls back to the newest complete tag".format(
+                path, type(err).__name__, err)) from err
 
 
 def model_ckpt_name(checkpoints_path, tag, mp_rank=0):
@@ -206,6 +339,10 @@ def layer_ckpt_name(checkpoints_path, tag, layer_id, model_rank=0):
         "layer_{:02d}-model_{:02d}-model_states.pt".format(layer_id, model_rank))
 
 
+def manifest_path(checkpoints_path, tag):
+    return os.path.join(checkpoints_path, str(tag), MANIFEST_NAME)
+
+
 def save_latest(save_dir, tag, async_save=False):
     """Atomically update the ``latest`` pointer. Callers must only invoke
     this AFTER every checkpoint file of ``tag`` has landed (the engine
@@ -216,13 +353,257 @@ def save_latest(save_dir, tag, async_save=False):
     writer = lambda f: f.write(str(tag).encode())
     if async_save:
         return _write_pool().submit(_atomic_write_bytes, path, writer)
-    _atomic_write_bytes(path, writer)
-    return None
+    return _atomic_write_bytes(path, writer)
 
 
 def read_latest(load_dir):
+    """The tag named by the ``latest`` pointer, or None when the pointer
+    is absent, empty/whitespace, or names a tag directory that no longer
+    exists — all three mean "no trustworthy pointer" and callers fall
+    back (scan for the newest complete tag) instead of failing later
+    with a confusing missing-file error."""
     latest_path = os.path.join(load_dir, "latest")
-    if os.path.isfile(latest_path):
+    if not os.path.isfile(latest_path):
+        return None
+
+    def _read():
         with open(latest_path, "r") as f:
-            return f.read().strip()
+            return f.read()
+    tag = retry_call(_read, policy=_RETRY_POLICY, retry_on=(OSError,),
+                     on_retry=_log_io_retry(latest_path)).strip()
+    if not tag:
+        logger.warning("latest pointer %s is empty — ignoring it",
+                       latest_path)
+        return None
+    if not os.path.isdir(os.path.join(load_dir, tag)):
+        logger.warning(
+            "latest pointer %s names tag %r but %s does not exist — "
+            "ignoring it", latest_path, tag, os.path.join(load_dir, tag))
+        return None
+    return tag
+
+
+# ----------------------------------------------------------- tag manifests
+def _file_crc32(path, chunk_bytes=1 << 20):
+    def _read():
+        if _FAULT_INJECTOR is not None:
+            _FAULT_INJECTOR.before_read(path)
+        crc = 0
+        with open(path, "rb") as f:
+            while True:
+                block = f.read(chunk_bytes)
+                if not block:
+                    break
+                crc = zlib.crc32(block, crc)
+        return crc
+    return retry_call(_read, policy=_RETRY_POLICY, retry_on=(OSError,),
+                      on_retry=_log_io_retry(path))
+
+
+def write_manifest(save_dir, tag, records, meta=None):
+    """Write ``<tag>/manifest.json`` as the LAST content file of the tag:
+    file list with per-file CRC32/byte-size plus ``meta`` (global_step,
+    dp/mp world sizes). ``records`` are this process's own write records;
+    files written by OTHER processes (multi-host zero shards — the save
+    barrier already ran, so they are complete) are picked up by scanning
+    the tag dir and checksummed by reading them back."""
+    tag_dir = os.path.join(save_dir, str(tag))
+    files = {}
+    for rec in records or ():
+        if not isinstance(rec, dict) or "path" not in rec:
+            continue
+        if os.path.dirname(os.path.abspath(rec["path"])) != \
+                os.path.abspath(tag_dir):
+            continue  # e.g. the `latest` pointer — lives above the tag
+        files[os.path.basename(rec["path"])] = {
+            "crc32": rec["crc32"], "bytes": rec["bytes"]}
+    if os.path.isdir(tag_dir):
+        for name in sorted(os.listdir(tag_dir)):
+            if name == MANIFEST_NAME or name.endswith(".tmp") or \
+                    name in files:
+                continue
+            path = os.path.join(tag_dir, name)
+            if not os.path.isfile(path):
+                continue
+            files[name] = {"crc32": _file_crc32(path),
+                           "bytes": os.path.getsize(path)}
+    manifest = {"format_version": CHECKPOINT_FORMAT_VERSION,
+                "tag": str(tag), "files": files}
+    manifest.update(meta or {})
+    payload = json.dumps(manifest, indent=2, sort_keys=True).encode()
+    return _atomic_write_bytes(manifest_path(save_dir, tag),
+                               lambda f: f.write(payload))
+
+
+def write_manifest_after(save_dir, tag, shard_futures, meta=None):
+    """Queue the manifest write behind the tag's async shard writes on the
+    serial pool. Refuses to write if ANY shard failed — the tag must then
+    read as incomplete, so ``latest`` (queued after this, gated on this
+    future too) keeps naming the previous complete checkpoint."""
+    shard_futures = tuple(f for f in shard_futures if f is not None)
+
+    def _write():
+        records = []
+        for fut in shard_futures:
+            err = fut.exception()
+            if err is not None:
+                raise RuntimeError(
+                    "manifest NOT written: an earlier checkpoint shard "
+                    "write failed — tag {} stays incomplete".format(
+                        tag)) from err
+            res = fut.result()
+            if isinstance(res, dict) and "path" in res:
+                records.append(res)
+        return write_manifest(save_dir, tag, records, meta)
+
+    return _write_pool().submit(_write)
+
+
+def read_manifest(load_dir, tag):
+    """The parsed manifest dict, or None when absent/unreadable."""
+    path = manifest_path(load_dir, tag)
+    if not os.path.isfile(path):
+        return None
+
+    def _read():
+        if _FAULT_INJECTOR is not None:
+            _FAULT_INJECTOR.before_read(path)
+        with open(path, "r") as f:
+            return json.load(f)
+    try:
+        manifest = retry_call(_read, policy=_RETRY_POLICY,
+                              retry_on=(OSError,),
+                              on_retry=_log_io_retry(path))
+    except (ValueError, OSError):
+        return None
+    return manifest if isinstance(manifest, dict) else None
+
+
+def verify_tag(load_dir, tag):
+    """Is ``<load_dir>/<tag>`` a complete, uncorrupted checkpoint?
+    Returns ``(True, None)`` or ``(False, reason)``. The completeness
+    invariant: the manifest is written last, so its presence proves every
+    listed file was fully written — and each file must still exist with
+    the recorded byte size and CRC32 (bit-rot detection)."""
+    tag_dir = os.path.join(load_dir, str(tag))
+    if not os.path.isdir(tag_dir):
+        return False, "tag directory {} does not exist".format(tag_dir)
+    path = manifest_path(load_dir, tag)
+    if not os.path.isfile(path):
+        return False, NO_MANIFEST
+    manifest = read_manifest(load_dir, tag)
+    if manifest is None:
+        return False, "manifest {} is unreadable".format(path)
+    version = manifest.get("format_version")
+    if not isinstance(version, int) or version > CHECKPOINT_FORMAT_VERSION:
+        return False, "manifest {} has unsupported format_version {!r}".format(
+            path, version)
+    entries = manifest.get("files")
+    if not isinstance(entries, dict) or not entries:
+        return False, "manifest {} lists no files".format(path)
+    for name, rec in entries.items():
+        fpath = os.path.join(tag_dir, name)
+        if not os.path.isfile(fpath):
+            return False, "missing checkpoint file {}".format(fpath)
+        size = os.path.getsize(fpath)
+        if size != rec.get("bytes"):
+            return False, "size mismatch on {}: {} bytes on disk, " \
+                "{} in manifest (truncated write?)".format(
+                    fpath, size, rec.get("bytes"))
+        crc = _file_crc32(fpath)
+        if crc != rec.get("crc32"):
+            return False, "checksum mismatch on {}: crc32 {} on disk, " \
+                "{} in manifest (storage bit-rot?)".format(
+                    fpath, crc, rec.get("crc32"))
+    return True, None
+
+
+def list_tags(load_dir):
+    """Tag directory names under ``load_dir`` (no completeness check)."""
+    if not os.path.isdir(load_dir):
+        return []
+    return [name for name in os.listdir(load_dir)
+            if os.path.isdir(os.path.join(load_dir, name))]
+
+
+def _tag_recency_key(load_dir, tag):
+    """Sort key ordering tags newest-first when reverse-sorted: manifest
+    global_step when available (authoritative), directory mtime as the
+    tie-break / manifest-less fallback."""
+    manifest = read_manifest(load_dir, tag)
+    step = manifest.get("global_step", -1) if manifest else -1
+    if not isinstance(step, (int, float)):
+        step = -1
+    try:
+        mtime = os.path.getmtime(os.path.join(load_dir, tag))
+    except OSError:
+        mtime = 0.0
+    return (step, mtime)
+
+
+def newest_complete_tag(load_dir, exclude=(), on_reject=None):
+    """Scan backward (newest first) through the tags under ``load_dir``
+    and return the first one whose manifest and checksums verify — the
+    last-good-checkpoint fallback. Tags in ``exclude`` (already tried and
+    rejected by the caller) are skipped; ``on_reject(tag, reason)``
+    observes every rejection so operators can see exactly what was
+    skipped and why."""
+    exclude = set(str(t) for t in exclude)
+    tags = [t for t in list_tags(load_dir) if t not in exclude]
+    tags.sort(key=lambda t: _tag_recency_key(load_dir, t), reverse=True)
+    for tag in tags:
+        ok, reason = verify_tag(load_dir, tag)
+        if ok:
+            return tag
+        if on_reject is not None:
+            on_reject(tag, reason)
     return None
+
+
+# ------------------------------------------------------------- retention GC
+def prune_checkpoints(save_dir, keep_last_n):
+    """Delete all but the newest ``keep_last_n`` tags. NEVER deletes the
+    tag named by ``latest`` or any tag newer than it — a crash between a
+    tag's manifest and the ``latest`` update leaves a complete tag the
+    pointer hasn't reached yet, and GC must not eat it. Returns the list
+    of deleted tags."""
+    if not keep_last_n or keep_last_n < 1:
+        return []
+    tags = list_tags(save_dir)
+    # one manifest read per tag — the keys are reused for the sort, the
+    # latest lookup, and the newer-than-latest protection below
+    keys = {t: _tag_recency_key(save_dir, t) for t in tags}
+    order = sorted(tags, key=keys.__getitem__, reverse=True)
+    keep = set(order[:keep_last_n])
+    latest = read_latest(save_dir)
+    if latest in keys:
+        keep.update(t for t in tags if keys[t] >= keys[latest])
+    deleted = []
+    for tag in order:
+        if tag in keep:
+            continue
+        try:
+            shutil.rmtree(os.path.join(save_dir, tag))
+            deleted.append(tag)
+        except OSError as err:
+            logger.warning("could not prune checkpoint tag %s: %s", tag, err)
+    if deleted:
+        logger.info("pruned old checkpoint tags under %s: %s", save_dir,
+                    ", ".join(deleted))
+    return deleted
+
+
+def prune_after(save_dir, keep_last_n, shard_futures):
+    """Queue retention GC behind an async save's writes. Runs only if
+    every earlier write (shards, manifest, latest) succeeded — after a
+    failed save ``latest`` still names an OLD tag, and GC keyed off a
+    stale pointer must not run."""
+    shard_futures = tuple(f for f in shard_futures if f is not None)
+
+    def _prune():
+        for fut in shard_futures:
+            if fut.exception() is not None:
+                return []
+        return prune_checkpoints(save_dir, keep_last_n)
+
+    return _write_pool().submit(_prune)

@@ -395,6 +395,38 @@ def get_checkpoint_tag_validation_mode(checkpoint_params):
             [ValidationMode.WARN, ValidationMode.IGNORE, ValidationMode.FAIL]))
 
 
+def get_checkpoint_io_retries(checkpoint_params):
+    val = checkpoint_params.get(CHECKPOINT_IO_RETRIES,
+                                CHECKPOINT_IO_RETRIES_DEFAULT)
+    if isinstance(val, bool) or not isinstance(val, int) or val < 0:
+        raise DeepSpeedConfigError(
+            "checkpoint.{} must be an int >= 0, got {!r}".format(
+                CHECKPOINT_IO_RETRIES, val))
+    return val
+
+
+def get_checkpoint_io_backoff(checkpoint_params):
+    val = checkpoint_params.get(CHECKPOINT_IO_RETRY_BACKOFF,
+                                CHECKPOINT_IO_RETRY_BACKOFF_DEFAULT)
+    if isinstance(val, bool) or not isinstance(val, (int, float)) or val < 0:
+        raise DeepSpeedConfigError(
+            "checkpoint.{} must be a number >= 0, got {!r}".format(
+                CHECKPOINT_IO_RETRY_BACKOFF, val))
+    return float(val)
+
+
+def get_checkpoint_keep_last_n(checkpoint_params):
+    val = checkpoint_params.get(CHECKPOINT_KEEP_LAST_N,
+                                CHECKPOINT_KEEP_LAST_N_DEFAULT)
+    if val is None:
+        return None
+    if isinstance(val, bool) or not isinstance(val, int) or val < 1:
+        raise DeepSpeedConfigError(
+            "checkpoint.{} must be an int >= 1 (or null to disable "
+            "pruning), got {!r}".format(CHECKPOINT_KEEP_LAST_N, val))
+    return val
+
+
 def get_pld_enabled(param_dict):
     if PROGRESSIVE_LAYER_DROP in param_dict:
         return get_scalar_param(param_dict[PROGRESSIVE_LAYER_DROP], PLD_ENABLED,
@@ -552,6 +584,11 @@ class DeepSpeedConfig(object):
         self.checkpoint_tag_validation_enabled = \
             validation_mode != ValidationMode.IGNORE
         self.checkpoint_tag_validation_fail = validation_mode == ValidationMode.FAIL
+        self.checkpoint_io_retries = get_checkpoint_io_retries(checkpoint_params)
+        self.checkpoint_io_backoff_seconds = \
+            get_checkpoint_io_backoff(checkpoint_params)
+        self.checkpoint_keep_last_n = \
+            get_checkpoint_keep_last_n(checkpoint_params)
 
     def _batch_assertion(self):
         train_batch = self.train_batch_size
@@ -648,7 +685,8 @@ class DeepSpeedConfig(object):
             "synchronize_checkpoint_boundary", "profile"},
         "progressive_layer_drop": {"enabled", "theta", "gamma"},
         "tensorboard": {"enabled", "output_path", "job_name"},
-        "checkpoint": {"tag_validation"},
+        "checkpoint": {"tag_validation", "io_retries",
+                       "io_retry_backoff_seconds", "keep_last_n"},
         "data_types": {"grad_accum_dtype"},
         "elasticity": {"enabled", "max_train_batch_size",
                        "micro_batch_sizes", "min_gpus", "max_gpus",

@@ -191,3 +191,17 @@ CHECKPOINT = "checkpoint"
 CHECKPOINT_TAG_VALIDATION = "tag_validation"
 CHECKPOINT_TAG_VALIDATION_DEFAULT = "Warn"
 CHECKPOINT_TAG_VALIDATION_MODES = ["Warn", "Ignore", "Fail"]
+
+# Transient-IO retry policy for checkpoint reads/writes (network
+# filesystems fail transiently under pod-scale load; see utils/retry.py).
+# io_retries counts attempts AFTER the first — 0 disables retrying.
+CHECKPOINT_IO_RETRIES = "io_retries"
+CHECKPOINT_IO_RETRIES_DEFAULT = 3
+CHECKPOINT_IO_RETRY_BACKOFF = "io_retry_backoff_seconds"
+CHECKPOINT_IO_RETRY_BACKOFF_DEFAULT = 0.05
+
+# Retention GC: keep the newest N tags after each successful save (the
+# tag `latest` names — and anything newer — is never deleted). None
+# disables pruning.
+CHECKPOINT_KEEP_LAST_N = "keep_last_n"
+CHECKPOINT_KEEP_LAST_N_DEFAULT = None

@@ -99,6 +99,12 @@ class DeepSpeedEngine:
         self._config = DeepSpeedConfig(self._config_file, mpu=None,
                                        param_dict=self._config_dict,
                                        mesh=self.mesh)
+        # transient-IO retry policy for every checkpoint read/write
+        # (ds_config "checkpoint" block; process-wide by design — the
+        # storage backend is shared, so the last engine configured wins)
+        ckpt.set_retry_policy(
+            retries=self._config.checkpoint_io_retries,
+            backoff_seconds=self._config.checkpoint_io_backoff_seconds)
         self.model = as_model(model, model_parameters)
         self._configure_precision()
         self._configure_zero()
@@ -1439,24 +1445,31 @@ class DeepSpeedEngine:
             self.global_steps)
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
-                        save_latest=True, async_save=False):
+                        save_latest=True, async_save=False,
+                        _write_manifest=True):
         """Save model+optimizer+scheduler+counters
         (reference engine.py:1569-1685).
 
-        Every file write is atomic (tmp + fsync + rename) and ``latest``
-        moves only after every shard file of the tag has landed — a crash
-        at any point leaves ``latest`` naming a complete checkpoint.
+        Every file write is atomic (tmp + fsync + rename), the tag's
+        ``manifest.json`` (file list + CRC32s) is written after every
+        content file, and ``latest`` moves only after the manifest — a
+        crash at any point leaves ``latest`` naming a complete,
+        checksum-verifiable checkpoint (docs/checkpoint_recovery.md).
         ``async_save``: pickle+write runs on a serial background thread
         (device state is still gathered synchronously, so training may
         continue mutating it); single-process only — multi-process saves
-        need the inter-file barrier and stay synchronous."""
+        need the inter-file barrier and stay synchronous.
+        ``_write_manifest=False`` is for subclasses (pipe engine) that
+        append more tag files and must finalize the manifest themselves."""
         tag = self._get_ckpt_tag(tag)
         self._validate_tag(tag)
         client_state = client_state or {}
         async_save = async_save and jax.process_count() == 1
         # at most one save in flight: surface any prior async failure
-        # here rather than silently dropping it
+        # here rather than silently dropping it, and let still-queued
+        # background writes land before we re-write the same paths
         self._drain_ckpt_writes()
+        ckpt.wait_pending_writes()
 
         is_writer = jax.process_index() == 0
         # bf16/static-scale runs only fetch the overflow flag at print
@@ -1503,12 +1516,18 @@ class DeepSpeedEngine:
             sd["torn_offload_step"] = self.host_state["torn_step"]
         sd.update(client_state)
 
-        futures = []
+        futures, records = [], []
+
+        def note(res):
+            # sync writes return integrity records, async ones futures of
+            # those records; both feed the tag manifest
+            if res is not None:
+                (futures if hasattr(res, "result") else records).append(res)
+
         if is_writer:
             path = ckpt.model_ckpt_name(save_dir, tag,
                                         mp_rank=0)
-            futures.append(ckpt.save_state_dict(path, sd,
-                                                async_save=async_save))
+            note(ckpt.save_state_dict(path, sd, async_save=async_save))
             logger.info("Saved checkpoint: {}".format(path))
         if offload_sharded:
             # EVERY process writes its own zero file with its host shards
@@ -1516,7 +1535,7 @@ class DeepSpeedEngine:
             # index so load re-slots them exactly
             zpath = ckpt.zero_ckpt_name(save_dir, tag,
                                         dp_rank=jax.process_index())
-            futures.append(ckpt.save_state_dict(zpath, {
+            note(ckpt.save_state_dict(zpath, {
                 "offload_shards": [
                     [(_shard_key(idx), p, m, v) for idx, p, m, v in shards]
                     for shards in self.host_state["shard_leaves"]],
@@ -1535,28 +1554,23 @@ class DeepSpeedEngine:
             # gathered tree, keeping elastic resharding on load
             zpath = ckpt.zero_ckpt_name(save_dir, tag,
                                         dp_rank=jax.process_index())
-            futures.append(ckpt.save_state_dict(zpath, {
+            note(ckpt.save_state_dict(zpath, {
                 "device_shards": self._device_zero_shard_payload(is_writer),
             }, async_save=async_save))
         if jax.process_count() > 1:
-            # EVERY process's files must land before `latest` moves: a
-            # crash after the pointer update may otherwise leave `latest`
-            # naming a checkpoint whose zero shards never finished
-            # (reference barriers around checkpoint IO, engine.py:1610)
+            # EVERY process's files must land before the manifest and
+            # `latest` move: a crash after the pointer update may
+            # otherwise leave `latest` naming a checkpoint whose zero
+            # shards never finished (reference barriers around checkpoint
+            # IO, engine.py:1610)
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices(
                 "save_checkpoint_files:{}".format(tag))
-        if is_writer and save_latest:
-            if async_save:
-                # the serial pool guarantees the latest task runs after
-                # this process's shard writes; save_latest_after also
-                # REFUSES the update if any of them failed, so `latest`
-                # can never name a tag with a missing shard
-                futures.append(ckpt.save_latest_after(
-                    save_dir, tag, futures))
-            else:
-                ckpt.save_latest(save_dir, tag)
+        if _write_manifest:
+            self._finalize_ckpt_tag(save_dir, tag, records, futures,
+                                    save_latest, async_save)
         self._ckpt_futures = [f for f in futures if f is not None]
+        self._ckpt_records = records
         if jax.process_count() > 1:
             # a process must not proceed to (and possibly load) a
             # checkpoint other writers haven't finished
@@ -1564,6 +1578,53 @@ class DeepSpeedEngine:
             multihost_utils.sync_global_devices(
                 "save_checkpoint:{}".format(tag))
         return True
+
+    def _ckpt_manifest_meta(self):
+        return {"global_step": int(self.global_steps),
+                "dp_world_size": int(self.dp_world_size),
+                "mp_world_size": int(self.mp_world_size)}
+
+    def _finalize_ckpt_tag(self, save_dir, tag, records, futures,
+                           save_latest, async_save):
+        """Close out a checkpoint tag, writer-rank only: manifest.json
+        LAST among the tag's files (its presence defines completeness),
+        then the ``latest`` pointer, then retention GC. In async mode
+        each step is queued on the serial writer pool gated on everything
+        before it, so a failure anywhere leaves the manifest unwritten
+        and ``latest`` naming the previous complete tag."""
+        if jax.process_index() != 0:
+            return
+        meta = self._ckpt_manifest_meta()
+        if async_save:
+            futures.append(ckpt.write_manifest_after(
+                save_dir, tag, futures, meta))
+        else:
+            records.append(ckpt.write_manifest(save_dir, tag, records, meta))
+        if not save_latest:
+            return
+        if async_save:
+            # the serial pool guarantees the latest task runs after this
+            # process's shard+manifest writes; save_latest_after also
+            # REFUSES the update if any of them failed, so `latest` can
+            # never name a tag with a missing or unverifiable file
+            futures.append(ckpt.save_latest_after(save_dir, tag, futures))
+        else:
+            ckpt.save_latest(save_dir, tag)
+        keep_last_n = getattr(self._config, "checkpoint_keep_last_n", None)
+        if keep_last_n:
+            if async_save:
+                futures.append(ckpt.prune_after(
+                    save_dir, keep_last_n, futures))
+            else:
+                ckpt.prune_checkpoints(save_dir, keep_last_n)
+
+    def wait_pending_writes(self):
+        """Block until every queued checkpoint write has landed — this
+        engine's in-flight async futures (re-raising the first failure)
+        and anything else on the global background writer pool. Call
+        before handing the checkpoint dir to another consumer."""
+        self._drain_ckpt_writes()
+        ckpt.wait_pending_writes()
 
     def _drain_ckpt_writes(self):
         """Block on any in-flight async checkpoint writes (re-raising the
@@ -1813,22 +1874,90 @@ class DeepSpeedEngine:
         ``load_from_fp32_weights``: restore the fp32 master from the saved
         fp32 shards (exact resume) vs recast from the fp16/bf16 params
         (reference stage2.py:1741-1763 toggle).
+
+        Integrity + last-good fallback (docs/checkpoint_recovery.md): the
+        chosen tag's manifest and file checksums are verified first; on
+        any mismatch/missing file — or corruption surfacing mid-load —
+        the scan walks backward through prior tags to the newest complete
+        one, logging exactly what was rejected and why, instead of
+        crashing or loading torn state. The fallback applies when
+        ``tag=None`` (resume-from-latest); an explicitly named tag that
+        fails returns ``(None, None)`` rather than silently substituting
+        different weights. Tags predating the manifest format load
+        unverified with a warning.
         """
         self._drain_ckpt_writes()
+        ckpt.wait_pending_writes()
+        requested = tag
         if tag is None:
             tag = ckpt.read_latest(load_dir)
-            if tag is None:
-                logger.warning(
-                    "Unable to find latest file at {}, if trying to load "
-                    "latest checkpoint please pass a valid tag".format(
-                        os.path.join(load_dir, "latest")))
-                return None, None
 
+        def _reject(bad_tag, why):
+            logger.error("checkpoint tag %r under %s rejected: %s",
+                         bad_tag, load_dir, why)
+
+        tried = []
+        verified_by_scan = False
+        while True:
+            if tag is None:
+                if requested is not None:
+                    # the caller named this tag explicitly: quietly
+                    # loading some OTHER tag would resume on the wrong
+                    # weights with no programmatic signal — fail instead
+                    # (tag=None opts into the last-good fallback)
+                    break
+                tag = ckpt.newest_complete_tag(load_dir, exclude=tried,
+                                               on_reject=_reject)
+                if tag is None:
+                    break
+                verified_by_scan = True
+                logger.warning(
+                    "falling back to newest complete checkpoint tag %r "
+                    "under %s", tag, load_dir)
+            tried.append(tag)
+            # a tag the scan returned already passed the full CRC check —
+            # don't re-read a multi-GB checkpoint just to verify it twice
+            ok, reason = (True, None) if verified_by_scan \
+                else ckpt.verify_tag(load_dir, tag)
+            if ok or reason == ckpt.NO_MANIFEST:
+                if not ok:
+                    logger.warning(
+                        "checkpoint %s/%s predates the manifest format — "
+                        "loading without integrity verification",
+                        load_dir, tag)
+                try:
+                    return self._load_checkpoint_tag(
+                        load_dir, tag, load_module_strict,
+                        load_optimizer_states, load_lr_scheduler_states,
+                        load_from_fp32_weights)
+                except ckpt.CheckpointCorruptionError as err:
+                    if ok:
+                        # the bytes CRC-verified, yet unpickling failed:
+                        # that is not bit-rot but an environment/pickle
+                        # compatibility problem every other tag would
+                        # repeat — crash loudly instead of silently
+                        # walking back to (None, None) and a fresh start
+                        raise
+                    _reject(tag, err)
+            else:
+                _reject(tag, reason)
+            tag = None  # scan for the next-newest complete tag
+
+        logger.warning(
+            "Unable to find a loadable checkpoint under {} (requested "
+            "tag: {}); pass a valid tag or check the rejection log "
+            "above".format(load_dir, requested if requested is not None
+                           else "latest"))
+        return None, None
+
+    def _load_checkpoint_tag(self, load_dir, tag, load_module_strict,
+                             load_optimizer_states,
+                             load_lr_scheduler_states,
+                             load_from_fp32_weights):
         path = ckpt.model_ckpt_name(load_dir, tag, mp_rank=0)
         if not os.path.isfile(path):
-            logger.warning("Client provided checkpoint load path: {} does not "
-                           "exist".format(path))
-            return None, None
+            raise ckpt.CheckpointCorruptionError(
+                "model states file {} does not exist".format(path))
         sd = ckpt.load_state_dict(path)
         sd = self._adapt_state_dict(sd)
 

@@ -751,15 +751,19 @@ class PipelineEngine(DeepSpeedEngine):
             "num_virtual": getattr(self.pipe_module, "num_virtual", 1),
         }
         tag = self._get_ckpt_tag(tag)
-        # `latest` must move only after EVERY file of the tag — including
-        # the per-layer body files written below — so the base save runs
-        # with save_latest=False and the pointer updates last (async: a
-        # save_latest_after gated on ALL futures on the serial pool).
+        # the manifest and `latest` must cover/move only after EVERY file
+        # of the tag — including the per-layer body files written below —
+        # so the base save defers finalization (_write_manifest=False)
+        # and this override closes the tag out itself (async: manifest
+        # and latest tasks gated on ALL futures on the serial pool).
         ok = super().save_checkpoint(save_dir, tag=tag,
                                      client_state=client_state,
                                      save_latest=False,
-                                     async_save=async_save)
+                                     async_save=async_save,
+                                     _write_manifest=False)
         futures = list(self._ckpt_futures)
+        records = list(getattr(self, "_ckpt_records", []))
+        async_eff = async_save and jax.process_count() == 1
         if jax.process_index() == 0:
             body = ckpt.tree_to_numpy(self.state["params"]["body"])
             module = self.pipe_module
@@ -767,17 +771,17 @@ class PipelineEngine(DeepSpeedEngine):
                 idx = self._global_to_slot(module, layer_id)
                 layer_tree = jax.tree_util.tree_map(
                     lambda x: x[idx], body)
-                futures.append(ckpt.save_state_dict(
+                res = ckpt.save_state_dict(
                     ckpt.layer_ckpt_name(save_dir, tag, layer_id),
                     layer_tree,
-                    async_save=async_save and jax.process_count() == 1))
-            if save_latest:
-                if async_save and jax.process_count() == 1:
-                    futures.append(ckpt.save_latest_after(
-                        save_dir, tag, futures))
-                else:
-                    ckpt.save_latest(save_dir, tag)
+                    async_save=async_eff)
+                if res is not None:
+                    (futures if hasattr(res, "result")
+                     else records).append(res)
+        self._finalize_ckpt_tag(save_dir, tag, records, futures,
+                                save_latest, async_eff)
         self._ckpt_futures = [f for f in futures if f is not None]
+        self._ckpt_records = records
         if jax.process_count() > 1:
             # the base save's barrier ran BEFORE the per-layer files and
             # the latest update above; without a second barrier a

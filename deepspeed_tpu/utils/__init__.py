@@ -1,3 +1,5 @@
 from .logging import logger, log_dist
 from .timer import SynchronizedWallClockTimer, ThroughputTimer
 from .distributed import init_distributed
+from .retry import RetryPolicy, retry_call, retryable, NO_RETRY
+from .fault_injection import FaultInjector, SimulatedKill, inject_faults

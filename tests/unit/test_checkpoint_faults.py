@@ -1,0 +1,302 @@
+"""Checkpoint fault-injection tests (docs/checkpoint_recovery.md).
+
+Proves the save→kill→resume contract: a kill injected after each of the
+K files of a tag leaves ``load_checkpoint`` resuming from the newest
+COMPLETE tag with all checksums verified — for plain, ZeRO-sharded, and
+async-save checkpoints, at every injection point. Also covers bit-rot
+detection (CRC32), truncation, transient-IO retry, and retention GC.
+
+All faults are counter-based (utils/fault_injection.py) — no timing, no
+randomness — so these run fast, CPU-only, and deterministically in the
+tier-1 ``-m 'not slow'`` selection under the ``faults`` marker.
+"""
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu as deepspeed
+from deepspeed_tpu.runtime import checkpointing as ckpt
+from deepspeed_tpu.utils.fault_injection import inject_faults, SimulatedKill
+from simple_model import make_simple_model, SimpleDataset, base_config
+
+pytestmark = pytest.mark.faults
+
+HIDDEN = 8
+WORLD = 8
+
+
+def _cfg(zero=False):
+    cfg = base_config(WORLD)
+    # no sleeping between injected transient failures
+    cfg["checkpoint"] = {"io_retries": 3, "io_retry_backoff_seconds": 0}
+    if zero:
+        cfg["bf16"] = {"enabled": True}
+        cfg["zero_optimization"] = {"stage": 2}
+    return cfg
+
+
+def make_engine(config, seed=0):
+    model = make_simple_model(HIDDEN, seed=seed)
+    engine, _, _, _ = deepspeed.initialize(model=model, config_params=config)
+    return engine
+
+
+def run_steps(engine, dataset, steps, offset=0):
+    mb = engine.train_micro_batch_size_per_gpu() * WORLD
+    for s in range(steps):
+        base = (offset + s) * mb
+        x = np.stack([dataset[(base + i) % len(dataset)][0]
+                      for i in range(mb)])
+        y = np.stack([dataset[(base + i) % len(dataset)][1]
+                      for i in range(mb)])
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+
+
+# --------------------------------------------------------------- kill tests
+@pytest.mark.parametrize("mode", ["plain", "zero", "async"])
+def test_kill_at_every_injection_point(tmp_path, mode):
+    """Acceptance criterion: for every k, killing the writer after k
+    complete files of tag 'later' leaves `latest` on tag 'good', tag
+    'good' checksum-verified, and load_checkpoint resuming from it."""
+    cfg = _cfg(zero=(mode == "zero"))
+    dataset = SimpleDataset(64, HIDDEN)
+    e1 = make_engine(cfg)
+    run_steps(e1, dataset, 1)
+
+    # how many write ops a full tag takes: content files + manifest,
+    # plus the `latest` pointer as the final injection point
+    probe = str(tmp_path / "probe")
+    e1.save_checkpoint(probe, tag="p")
+    n_files = len(ckpt.read_manifest(probe, "p")["files"])
+    assert n_files >= (2 if mode == "zero" else 1)
+    total_writes = n_files + 2
+
+    e2 = make_engine(cfg, seed=9)
+    for k in range(total_writes):
+        d = str(tmp_path / "k{}".format(k))
+        e1.save_checkpoint(d, tag="good")
+        with inject_faults(kill_after_files=k):
+            with pytest.raises(SimulatedKill):
+                if mode == "async":
+                    e1.save_checkpoint(d, tag="later", async_save=True)
+                    e1.wait_pending_writes()
+                else:
+                    e1.save_checkpoint(d, tag="later")
+        # `latest` still names the last complete tag and it verifies
+        assert ckpt.read_latest(d) == "good"
+        ok, why = ckpt.verify_tag(d, "good")
+        assert ok, why
+        path, _ = e2.load_checkpoint(d)
+        assert path is not None and os.sep + "good" + os.sep in path
+        assert e2.global_steps == e1.global_steps
+
+    # no injection: the same save completes and moves the pointer
+    d = str(tmp_path / "clean")
+    e1.save_checkpoint(d, tag="good")
+    e1.save_checkpoint(d, tag="later")
+    assert ckpt.read_latest(d) == "later"
+    assert ckpt.verify_tag(d, "later")[0]
+
+
+# ----------------------------------------------------- corruption / bit-rot
+def test_bitrot_rejected_and_falls_back_to_prior_tag(tmp_path):
+    """A bit-flip landing AFTER a file was fully written (storage rot —
+    atomic rename can't help) fails CRC verification; load walks back to
+    the newest complete tag."""
+    dataset = SimpleDataset(64, HIDDEN)
+    save_dir = str(tmp_path / "ckpt")
+    e1 = make_engine(_cfg())
+    run_steps(e1, dataset, 1)
+    e1.save_checkpoint(save_dir, tag="t1")
+    run_steps(e1, dataset, 1, offset=1)
+    with inject_faults(corrupt_substr="model_states", corrupt_mode="flip"):
+        e1.save_checkpoint(save_dir, tag="t2")
+    assert ckpt.read_latest(save_dir) == "t2"
+    ok, why = ckpt.verify_tag(save_dir, "t2")
+    assert not ok and "checksum mismatch" in why
+
+    e2 = make_engine(_cfg(), seed=3)
+    path, _ = e2.load_checkpoint(save_dir)
+    assert path is not None and os.sep + "t1" + os.sep in path
+    assert e2.global_steps == 1
+
+
+def test_fallback_scans_to_newest_complete_not_oldest(tmp_path):
+    """With t1 < t2 < t3 and only t3 corrupted, the fallback lands on t2
+    (newest complete), not t1."""
+    dataset = SimpleDataset(64, HIDDEN)
+    save_dir = str(tmp_path / "ckpt")
+    e1 = make_engine(_cfg(zero=True))
+    run_steps(e1, dataset, 1)
+    e1.save_checkpoint(save_dir, tag="t1")
+    run_steps(e1, dataset, 1, offset=1)
+    e1.save_checkpoint(save_dir, tag="t2")
+    run_steps(e1, dataset, 1, offset=2)
+    with inject_faults(corrupt_substr="optim_states",
+                       corrupt_mode="truncate"):
+        e1.save_checkpoint(save_dir, tag="t3")
+    ok, why = ckpt.verify_tag(save_dir, "t3")
+    assert not ok and "size mismatch" in why
+
+    e2 = make_engine(_cfg(zero=True), seed=3)
+    path, _ = e2.load_checkpoint(save_dir)
+    assert path is not None and os.sep + "t2" + os.sep in path
+    assert e2.global_steps == 2
+
+
+def test_truncated_shard_raises_corruption_error_naming_file(tmp_path):
+    """load_state_dict on a torn pickle raises CheckpointCorruptionError
+    naming the file and pointing at the fallback path — not a bare
+    EOFError."""
+    path = str(tmp_path / "shard.pt")
+    with open(path, "wb") as f:
+        pickle.dump({"x": np.arange(100)}, f, protocol=4)
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) // 2)
+    with pytest.raises(ckpt.CheckpointCorruptionError) as err:
+        ckpt.load_state_dict(path)
+    assert "shard.pt" in str(err.value)
+    assert "falls back" in str(err.value)
+
+
+# ------------------------------------------------------------ transient IO
+def test_transient_write_failures_are_retried(tmp_path):
+    dataset = SimpleDataset(64, HIDDEN)
+    save_dir = str(tmp_path / "ckpt")
+    e1 = make_engine(_cfg())  # io_retries=3
+    run_steps(e1, dataset, 1)
+    with inject_faults(fail_substr="model_states", n_failures=2) as fi:
+        e1.save_checkpoint(save_dir, tag="t")
+    assert [e for e, _ in fi.events].count("write_fail") == 2
+    ok, why = ckpt.verify_tag(save_dir, "t")
+    assert ok, why
+
+
+def test_write_failures_beyond_retry_budget_keep_latest_intact(tmp_path):
+    dataset = SimpleDataset(64, HIDDEN)
+    save_dir = str(tmp_path / "ckpt")
+    cfg = _cfg()
+    cfg["checkpoint"]["io_retries"] = 1
+    e1 = make_engine(cfg)
+    run_steps(e1, dataset, 1)
+    e1.save_checkpoint(save_dir, tag="good")
+    with inject_faults(fail_substr="model_states", n_failures=5):
+        with pytest.raises(OSError):
+            e1.save_checkpoint(save_dir, tag="bad")
+    assert ckpt.read_latest(save_dir) == "good"
+    e2 = make_engine(cfg, seed=3)
+    path, _ = e2.load_checkpoint(save_dir)
+    assert path is not None and os.sep + "good" + os.sep in path
+
+
+def test_transient_read_failures_are_retried(tmp_path):
+    dataset = SimpleDataset(64, HIDDEN)
+    save_dir = str(tmp_path / "ckpt")
+    e1 = make_engine(_cfg())
+    run_steps(e1, dataset, 1)
+    e1.save_checkpoint(save_dir, tag="t")
+    e2 = make_engine(_cfg(), seed=3)
+    with inject_faults(fail_substr="model_states", n_failures=2,
+                       fail_reads=True) as fi:
+        path, _ = e2.load_checkpoint(save_dir)
+    assert path is not None
+    assert [e for e, _ in fi.events].count("read_fail") == 2
+
+
+# ------------------------------------------------------------- retention GC
+def test_retention_gc_keeps_last_n_and_never_eats_latest(tmp_path):
+    dataset = SimpleDataset(64, HIDDEN)
+    save_dir = str(tmp_path / "ckpt")
+    cfg = _cfg()
+    cfg["checkpoint"]["keep_last_n"] = 2
+    e1 = make_engine(cfg)
+    for i in range(4):
+        run_steps(e1, dataset, 1, offset=i)
+        e1.save_checkpoint(save_dir)  # tags global_step1..4
+    tags = set(ckpt.list_tags(save_dir))
+    assert tags == {"global_step3", "global_step4"}
+    assert ckpt.read_latest(save_dir) == "global_step4"
+    e2 = make_engine(cfg, seed=3)
+    path, _ = e2.load_checkpoint(save_dir)
+    assert path is not None and e2.global_steps == 4
+
+
+def test_prune_protects_latest_and_anything_newer(tmp_path):
+    """Direct unit check of the GC invariant: with `latest` pinned to an
+    OLD tag (crash landed between a newer tag's manifest and the pointer
+    update), neither the pinned tag nor the newer ones are deleted."""
+    save_dir = str(tmp_path / "ckpt")
+    for step, tag in enumerate(["a", "b", "c"], start=1):
+        rec = ckpt.save_state_dict(
+            ckpt.model_ckpt_name(save_dir, tag), {"step": step})
+        ckpt.write_manifest(save_dir, tag, [rec], {"global_step": step})
+    ckpt.save_latest(save_dir, "b")
+    deleted = ckpt.prune_checkpoints(save_dir, keep_last_n=1)
+    assert deleted == ["a"]
+    assert set(ckpt.list_tags(save_dir)) == {"b", "c"}
+
+
+# --------------------------------------------- latest-pointer edge cases
+def test_read_latest_tolerates_empty_and_dangling_pointer(tmp_path):
+    save_dir = str(tmp_path / "ckpt")
+    os.makedirs(save_dir)
+    latest = os.path.join(save_dir, "latest")
+    with open(latest, "w") as f:
+        f.write("  \n\t")
+    assert ckpt.read_latest(save_dir) is None
+    with open(latest, "w") as f:
+        f.write("ghost_tag")
+    assert ckpt.read_latest(save_dir) is None
+
+
+def test_dangling_latest_falls_back_to_complete_tag(tmp_path):
+    """A `latest` pointer naming a pruned/vanished tag dir must not
+    produce a confusing missing-file error — load scans for the newest
+    complete tag instead."""
+    dataset = SimpleDataset(64, HIDDEN)
+    save_dir = str(tmp_path / "ckpt")
+    e1 = make_engine(_cfg())
+    run_steps(e1, dataset, 1)
+    e1.save_checkpoint(save_dir, tag="real")
+    with open(os.path.join(save_dir, "latest"), "w") as f:
+        f.write("vanished")
+    e2 = make_engine(_cfg(), seed=3)
+    path, _ = e2.load_checkpoint(save_dir)
+    assert path is not None and os.sep + "real" + os.sep in path
+
+
+def test_explicit_tag_failure_does_not_substitute_another_tag(tmp_path):
+    """The last-good fallback applies to resume-from-latest loads only:
+    a caller naming a tag explicitly must get those weights or (None,
+    None) — never a silent substitution."""
+    dataset = SimpleDataset(64, HIDDEN)
+    save_dir = str(tmp_path / "ckpt")
+    e1 = make_engine(_cfg())
+    run_steps(e1, dataset, 1)
+    e1.save_checkpoint(save_dir, tag="good")
+    e2 = make_engine(_cfg(), seed=3)
+    path, state = e2.load_checkpoint(save_dir, tag="no_such_tag")
+    assert path is None and state is None
+    # tag=None on the same dir does resume
+    path, _ = e2.load_checkpoint(save_dir)
+    assert path is not None and os.sep + "good" + os.sep in path
+
+
+# ---------------------------------------------------------- async plumbing
+def test_wait_pending_writes_lands_queued_files(tmp_path):
+    """The module-level pool barrier (also registered via atexit) makes
+    every queued async write visible on disk without touching engine
+    future bookkeeping."""
+    dataset = SimpleDataset(64, HIDDEN)
+    save_dir = str(tmp_path / "ckpt")
+    e1 = make_engine(_cfg())
+    run_steps(e1, dataset, 1)
+    e1.save_checkpoint(save_dir, tag="t", async_save=True)
+    ckpt.wait_pending_writes()
+    ok, why = ckpt.verify_tag(save_dir, "t")
+    assert ok, why
+    assert ckpt.read_latest(save_dir) == "t"

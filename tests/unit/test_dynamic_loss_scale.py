@@ -64,6 +64,53 @@ def test_delayed_shift_hysteresis():
     assert float(state.cur_scale) == 2 ** 7
 
 
+def test_repeated_overflow_clamps_at_min_scale_with_hysteresis():
+    """The hysteresis floor: under a storm of overflows the scale must
+    clamp at ``min_scale`` (never underflow toward 0) and the hysteresis
+    counter must never be driven below 1."""
+    state = _scaler(init_scale=2 ** 3, min_scale=1.0, delayed_shift=2,
+                    scale_window=100)
+    scales = []
+    for _ in range(10):
+        state = ls.update_scale(state, jnp.asarray(True))
+        scales.append(float(state.cur_scale))
+        assert float(state.cur_scale) >= 1.0
+        assert int(state.cur_hysteresis) >= 1
+    assert scales[0] == 2 ** 3   # first overflow absorbed by hysteresis
+    assert scales[-1] == 1.0     # clamped at the floor, not 0
+
+
+def test_scale_never_underflows_to_zero():
+    """Even with a tiny min_scale and hundreds of consecutive overflows
+    the scale stays strictly positive (a zero scale would silently zero
+    every gradient)."""
+    state = _scaler(init_scale=2 ** 16, min_scale=2.0 ** -24,
+                    delayed_shift=1, scale_window=1000)
+    for _ in range(200):
+        state = ls.update_scale(state, jnp.asarray(True))
+        assert float(state.cur_scale) > 0.0
+    assert float(state.cur_scale) == 2.0 ** -24
+
+
+def test_hysteresis_window_restarts_after_min_scale_clamp():
+    """After clamping at the floor, a clean ``scale_window`` must both
+    regrow the scale and REFILL the hysteresis budget, so the next
+    overflow is absorbed again instead of instantly re-dropping."""
+    state = _scaler(init_scale=4, min_scale=1.0, delayed_shift=3,
+                    scale_window=2)
+    for _ in range(8):
+        state = ls.update_scale(state, jnp.asarray(True))
+    assert float(state.cur_scale) == 1.0
+    state = ls.update_scale(state, jnp.asarray(False))
+    assert float(state.cur_scale) == 1.0      # window not yet elapsed
+    state = ls.update_scale(state, jnp.asarray(False))
+    assert float(state.cur_scale) == 2.0      # regrown...
+    assert int(state.cur_hysteresis) == 3     # ...and hysteresis refilled
+    state = ls.update_scale(state, jnp.asarray(True))
+    assert float(state.cur_scale) == 2.0      # absorbed by fresh budget
+    assert int(state.cur_hysteresis) == 2
+
+
 def test_static_scale_never_moves():
     state = ls.create_loss_scaler(static_loss_scale=128.0)
     for flag in (True, False, True):

@@ -1,0 +1,98 @@
+"""utils/retry.py: exponential backoff, jitter, retry budget, exception
+filtering. Deterministic — sleeps and RNG are injected."""
+import random
+
+import pytest
+
+from deepspeed_tpu.utils.retry import (NO_RETRY, RetryPolicy, backoff_delays,
+                                       retry_call, retryable)
+
+
+class _Flaky:
+    def __init__(self, failures, exc=OSError):
+        self.failures = failures
+        self.exc = exc
+        self.calls = 0
+
+    def __call__(self):
+        self.calls += 1
+        if self.calls <= self.failures:
+            raise self.exc("transient {}".format(self.calls))
+        return "ok"
+
+
+def _policy(retries=3):
+    return RetryPolicy(retries=retries, backoff_seconds=0.1,
+                       max_backoff_seconds=1.0, jitter=0.0)
+
+
+def test_succeeds_after_transient_failures():
+    fn = _Flaky(failures=2)
+    sleeps = []
+    assert retry_call(fn, policy=_policy(), sleep=sleeps.append) == "ok"
+    assert fn.calls == 3
+    # exponential: 0.1, then 0.2
+    assert sleeps == [pytest.approx(0.1), pytest.approx(0.2)]
+
+
+def test_exhausted_budget_reraises_last_error():
+    fn = _Flaky(failures=10)
+    with pytest.raises(OSError, match="transient 4"):
+        retry_call(fn, policy=_policy(retries=3), sleep=lambda _: None)
+    assert fn.calls == 4  # 1 try + 3 retries
+
+
+def test_zero_retries_tries_exactly_once():
+    fn = _Flaky(failures=1)
+    with pytest.raises(OSError):
+        retry_call(fn, policy=NO_RETRY)
+    assert fn.calls == 1
+
+
+def test_non_matching_exceptions_propagate_immediately():
+    fn = _Flaky(failures=5, exc=ValueError)
+    with pytest.raises(ValueError):
+        retry_call(fn, policy=_policy(), sleep=lambda _: None)
+    assert fn.calls == 1
+
+
+def test_backoff_caps_at_max():
+    policy = RetryPolicy(retries=6, backoff_seconds=0.1,
+                         max_backoff_seconds=0.5, jitter=0.0)
+    delays = backoff_delays(policy)
+    assert delays == pytest.approx([0.1, 0.2, 0.4, 0.5, 0.5, 0.5])
+
+
+def test_jitter_is_bounded_and_deterministic_with_seeded_rng():
+    policy = RetryPolicy(retries=4, backoff_seconds=0.1,
+                         max_backoff_seconds=1.0, jitter=0.25)
+    a = backoff_delays(policy, rng=random.Random(7))
+    b = backoff_delays(policy, rng=random.Random(7))
+    assert a == b
+    bases = [0.1, 0.2, 0.4, 0.8]
+    for delay, base in zip(a, bases):
+        assert base <= delay <= base * 1.25
+
+
+def test_on_retry_observes_each_attempt():
+    fn = _Flaky(failures=2)
+    seen = []
+    retry_call(fn, policy=_policy(),
+               on_retry=lambda attempt, exc, delay: seen.append(attempt),
+               sleep=lambda _: None)
+    assert seen == [0, 1]
+
+
+def test_retryable_decorator_passes_arguments():
+    calls = {"n": 0}
+
+    @retryable(policy=_policy())
+    def flaky_add(a, b):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("transient")
+        return a + b
+
+    # sleep not injectable through the decorator: keep the schedule tiny
+    assert flaky_add(2, 3) == 5
+    assert calls["n"] == 2

@@ -1,0 +1,99 @@
+"""Benchmark: GPT-2 serving throughput through the inference subsystem.
+
+Prints ONE JSON line in bench.py's shape:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
+
+value = decode tokens/s/chip through the continuous-batching scheduler
+(the serving steady state). vs_baseline = decode model-flops utilization
+(2N flops/token, forward only) against a 5% target — decode is
+HBM-bandwidth bound, so single-digit MFU is the healthy regime and 0.05
+is the modest north star this harness tracks.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+from bench import emit_error_json, peak_for, safe_default_backend
+
+
+def main():
+    import jax
+    import deepspeed_tpu as deepspeed
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.utils.monitor import ServingMetrics
+
+    on_tpu = safe_default_backend() == "tpu"
+    if on_tpu:
+        cfg = gpt2.config_for("gpt2_medium", max_seq_len=1024, remat=False)
+        inference = {"max_batch_size": 16, "dtype": "bf16",
+                     "prefill_buckets": [128, 256, 512],
+                     "max_new_tokens": 64, "greedy": True}
+        n_requests, prompt_lens = 48, (64, 180, 400)
+    else:
+        cfg = gpt2.GPT2Config(vocab_size=512, max_seq_len=256, n_layers=2,
+                              n_heads=4, d_model=128,
+                              use_flash_attention=False, remat=False)
+        inference = {"max_batch_size": 4, "dtype": "fp32",
+                     "prefill_buckets": [16, 32, 64],
+                     "max_new_tokens": 8, "greedy": True}
+        n_requests, prompt_lens = 8, (5, 12, 30)
+
+    n_params = gpt2.num_params(cfg)
+    model = gpt2.make_gpt2_model(config=cfg)
+    engine = deepspeed.init_inference(model=model,
+                                      config={"inference": inference})
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size,
+                           size=prompt_lens[i % len(prompt_lens)]).tolist()
+               for i in range(n_requests)]
+
+    # warmup: compile every prefill bucket + the decode fn off the clock
+    engine.generate(prompts[:len(inference["prefill_buckets"])],
+                    max_new_tokens=2)
+
+    metrics = ServingMetrics()
+    t0 = time.time()
+    outs = engine.generate(prompts, metrics=metrics)
+    wall = time.time() - t0
+    assert len(outs) == n_requests and all(len(o) > 0 for o in outs)
+
+    snap = metrics.snapshot()
+    chips = jax.device_count()
+    decode_tps = snap["decode_tokens_per_sec"]
+    # decode flops/token: forward-only dense path ~ 2N
+    flops_per_token = 2.0 * n_params
+    mfu = (decode_tps * flops_per_token / chips) / peak_for(jax.devices()[0])
+
+    print(json.dumps({
+        "metric": "gpt2_inference_decode_tokens_per_sec_per_chip",
+        "value": round(decode_tps / chips, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.05, 4),
+        "extra": {
+            "prefill_tokens_per_sec": snap["prefill_tokens_per_sec"],
+            "decode_tokens_per_sec": decode_tps,
+            "decode_mfu": round(mfu, 4),
+            "mean_slot_occupancy": snap["mean_slot_occupancy"],
+            "peak_queue_depth": snap["peak_queue_depth"],
+            "requests": n_requests,
+            "slots": engine.num_slots,
+            "prefill_buckets": engine.prefill_buckets,
+            "prefill_traces": engine.compile_stats["prefill_traces"],
+            "wall_seconds": round(wall, 2),
+            "params": n_params,
+            "kv_cache_mb": round(engine.kv.nbytes / 2 ** 20, 1),
+            "device": getattr(jax.devices()[0], "device_kind", "cpu"),
+            "backend": jax.default_backend(),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except Exception as err:  # noqa: BLE001 - emit parseable JSON, not a trace
+        emit_error_json("gpt2_inference_decode_tokens_per_sec_per_chip", err)
+        sys.exit(1)

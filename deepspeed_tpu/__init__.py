@@ -81,6 +81,58 @@ def initialize(args=None, model=None, optimizer=None, model_parameters=None,
     return tuple(return_items)
 
 
+def init_inference(model=None, config=None, mp_size=1, mesh=None,
+                   dtype=None, injection_policy=None,
+                   replace_method="auto", seed=0):
+    """Initialize the DeepSpeed-TPU inference engine.
+
+    Mirrors reference ``deepspeed.init_inference(model, mp_size, dtype,
+    injection_policy, replace_method, ...)`` alongside :func:`initialize`.
+    Returns an :class:`deepspeed_tpu.inference.InferenceEngine` with a
+    preallocated slot-based KV cache, jitted prefill/decode paths and a
+    continuous-batching scheduler (``engine.generate(prompts)``).
+
+    ``model`` is a :class:`deepspeed_tpu.Model` carrying a GPT2Config at
+    ``.config`` (``models.gpt2.make_gpt2_model``). ``config`` is a
+    ds_config dict/path whose ``inference`` section sets max_batch_size,
+    max_seq_len, prefill_buckets, dtype and sampling defaults. ``mp_size``
+    > 1 (or an explicit ``mesh`` with a ``model`` axis) shards params with
+    the model's Megatron partition specs and the KV cache over its heads
+    axis. When ``replace_method`` is truthy (default "auto") and
+    ``model.params`` is an HF-flax GPT-2 tree (a ``transformer`` subtree),
+    the params are converted IN PLACE via
+    ``module_inject.hf_gpt2_to_gpt2_params`` using ``injection_policy``
+    (default ``HFGPT2LayerPolicy``) — mirroring the reference's
+    module-mutating injection.
+    """
+    from .inference.engine import InferenceEngine
+
+    assert model is not None, "deepspeed.init_inference requires a model"
+
+    params = getattr(model, "params", None)
+    if replace_method and isinstance(params, dict):
+        tree = params.get("params", params)
+        if isinstance(tree, dict) and "transformer" in tree:
+            from .module_inject import (hf_gpt2_to_gpt2_params,
+                                        HFGPT2LayerPolicy)
+            model.params = hf_gpt2_to_gpt2_params(
+                params, policy=injection_policy or HFGPT2LayerPolicy)
+
+    log_dist("DeepSpeedTPU inference info: version={}".format(__version__),
+             ranks=[0])
+
+    if mesh is None and mp_size > 1:
+        from .parallel.topology import build_mesh
+        import jax
+        assert jax.device_count() % mp_size == 0, \
+            "mp_size {} does not divide device count {}".format(
+                mp_size, jax.device_count())
+        mesh = build_mesh(data=jax.device_count() // mp_size, model=mp_size)
+
+    return InferenceEngine(model, config=config, mesh=mesh, dtype=dtype,
+                           seed=seed)
+
+
 def _add_core_arguments(parser):
     """Add DeepSpeed args group (reference __init__.py:148)."""
     group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")

@@ -1,0 +1,31 @@
+"""TPU-native inference serving: ``deepspeed_tpu.init_inference()``.
+
+Subsystem layout:
+  config.py    — the ds_config ``inference`` section
+  kv_cache.py  — preallocated slot-based KV cache, heads-sharded
+  engine.py    — InferenceEngine: jitted prefill + fused decode_step
+  sampling.py  — jit-compatible greedy/temperature/top-k/top-p
+  scheduler.py — continuous batching at decode-step granularity
+
+``runtime/config.py`` imports ``.config`` while it is itself still
+initializing, so the engine/scheduler classes (which import DeepSpeedConfig
+back) are re-exported lazily.
+"""
+from .config import DeepSpeedInferenceConfig, DeepSpeedInferenceConfigError
+
+__all__ = ["DeepSpeedInferenceConfig", "DeepSpeedInferenceConfigError",
+           "InferenceEngine", "ContinuousBatchingScheduler",
+           "InferenceRequest", "KVCache"]
+
+
+def __getattr__(name):
+    if name == "InferenceEngine":
+        from .engine import InferenceEngine
+        return InferenceEngine
+    if name in ("ContinuousBatchingScheduler", "InferenceRequest"):
+        from . import scheduler
+        return getattr(scheduler, name)
+    if name == "KVCache":
+        from .kv_cache import KVCache
+        return KVCache
+    raise AttributeError(name)

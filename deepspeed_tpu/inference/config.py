@@ -1,0 +1,156 @@
+"""``ds_config`` ``inference`` section parser.
+
+Reference parity: deepspeed/inference's InferenceConfig surface
+(init_inference kwargs: mp_size/dtype/replace_method), folded into the
+same JSON config file the training engine reads so one ds_config drives
+both ``initialize()`` and ``init_inference()``. TPU-native additions:
+slot count (``max_batch_size``), ``prefill_buckets`` (padded prompt
+lengths — each bucket is one jit trace, so recompiles are bounded by the
+bucket list), and jit-friendly sampling defaults.
+"""
+import jax.numpy as jnp
+
+INFERENCE = "inference"
+
+INFERENCE_MAX_BATCH_SIZE = "max_batch_size"
+INFERENCE_MAX_BATCH_SIZE_DEFAULT = 8
+
+# None -> the model config's max_seq_len at engine build time.
+INFERENCE_MAX_SEQ_LEN = "max_seq_len"
+INFERENCE_MAX_SEQ_LEN_DEFAULT = None
+
+# None -> derived at engine build time: powers of two from 64 up to
+# max_seq_len (always including max_seq_len itself).
+INFERENCE_PREFILL_BUCKETS = "prefill_buckets"
+INFERENCE_PREFILL_BUCKETS_DEFAULT = None
+
+INFERENCE_DTYPE = "dtype"
+INFERENCE_DTYPE_DEFAULT = "fp32"
+_DTYPE_MAP = {
+    "fp32": jnp.float32, "float32": jnp.float32,
+    "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+    "fp16": jnp.float16, "float16": jnp.float16,
+}
+
+INFERENCE_MAX_NEW_TOKENS = "max_new_tokens"
+INFERENCE_MAX_NEW_TOKENS_DEFAULT = 128
+
+INFERENCE_EOS_TOKEN_ID = "eos_token_id"
+INFERENCE_EOS_TOKEN_ID_DEFAULT = None
+
+# Sampling defaults. greedy=True is argmax decode (deterministic);
+# temperature/top_p are traced jit operands (overridable per generate()
+# call without recompiling), top_k/greedy are trace-static.
+INFERENCE_GREEDY = "greedy"
+INFERENCE_GREEDY_DEFAULT = True
+INFERENCE_TEMPERATURE = "temperature"
+INFERENCE_TEMPERATURE_DEFAULT = 1.0
+INFERENCE_TOP_K = "top_k"
+INFERENCE_TOP_K_DEFAULT = 0          # 0 disables top-k filtering
+INFERENCE_TOP_P = "top_p"
+INFERENCE_TOP_P_DEFAULT = 1.0        # 1.0 disables nucleus filtering
+
+
+class DeepSpeedInferenceConfigError(Exception):
+    pass
+
+
+def _require(cond, msg):
+    if not cond:
+        raise DeepSpeedInferenceConfigError("inference config: " + msg)
+
+
+class DeepSpeedInferenceConfig:
+    """Typed view of the ``inference`` sub-dict of a ds_config."""
+
+    KNOWN_KEYS = {
+        INFERENCE_MAX_BATCH_SIZE, INFERENCE_MAX_SEQ_LEN,
+        INFERENCE_PREFILL_BUCKETS, INFERENCE_DTYPE,
+        INFERENCE_MAX_NEW_TOKENS, INFERENCE_EOS_TOKEN_ID,
+        INFERENCE_GREEDY, INFERENCE_TEMPERATURE, INFERENCE_TOP_K,
+        INFERENCE_TOP_P,
+    }
+
+    def __init__(self, param_dict=None):
+        sub = (param_dict or {}).get(INFERENCE, {})
+        _require(isinstance(sub, dict),
+                 "must be a dict, got {}".format(type(sub).__name__))
+
+        self.max_batch_size = sub.get(INFERENCE_MAX_BATCH_SIZE,
+                                      INFERENCE_MAX_BATCH_SIZE_DEFAULT)
+        _require(isinstance(self.max_batch_size, int) and
+                 not isinstance(self.max_batch_size, bool) and
+                 self.max_batch_size >= 1,
+                 "{} must be an int >= 1, got {!r}".format(
+                     INFERENCE_MAX_BATCH_SIZE, self.max_batch_size))
+
+        self.max_seq_len = sub.get(INFERENCE_MAX_SEQ_LEN,
+                                   INFERENCE_MAX_SEQ_LEN_DEFAULT)
+        _require(self.max_seq_len is None or
+                 (isinstance(self.max_seq_len, int) and self.max_seq_len >= 2),
+                 "{} must be an int >= 2 or null, got {!r}".format(
+                     INFERENCE_MAX_SEQ_LEN, self.max_seq_len))
+
+        buckets = sub.get(INFERENCE_PREFILL_BUCKETS,
+                          INFERENCE_PREFILL_BUCKETS_DEFAULT)
+        if buckets is not None:
+            _require(isinstance(buckets, (list, tuple)) and len(buckets) > 0
+                     and all(isinstance(b, int) and b >= 1 for b in buckets),
+                     "{} must be a non-empty list of ints, got {!r}".format(
+                         INFERENCE_PREFILL_BUCKETS, buckets))
+            buckets = sorted(set(int(b) for b in buckets))
+        self.prefill_buckets = buckets
+
+        dtype_str = str(sub.get(INFERENCE_DTYPE,
+                                INFERENCE_DTYPE_DEFAULT)).lower()
+        _require(dtype_str in _DTYPE_MAP,
+                 "{} must be one of {}, got {!r}".format(
+                     INFERENCE_DTYPE, sorted(_DTYPE_MAP), dtype_str))
+        self.dtype_name = dtype_str
+        self.dtype = _DTYPE_MAP[dtype_str]
+
+        self.max_new_tokens = sub.get(INFERENCE_MAX_NEW_TOKENS,
+                                      INFERENCE_MAX_NEW_TOKENS_DEFAULT)
+        _require(isinstance(self.max_new_tokens, int) and
+                 self.max_new_tokens >= 1,
+                 "{} must be an int >= 1, got {!r}".format(
+                     INFERENCE_MAX_NEW_TOKENS, self.max_new_tokens))
+
+        self.eos_token_id = sub.get(INFERENCE_EOS_TOKEN_ID,
+                                    INFERENCE_EOS_TOKEN_ID_DEFAULT)
+        _require(self.eos_token_id is None or
+                 isinstance(self.eos_token_id, int),
+                 "{} must be an int or null, got {!r}".format(
+                     INFERENCE_EOS_TOKEN_ID, self.eos_token_id))
+
+        self.greedy = bool(sub.get(INFERENCE_GREEDY, INFERENCE_GREEDY_DEFAULT))
+        self.temperature = float(sub.get(INFERENCE_TEMPERATURE,
+                                         INFERENCE_TEMPERATURE_DEFAULT))
+        _require(self.temperature > 0.0,
+                 "{} must be > 0, got {!r}".format(INFERENCE_TEMPERATURE,
+                                                   self.temperature))
+        self.top_k = sub.get(INFERENCE_TOP_K, INFERENCE_TOP_K_DEFAULT)
+        _require(isinstance(self.top_k, int) and self.top_k >= 0,
+                 "{} must be an int >= 0, got {!r}".format(INFERENCE_TOP_K,
+                                                           self.top_k))
+        self.top_p = float(sub.get(INFERENCE_TOP_P, INFERENCE_TOP_P_DEFAULT))
+        _require(0.0 < self.top_p <= 1.0,
+                 "{} must be in (0, 1], got {!r}".format(INFERENCE_TOP_P,
+                                                         self.top_p))
+
+    def resolve_buckets(self, max_seq_len):
+        """Final ascending bucket list for a concrete model max_seq_len:
+        each bucket is one prefill jit trace."""
+        if self.prefill_buckets is not None:
+            over = [b for b in self.prefill_buckets if b > max_seq_len]
+            _require(not over,
+                     "prefill_buckets {} exceed max_seq_len {}".format(
+                         over, max_seq_len))
+            buckets = list(self.prefill_buckets)
+        else:
+            buckets, b = [], 64
+            while b < max_seq_len:
+                buckets.append(b)
+                b *= 2
+            buckets.append(max_seq_len)
+        return buckets

@@ -1,0 +1,293 @@
+"""InferenceEngine: slot-based KV-cache serving for GPT-2-family models.
+
+The serving counterpart of ``runtime/engine.py``'s training engine,
+returned by ``deepspeed_tpu.init_inference()``. Two jitted hot paths:
+
+  * ``prefill`` — embed one request's full prompt (padded to a length
+    bucket, so the number of jit traces is bounded by the bucket list),
+    write its K/V into the request's cache slot, sample the first token;
+  * ``decode_step`` — one token for EVERY slot in a single fused step
+    (slots, 1) -> logits -> sample, writing K/V at each slot's live
+    length. Inactive slots compute garbage that the scheduler ignores;
+    their cache writes land past their live length and are masked out.
+
+Tensor parallelism: params are placed via the model's
+``partition_spec_fn`` (Megatron column/row layout) and the KV cache is
+sharded over its heads axis (kv_cache.KV_CACHE_SPEC), so XLA runs decode
+with each model shard attending over exactly the heads it owns.
+"""
+import dataclasses
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import logger
+from .config import DeepSpeedInferenceConfig
+from .kv_cache import KVCache
+from .sampling import make_sampler
+
+_UNSET = object()    # "argument not given" (None means "no EOS token")
+
+
+def _as_inference_config(config, mesh=None):
+    if isinstance(config, DeepSpeedInferenceConfig):
+        return config
+    from ..runtime.config import DeepSpeedConfig
+    if isinstance(config, DeepSpeedConfig):
+        return config.inference_config
+    if config is None:
+        return DeepSpeedInferenceConfig({})
+    if isinstance(config, dict):
+        return DeepSpeedConfig(None, param_dict=config, mesh=mesh,
+                               inference_only=True).inference_config
+    return DeepSpeedConfig(config, mesh=mesh,
+                           inference_only=True).inference_config
+
+
+class InferenceEngine:
+    """Incremental-decode engine over a ``runtime.model.Model`` whose
+    ``.config`` is a :class:`models.gpt2.GPT2Config` (``make_gpt2_model``
+    attaches it). Prompt/token values are plain ints; all device state
+    (params, KV cache) lives on ``mesh`` when one is given."""
+
+    def __init__(self, model, config=None, mesh=None, dtype=None, seed=0):
+        from ..runtime.model import as_model
+        self.module = as_model(model)
+        model_config = getattr(self.module, "config", None) or \
+            getattr(model, "config", None)
+        assert model_config is not None and hasattr(model_config, "n_heads"), \
+            "init_inference needs a model with a GPT2Config at .config " \
+            "(e.g. models.gpt2.make_gpt2_model)"
+        self.inference_config = _as_inference_config(config, mesh=mesh)
+        # dtype override is engine-local state: the config object may be
+        # shared with other engines (or the training engine) and must not
+        # be mutated
+        if dtype is not None:
+            name = dtype if isinstance(dtype, str) else np.dtype(dtype).name
+            parsed = DeepSpeedInferenceConfig({"inference": {"dtype": name}})
+            self.dtype, self.dtype_name = parsed.dtype, parsed.dtype_name
+        else:
+            self.dtype = self.inference_config.dtype
+            self.dtype_name = self.inference_config.dtype_name
+        self.mesh = mesh
+
+        # serving model config: deterministic, dense path (the cached
+        # attention owns masking; flash/scan/SP are training-path levers)
+        self.model_config = dataclasses.replace(
+            model_config, dropout=0.0, scan_blocks=False,
+            sequence_parallel=None, sp_mesh=None, sparse_attention=None,
+            sparse_embedding_grads=False, embedding_grad_mesh=None)
+
+        ic = self.inference_config
+        self.max_seq_len = ic.max_seq_len or model_config.max_seq_len
+        assert self.max_seq_len <= model_config.max_seq_len, \
+            "inference.max_seq_len {} exceeds the model's positional " \
+            "table {}".format(self.max_seq_len, model_config.max_seq_len)
+        self.num_slots = ic.max_batch_size
+        self.prefill_buckets = ic.resolve_buckets(self.max_seq_len)
+
+        params = self.module.params
+        if getattr(model_config, "scan_blocks", False):
+            # serving iterates blocks as a python list; unstack the
+            # scan-trained (L, ...) layout once at engine build
+            blocks = params["blocks"]
+            params = dict(params)
+            params["blocks"] = [
+                jax.tree_util.tree_map(lambda t, i=i: t[i], blocks)
+                for i in range(model_config.n_layers)]
+        self.params = self._place_params(params, self.dtype)
+        self.kv = KVCache.allocate(
+            self.num_slots, self.model_config.n_layers,
+            self.model_config.n_heads, self.max_seq_len,
+            self.model_config.d_head, self.dtype, mesh=mesh)
+        # host mirror of each slot's live length (tokens whose K/V are in
+        # the cache); the scheduler owns slot assignment on top of this
+        self.lengths = np.zeros((self.num_slots,), np.int32)
+
+        self._rng = jax.random.PRNGKey(seed)
+        self._prefill_fns = {}       # (bucket, greedy, top_k) -> jit fn
+        self._decode_fns = {}        # (greedy, top_k) -> jit fn
+        self.compile_stats = {"prefill_traces": 0, "decode_traces": 0}
+        logger.info(
+            "InferenceEngine: slots={} max_seq={} buckets={} dtype={} "
+            "kv_cache={:.1f} MB".format(
+                self.num_slots, self.max_seq_len, self.prefill_buckets,
+                self.dtype_name, self.kv.nbytes / 2 ** 20))
+
+    # ---------------------------------------------------------- placement
+
+    def _place_params(self, params, dtype):
+        def cast(x):
+            x = jnp.asarray(x)
+            return x.astype(dtype) if jnp.issubdtype(x.dtype,
+                                                     jnp.floating) else x
+        params = jax.tree_util.tree_map(cast, params)
+        if self.mesh is not None and \
+                self.module.partition_spec_fn is not None:
+            from ..runtime.zero.partition import ZeroShardingPlan
+            plan = ZeroShardingPlan(
+                self.mesh, stage=0,
+                model_spec_fn=self.module.partition_spec_fn)
+            shardings = plan.tree_shardings(params, "param")
+            params = jax.tree_util.tree_map(jax.device_put, params,
+                                            shardings)
+        return params
+
+    # ----------------------------------------------------------- jit fns
+
+    def _sampling_key(self, sampling):
+        ic = self.inference_config
+        s = sampling or {}
+        greedy = bool(s.get("greedy", ic.greedy))
+        # greedy ignores top_k: normalize it out of the jit cache key so a
+        # sampling override can't recompile an identical argmax program.
+        # Clamp to the vocab — lax.top_k(k > vocab) is an opaque trace
+        # error, and k == vocab is already "no filtering".
+        top_k = 0 if greedy else min(int(s.get("top_k", ic.top_k)),
+                                     self.model_config.vocab_size)
+        temperature = float(s.get("temperature", ic.temperature))
+        top_p = float(s.get("top_p", ic.top_p))
+        return greedy, top_k, temperature, top_p
+
+    @staticmethod
+    def _last_logits(params, hidden):
+        # tied-embedding LM head (models/gpt2.py lm_loss convention)
+        return hidden @ params["wte"].astype(hidden.dtype).T
+
+    def _get_prefill_fn(self, bucket, greedy, top_k):
+        key = (bucket, greedy, top_k)
+        fn = self._prefill_fns.get(key)
+        if fn is not None:
+            return fn
+        from ..models import gpt2
+        cfg = self.model_config
+        sampler = make_sampler(greedy, top_k)
+
+        def prefill(params, k_cache, v_cache, ids, slot, length, rng,
+                    temperature, top_p):
+            # ids (1, bucket); slot/length scalar int32. The request's
+            # cache rows are sliced out, filled, and written back.
+            k_row = jax.lax.dynamic_slice_in_dim(k_cache, slot, 1, axis=0)
+            v_row = jax.lax.dynamic_slice_in_dim(v_cache, slot, 1, axis=0)
+            hidden, (k_row, v_row) = gpt2.forward_hidden(
+                params, ids, cfg, cache=(k_row, v_row),
+                positions=jnp.zeros((1,), jnp.int32))
+            k_cache = jax.lax.dynamic_update_slice_in_dim(
+                k_cache, k_row, slot, axis=0)
+            v_cache = jax.lax.dynamic_update_slice_in_dim(
+                v_cache, v_row, slot, axis=0)
+            last = jnp.take(hidden[0], length - 1, axis=0)    # (d,)
+            logits = self._last_logits(params, last[None])    # (1, V)
+            token = sampler(logits, rng, temperature, top_p)[0]
+            return k_cache, v_cache, token, logits[0]
+
+        fn = jax.jit(prefill, donate_argnums=(1, 2))
+        self._prefill_fns[key] = fn
+        self.compile_stats["prefill_traces"] += 1
+        return fn
+
+    def _get_decode_fn(self, greedy, top_k):
+        key = (greedy, top_k)
+        fn = self._decode_fns.get(key)
+        if fn is not None:
+            return fn
+        from ..models import gpt2
+        cfg = self.model_config
+        sampler = make_sampler(greedy, top_k)
+
+        def decode(params, k_cache, v_cache, tokens, lengths, rng,
+                   temperature, top_p):
+            # tokens/lengths: (slots,) int32 — one new token per slot
+            hidden, (k_cache, v_cache) = gpt2.forward_hidden(
+                params, tokens[:, None], cfg, cache=(k_cache, v_cache),
+                positions=lengths)
+            logits = self._last_logits(params, hidden[:, 0])  # (slots, V)
+            next_tokens = sampler(logits, rng, temperature, top_p)
+            return k_cache, v_cache, next_tokens, logits
+
+        fn = jax.jit(decode, donate_argnums=(1, 2))
+        self._decode_fns[key] = fn
+        self.compile_stats["decode_traces"] += 1
+        return fn
+
+    def _next_rng(self):
+        self._rng, key = jax.random.split(self._rng)
+        return key
+
+    # ------------------------------------------------------------ serving
+
+    def bucket_for(self, length):
+        for b in self.prefill_buckets:
+            if length <= b:
+                return b
+        raise ValueError(
+            "prompt length {} exceeds the largest prefill bucket {} "
+            "(inference.prefill_buckets / max_seq_len)".format(
+                length, self.prefill_buckets[-1]))
+
+    def prefill(self, slot, prompt, sampling=None):
+        """Embed ``prompt`` (sequence of int token ids) into cache slot
+        ``slot`` and return the first sampled token (int)."""
+        assert 0 <= slot < self.num_slots
+        n = len(prompt)
+        assert n >= 1, "empty prompt"
+        assert n < self.max_seq_len, \
+            "prompt length {} leaves no room to decode (max_seq_len " \
+            "{})".format(n, self.max_seq_len)
+        bucket = self.bucket_for(n)
+        greedy, top_k, temperature, top_p = self._sampling_key(sampling)
+        fn = self._get_prefill_fn(bucket, greedy, top_k)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :n] = np.asarray(prompt, np.int32)
+        k, v, token, _ = fn(
+            self.params, self.kv.k, self.kv.v, jnp.asarray(ids),
+            jnp.int32(slot), jnp.int32(n), self._next_rng(),
+            jnp.float32(temperature), jnp.float32(top_p))
+        self.kv.update((k, v))
+        self.lengths[slot] = n
+        return int(token)
+
+    def decode_step(self, tokens, sampling=None):
+        """One decode step for ALL slots: ``tokens`` (slots,) are each
+        slot's most recent token (anything for inactive slots). Returns
+        the (slots,) int array of sampled next tokens; the caller decides
+        which slots' results are live and calls :meth:`advance` for them.
+        """
+        tokens = np.asarray(tokens, np.int32)
+        assert tokens.shape == (self.num_slots,)
+        greedy, top_k, temperature, top_p = self._sampling_key(sampling)
+        fn = self._get_decode_fn(greedy, top_k)
+        k, v, next_tokens, _ = fn(
+            self.params, self.kv.k, self.kv.v, jnp.asarray(tokens),
+            jnp.asarray(self.lengths), self._next_rng(),
+            jnp.float32(temperature), jnp.float32(top_p))
+        self.kv.update((k, v))
+        return np.asarray(next_tokens)
+
+    def advance(self, slot):
+        """Account slot's decode-step cache write (its length grew by 1)."""
+        self.lengths[slot] += 1
+
+    def can_decode(self, slot):
+        return self.lengths[slot] < self.max_seq_len
+
+    def free_slot(self, slot):
+        self.lengths[slot] = 0
+
+    def generate(self, prompts, max_new_tokens=None, sampling=None,
+                 eos_token_id=_UNSET, metrics=None):
+        """Generate completions for ``prompts`` via the continuous-batching
+        scheduler. Returns a list of generated-token lists, prompt order.
+        ``eos_token_id`` left unset falls through to the config default
+        (``inference.eos_token_id``); pass None to disable early stop."""
+        from .scheduler import ContinuousBatchingScheduler
+        sched = ContinuousBatchingScheduler(self, metrics=metrics,
+                                            sampling=sampling)
+        kwargs = ({} if eos_token_id is _UNSET
+                  else {"eos_token_id": eos_token_id})
+        uids = [sched.submit(p, max_new_tokens=max_new_tokens, **kwargs)
+                for p in prompts]
+        results = sched.run()
+        return [results[u] for u in uids]

@@ -1,0 +1,72 @@
+"""Preallocated slot-based KV cache for incremental decode.
+
+One buffer pair ``(k, v)`` of shape ``(slots, layers, heads, max_seq,
+d_head)`` holds every active request's attention state; a request owns one
+slot for its lifetime and its batch row in prefill/decode IS its slot
+index. Freed slots are reused without clearing — the absolute-position
+causal mask in the model's cached attention (models/gpt2.py
+``_cached_attn_ctx``) makes stale entries unreachable.
+
+Sharding: the ``heads`` axis carries the tensor-parallel partition,
+matching ``models/gpt2.py::partition_spec_fn``'s Megatron layout on the
+``model`` mesh axis (QKV column-parallel => each model shard produces its
+own heads' K/V, so the cache rows it writes are exactly the rows it owns
+and decode inserts no cross-shard cache traffic).
+"""
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel.topology import MODEL_AXIS
+
+# (slots, layers, heads, max_seq, d_head): heads sharded over the model axis
+KV_CACHE_SPEC = P(None, None, MODEL_AXIS, None, None)
+
+
+@dataclass
+class KVCache:
+    """The ``(k, v)`` buffer pair. Buffers are jax arrays updated
+    functionally: the engine's jitted prefill/decode donate them, so each
+    step writes in place at steady state."""
+
+    k: object
+    v: object
+
+    @classmethod
+    def allocate(cls, slots, layers, heads, max_seq, d_head, dtype,
+                 mesh=None):
+        shape = (slots, layers, heads, max_seq, d_head)
+        k = jnp.zeros(shape, dtype)
+        v = jnp.zeros(shape, dtype)
+        if mesh is not None and MODEL_AXIS in mesh.shape:
+            assert heads % mesh.shape[MODEL_AXIS] == 0, \
+                "n_heads {} not divisible by model-parallel degree {}".format(
+                    heads, mesh.shape[MODEL_AXIS])
+            sharding = NamedSharding(mesh, KV_CACHE_SPEC)
+            k = jax.device_put(k, sharding)
+            v = jax.device_put(v, sharding)
+        return cls(k, v)
+
+    @property
+    def num_slots(self):
+        return self.k.shape[0]
+
+    @property
+    def num_layers(self):
+        return self.k.shape[1]
+
+    @property
+    def max_seq_len(self):
+        return self.k.shape[3]
+
+    @property
+    def nbytes(self):
+        return self.k.size * self.k.dtype.itemsize * 2
+
+    def buffers(self):
+        return self.k, self.v
+
+    def update(self, buffers):
+        self.k, self.v = buffers

@@ -1,0 +1,152 @@
+"""Continuous-batching scheduler over the InferenceEngine's cache slots.
+
+Admission happens at DECODE-STEP granularity: each ``step()`` first
+prefills queued requests into whatever slots are free, then runs one
+fused decode step for every active slot, then retires slots whose
+request hit EOS / max_new_tokens / the cache ceiling. A long request
+therefore never serializes the short ones behind it — a freed slot is
+refilled on the very next step while the rest keep decoding (the Orca
+/ vLLM iteration-level scheduling discipline).
+
+Timing uses utils/timer.py's device-synchronized timers and lands in a
+:class:`utils.monitor.ServingMetrics` (prefill vs decode tokens/s, slot
+occupancy, queue depth) which can mirror into the training monitor's
+TensorBoard/JSONL stream.
+"""
+from collections import deque
+
+from ..utils.monitor import ServingMetrics
+from ..utils.timer import SynchronizedWallClockTimer
+
+_UNSET = object()
+
+
+class InferenceRequest:
+    """One queued/running generation request."""
+
+    __slots__ = ("uid", "prompt", "max_new_tokens", "eos_token_id",
+                 "generated", "slot")
+
+    def __init__(self, uid, prompt, max_new_tokens, eos_token_id):
+        self.uid = uid
+        self.prompt = list(prompt)
+        self.max_new_tokens = max_new_tokens
+        self.eos_token_id = eos_token_id
+        self.generated = []
+        self.slot = None
+
+
+class ContinuousBatchingScheduler:
+
+    def __init__(self, engine, metrics=None, sampling=None):
+        self.engine = engine
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self.sampling = sampling
+        self.queue = deque()
+        self.slots = [None] * engine.num_slots
+        self.results = {}
+        self.timers = SynchronizedWallClockTimer()
+        self._next_uid = 0
+        self.steps = 0
+
+    # ------------------------------------------------------------- intake
+
+    def submit(self, prompt, max_new_tokens=None, eos_token_id=_UNSET):
+        """Queue a request; returns its uid (results keyed by it)."""
+        ic = self.engine.inference_config
+        prompt = list(prompt)
+        assert len(prompt) >= 1, "empty prompt"
+        # admission-time validation so a bad request fails its caller,
+        # not a later step() on someone else's request
+        self.engine.bucket_for(len(prompt))
+        assert len(prompt) < self.engine.max_seq_len, \
+            "prompt length {} leaves no room to decode (max_seq_len " \
+            "{})".format(len(prompt), self.engine.max_seq_len)
+        assert max_new_tokens is None or max_new_tokens >= 1, \
+            "max_new_tokens must be >= 1, got {!r}".format(max_new_tokens)
+        req = InferenceRequest(
+            self._next_uid, prompt,
+            max_new_tokens if max_new_tokens is not None
+            else ic.max_new_tokens,
+            ic.eos_token_id if eos_token_id is _UNSET else eos_token_id)
+        self._next_uid += 1
+        self.queue.append(req)
+        return req.uid
+
+    # ------------------------------------------------------------ stepping
+
+    @property
+    def num_active(self):
+        return sum(1 for r in self.slots if r is not None)
+
+    @property
+    def has_work(self):
+        return bool(self.queue) or self.num_active > 0
+
+    def _retire_if_done(self, req):
+        done = (len(req.generated) >= req.max_new_tokens or
+                (req.eos_token_id is not None and req.generated and
+                 req.generated[-1] == req.eos_token_id) or
+                not self.engine.can_decode(req.slot))
+        if done:
+            self.results[req.uid] = list(req.generated)
+            self.slots[req.slot] = None
+            self.engine.free_slot(req.slot)
+            req.slot = None
+        return done
+
+    def step(self):
+        """Admit -> one decode step -> retire. Returns uids retired now."""
+        retired = []
+
+        # admit queued requests into free slots, one prefill each
+        for slot in range(len(self.slots)):
+            if self.slots[slot] is not None or not self.queue:
+                continue
+            req = self.queue.popleft()
+            req.slot = slot
+            self.slots[slot] = req
+            t = self.timers("prefill")
+            t.start()
+            first = self.engine.prefill(slot, req.prompt,
+                                        sampling=self.sampling)
+            t.stop()
+            self.metrics.record_prefill(len(req.prompt),
+                                        t.elapsed(reset=True))
+            req.generated.append(first)
+            if self._retire_if_done(req):
+                retired.append(req.uid)
+
+        # occupancy counts slots that did work THIS step — retire-at-admit
+        # already freed some, so measure before the decode retire pass too
+        busy = self.num_active + len(retired)
+        active = [r for r in self.slots if r is not None]
+        if active:
+            tokens = [0] * self.engine.num_slots
+            for r in active:
+                tokens[r.slot] = r.generated[-1]
+            t = self.timers("decode")
+            t.start()
+            next_tokens = self.engine.decode_step(tokens,
+                                                  sampling=self.sampling)
+            t.stop()
+            self.metrics.record_decode(len(active), t.elapsed(reset=True))
+            for r in active:
+                self.engine.advance(r.slot)
+                r.generated.append(int(next_tokens[r.slot]))
+                if self._retire_if_done(r):
+                    retired.append(r.uid)
+
+        self.steps += 1
+        self.metrics.record_schedule(
+            occupancy=min(busy, self.engine.num_slots) /
+            self.engine.num_slots,
+            queue_depth=len(self.queue), step=self.steps)
+        return retired
+
+    def run(self):
+        """Drive step() until every submitted request has retired; returns
+        {uid: generated tokens}."""
+        while self.has_work:
+            self.step()
+        return self.results

@@ -295,8 +295,94 @@ def _fused_attn_ctx(x, block_params, config):
         block_params["attn"]["qkv_bias"], config.n_heads)
 
 
-def forward_hidden(params, input_ids, config, rng=None, train=False):
-    """Embedding + transformer stack -> final hidden states."""
+def _cached_attn_ctx(x, block, config, k_cache, v_cache, layer_idx,
+                     positions):
+    """Incremental attention against the slot-based KV cache.
+
+    ``x`` is the LN'd input for ``s`` NEW tokens per slot (batch row i IS
+    cache slot i); the new K/V are written into the cache at
+    ``positions[i] .. positions[i]+s`` and the query attends over the whole
+    cache row under the absolute-position causal mask ``k_pos <= q_pos``
+    (stale entries past a slot's live length are masked out, so slot reuse
+    needs no explicit cache clearing). One code path serves both prefill
+    (s = bucket, positions = 0) and decode (s = 1, positions = length).
+    Returns ``(ctx, k_cache, v_cache)`` — caches are functionally updated.
+    """
+    b, s, d = x.shape
+    h, dh = config.n_heads, config.d_head
+    qkv = x @ block["qkv_kernel"].astype(x.dtype) + \
+        block["qkv_bias"].astype(x.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, h, dh)
+    k = k.reshape(b, s, h, dh).transpose(0, 2, 1, 3)     # (b, h, s, dh)
+    v = v.reshape(b, s, h, dh).transpose(0, 2, 1, 3)
+
+    def write_row(row, new, pos):
+        # row (h, S, dh), new (h, s, dh): in-place update at seq offset pos
+        return jax.lax.dynamic_update_slice(row, new, (0, pos, 0))
+
+    k_rows = jax.vmap(write_row)(k_cache[:, layer_idx],
+                                 k.astype(k_cache.dtype), positions)
+    v_rows = jax.vmap(write_row)(v_cache[:, layer_idx],
+                                 v.astype(v_cache.dtype), positions)
+    k_cache = k_cache.at[:, layer_idx].set(k_rows)
+    v_cache = v_cache.at[:, layer_idx].set(v_rows)
+
+    S = k_rows.shape[2]
+    qf = q.astype(jnp.float32) * (1.0 / math.sqrt(dh))
+    scores = jnp.einsum("bqhd,bhkd->bhqk", qf, k_rows.astype(jnp.float32))
+    k_pos = jnp.arange(S)[None, None, None, :]
+    q_pos = (positions[:, None] + jnp.arange(s)[None, :])[:, None, :, None]
+    scores = jnp.where(k_pos <= q_pos, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bhqk,bhkd->bqhd", probs, v_rows.astype(jnp.float32))
+    return ctx.astype(x.dtype).reshape(b, s, d), k_cache, v_cache
+
+
+def _forward_hidden_cached(params, input_ids, config, cache, positions):
+    """Cache-threaded variant of :func:`forward_hidden` for serving.
+
+    ``cache`` is ``(k, v)`` with shape (slots, layers, heads, max_seq,
+    d_head) — the inference KV cache (inference/kv_cache.py); input batch
+    size must equal the cache's slot count. ``positions`` (b,) int32 is the
+    absolute position of input_ids[:, 0] per slot. Returns
+    ``(hidden, (k, v))``.
+    """
+    if config.scan_blocks or config.sequence_parallel or \
+            config.sparse_attention:
+        raise ValueError(
+            "KV-cache decode supports the plain dense GPT-2 path only "
+            "(scan_blocks / sequence_parallel / sparse_attention must be "
+            "off in the inference model config)")
+    b, s = input_ids.shape
+    k_cache, v_cache = cache
+    compute_dtype = params["ln_f"]["scale"].dtype
+    tok = jnp.take(params["wte"], input_ids, axis=0)
+    pos_ids = positions[:, None] + jnp.arange(s)[None, :]
+    pos = jnp.take(params["wpe"], pos_ids, axis=0)
+    x = tok.astype(compute_dtype) + pos.astype(compute_dtype)
+    for i, bp in enumerate(params["blocks"]):
+        ln1 = _layer_norm(x, bp["ln1"]["scale"], bp["ln1"]["bias"])
+        ctx, k_cache, v_cache = _cached_attn_ctx(
+            ln1, bp["attn"], config, k_cache, v_cache, i, positions)
+        x = _block_rest(x, ctx, bp, config, rng=None, train=False)
+    x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    return x, (k_cache, v_cache)
+
+
+def forward_hidden(params, input_ids, config, rng=None, train=False,
+                   cache=None, positions=None):
+    """Embedding + transformer stack -> final hidden states.
+
+    With ``cache`` (a ``(k, v)`` KV-cache buffer pair) and ``positions``
+    (per-row absolute offset of the first token) the stack runs the
+    incremental serving path and returns ``(hidden, cache)`` instead.
+    """
+    if cache is not None:
+        if positions is None:
+            positions = jnp.zeros((input_ids.shape[0],), jnp.int32)
+        return _forward_hidden_cached(params, input_ids, config, cache,
+                                      positions)
     b, s = input_ids.shape
     compute_dtype = params["ln_f"]["scale"].dtype
     if config.sparse_embedding_grads:

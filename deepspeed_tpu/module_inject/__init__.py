@@ -1,5 +1,8 @@
-from .replace_module import (HFBertLayerPolicy, DSPolicy,
+from .replace_module import (HFBertLayerPolicy, HFGPT2LayerPolicy, DSPolicy,
                              replace_transformer_layer,
                              revert_transformer_layer,
                              hf_layer_to_ds_params,
-                             ds_params_to_hf_layer)
+                             ds_params_to_hf_layer,
+                             hf_gpt2_layer_to_block_params,
+                             block_params_to_hf_gpt2_layer,
+                             hf_gpt2_to_gpt2_params)

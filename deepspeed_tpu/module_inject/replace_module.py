@@ -69,6 +69,102 @@ class HFBertLayerPolicy(DSPolicy):
                 out_ln["scale"], out_ln["bias"])
 
 
+class HFGPT2LayerPolicy(DSPolicy):
+    """HF (flax) GPT2Block: ln_1, attn.{c_attn,c_proj}, ln_2,
+    mlp.{c_fc,c_proj} (reference replace_module.py HFGPT2LayerPolicy).
+    Pre-LN architecture; c_attn is already the fused QKV projection and
+    flax stores Conv1D kernels (in, out) — the exact layout of
+    models/gpt2.py's block params, so the conversion is pure renames."""
+
+    pre_attn_norm = True
+
+    @staticmethod
+    def attention(layer):
+        attn = layer["attn"]
+        return (attn["c_attn"]["kernel"], attn["c_attn"]["bias"],
+                attn["c_proj"]["kernel"], attn["c_proj"]["bias"])
+
+    @staticmethod
+    def mlp(layer):
+        return (layer["mlp"]["c_fc"]["kernel"], layer["mlp"]["c_fc"]["bias"],
+                layer["mlp"]["c_proj"]["kernel"],
+                layer["mlp"]["c_proj"]["bias"])
+
+    @staticmethod
+    def layernorm(layer):
+        return (layer["ln_1"]["scale"], layer["ln_1"]["bias"],
+                layer["ln_2"]["scale"], layer["ln_2"]["bias"])
+
+
+def hf_gpt2_layer_to_block_params(layer, policy=HFGPT2LayerPolicy):
+    """One HF GPT2Block subtree -> models/gpt2.py block params."""
+    qkv_w, qkv_b, proj_w, proj_b = policy.attention(layer)
+    fc_w, fc_b, out_w, out_b = policy.mlp(layer)
+    ln1_s, ln1_b, ln2_s, ln2_b = policy.layernorm(layer)
+    arr = jnp.asarray
+    return {
+        "ln1": {"scale": arr(ln1_s), "bias": arr(ln1_b)},
+        "attn": {"qkv_kernel": arr(qkv_w), "qkv_bias": arr(qkv_b),
+                 "proj_kernel": arr(proj_w), "proj_bias": arr(proj_b)},
+        "ln2": {"scale": arr(ln2_s), "bias": arr(ln2_b)},
+        "mlp": {"fc_kernel": arr(fc_w), "fc_bias": arr(fc_b),
+                "proj_kernel": arr(out_w), "proj_bias": arr(out_b)},
+    }
+
+
+def block_params_to_hf_gpt2_layer(block, policy=HFGPT2LayerPolicy):
+    """Inverse conversion: models/gpt2.py block params -> HF GPT2Block."""
+    assert policy is HFGPT2LayerPolicy, "revert implemented for GPT2 policy"
+    return {
+        "ln_1": {"scale": block["ln1"]["scale"],
+                 "bias": block["ln1"]["bias"]},
+        "attn": {
+            "c_attn": {"kernel": block["attn"]["qkv_kernel"],
+                       "bias": block["attn"]["qkv_bias"]},
+            "c_proj": {"kernel": block["attn"]["proj_kernel"],
+                       "bias": block["attn"]["proj_bias"]},
+        },
+        "ln_2": {"scale": block["ln2"]["scale"],
+                 "bias": block["ln2"]["bias"]},
+        "mlp": {
+            "c_fc": {"kernel": block["mlp"]["fc_kernel"],
+                     "bias": block["mlp"]["fc_bias"]},
+            "c_proj": {"kernel": block["mlp"]["proj_kernel"],
+                       "bias": block["mlp"]["proj_bias"]},
+        },
+    }
+
+
+def _hf_gpt2_transformer(model_params):
+    """Locate the transformer subtree of a HF-flax GPT2 params tree
+    (FlaxGPT2LMHeadModel: params['transformer'])."""
+    tree = model_params
+    if "params" in tree:
+        tree = tree["params"]
+    if "transformer" in tree:
+        tree = tree["transformer"]
+    if "h" not in tree:
+        raise ValueError("Could not locate HF GPT2 blocks ('h'); got keys {}"
+                         .format(list(tree.keys())[:8]))
+    return tree
+
+
+def hf_gpt2_to_gpt2_params(model_params, policy=HFGPT2LayerPolicy):
+    """Full HF-flax GPT2 params tree -> models/gpt2.py params tree
+    (wte/wpe/blocks/ln_f) ready for make_gpt2_model / init_inference."""
+    tree = _hf_gpt2_transformer(model_params)
+    layers = tree["h"]
+    blocks = [hf_gpt2_layer_to_block_params(layers[str(i)], policy)
+              for i in range(len(layers))]
+    return {
+        "wte": jnp.asarray(tree["wte"]["embedding"]),
+        "wpe": jnp.asarray(tree["wpe"]["embedding"]),
+        "blocks": blocks,
+        "ln_f": {"scale": jnp.asarray(tree["ln_f"]["scale"]),
+                 "bias": jnp.asarray(tree["ln_f"]["bias"])},
+    }
+
+
 def hf_layer_to_ds_params(layer, policy=HFBertLayerPolicy):
     """One HF layer subtree -> fused DeepSpeedTransformerLayer params."""
     qw, qb, kw, kb, vw, vb, ow, ob = policy.attention(layer)

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.topology import DATA_AXIS
+from ..parallel.topology import DATA_AXIS, shard_map_compat
 
 
 def sparse_embedding_lookup(wte, ids, mesh=None, axis=DATA_AXIS):
@@ -62,12 +62,11 @@ def _sparse_lookup_bwd(mesh, axis, vocab, d, dtype_name, ids, dout):
             .at[flat_ids].add(flat_rows)
         return dense.astype(wte_dtype)
 
-    grad = jax.shard_map(
+    grad = shard_map_compat(
         local, mesh=mesh,
         in_specs=(P(axis), P(axis)),
         out_specs=P(),
-        axis_names={axis},
-        check_vma=False,    # post-gather the result is replica-invariant
+        axis_names={axis},  # post-gather the result is replica-invariant
     )(ids, dout)
     return grad, np.zeros(ids.shape, jax.dtypes.float0)
 

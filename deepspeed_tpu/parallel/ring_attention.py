@@ -180,10 +180,7 @@ _dense_reference_attention = _dense_attention
 
 @functools.lru_cache(maxsize=64)
 def _make_sharded(mesh, impl, axis_name, causal, sm_scale, attn_fn):
-    try:
-        from jax import shard_map
-    except ImportError:          # older jax
-        from jax.experimental.shard_map import shard_map
+    from .topology import shard_map_compat
 
     if impl == "ring":
         fn = functools.partial(ring_attention, axis_name=axis_name,
@@ -197,11 +194,8 @@ def _make_sharded(mesh, impl, axis_name, causal, sm_scale, attn_fn):
 
     batch_axis = DATA_AXIS if mesh.shape.get(DATA_AXIS, 1) > 1 else None
     spec = P(batch_axis, axis_name, None, None)
-    kwargs = dict(mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
-    try:
-        sharded = shard_map(fn, check_vma=False, **kwargs)
-    except TypeError:            # older jax spells it check_rep
-        sharded = shard_map(fn, check_rep=False, **kwargs)
+    sharded = shard_map_compat(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                               out_specs=spec)
     # jit so the eager path (e.g. under an outer jax.checkpoint, where
     # remat-of-shard_map can't evaluate eagerly) always compiles; under an
     # outer jit this inlines for free.

@@ -28,13 +28,9 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
-from ...parallel.topology import DATA_AXIS
+from ...parallel.topology import DATA_AXIS, shard_map_compat
 
 _BIT_WEIGHTS = 2 ** np.arange(8, dtype=np.uint8)
 
@@ -157,7 +153,7 @@ class CompressedBackend:
                 out, nwe, nse = body(v[0], we[0], se[0])
                 return out[None], nwe[None], nse[None]
 
-            sharded = shard_map(
+            sharded = shard_map_compat(
                 per_device, mesh=self.mesh,
                 in_specs=(P(axis), P(axis), P(axis)),
                 out_specs=(P(axis), P(axis), P(axis)))

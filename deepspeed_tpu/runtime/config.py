@@ -18,6 +18,7 @@ from .zero.constants import (ZERO_OPTIMIZATION, ZERO_OPTIMIZATION_DISABLED,
                              MAX_STAGE_ZERO_OPTIMIZATION)
 from .activation_checkpointing.config import DeepSpeedActivationCheckpointingConfig
 from ..profiling.config import DeepSpeedFlopsProfilerConfig
+from ..inference.config import DeepSpeedInferenceConfig, INFERENCE
 from ..utils.logging import logger
 
 TENSOR_CORE_ALIGN_SIZE = 8
@@ -451,8 +452,15 @@ class DeepSpeedConfig(object):
     (reference config.py:529-539).
     """
 
-    def __init__(self, json_file, mpu=None, param_dict=None, mesh=None):
+    def __init__(self, json_file, mpu=None, param_dict=None, mesh=None,
+                 inference_only=False):
         super(DeepSpeedConfig, self).__init__()
+        # init_inference sets this: an inference-only parse needs no
+        # training batch triple. Keyed on the CALLER, not on the presence
+        # of an "inference" section — one config may drive both
+        # initialize() and init_inference(), and the training path must
+        # keep validating its triple.
+        self._inference_only = inference_only
 
         if param_dict is None:
             with open(json_file, "r") as f:
@@ -544,6 +552,7 @@ class DeepSpeedConfig(object):
         self.activation_checkpointing_config = \
             DeepSpeedActivationCheckpointingConfig(param_dict)
         self.flops_profiler_config = DeepSpeedFlopsProfilerConfig(param_dict)
+        self.inference_config = DeepSpeedInferenceConfig(param_dict)
 
         self.gradient_clipping = get_gradient_clipping(param_dict)
         self.grad_accum_dtype = get_grad_accum_dtype(param_dict)
@@ -632,6 +641,11 @@ class DeepSpeedConfig(object):
         elif micro_batch is not None:
             self.train_batch_size = micro_batch * self.world_size
             self.gradient_accumulation_steps = 1
+        elif self._inference_only:
+            # init_inference parse: no training batch triple required
+            self.train_micro_batch_size_per_gpu = 1
+            self.gradient_accumulation_steps = 1
+            self.train_batch_size = self.world_size
         else:
             raise AssertionError(
                 "Either train_batch_size or train_micro_batch_size_per_gpu "
@@ -657,6 +671,7 @@ class DeepSpeedConfig(object):
         "sparse_gradients", "prescale_gradients",
         "gradient_predivide_factor", "disable_allgather", "fp32_allreduce",
         "vocabulary_size", "config_validation", "data_types",
+        INFERENCE,
         # deprecated boolean form + its companion (read_zero_config_deprecated)
         "allgather_size",
     }
@@ -688,6 +703,7 @@ class DeepSpeedConfig(object):
         "checkpoint": {"tag_validation", "io_retries",
                        "io_retry_backoff_seconds", "keep_last_n"},
         "data_types": {"grad_accum_dtype"},
+        INFERENCE: DeepSpeedInferenceConfig.KNOWN_KEYS,
         "elasticity": {"enabled", "max_train_batch_size",
                        "micro_batch_sizes", "min_gpus", "max_gpus",
                        "min_time", "prefer_larger_batch",

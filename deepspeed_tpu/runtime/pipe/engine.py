@@ -41,7 +41,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ...parallel.topology import DATA_AXIS, PIPE_AXIS
+from ...parallel.topology import DATA_AXIS, PIPE_AXIS, shard_map_compat
 from ...utils.logging import log_dist
 from ..engine import DeepSpeedEngine
 from ..model import Model
@@ -286,14 +286,13 @@ class PipelineEngine(DeepSpeedEngine):
                 # only the last stage accumulated anything; psum broadcasts
                 return jax.lax.psum(loss_sum, PIPE_AXIS) / M
 
-            return jax.shard_map(
+            return shard_map_compat(
                 shard_fn, mesh=mesh,
                 in_specs=(body_spec, P(PIPE_AXIS), P(PIPE_AXIS),
                           P(PIPE_AXIS), other_spec, batch_spec,
                           labels_spec),
                 out_specs=P(),
                 axis_names={PIPE_AXIS},
-                check_vma=False,
             )(params["body"], depths_2d, fwd_m, fwd_c, other,
               inputs_stack, labels_stack)
 
@@ -621,7 +620,7 @@ class PipelineEngine(DeepSpeedEngine):
                 body_g = jax.tree_util.tree_map(lambda g: g[None], body_g)
                 return mean_loss, body_g, other_g
 
-            mean_loss, body_g, other_g = jax.shard_map(
+            mean_loss, body_g, other_g = shard_map_compat(
                 shard_fn, mesh=mesh,
                 in_specs=(body_spec, P(PIPE_AXIS), P(PIPE_AXIS),
                           P(PIPE_AXIS), P(PIPE_AXIS), P(PIPE_AXIS),
@@ -632,7 +631,6 @@ class PipelineEngine(DeepSpeedEngine):
                                lambda _: P(PIPE_AXIS), body_spec),
                            jax.tree_util.tree_map(lambda _: P(), other)),
                 axis_names={PIPE_AXIS},
-                check_vma=False,
             )(params["body"], depths_2d, fwd_m, fwd_c, bwd_m, bwd_c,
               other, inputs_stack, labels_stack, rng, scale)
             grads = dict(other_g)

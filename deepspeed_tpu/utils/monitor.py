@@ -69,3 +69,79 @@ class SummaryMonitor:
         if self._jsonl is not None:
             self._jsonl.close()
             self._jsonl = None
+
+
+class ServingMetrics:
+    """Inference-serving counters: prefill vs decode tokens/s, slot
+    occupancy, queue depth.
+
+    Filled by the continuous-batching scheduler
+    (inference/scheduler.py) at decode-step granularity; pass a
+    :class:`SummaryMonitor` to also mirror the scalars into the same
+    TensorBoard/JSONL stream the training engine writes
+    (``Serve/{prefill_tokens_per_sec,decode_tokens_per_sec,
+    slot_occupancy,queue_depth}``)."""
+
+    def __init__(self, monitor=None):
+        self.monitor = monitor
+        self.prefill_tokens = 0
+        self.prefill_seconds = 0.0
+        self.prefill_calls = 0
+        self.decode_tokens = 0
+        self.decode_seconds = 0.0
+        self.decode_steps = 0
+        self.schedule_steps = 0
+        self.occupancy_sum = 0.0
+        self.last_queue_depth = 0
+        self.peak_queue_depth = 0
+
+    def record_prefill(self, tokens, seconds):
+        self.prefill_tokens += int(tokens)
+        self.prefill_seconds += float(seconds)
+        self.prefill_calls += 1
+
+    def record_decode(self, tokens, seconds):
+        """One fused decode step: ``tokens`` = number of LIVE slots that
+        produced a token this step."""
+        self.decode_tokens += int(tokens)
+        self.decode_seconds += float(seconds)
+        self.decode_steps += 1
+
+    def record_schedule(self, occupancy, queue_depth, step):
+        self.schedule_steps += 1
+        self.occupancy_sum += float(occupancy)
+        self.last_queue_depth = int(queue_depth)
+        self.peak_queue_depth = max(self.peak_queue_depth, int(queue_depth))
+        if self.monitor is not None:
+            self.monitor.add_scalar("Serve/slot_occupancy", occupancy, step)
+            self.monitor.add_scalar("Serve/queue_depth", queue_depth, step)
+            self.monitor.add_scalar("Serve/prefill_tokens_per_sec",
+                                    self.prefill_tokens_per_sec, step)
+            self.monitor.add_scalar("Serve/decode_tokens_per_sec",
+                                    self.decode_tokens_per_sec, step)
+
+    @property
+    def prefill_tokens_per_sec(self):
+        return (self.prefill_tokens / self.prefill_seconds
+                if self.prefill_seconds > 0 else 0.0)
+
+    @property
+    def decode_tokens_per_sec(self):
+        return (self.decode_tokens / self.decode_seconds
+                if self.decode_seconds > 0 else 0.0)
+
+    @property
+    def mean_occupancy(self):
+        return (self.occupancy_sum / self.schedule_steps
+                if self.schedule_steps else 0.0)
+
+    def snapshot(self):
+        return {
+            "prefill_tokens": self.prefill_tokens,
+            "prefill_tokens_per_sec": round(self.prefill_tokens_per_sec, 2),
+            "decode_tokens": self.decode_tokens,
+            "decode_steps": self.decode_steps,
+            "decode_tokens_per_sec": round(self.decode_tokens_per_sec, 2),
+            "mean_slot_occupancy": round(self.mean_occupancy, 4),
+            "peak_queue_depth": self.peak_queue_depth,
+        }

@@ -133,6 +133,17 @@ def main():
     else:
         raise RuntimeError("no benchmark configuration compiled")
 
+    # per-step collective bytes-on-wire for the run's ZeRO config vs the
+    # flat-fp32 baseline — the comm-efficiency win stays visible in the
+    # JSON record even on the CPU fallback rung where nothing is measured
+    # on a real interconnect
+    try:
+        from deepspeed_tpu.runtime.comm.wire import \
+            estimate_engine_comm_bytes
+        comm = estimate_engine_comm_bytes(engine)
+    except Exception as err:  # noqa: BLE001 - estimator must never kill bench
+        comm = {"error": str(err)[:200]}
+
     tokens_per_step = global_batch * seq
     tokens_per_sec = tokens_per_step * steps / dt
     # flops/token: 6N for the dense path + 12*L*d*s for attention scores/ctx
@@ -155,6 +166,7 @@ def main():
             "backend": jax.default_backend(),
             "rung": {"micro_batch": micro_batch, "remat": remat,
                      "bf16_state": bf16_state},
+            "comm": comm,
         },
     }))
 

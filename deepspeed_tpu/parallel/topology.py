@@ -16,6 +16,43 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 PIPE_AXIS = "pipe"
 SEQUENCE_AXIS = "sequence"
+# hpZ (ZeRO++ hierarchical partitioning): the data axis factored into
+# (replica, shard) sub-axes. Shard is INNER (stride 1 in device order →
+# ICI-adjacent chips), so the per-step weight all-gathers that cross only
+# the shard sub-axis ride the short hop; replica-crossing traffic
+# (optimizer-state partition) is the rarer, cheaper-to-amortize one.
+DATA_REPLICA_AXIS = "data_replica"
+DATA_SHARD_AXIS = "data_shard"
+
+
+def factor_data_axis(mesh, shard_size):
+    """Factor a mesh's ``data`` axis into (``data_replica``,
+    ``data_shard``) sub-axes of sizes ``(dp // shard_size, shard_size)``.
+
+    The device assignment is preserved — only the naming changes — so any
+    sharding that names BOTH sub-axes (as a tuple) is placement-identical
+    to one naming the original ``data`` axis, while shardings naming only
+    ``data_shard`` stay within ICI-adjacent groups of ``shard_size``.
+    """
+    from jax.sharding import Mesh
+    axes = list(mesh.axis_names)
+    if DATA_AXIS not in axes:
+        raise ValueError(
+            "mesh {} has no '{}' axis to factor".format(
+                dict(mesh.shape), DATA_AXIS))
+    dp = int(mesh.shape[DATA_AXIS])
+    shard_size = int(shard_size)
+    if shard_size <= 1 or dp % shard_size != 0:
+        raise ValueError(
+            "zero_hierarchical_partition={} must be >1 and divide the "
+            "data-parallel degree {}".format(shard_size, dp))
+    i = axes.index(DATA_AXIS)
+    devices = mesh.devices
+    new_shape = devices.shape[:i] + (dp // shard_size, shard_size) + \
+        devices.shape[i + 1:]
+    new_axes = axes[:i] + [DATA_REPLICA_AXIS, DATA_SHARD_AXIS] + \
+        axes[i + 1:]
+    return Mesh(devices.reshape(new_shape), tuple(new_axes))
 
 
 def shard_map_compat(fn, mesh, in_specs, out_specs, axis_names=None):

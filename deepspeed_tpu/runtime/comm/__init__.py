@@ -1,2 +1,9 @@
 from .compressed import (CompressedBackend, compressed_allreduce_local,
-                         pack_signs, unpack_signs)
+                         masked_compress)
+from .quantize import (DEFAULT_BLOCK_SIZE, QuantizedCollectives,
+                       dequantize_blockwise, dequantize_param, pack_signs,
+                       quantize_blockwise, quantize_dequantize,
+                       quantize_param, quantize_with_error_feedback,
+                       quantized_all_gather_local,
+                       quantized_reduce_scatter_local, qwz_gather,
+                       sign_scale, unpack_signs)

@@ -24,28 +24,14 @@ DCN-limited pods here.
 """
 import functools
 
-import numpy as np
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ...parallel.topology import DATA_AXIS, shard_map_compat
-
-_BIT_WEIGHTS = 2 ** np.arange(8, dtype=np.uint8)
-
-
-def pack_signs(x):
-    """Pack sign bits of ``x`` (size divisible by 8) into uint8, 8 lanes per
-    byte (cupy packbits equivalent, compression/cupy.py:20)."""
-    bits = (x >= 0).astype(jnp.uint8).reshape(-1, 8)
-    return (bits * jnp.asarray(_BIT_WEIGHTS)).sum(axis=-1).astype(jnp.uint8)
-
-
-def unpack_signs(packed, scale):
-    """uint8 bytes -> ±scale floats."""
-    bits = (packed[:, None] >> jnp.arange(8, dtype=jnp.uint8)) & 1
-    return scale * (2.0 * bits.astype(jnp.float32) - 1.0).reshape(-1)
+# The bit-pack/scale primitives live with the blockwise codec —
+# re-exported here for the existing call sites (runtime.comm/__init__).
+from .quantize import pack_signs, sign_scale, unpack_signs
 
 
 def masked_compress(x, mask, count):
@@ -54,11 +40,14 @@ def masked_compress(x, mask, count):
     zero error feedback — quantizing a 0 lane to +scale would make its
     error oscillate at ±scale and pollute ``||x||/sqrt(n)`` (torch's
     sign(0)=0 gives the reference this for free). Returns (packed signs,
-    scale, decompressed, error residual)."""
+    scale, decompressed, error residual). Everything stays in ``x``'s
+    dtype — a bf16 buffer gets a bf16 scale, no mid-pipeline upcast."""
+    mask = mask.astype(x.dtype)
     masked = x * mask
-    scale = jnp.linalg.norm(masked) / jnp.sqrt(jnp.maximum(count, 1.0))
+    scale = sign_scale(masked, count)
     packed = pack_signs(x)
-    decompressed = scale * jnp.where(x >= 0, 1.0, -1.0) * mask
+    signs = jnp.where(x >= 0, 1.0, -1.0).astype(x.dtype)
+    decompressed = scale * signs * mask
     return packed, scale, decompressed, (x - decompressed) * mask
 
 

@@ -1,0 +1,324 @@
+"""Blockwise int8 symmetric quantization codec for ZeRO collectives.
+
+Reference parity: the ZeRO++ communication codecs (arXiv:2306.10209 —
+qwZ quantized weight all-gather, qgZ quantized gradient reduce-scatter)
+and EQuARX-style blockwise-quantized collectives inside XLA
+(arXiv:2506.17615). One codec, three transports:
+
+  * flat codec (``quantize_blockwise``/``dequantize_blockwise``): a flat
+    buffer becomes ``(int8 blocks, per-block scales)``; the explicit
+    shard_map collectives (``quantized_all_gather_local``,
+    ``quantized_reduce_scatter_local``) exchange that representation, so
+    wire volume is ~4x below fp32 (1 byte/lane + one scale per block);
+  * shape-preserving codec (``quantize_param``/``dequantize_param``):
+    blocks tile the LAST dimension and the int8 array keeps the input's
+    shape, so GSPMD sharding annotations stay meaningful — this is what
+    ``qwz_gather`` rides;
+  * ``qwz_gather``: the ZeRO-3 quantized weight all-gather as pure
+    dataflow — quantize the data-sharded parameter, constrain the int8
+    blocks + scales to the gathered (data-axes-dropped) sharding so XLA
+    emits the all-gather ON THE INT8 REPRESENTATION, dequantize
+    on-device. A straight-through custom_vjp sends the cotangent back
+    constrained to the sharded layout (XLA lowers it to the gradient
+    reduce-scatter), exactly the ZeRO++ fused gather/scatter pair.
+
+Scales follow the INPUT dtype (a bf16 buffer quantizes to bf16 scales):
+the encode side casts the scale to the storage dtype BEFORE dividing, so
+encode/decode agree bit-exactly and nothing upcasts mid-pipeline.
+
+``compressed.py``'s 1-bit path shares the sign-pack helpers below
+(``pack_signs``/``unpack_signs``/``sign_scale``).
+"""
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# Per-block lane count. 256 fp32 lanes -> 256 int8 bytes + one scale:
+# 3.9x below fp32 on the wire; small enough that one outlier lane only
+# poisons 255 neighbors (EQuARX uses the same order of magnitude).
+DEFAULT_BLOCK_SIZE = 256
+
+_QMAX = 127.0  # symmetric int8 range [-127, 127]; -128 unused
+
+
+# --------------------------------------------------------------- sign helpers
+# (shared with the 1-bit path in compressed.py)
+_BIT_WEIGHTS = 2 ** np.arange(8, dtype=np.uint8)
+
+
+def pack_signs(x):
+    """Pack sign bits of ``x`` (size divisible by 8) into uint8, 8 lanes per
+    byte (cupy packbits equivalent, compression/cupy.py:20)."""
+    bits = (x >= 0).astype(jnp.uint8).reshape(-1, 8)
+    return (bits * jnp.asarray(_BIT_WEIGHTS)).sum(axis=-1).astype(jnp.uint8)
+
+
+def unpack_signs(packed, scale):
+    """uint8 bytes -> ±scale values, in the SCALE's dtype (a bf16 scale
+    decodes to bf16 — nothing upcasts to fp32 mid-pipeline)."""
+    scale = jnp.asarray(scale)
+    bits = (packed[:, None] >> jnp.arange(8, dtype=jnp.uint8)) & 1
+    signs = (2 * bits.astype(scale.dtype) - 1).reshape(-1)
+    return scale * signs
+
+
+def sign_scale(masked, count):
+    """The 1-bit codec's single scale ``||x||/sqrt(n)`` over the real
+    lanes, in the input's dtype (norm computed fp32 for range safety)."""
+    norm = jnp.linalg.norm(masked.astype(jnp.float32))
+    return (norm / jnp.sqrt(jnp.maximum(count, 1.0))).astype(masked.dtype)
+
+
+# ----------------------------------------------------------------- flat codec
+def _block_count(n, block_size):
+    return -(-n // block_size)
+
+
+def _quantize_blocks(blocks, dtype):
+    """The shared codec core over pre-blocked values (block dim LAST):
+    symmetric per-block scale = absmax/127, cast to the storage ``dtype``
+    BEFORE the divide so the decode side reconstructs with the identical
+    scale value. Returns ``(q int8, scales[..., 1] in dtype)``."""
+    blocks = blocks.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(blocks), axis=-1, keepdims=True)
+    scales = (absmax / _QMAX).astype(dtype)
+    safe = jnp.maximum(scales.astype(jnp.float32), jnp.float32(1e-30))
+    q = jnp.clip(jnp.round(blocks / safe), -_QMAX, _QMAX).astype(jnp.int8)
+    return q, scales
+
+
+def quantize_blockwise(x, block_size=DEFAULT_BLOCK_SIZE):
+    """Flat buffer -> ``(q, scales)``: ``q`` int8 of shape
+    ``(nblocks, block_size)`` (zero-padded past ``x.size``), ``scales`` of
+    shape ``(nblocks,)`` in ``x``'s dtype."""
+    dtype = x.dtype
+    flat = x.reshape(-1)
+    n = flat.size
+    padded = _block_count(n, block_size) * block_size
+    if padded != n:
+        flat = jnp.pad(flat, (0, padded - n))
+    q, scales = _quantize_blocks(flat.reshape(-1, block_size), dtype)
+    return q, scales.reshape(-1)
+
+
+def dequantize_blockwise(q, scales, size=None, dtype=None):
+    """Inverse of ``quantize_blockwise``: flat array of ``size`` lanes in
+    ``dtype`` (defaults: all lanes, the scales' dtype)."""
+    dtype = scales.dtype if dtype is None else dtype
+    out = (q.astype(jnp.float32)
+           * scales.astype(jnp.float32)[:, None]).reshape(-1)
+    if size is not None and size != out.size:
+        out = out[:size]
+    return out.astype(dtype)
+
+
+def quantize_dequantize(x, block_size=DEFAULT_BLOCK_SIZE):
+    """Round-trip through the flat codec, same shape/dtype as ``x``."""
+    q, scales = quantize_blockwise(x, block_size)
+    return dequantize_blockwise(q, scales, x.size, x.dtype).reshape(x.shape)
+
+
+def quantize_with_error_feedback(x, err, block_size=DEFAULT_BLOCK_SIZE,
+                                 scale=1.0):
+    """Error-compensated round-trip (the qgZ accumulator): quantize
+    ``x + err*scale``, return ``(dequantized, new_err)`` where ``new_err``
+    is the residual the NEXT call folds back in — the long-run average is
+    unbiased even though each step is int8.
+
+    ``scale``: the unit ``x`` is expressed in (e.g. the dynamic loss
+    scale). The residual is stored DIVIDED by it, so when the caller's
+    scale changes between calls the carried correction keeps the right
+    magnitude instead of injecting a 2x-off bias right after a scale
+    halving/doubling."""
+    scale = jnp.asarray(scale, jnp.float32)
+    corrected = x.astype(jnp.float32) + err * scale
+    qd = quantize_dequantize(corrected, block_size)
+    return qd.astype(x.dtype), (corrected - qd) / scale
+
+
+# ------------------------------------------------- shape-preserving codec
+def _lastdim_block(last, block_size):
+    """Largest divisor of ``last`` that is <= block_size (static shapes:
+    plain python). A ragged tail block would change the array's shape and
+    break the sharding annotation the qwZ path relies on."""
+    block = min(int(block_size), int(last))
+    while last % block:
+        block -= 1
+    return block
+
+
+def quantize_param(x, block_size=DEFAULT_BLOCK_SIZE):
+    """Shape-preserving codec: ``q`` is int8 with ``x``'s shape, scales
+    have shape ``x.shape[:-1] + (nblocks,)`` where blocks tile the LAST
+    dimension. Rank-0/1-lane inputs degrade to one block."""
+    if x.ndim == 0:
+        x = x.reshape(1)
+    block = _lastdim_block(x.shape[-1], block_size)
+    blocks = x.reshape(x.shape[:-1] + (x.shape[-1] // block, block))
+    q, scales = _quantize_blocks(blocks, x.dtype)
+    return q.reshape(x.shape), scales.squeeze(-1)
+
+
+def dequantize_param(q, scales, dtype):
+    """Inverse of ``quantize_param``."""
+    nblocks = scales.shape[-1]
+    block = q.shape[-1] // nblocks
+    blocks = q.reshape(q.shape[:-1] + (nblocks, block))
+    out = blocks.astype(jnp.float32) * \
+        scales.astype(jnp.float32)[..., None]
+    return out.reshape(q.shape).astype(dtype)
+
+
+# ------------------------------------------------------------- qwZ gather
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def qwz_gather(x, gathered_sharding, sharded_sharding,
+               block_size=DEFAULT_BLOCK_SIZE):
+    """ZeRO++ quantized weight all-gather as GSPMD dataflow.
+
+    ``x``: a data-axis-sharded parameter (``sharded_sharding``). The int8
+    blocks + scales are constrained to ``gathered_sharding`` (the param's
+    spec with data axes dropped), so the all-gather XLA inserts moves the
+    QUANTIZED representation — ~4x less wire than an fp32 gather, 2x less
+    than bf16. Dequantizes to ``x.dtype`` on-device.
+
+    Backward is straight-through: the cotangent (the full gradient) is
+    constrained to ``sharded_sharding``, which XLA lowers to the ZeRO
+    gradient reduce-scatter. The quantization noise is NOT differentiated
+    through (sign/round have useless gradients), matching ZeRO++.
+    """
+    return _qwz_fwd_value(x, gathered_sharding, block_size)
+
+
+def _qwz_fwd_value(x, gathered_sharding, block_size):
+    q, scales = quantize_param(x, block_size)
+    if gathered_sharding is not None:
+        q = jax.lax.with_sharding_constraint(q, gathered_sharding)
+        scales = jax.lax.with_sharding_constraint(
+            scales, _rank_adjusted(gathered_sharding, scales.ndim))
+    return dequantize_param(q, scales, x.dtype).reshape(x.shape)
+
+
+def _rank_adjusted(sharding, ndim):
+    """``gathered_sharding`` is built for the param's rank; scales drop or
+    keep rank (rank-0 params became rank-1). Pad/trim the spec to fit."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    spec = list(sharding.spec)
+    spec = (spec + [None] * ndim)[:ndim]
+    return NamedSharding(sharding.mesh, P(*spec))
+
+
+def _qwz_fwd(x, gathered_sharding, sharded_sharding, block_size):
+    return _qwz_fwd_value(x, gathered_sharding, block_size), None
+
+
+def _qwz_bwd(gathered_sharding, sharded_sharding, block_size, _res, ct):
+    if sharded_sharding is not None:
+        ct = jax.lax.with_sharding_constraint(ct, sharded_sharding)
+    return (ct,)
+
+
+qwz_gather.defvjp(_qwz_fwd, _qwz_bwd)
+
+
+# ------------------------------------------------- shard_map collective bodies
+def quantized_all_gather_local(x, axis_name,
+                               block_size=DEFAULT_BLOCK_SIZE):
+    """Per-device body (call inside shard_map over ``axis_name``): quantize
+    this device's flat shard, all-gather int8 blocks + scales, dequantize.
+    Returns the concatenated (world*n,) buffer in ``x.dtype``."""
+    n = x.size
+    q, scales = quantize_blockwise(x, block_size)
+    qg = jax.lax.all_gather(q, axis_name)          # (world, nb, block)
+    sg = jax.lax.all_gather(scales, axis_name)     # (world, nb)
+    deq = jax.vmap(lambda qq, ss: dequantize_blockwise(qq, ss, n, x.dtype))(
+        qg, sg)
+    return deq.reshape(-1)
+
+
+def quantized_reduce_scatter_local(x, axis_name, world_size,
+                                   block_size=DEFAULT_BLOCK_SIZE,
+                                   error=None):
+    """qgZ-style quantized reduce-scatter per-device body.
+
+    ``x``: this device's full-length partial-sum buffer, size divisible by
+    ``world_size``; chunk w is destined to rank w. Each chunk is int8-
+    quantized (optionally with persistent ``error`` feedback), the int8
+    chunks + scales ride ``all_to_all``, and each rank dequantizes and
+    sums its own chunk across workers — wire is int8+scales instead of
+    fp32, the reduction itself stays full precision on-device.
+
+    Returns ``(local_sum_chunk, new_error)`` (``new_error`` is None when
+    no feedback buffer was passed).
+    """
+    chunk = x.size // world_size
+    corrected = x if error is None else x + error.astype(x.dtype)
+    rows = corrected.reshape(world_size, chunk)
+    # quantize every destination chunk with its own block grid
+    q, scales = jax.vmap(
+        lambda r: quantize_blockwise(r, block_size))(rows)
+    new_error = None
+    if error is not None:
+        deq = jax.vmap(
+            lambda qq, ss: dequantize_blockwise(qq, ss, chunk, x.dtype))(
+                q, scales)
+        new_error = (corrected - deq.reshape(-1)).astype(jnp.float32)
+    recv_q = jax.lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                                tiled=False)
+    recv_s = jax.lax.all_to_all(scales, axis_name, split_axis=0,
+                                concat_axis=0, tiled=False)
+    deq = jax.vmap(
+        lambda qq, ss: dequantize_blockwise(qq, ss, chunk, jnp.float32))(
+            recv_q, recv_s)
+    return deq.sum(axis=0).astype(x.dtype), new_error
+
+
+# ------------------------------------------------------------ mesh transports
+class QuantizedCollectives:
+    """CompressedBackend-style façade: blockwise-int8 all-gather /
+    reduce-scatter over one mesh axis, jitted through shard_map.
+
+    ``all_gather(values)``: (world, n) stacked shards -> (world, world*n)
+    gathered rows. ``reduce_scatter(values)``: (world, world*chunk)
+    per-rank partials -> (world, chunk) summed chunks.
+    """
+
+    def __init__(self, mesh, axis=None, block_size=DEFAULT_BLOCK_SIZE):
+        from ...parallel.topology import DATA_AXIS
+        self.mesh = mesh
+        self.axis = DATA_AXIS if axis is None else axis
+        self.world_size = int(mesh.shape[self.axis])
+        self.block_size = block_size
+        self._jit_cache = {}
+
+    def _build(self, kind, n):
+        from jax.sharding import PartitionSpec as P
+        from ...parallel.topology import shard_map_compat
+        key = (kind, n)
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+        axis, world, block = self.axis, self.world_size, self.block_size
+
+        if kind == "all_gather":
+            def per_device(v):
+                return quantized_all_gather_local(v[0], axis, block)[None]
+        else:
+            def per_device(v):
+                out, _ = quantized_reduce_scatter_local(v[0], axis, world,
+                                                        block)
+                return out[None]
+
+        fn = jax.jit(shard_map_compat(
+            per_device, mesh=self.mesh, in_specs=(P(axis),),
+            out_specs=P(axis)))
+        self._jit_cache[key] = fn
+        return fn
+
+    def all_gather(self, values):
+        return self._build("all_gather", values.shape[-1])(values)
+
+    def reduce_scatter(self, values):
+        assert values.shape[-1] % self.world_size == 0, values.shape
+        return self._build("reduce_scatter", values.shape[-1])(values)

@@ -1,0 +1,210 @@
+"""Per-step collective bytes-on-wire estimator for ZeRO configs.
+
+An analytic model of the per-device wire volume the training step's
+ZeRO collectives move, so the communication win of the ZeRO++ modes
+(qwZ/hpZ/qgZ) is visible in BENCH_*.json and the dryrun even on the CPU
+fallback rung where nothing rides a real interconnect.
+
+Ring-collective pricing (what GSPMD lowers to on a mesh axis of size g):
+  all-gather / reduce-scatter move ``payload * (g-1)/g`` bytes per device;
+  an all-reduce is a reduce-scatter + all-gather: ``2 * payload * (g-1)/g``.
+
+Counted per optimizer step (gas = gradient-accumulation micro-steps):
+  * stage 3: each data-sharded param leaf is all-gathered twice per
+    micro-step (forward + backward re-gather) over its gather group —
+    the FULL data axis flat, only the ``data_shard`` sub-axis under hpZ;
+  * stage >= 2: each micro-step's gradients reduce-scatter over the full
+    data axis; stage 0-1 all-reduce instead;
+  * stage 1-2: the updated params re-replicate once per step (the
+    all-gather of updated partitions).
+
+Quantized payloads price the codec's wire format: 1 byte/lane + one
+scale (in the buffer's dtype) per ``block_size`` lanes. For qgZ this
+prices the quantized reduce-scatter transport
+(``quantized_reduce_scatter_local``); the pure-GSPMD engine path models
+its numerics while the wire stays in the compute dtype — the JSON keys
+are explicit about being estimates.
+"""
+import numpy as np
+
+import jax
+
+from .quantize import DEFAULT_BLOCK_SIZE
+
+_FP32_BYTES = 4
+
+
+def _ring_factor(group):
+    return (group - 1) / group if group > 1 else 0.0
+
+
+def _payload(numel, itemsize, quantized, scale_itemsize, block_size):
+    if not quantized:
+        return numel * itemsize
+    nblocks = -(-numel // block_size)
+    return numel * 1 + nblocks * scale_itemsize
+
+
+def _price_tree(params, eligible_fn, stage, dp, gather_group, gas,
+                compute_itemsize, grad_itemsize, quantized_weights,
+                quantized_gradients, block_size):
+    """The one pricing body both entry points share.
+
+    ``eligible_fn(path, shape, numel) -> bool``: is this leaf a stage-3
+    data-sharded (per-micro-step-gathered) param. Weight gathers price
+    the shape-preserving codec (blocks tile the last dim — what
+    ``qwz_gather`` actually ships); gradient reduces price the FLAT
+    codec (``quantize_with_error_feedback`` uses ``block_size``-lane
+    flat blocks).
+    """
+    from .quantize import _lastdim_block
+    from ..zero.partition import _path_str
+    totals = {"allgather_bytes": 0.0, "reduce_bytes": 0.0}
+
+    def leaf(path, p):
+        shape = np.shape(p)
+        numel = int(np.prod(shape)) if shape else 1
+        if stage >= 3 and eligible_fn(path, shape, numel):
+            wblk = _lastdim_block(shape[-1], block_size) if shape else 1
+            per_gather = _payload(numel, compute_itemsize,
+                                  quantized_weights, compute_itemsize,
+                                  wblk) * _ring_factor(gather_group)
+            # forward + backward re-gather, every micro-step
+            totals["allgather_bytes"] += 2 * gas * per_gather
+        elif stage in (1, 2) and dp > 1 and numel >= dp and \
+                any(d % dp == 0 for d in shape):
+            # updated-partition re-replication, once per step (the plan
+            # only shards — and thus re-gathers — leaves with a
+            # dp-divisible dim; others stay replicated)
+            totals["allgather_bytes"] += numel * compute_itemsize * \
+                _ring_factor(dp)
+        if dp > 1:
+            grad_payload = _payload(numel, grad_itemsize,
+                                    quantized_gradients, grad_itemsize,
+                                    block_size)
+            factor = _ring_factor(dp) if stage >= 2 \
+                else 2 * _ring_factor(dp)
+            totals["reduce_bytes"] += gas * grad_payload * factor
+
+    jax.tree_util.tree_map_with_path(
+        lambda kp, p: leaf(_path_str(kp), p), params)
+    out = {k: int(round(v)) for k, v in totals.items()}
+    out["total_bytes"] = out["allgather_bytes"] + out["reduce_bytes"]
+    return out
+
+
+def estimate_step_comm_bytes(plan, params, gas=1, compute_itemsize=4,
+                             grad_itemsize=4, quantized_weights=False,
+                             quantized_gradients=False,
+                             block_size=DEFAULT_BLOCK_SIZE,
+                             _force_flat_fp32=False):
+    """Per-device collective bytes for ONE optimizer step under ``plan``.
+
+    Returns ``{"allgather_bytes", "reduce_bytes", "total_bytes"}``.
+    ``_force_flat_fp32`` reprices as flat (full data axis) fp32 with no
+    quantization — the comparison baseline — INCLUDING flat-plan leaf
+    eligibility, so the baseline never bills gathers for a leaf flat
+    ZeRO-3 would keep replicated.
+    """
+    if _force_flat_fp32:
+        compute_itemsize = grad_itemsize = _FP32_BYTES
+        quantized_weights = quantized_gradients = False
+    return _price_tree(
+        params,
+        lambda path, shape, numel: plan.param_is_data_sharded(
+            path, shape, flat=_force_flat_fp32),
+        stage=plan.stage, dp=plan.dp_size,
+        gather_group=plan.dp_size if _force_flat_fp32
+        else plan.param_shard_size,
+        gas=gas, compute_itemsize=compute_itemsize,
+        grad_itemsize=grad_itemsize,
+        quantized_weights=quantized_weights,
+        quantized_gradients=quantized_gradients, block_size=block_size)
+
+
+def project_comm_bytes(params, stage, dp, gas=1, compute_itemsize=4,
+                       grad_itemsize=4, quantized_weights=False,
+                       hierarchical_partition=0, quantized_gradients=False,
+                       persistence_threshold=100000,
+                       block_size=DEFAULT_BLOCK_SIZE):
+    """Price a param tree's ZeRO collectives at a HYPOTHETICAL dp degree
+    — no mesh/plan needed. Leaf eligibility approximates
+    ZeroShardingPlan's rule (numel >= max(threshold, group) and a
+    group-divisible dim). Lets a single-device CPU bench still report
+    what the config would move on a pod."""
+    gather_group = hierarchical_partition \
+        if stage >= 3 and hierarchical_partition > 1 else dp
+    return _price_tree(
+        params,
+        lambda path, shape, numel: bool(shape) and
+        numel >= max(persistence_threshold, gather_group) and
+        any(d % gather_group == 0 for d in shape),
+        stage=stage, dp=dp, gather_group=gather_group, gas=gas,
+        compute_itemsize=compute_itemsize, grad_itemsize=grad_itemsize,
+        quantized_weights=quantized_weights,
+        quantized_gradients=quantized_gradients, block_size=block_size)
+
+
+def estimate_engine_comm_bytes(engine):
+    """The engine's live config priced against the flat-fp32 baseline.
+
+    JSON-ready dict: current-config and fp32-flat per-step bytes plus
+    reduction ratios (>= 1 means the config moves fewer bytes).
+    """
+    import jax.numpy as jnp
+    plan = engine.zero_plan
+    params = engine.state["params"] if engine.state is not None \
+        else engine.model.params
+    compute_itemsize = jnp.dtype(engine.compute_dtype).itemsize
+    gas = engine.gradient_accumulation_steps()
+    cur = estimate_step_comm_bytes(
+        plan, params, gas=gas, compute_itemsize=compute_itemsize,
+        grad_itemsize=compute_itemsize,
+        quantized_weights=engine.zero_quantized_weights(),
+        quantized_gradients=engine.zero_quantized_gradients())
+    base = estimate_step_comm_bytes(plan, params, gas=gas,
+                                    _force_flat_fp32=True)
+
+    def ratio(b, c):
+        return round(b / c, 2) if c else None
+
+    out = {
+        "zero_stage": plan.stage,
+        "quantized_weights": engine.zero_quantized_weights(),
+        "hierarchical_partition": engine.zero_hierarchical_partition(),
+        "quantized_gradients": engine.zero_quantized_gradients(),
+        "allgather_bytes_per_step": cur["allgather_bytes"],
+        "reduce_bytes_per_step": cur["reduce_bytes"],
+        "total_bytes_per_step": cur["total_bytes"],
+        "fp32_flat_allgather_bytes_per_step": base["allgather_bytes"],
+        "fp32_flat_reduce_bytes_per_step": base["reduce_bytes"],
+        "fp32_flat_total_bytes_per_step": base["total_bytes"],
+        "allgather_reduction_x": ratio(base["allgather_bytes"],
+                                       cur["allgather_bytes"]),
+        "total_reduction_x": ratio(base["total_bytes"],
+                                   cur["total_bytes"]),
+    }
+    if plan.dp_size <= 1:
+        # single-device rung (the CPU bench fallback): nothing crosses a
+        # wire, so also project the same config at a nominal pod scale to
+        # keep the configured comm behavior visible in the artifact
+        dp = 8
+        zc = engine._config.zero_config
+        proj = project_comm_bytes(
+            params, plan.stage, dp, gas=gas,
+            compute_itemsize=compute_itemsize,
+            grad_itemsize=compute_itemsize,
+            quantized_weights=bool(zc.quantized_weights),
+            hierarchical_partition=int(zc.hierarchical_partition or 0),
+            quantized_gradients=bool(zc.quantized_gradients),
+            persistence_threshold=zc.param_persistence_threshold)
+        proj_base = project_comm_bytes(
+            params, plan.stage, dp, gas=gas,
+            persistence_threshold=zc.param_persistence_threshold)
+        out["projected_dp{}".format(dp)] = {
+            "total_bytes_per_step": proj["total_bytes"],
+            "fp32_flat_total_bytes_per_step": proj_base["total_bytes"],
+            "total_reduction_x": ratio(proj_base["total_bytes"],
+                                       proj["total_bytes"]),
+        }
+    return out

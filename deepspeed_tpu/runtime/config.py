@@ -689,6 +689,9 @@ class DeepSpeedConfig(object):
             "stage3_param_persistence_threshold", "elastic_checkpoint",
             "load_from_fp32_weights",
             "stage3_gather_fp16_weights_on_model_save",
+            # ZeRO++ comm-efficiency modes (docs/zeropp.md)
+            "zero_quantized_weights", "zero_hierarchical_partition",
+            "zero_quantized_gradients",
             # short alias of stage3_param_persistence_threshold (the
             # zero.Init config-dict spelling)
             "param_persistence_threshold"},

@@ -262,10 +262,56 @@ class DeepSpeedEngine:
 
     def _configure_zero(self):
         zc = self._config.zero_config
+        stage = self._config.zero_optimization_stage
+        hpz = int(zc.hierarchical_partition or 0)
+        if hpz > 1 and not self._config.zero_enabled:
+            logger.warning(
+                "zero_hierarchical_partition=%d ignored: ZeRO is "
+                "disabled (zero_optimization.stage=0)", hpz)
+        if hpz > 1 and self._config.zero_enabled:
+            # hpZ (ZeRO++ hierarchical partitioning): factor the data axis
+            # into (replica, shard) sub-axes so stage-3 params shard only
+            # within the shard group and per-step gathers ride the short
+            # intra-replica hop. Placement of master/opt/grad state is
+            # unchanged (they shard over BOTH sub-axes).
+            from ..parallel.topology import (factor_data_axis, PIPE_AXIS,
+                                             DATA_REPLICA_AXIS,
+                                             DATA_SHARD_AXIS)
+            if stage < 3:
+                logger.warning(
+                    "zero_hierarchical_partition=%d has no effect below "
+                    "ZeRO stage 3 (params are not data-sharded); ignoring",
+                    hpz)
+            elif PIPE_AXIS in self.mesh.shape:
+                raise ValueError(
+                    "zero_hierarchical_partition is not a certified "
+                    "combination with pipeline parallelism (the pipe "
+                    "loop's shard_map specs name the flat 'data' axis)")
+            elif self._batch_axis != DATA_AXIS:
+                raise ValueError(
+                    "zero_hierarchical_partition needs a 'data' mesh axis "
+                    "to factor; mesh has {}".format(dict(self.mesh.shape)))
+            else:
+                self.mesh = factor_data_axis(self.mesh, hpz)
+                self._batch_axis = (DATA_REPLICA_AXIS, DATA_SHARD_AXIS)
         self.zero_plan = ZeroShardingPlan(
-            self.mesh, stage=self._config.zero_optimization_stage,
+            self.mesh, stage=stage,
             param_persistence_threshold=zc.param_persistence_threshold,
             model_spec_fn=self.model.partition_spec_fn)
+        # qwZ / qgZ (ZeRO++ quantized collectives): resolved here so the
+        # jitted step builders can close over plain bools
+        self._qwz_enabled = bool(zc.quantized_weights) and stage >= 3 \
+            and self.zero_plan.param_data_axes != ()
+        if zc.quantized_weights and stage < 3:
+            logger.warning(
+                "zero_quantized_weights has no effect below ZeRO stage 3 "
+                "(there is no per-step weight all-gather); ignoring")
+        self._qgz_enabled = bool(zc.quantized_gradients) and \
+            self._config.zero_enabled and stage >= 2
+        if zc.quantized_gradients and not self._qgz_enabled:
+            logger.warning(
+                "zero_quantized_gradients needs ZeRO stage >= 2 (the "
+                "gradient reduce-scatter partition); ignoring")
 
     def _configure_optimizer(self, client_optimizer):
         from ..ops.adam.fused_adam import FusedAdam, DeepSpeedCPUAdam
@@ -416,6 +462,7 @@ class DeepSpeedEngine:
                 # overflow flag every step, so the host counter is already
                 # exact on the offload path
             }
+            self._init_qg_error(acc_grads)
             self.model.params = None
             return
 
@@ -482,8 +529,20 @@ class DeepSpeedEngine:
             # even when the overflow flag is only fetched periodically
             "skip_count": jnp.int32(0),
         }
+        self._init_qg_error(acc_grads)
         del params_f32
         self.model.params = None  # single source of truth is the state
+
+    def _init_qg_error(self, acc_grads):
+        """qgZ error-feedback accumulator, sharded like the grads it
+        compensates (fp32: residuals are sub-int8-lsb sized; stored in
+        unscaled units — see _micro_step_fn)."""
+        if not self._qgz_enabled:
+            return
+        self.state["qg_error"] = jax.tree_util.tree_map(
+            lambda g: jax.device_put(
+                jnp.zeros(g.shape, jnp.float32), g.sharding),
+            acc_grads)
 
     # ----------------------------------------------------------- data plumbing
     def deepspeed_io(self, dataset, batch_size=None, route=ROUTE_TRAIN,
@@ -528,11 +587,40 @@ class DeepSpeedEngine:
             return out[0]
         return out
 
+    def _qwz_gather_tree_fn(self):
+        """qwZ: params tree -> gathered-params tree (None when disabled).
+
+        Each data-sharded stage-3 leaf goes through ``qwz_gather``: the
+        all-gather XLA emits moves int8 blocks + per-block scales instead
+        of the compute dtype, and the straight-through vjp routes the
+        cotangent back as the sharded-layout reduce-scatter."""
+        if not getattr(self, "_qwz_enabled", False):
+            return None
+        from .comm.quantize import qwz_gather
+        from .zero.partition import _path_str
+        plan = self.zero_plan
+
+        def gather(params):
+            def leaf(path, p):
+                shape = np.shape(p)
+                if not plan.param_is_data_sharded(path, shape):
+                    return p
+                return qwz_gather(p, plan.gather_sharding(path, shape),
+                                  plan.param_sharding(path, shape))
+            return jax.tree_util.tree_map_with_path(
+                lambda kp, p: leaf(_path_str(kp), p), params)
+
+        return gather
+
     def _micro_step_fn(self):
         apply_fn = self.model.apply_fn
         gas = self.gradient_accumulation_steps()
         plan = self.zero_plan
         model = self.model
+        qwz = self._qwz_gather_tree_fn()
+        qgz = getattr(self, "_qgz_enabled", False)
+        if qgz:
+            from .comm.quantize import quantize_with_error_feedback
 
         def micro(state, batch, rng, pld_theta=None):
             kwargs = {**model.rng_kwargs(rng), **model.mode_kwargs(True)}
@@ -546,6 +634,8 @@ class DeepSpeedEngine:
                     kwargs["pld_theta"] = pld_theta
 
             def loss_fn(compute_params):
+                if qwz is not None:
+                    compute_params = qwz(compute_params)
                 out = apply_fn(compute_params, *batch, **kwargs)
                 loss = self._loss_of(out)
                 scaled = loss.astype(jnp.float32) * \
@@ -554,11 +644,31 @@ class DeepSpeedEngine:
 
             (_, loss), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(state["params"])
+            new_state = dict(state)
+            if qgz:
+                # qgZ: each micro-step's gradient contribution passes
+                # through the error-compensated int8 codec before
+                # accumulation — the numerics of a quantized gradient
+                # reduce-scatter, with the residual carried across steps
+                # so the long-run average stays unbiased. The residual is
+                # stored in UNSCALED units (grads carry the loss scale),
+                # so a dynamic-scale change between steps cannot inject a
+                # wrong-magnitude correction.
+                cur_scale = state["scaler"].cur_scale
+                qd_and_err = jax.tree_util.tree_map(
+                    lambda g, e: quantize_with_error_feedback(
+                        g, e, scale=cur_scale),
+                    grads, state["qg_error"])
+                grads = jax.tree_util.tree_map(
+                    lambda p, qe: qe[0], grads, qd_and_err)
+                new_state["qg_error"] = plan.constrain(
+                    jax.tree_util.tree_map(
+                        lambda p, qe: qe[1], grads, qd_and_err),
+                    "grad")
             new_acc = jax.tree_util.tree_map(
                 lambda a, g: a + g.astype(a.dtype), state["acc_grads"],
                 grads)
             new_acc = plan.constrain(new_acc, "grad")
-            new_state = dict(state)
             new_state["acc_grads"] = new_acc
             return new_state, loss
 
@@ -617,6 +727,14 @@ class DeepSpeedEngine:
                 for key, val in new_opt.items()
             }
             new_state["scaler"] = ls.update_scale(scaler, overflow)
+            if "qg_error" in state:
+                # an overflowed micro window quantized inf/nan grads, so
+                # the qgZ residual is poisoned — reset it with the skip
+                # (a stale-scale residual is also dropped here, matching
+                # the reference's error-state reset on overflow)
+                new_state["qg_error"] = jax.tree_util.tree_map(
+                    lambda e: jnp.where(overflow, jnp.zeros_like(e), e),
+                    state["qg_error"])
             if "skip_count" in state:
                 new_state["skip_count"] = (
                     state["skip_count"] + overflow.astype(jnp.int32))
@@ -696,8 +814,12 @@ class DeepSpeedEngine:
     def _eval_fn(self):
         apply_fn = self.model.apply_fn
         model = self.model
+        qwz = self._qwz_gather_tree_fn()
 
         def eval_step(params, batch):
+            if qwz is not None:
+                # eval sees the same int8-gathered weights training does
+                params = qwz(params)
             out = apply_fn(params, *batch, **model.mode_kwargs(False))
             return self._loss_of(out)
 
@@ -931,6 +1053,11 @@ class DeepSpeedEngine:
         else:
             self.state["acc_grads"] = jax.tree_util.tree_map(
                 jnp.zeros_like, self.state["acc_grads"])
+            if "qg_error" in self.state:
+                # poisoned by the inf/nan grads this window quantized —
+                # reset with the skip (mirrors _apply_step_fn)
+                self.state["qg_error"] = jax.tree_util.tree_map(
+                    jnp.zeros_like, self.state["qg_error"])
         self.state["scaler"] = ls.update_scale(scaler, overflow)
         return {"overflow": overflow, "grad_norm": grad_norm,
                 "loss_scale": cur_scale}
@@ -1307,6 +1434,21 @@ class DeepSpeedEngine:
         # ZeRO optimizers too)
         return self.zero_optimization() and \
             self._config.zero_config.cpu_offload
+
+    def zero_quantized_weights(self):
+        """qwZ live: stage-3 weight all-gathers ride int8 blocks."""
+        return getattr(self, "_qwz_enabled", False)
+
+    def zero_hierarchical_partition(self):
+        """hpZ live: the secondary-partition (shard sub-axis) size, or 0."""
+        plan = getattr(self, "zero_plan", None)
+        if plan is not None and plan.hierarchical:
+            return plan.param_shard_size
+        return 0
+
+    def zero_quantized_gradients(self):
+        """qgZ live: micro-step grads pass the error-compensated codec."""
+        return getattr(self, "_qgz_enabled", False)
 
     def fp16_enabled(self):
         return self._config.fp16_enabled

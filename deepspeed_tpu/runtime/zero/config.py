@@ -33,6 +33,9 @@ class DeepSpeedZeroConfig(object):
         self.gather_fp16_weights_on_model_save = None
         self.elastic_checkpoint = None
         self.load_from_fp32_weights = None
+        self.quantized_weights = None
+        self.hierarchical_partition = None
+        self.quantized_gradients = None
 
         if ZERO_OPTIMIZATION in param_dict:
             zero_config_dict = param_dict[ZERO_OPTIMIZATION]
@@ -101,6 +104,22 @@ class DeepSpeedZeroConfig(object):
                                     ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT_DEFAULT)
         self.load_from_fp32_weights = g(ZERO_OPTIMIZATION_LOAD_FROM_FP32_WEIGHTS,
                                         ZERO_OPTIMIZATION_LOAD_FROM_FP32_WEIGHTS_DEFAULT)
+        # ZeRO++ comm-efficiency modes (independently toggleable, off by
+        # default; see runtime/comm/quantize.py + docs/zeropp.md)
+        self.quantized_weights = bool(g(
+            ZERO_OPTIMIZATION_QUANTIZED_WEIGHTS,
+            ZERO_OPTIMIZATION_QUANTIZED_WEIGHTS_DEFAULT))
+        hpz = g(ZERO_OPTIMIZATION_HIERARCHICAL_PARTITION,
+                ZERO_OPTIMIZATION_HIERARCHICAL_PARTITION_DEFAULT)
+        if isinstance(hpz, bool) or not isinstance(hpz, int) or hpz < 0:
+            raise ValueError(
+                "zero_optimization.{} must be an int >= 0 (the secondary "
+                "partition size; 0/1 disables), got {!r}".format(
+                    ZERO_OPTIMIZATION_HIERARCHICAL_PARTITION, hpz))
+        self.hierarchical_partition = hpz
+        self.quantized_gradients = bool(g(
+            ZERO_OPTIMIZATION_QUANTIZED_GRADIENTS,
+            ZERO_OPTIMIZATION_QUANTIZED_GRADIENTS_DEFAULT))
 
     def repr(self):
         return self.__dict__

@@ -20,7 +20,10 @@ ZeRO optimization should be enabled as:
   "stage3_max_reuse_distance": 1000000000,
   "stage3_prefetch_bucket_size": 500000000,
   "stage3_param_persistence_threshold": 100000,
-  "elastic_checkpoint": [true|false]
+  "elastic_checkpoint": [true|false],
+  "zero_quantized_weights": [true|false],
+  "zero_hierarchical_partition": 0,
+  "zero_quantized_gradients": [true|false]
 }
 """
 
@@ -84,3 +87,23 @@ ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT_DEFAULT = True
 
 ZERO_OPTIMIZATION_LOAD_FROM_FP32_WEIGHTS = "load_from_fp32_weights"
 ZERO_OPTIMIZATION_LOAD_FROM_FP32_WEIGHTS_DEFAULT = True
+
+# --- ZeRO++ communication-efficiency modes (arXiv:2306.10209), all
+# independently toggleable and default-off ---
+
+# qwZ: stage-3 weight all-gathers move blockwise-int8 data + per-block
+# scales instead of the compute dtype (runtime/comm/quantize.py).
+ZERO_OPTIMIZATION_QUANTIZED_WEIGHTS = "zero_quantized_weights"
+ZERO_OPTIMIZATION_QUANTIZED_WEIGHTS_DEFAULT = False
+
+# hpZ: secondary partition size — the ``data`` mesh axis is factored into
+# (replica, shard) sub-axes of shard size N; stage-3 params shard only
+# within the N-device shard group so per-step gathers ride the short hop.
+# 0/1 disables; N must divide the data-parallel degree.
+ZERO_OPTIMIZATION_HIERARCHICAL_PARTITION = "zero_hierarchical_partition"
+ZERO_OPTIMIZATION_HIERARCHICAL_PARTITION_DEFAULT = 0
+
+# qgZ: each micro-step's gradient contribution passes through the
+# error-compensated int8 codec before accumulation (ZeRO-2/3).
+ZERO_OPTIMIZATION_QUANTIZED_GRADIENTS = "zero_quantized_gradients"
+ZERO_OPTIMIZATION_QUANTIZED_GRADIENTS_DEFAULT = False

@@ -27,18 +27,45 @@ import numpy as np
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ...parallel.topology import DATA_AXIS
+from ...parallel.topology import (DATA_AXIS, DATA_REPLICA_AXIS,
+                                  DATA_SHARD_AXIS)
 
 
 class ZeroShardingPlan:
-    """Computed shardings for every piece of the train state."""
+    """Computed shardings for every piece of the train state.
+
+    Secondary partitioning (ZeRO++ hpZ): on a mesh whose ``data`` axis was
+    factored into (``data_replica``, ``data_shard``) sub-axes
+    (topology.factor_data_axis), master/optimizer/gradient state shards
+    over BOTH sub-axes (the primary partition — identical placement to the
+    flat plan) while stage-3 compute params shard only over ``data_shard``
+    (the secondary partition): forward/backward all-gathers then cross
+    only the short intra-replica hop, at the cost of params being
+    replicated ``data_replica``-ways.
+    """
 
     def __init__(self, mesh, stage=0, param_persistence_threshold=100000,
                  model_spec_fn=None):
         self.mesh = mesh
         self.stage = stage
         self.persist_threshold = param_persistence_threshold
-        self.dp_size = int(mesh.shape.get(DATA_AXIS, 1))
+        if DATA_AXIS in mesh.shape:
+            self.data_axes = (DATA_AXIS,)
+            self.param_data_axes = (DATA_AXIS,)
+        elif DATA_SHARD_AXIS in mesh.shape:
+            self.data_axes = tuple(a for a in (DATA_REPLICA_AXIS,
+                                               DATA_SHARD_AXIS)
+                                   if a in mesh.shape)
+            self.param_data_axes = (DATA_SHARD_AXIS,)
+        else:
+            self.data_axes = ()
+            self.param_data_axes = ()
+        self.dp_size = int(np.prod([mesh.shape[a] for a in self.data_axes],
+                                   dtype=np.int64)) if self.data_axes else 1
+        self.param_shard_size = int(np.prod(
+            [mesh.shape[a] for a in self.param_data_axes],
+            dtype=np.int64)) if self.param_data_axes else 1
+        self.hierarchical = self.param_data_axes != self.data_axes
         # Optional per-param tensor-parallel PartitionSpec provider
         # (path, shape) -> PartitionSpec, used by TP-aware models.
         self.model_spec_fn = model_spec_fn
@@ -68,34 +95,68 @@ class ZeroShardingPlan:
             return None
         return P(*cleaned)
 
-    def _zero_spec(self, path, shape, threshold):
-        """Combine any TP spec with data-axis sharding of a free dimension."""
+    def _zero_spec(self, path, shape, threshold, data_axes=None):
+        """Combine any TP spec with data-axis sharding of a free dimension.
+
+        ``data_axes``: the mesh axes (tuple) the free dimension shards
+        over — the full factored set for master/grad state, the shard
+        sub-axis only for secondary-partitioned stage-3 params."""
+        if data_axes is None:
+            data_axes = self.data_axes
+        shard_ways = int(np.prod([self.mesh.shape[a] for a in data_axes],
+                                 dtype=np.int64)) if data_axes else 1
         tp_spec = self._tp_spec(path, shape)
         base = list(tp_spec) if tp_spec is not None else [None] * len(shape)
         while len(base) < len(shape):
             base.append(None)
         numel = int(np.prod(shape)) if shape else 1
-        # dp_size <= 1 also covers meshes that dropped the size-1 data
+        # shard_ways <= 1 also covers meshes that dropped the size-1 data
         # axis entirely (e.g. a pure-sequence mesh): annotating 'data'
         # there would name an axis the mesh doesn't carry
-        if self.dp_size <= 1 or numel < max(threshold, self.dp_size) \
+        if shard_ways <= 1 or numel < max(threshold, shard_ways) \
                 or not shape:
             return P(*base) if tp_spec is not None else P()
-        # Shard the first unclaimed axis divisible by dp
+        # Shard the first unclaimed axis divisible by the shard degree
         for dim, size in enumerate(shape):
-            if base[dim] is None and size % self.dp_size == 0:
-                base[dim] = DATA_AXIS
+            if base[dim] is None and size % shard_ways == 0:
+                base[dim] = data_axes[0] if len(data_axes) == 1 \
+                    else tuple(data_axes)
                 return P(*base)
         return P(*base)
 
     # --- public sharding queries -------------------------------------------
     def param_sharding(self, path, shape):
-        """Compute-dtype parameters: sharded only at stage 3."""
+        """Compute-dtype parameters: sharded only at stage 3 (over the
+        secondary-partition sub-axis when the plan is hierarchical)."""
         if self.stage >= 3:
-            return self._named(self._zero_spec(path, shape,
-                                               self.persist_threshold))
+            return self._named(self._zero_spec(
+                path, shape, self.persist_threshold,
+                data_axes=self.param_data_axes))
         tp_spec = self._tp_spec(path, shape)
         return self._named(tp_spec if tp_spec is not None else P())
+
+    def gather_sharding(self, path, shape):
+        """The qwZ all-gather target: the param's spec with every data
+        (sub-)axis dropped — TP placement intact, data axes replicated."""
+        tp_spec = self._tp_spec(path, shape)
+        return self._named(tp_spec if tp_spec is not None else P())
+
+    def param_is_data_sharded(self, path, shape, flat=False):
+        """Whether the stage-3 compute param actually shards over a data
+        (sub-)axis — the leaves qwZ gathers explicitly. ``flat=True``
+        answers for the UN-factored plan (full data axis) instead: what
+        flat ZeRO-3 would shard — the wire estimator's baseline."""
+        data_axes = self.data_axes if flat else self.param_data_axes
+        if self.stage < 3 or not data_axes:
+            return False
+        spec = self._zero_spec(path, shape, self.persist_threshold,
+                               data_axes=data_axes)
+        wanted = set(data_axes)
+        for entry in spec:
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            if any(ax in wanted for ax in axes):
+                return True
+        return False
 
     def master_sharding(self, path, shape):
         """fp32 master + optimizer moments: sharded from stage 1 up."""

@@ -50,8 +50,13 @@ def test_checkpoint_matches_plain_grads():
 
     g_plain = jax.grad(loss_plain, argnums=(0, 1))(w1, w2)
     g_ckpt = jax.grad(loss_ckpt, argnums=(0, 1))(w1, w2)
+    # rtol 1e-5 + atol 2e-6, not 1e-6/0: the rematerialized backward
+    # re-orders the fp32 reductions, and jax 0.4.x CPU drifts the last
+    # digit (~7e-7 abs) on near-zero lanes — same math, different
+    # summation tree
     for a, b in zip(g_plain, g_ckpt):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                                   atol=2e-6)
 
 
 def test_checkpoint_inside_jit():
@@ -74,8 +79,10 @@ def test_partition_activations_grads_match():
 
     g_ckpt = jax.grad(loss_ckpt, argnums=(0, 1))(w1, w2)
     g_plain = jax.grad(lambda a, b: _mlp(a, b, x), argnums=(0, 1))(w1, w2)
+    # same last-digit remat drift as test_checkpoint_matches_plain_grads
     for a, b in zip(g_plain, g_ckpt):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                                   atol=2e-6)
 
 
 def test_configure_from_ds_config(tmp_config_file):

@@ -1,0 +1,321 @@
+"""ZeRO++ communication-efficiency layer (runtime/comm/quantize.py).
+
+Codec round-trip error bounds, the shard_map quantized collectives, and
+the three engine modes: qwZ (int8 weight all-gather == fp32 gather within
+int8 tolerance), hpZ (identical params to flat ZeRO-3), and qgZ
+(short-run loss-curve parity with fp32 gradients) — all on the virtual
+8-CPU mesh.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import deepspeed_tpu as deepspeed
+from deepspeed_tpu.parallel.topology import (DATA_AXIS, DATA_REPLICA_AXIS,
+                                             DATA_SHARD_AXIS, build_mesh,
+                                             factor_data_axis)
+from deepspeed_tpu.runtime.comm import quantize as qz
+from deepspeed_tpu.runtime.comm.wire import (estimate_engine_comm_bytes,
+                                             estimate_step_comm_bytes)
+from simple_model import make_simple_model, SimpleDataset, base_config
+
+pytestmark = pytest.mark.comm
+
+HIDDEN = 16
+WORLD = 8
+
+
+# ----------------------------------------------------------------- the codec
+def test_flat_codec_roundtrip_error_bound():
+    """|x - dequant(quant(x))| <= scale/2 per lane (symmetric rounding)."""
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(1000).astype(np.float32) * 3.0)
+    q, scales = qz.quantize_blockwise(x, block_size=256)
+    assert q.dtype == jnp.int8 and scales.dtype == jnp.float32
+    rt = qz.dequantize_blockwise(q, scales, x.size, x.dtype)
+    per_lane_bound = np.repeat(np.asarray(scales), 256)[:1000] * 0.5
+    err = np.abs(np.asarray(rt) - np.asarray(x))
+    assert (err <= per_lane_bound + 1e-7).all(), err.max()
+
+
+def test_flat_codec_scale_dtype_follows_input():
+    """bf16 in -> bf16 scales and bf16 round-trip (no fp32 upcast)."""
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(512), jnp.bfloat16)
+    q, scales = qz.quantize_blockwise(x)
+    assert scales.dtype == jnp.bfloat16
+    rt = qz.dequantize_blockwise(q, scales, x.size, x.dtype)
+    assert rt.dtype == jnp.bfloat16
+    rel = float(jnp.mean(jnp.abs(rt.astype(jnp.float32) -
+                                 x.astype(jnp.float32))) /
+                jnp.mean(jnp.abs(x.astype(jnp.float32))))
+    assert rel < 0.02, rel
+
+
+def test_param_codec_preserves_shape():
+    rs = np.random.RandomState(2)
+    w = jnp.asarray(rs.randn(24, 100).astype(np.float32))
+    q, scales = qz.quantize_param(w, block_size=32)
+    assert q.shape == w.shape and q.dtype == jnp.int8
+    # 100 has no divisor in (32, 25]; largest divisor <= 32 is 25
+    assert scales.shape == (24, 4)
+    rt = qz.dequantize_param(q, scales, w.dtype)
+    rel = float(jnp.mean(jnp.abs(rt - w)) / jnp.mean(jnp.abs(w)))
+    assert rel < 0.01, rel
+
+
+def test_zero_block_roundtrips_to_zero():
+    x = jnp.zeros(512, jnp.float32)
+    rt = qz.quantize_dequantize(x)
+    np.testing.assert_array_equal(np.asarray(rt), 0.0)
+
+
+def test_error_feedback_unbiased():
+    """The qgZ accumulator telescopes: mean over T calls -> exact value."""
+    rs = np.random.RandomState(3)
+    x = jnp.asarray(rs.randn(777).astype(np.float32))
+    err = jnp.zeros(777, jnp.float32)
+    acc = np.zeros(777, np.float64)
+    T = 100
+    for _ in range(T):
+        qd, err = qz.quantize_with_error_feedback(x, err)
+        acc += np.asarray(qd, np.float64)
+    bias = np.abs(acc / T - np.asarray(x)).mean() / \
+        np.abs(np.asarray(x)).mean()
+    assert bias < 0.01, bias
+
+
+def test_error_feedback_scale_invariant():
+    """Residuals live in unscaled units: feeding x*s with scale=s carries
+    the same correction as feeding x with scale=1, so a loss-scale change
+    between calls cannot inject a wrong-magnitude bias."""
+    rs = np.random.RandomState(8)
+    x = jnp.asarray(rs.randn(512).astype(np.float32))
+    _, err_unit = qz.quantize_with_error_feedback(x, jnp.zeros(512))
+    qd_scaled, err_scaled = qz.quantize_with_error_feedback(
+        x * 1024.0, jnp.zeros(512), scale=1024.0)
+    np.testing.assert_allclose(np.asarray(err_scaled),
+                               np.asarray(err_unit), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(qd_scaled) / 1024.0,
+                               np.asarray(x) - np.asarray(err_unit),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_qgz_error_reset_on_overflow():
+    """An overflowed step must zero the qgZ residual (inf grads would
+    otherwise poison it permanently)."""
+    cfg = _zero_cfg(zero_quantized_gradients=True)
+    del cfg["bf16"]
+    cfg["fp16"] = {"enabled": True, "initial_scale_power": 4}
+    engine = _make_engine(cfg)
+    dataset = SimpleDataset(64, HIDDEN, seed=12)
+    _run_steps(engine, dataset, 1)  # healthy step: residual becomes nonzero
+    assert any(float(jnp.abs(e).max()) > 0
+               for e in jax.tree_util.tree_leaves(engine.state["qg_error"]))
+    # poison the accumulators the way an inf loss would and take a step
+    engine.state["acc_grads"] = jax.tree_util.tree_map(
+        lambda g: jnp.full_like(g, jnp.inf), engine.state["acc_grads"])
+    engine.state["qg_error"] = jax.tree_util.tree_map(
+        lambda e: jnp.full_like(e, jnp.nan), engine.state["qg_error"])
+    engine._take_model_step()
+    assert engine.skipped_steps >= 1
+    for e in jax.tree_util.tree_leaves(engine.state["qg_error"]):
+        np.testing.assert_array_equal(np.asarray(e), 0.0)
+
+
+def test_sign_helpers_scale_dtype():
+    """The deduped 1-bit helpers keep a bf16 buffer in bf16."""
+    x = jnp.asarray(np.linspace(-1, 1, 64), jnp.bfloat16)
+    scale = qz.sign_scale(x, 64.0)
+    assert scale.dtype == jnp.bfloat16
+    out = qz.unpack_signs(qz.pack_signs(x), scale)
+    assert out.dtype == jnp.bfloat16
+
+
+# -------------------------------------------------- shard_map collectives
+def test_quantized_all_gather_matches_fp32_gather():
+    mesh = build_mesh(data=WORLD)
+    qc = qz.QuantizedCollectives(mesh)
+    rs = np.random.RandomState(4)
+    vals = jnp.asarray(rs.randn(WORLD, 512).astype(np.float32))
+    out = qc.all_gather(vals)
+    assert out.shape == (WORLD, WORLD * 512)
+    exact = np.asarray(vals).reshape(-1)
+    for rank in (0, 3, 7):
+        got = np.asarray(out[rank])
+        rel = np.abs(got - exact).mean() / np.abs(exact).mean()
+        assert rel < 0.01, rel
+
+
+def test_quantized_reduce_scatter_matches_sum():
+    mesh = build_mesh(data=WORLD)
+    qc = qz.QuantizedCollectives(mesh)
+    rs = np.random.RandomState(5)
+    vals = jnp.asarray(rs.randn(WORLD, WORLD * 64).astype(np.float32))
+    out = qc.reduce_scatter(vals)
+    true = np.asarray(vals).sum(axis=0).reshape(WORLD, 64)
+    rel = np.abs(np.asarray(out) - true).mean() / np.abs(true).mean()
+    assert rel < 0.02, rel
+
+
+# ------------------------------------------------------------- qwZ gather
+def test_qwz_gather_matches_fp32_gather_within_int8_tolerance():
+    """The int8 all-gather reproduces the fp32 gather to within the
+    per-block quantization bound, and its vjp is straight-through."""
+    mesh = build_mesh(data=WORLD)
+    sharded = NamedSharding(mesh, P(DATA_AXIS, None))
+    gathered = NamedSharding(mesh, P())
+    rs = np.random.RandomState(6)
+    w = jax.device_put(
+        jnp.asarray(rs.randn(WORLD * 4, 64).astype(np.float32)), sharded)
+
+    gathered_w = jax.jit(
+        lambda x: qz.qwz_gather(x, gathered, sharded))(w)
+    assert gathered_w.shape == w.shape
+    _, scales = qz.quantize_param(np.asarray(w))
+    bound = np.asarray(scales, np.float32).max() * 0.51
+    err = np.abs(np.asarray(gathered_w) - np.asarray(w)).max()
+    assert err <= bound, (err, bound)
+
+    # straight-through backward: grads flow as identity
+    g = jax.jit(jax.grad(
+        lambda x: jnp.sum(qz.qwz_gather(x, gathered, sharded) * 2.0)))(w)
+    np.testing.assert_allclose(np.asarray(g), 2.0)
+
+
+# ------------------------------------------------------------ engine modes
+def _make_engine(config, seed=2):
+    model = make_simple_model(HIDDEN, seed=seed)
+    engine, _, _, _ = deepspeed.initialize(model=model,
+                                           config_params=config)
+    return engine
+
+
+def _run_steps(engine, dataset, steps):
+    mb = engine.train_micro_batch_size_per_gpu() * WORLD
+    losses = []
+    for s in range(steps):
+        x = np.stack([dataset[(s * mb + i) % len(dataset)][0]
+                      for i in range(mb)])
+        y = np.stack([dataset[(s * mb + i) % len(dataset)][1]
+                      for i in range(mb)])
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    return losses
+
+
+def _zero_cfg(**zero_overrides):
+    cfg = base_config(WORLD)
+    cfg["bf16"] = {"enabled": True}
+    zero = {"stage": 3, "stage3_param_persistence_threshold": 0}
+    zero.update(zero_overrides)
+    cfg["zero_optimization"] = zero
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def flat_zero3():
+    dataset = SimpleDataset(512, HIDDEN, seed=11)
+    engine = _make_engine(_zero_cfg())
+    losses = _run_steps(engine, dataset, 6)
+    params = jax.tree_util.tree_map(np.asarray, engine.get_params())
+    return dataset, losses, params
+
+
+def test_hpz_mesh_factoring():
+    mesh = factor_data_axis(build_mesh(data=WORLD), 4)
+    assert dict(mesh.shape) == {DATA_REPLICA_AXIS: 2, DATA_SHARD_AXIS: 4}
+    with pytest.raises(ValueError):
+        factor_data_axis(build_mesh(data=WORLD), 3)  # 3 does not divide 8
+
+
+def test_hpz_identical_params_to_flat_zero3(flat_zero3):
+    """hpZ only changes placement: same losses, same params."""
+    dataset, ref_losses, ref_params = flat_zero3
+    engine = _make_engine(_zero_cfg(zero_hierarchical_partition=4))
+    assert engine.zero_hierarchical_partition() == 4
+    assert DATA_SHARD_AXIS in engine.mesh.shape
+    losses = _run_steps(engine, dataset, 6)
+    np.testing.assert_allclose(np.array(losses), np.array(ref_losses),
+                               rtol=5e-3, atol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(ref_params),
+                    jax.tree_util.tree_leaves(engine.get_params())):
+        np.testing.assert_allclose(a, np.asarray(b), rtol=5e-3, atol=1e-5)
+
+
+def test_qwz_short_run_loss_parity(flat_zero3):
+    """int8 weight gathers: loss curve tracks the fp32-gather baseline."""
+    dataset, ref_losses, _ = flat_zero3
+    engine = _make_engine(_zero_cfg(zero_quantized_weights=True))
+    assert engine.zero_quantized_weights()
+    losses = _run_steps(engine, dataset, 6)
+    np.testing.assert_allclose(np.array(losses), np.array(ref_losses),
+                               rtol=0.05, atol=1e-4)
+
+
+def test_qgz_short_run_loss_parity(flat_zero3):
+    """Quantized-gradient mode vs fp32 gradients: loss-curve parity."""
+    dataset, ref_losses, _ = flat_zero3
+    engine = _make_engine(_zero_cfg(zero_quantized_gradients=True))
+    assert engine.zero_quantized_gradients()
+    assert "qg_error" in engine.state
+    losses = _run_steps(engine, dataset, 6)
+    np.testing.assert_allclose(np.array(losses), np.array(ref_losses),
+                               rtol=0.05, atol=1e-4)
+
+
+def test_all_modes_combined_loss_parity(flat_zero3):
+    dataset, ref_losses, _ = flat_zero3
+    engine = _make_engine(_zero_cfg(zero_quantized_weights=True,
+                                    zero_hierarchical_partition=2,
+                                    zero_quantized_gradients=True))
+    losses = _run_steps(engine, dataset, 6)
+    rel = abs(losses[-1] - ref_losses[-1]) / abs(ref_losses[-1])
+    assert rel < 0.05, (losses, ref_losses)
+
+
+def test_modes_ignored_below_their_stage():
+    """Toggles are stage-gated: stage 1 config leaves them all off."""
+    cfg = base_config(WORLD)
+    cfg["bf16"] = {"enabled": True}
+    cfg["zero_optimization"] = {"stage": 1, "zero_quantized_weights": True,
+                                "zero_hierarchical_partition": 2,
+                                "zero_quantized_gradients": True}
+    engine = _make_engine(cfg)
+    assert not engine.zero_quantized_weights()
+    assert not engine.zero_quantized_gradients()
+    assert engine.zero_hierarchical_partition() == 0
+    assert DATA_AXIS in engine.mesh.shape
+
+
+def test_hierarchical_partition_must_divide_dp():
+    with pytest.raises(ValueError, match="divide"):
+        _make_engine(_zero_cfg(zero_hierarchical_partition=3))
+
+
+# ------------------------------------------------------------ wire estimate
+def test_wire_estimate_reduction_ratio():
+    """qwZ+hpZ all-gather bytes drop >= 3x vs flat fp32 ZeRO-3."""
+    engine = _make_engine(_zero_cfg(zero_quantized_weights=True,
+                                    zero_hierarchical_partition=2,
+                                    zero_quantized_gradients=True))
+    comm = estimate_engine_comm_bytes(engine)
+    assert comm["allgather_reduction_x"] >= 3.0, comm
+    assert comm["total_bytes_per_step"] < \
+        comm["fp32_flat_total_bytes_per_step"]
+
+
+def test_wire_estimate_flat_fp32_baseline_is_neutral():
+    """The flat-fp32 estimate of a flat fp32-wire config equals itself."""
+    engine = _make_engine(_zero_cfg())
+    plan = engine.zero_plan
+    params = engine.state["params"]
+    cur = estimate_step_comm_bytes(plan, params, compute_itemsize=4,
+                                   grad_itemsize=4)
+    base = estimate_step_comm_bytes(plan, params, _force_flat_fp32=True)
+    assert cur == base

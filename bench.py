@@ -31,18 +31,16 @@ def peak_for(device):
     return 0.1e12
 
 
-def safe_default_backend():
-    """``jax.default_backend()`` with CPU fallback: a broken TPU plugin
-    raises RuntimeError out of backend init (BENCH_r05 failed there), and
-    a bench run must always emit parseable JSON — so force the CPU client
-    and retry instead of propagating the traceback."""
+def safe_default_backend(retries=3, backoff_s=2.0):
+    """``jax.default_backend()`` with BOUNDED retry + CPU fallback: a
+    broken TPU plugin raises RuntimeError out of backend init (BENCH_r05
+    failed there), and a bench run must always emit parseable JSON — so
+    retry the probe a few times (transient tunnel hiccups), then force
+    the CPU client, and only propagate after the CPU client itself fails
+    (main()'s handler still emits the error JSON line in that case)."""
     import jax
-    try:
-        return jax.default_backend()
-    except Exception as err:  # noqa: BLE001 - any backend-init failure
-        print("bench: backend probe failed ({}); forcing CPU".format(
-            str(err)[:120]), file=sys.stderr)
-        jax.config.update("jax_platforms", "cpu")
+
+    def _drop_backends():
         try:
             import jax.extend.backend as _jeb
             _jeb.clear_backends()
@@ -51,7 +49,23 @@ def safe_default_backend():
                 jax.clear_backends()
             except Exception:  # noqa: BLE001
                 pass
-        return jax.default_backend()
+
+    last_err = None
+    for attempt in range(retries):
+        try:
+            return jax.default_backend()
+        except Exception as err:  # noqa: BLE001 - any backend-init failure
+            last_err = err
+            print("bench: backend probe failed (attempt {}/{}: {}); "
+                  "retrying".format(attempt + 1, retries,
+                                    str(err)[:120]), file=sys.stderr)
+            _drop_backends()
+            time.sleep(backoff_s * (attempt + 1))
+    print("bench: backend init failed {} times ({}); forcing CPU".format(
+        retries, str(last_err)[:120]), file=sys.stderr)
+    jax.config.update("jax_platforms", "cpu")
+    _drop_backends()
+    return jax.default_backend()
 
 
 def main():

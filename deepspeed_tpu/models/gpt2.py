@@ -370,6 +370,38 @@ def _forward_hidden_cached(params, input_ids, config, cache, positions):
     return x, (k_cache, v_cache)
 
 
+def make_block_fn(config, train):
+    """One transformer block as ``block_fn(x, block_params, rng) -> x``,
+    with the config's remat/fused-attention choices applied. Shared by
+    the monolithic forward (forward_hidden) and the streamed-offload
+    segments (stream_spec_for) so both run identical per-block math.
+
+    "full": recompute everything in bwd (min memory, ~4/3 flops);
+    "dots": save matmul outputs, recompute elementwise only — the usual
+    MFU sweet spot on TPU (HBM traffic for ln/gelu recompute is cheaper
+    than re-running the gemms on the MXU). Under scan the CSE-prevention
+    barriers are unnecessary and inhibit fusion."""
+    policy = (jax.checkpoint_policies.nothing_saveable
+              if config.remat_policy == "full" else
+              jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    if _use_fused_attn(config):
+        # attention runs OUTSIDE the remat region via its own custom_vjp
+        # (saves ctx+lse, recomputes LN+QKV in bwd, never re-runs the flash
+        # forward); only the proj/MLP remainder is rematerialized, under
+        # the same remat_policy as the unfused path.
+        rest_fn = partial(_block_rest, config=config, train=train)
+        if config.remat:
+            rest_fn = jax.checkpoint(rest_fn, policy=policy,
+                                     prevent_cse=not config.scan_blocks)
+        return lambda x, bp, rng: rest_fn(
+            x, _fused_attn_ctx(x, bp, config), bp, rng=rng)
+    block_fn = partial(_block, config=config, train=train)
+    if config.remat:
+        block_fn = jax.checkpoint(block_fn, policy=policy,
+                                  prevent_cse=not config.scan_blocks)
+    return block_fn
+
+
 def forward_hidden(params, input_ids, config, rng=None, train=False,
                    cache=None, positions=None):
     """Embedding + transformer stack -> final hidden states.
@@ -393,30 +425,7 @@ def forward_hidden(params, input_ids, config, rng=None, train=False,
         tok = jnp.take(params["wte"], input_ids, axis=0)
     x = tok.astype(compute_dtype) + params["wpe"][:s].astype(compute_dtype)
 
-    # "full": recompute everything in bwd (min memory, ~4/3 flops);
-    # "dots": save matmul outputs, recompute elementwise only — the usual
-    # MFU sweet spot on TPU (HBM traffic for ln/gelu recompute is cheaper
-    # than re-running the gemms on the MXU). Under scan the CSE-prevention
-    # barriers are unnecessary and inhibit fusion.
-    policy = (jax.checkpoint_policies.nothing_saveable
-              if config.remat_policy == "full" else
-              jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
-    if _use_fused_attn(config):
-        # attention runs OUTSIDE the remat region via its own custom_vjp
-        # (saves ctx+lse, recomputes LN+QKV in bwd, never re-runs the flash
-        # forward); only the proj/MLP remainder is rematerialized, under
-        # the same remat_policy as the unfused path.
-        rest_fn = partial(_block_rest, config=config, train=train)
-        if config.remat:
-            rest_fn = jax.checkpoint(rest_fn, policy=policy,
-                                     prevent_cse=not config.scan_blocks)
-        block_fn = lambda x, bp, rng: rest_fn(
-            x, _fused_attn_ctx(x, bp, config), bp, rng=rng)
-    else:
-        block_fn = partial(_block, config=config, train=train)
-        if config.remat:
-            block_fn = jax.checkpoint(block_fn, policy=policy,
-                                      prevent_cse=not config.scan_blocks)
+    block_fn = make_block_fn(config, train)
 
     if config.scan_blocks:
         n = config.n_layers
@@ -500,6 +509,56 @@ def lm_loss(params, input_ids, labels, config, rng=None, train=True):
         return chunked_causal_lm_loss(hidden, params["wte"], labels, chunk)
     logits = hidden @ params["wte"].astype(hidden.dtype).T  # tied embedding
     return causal_lm_cross_entropy(logits, labels)
+
+
+def stream_spec_for(config):
+    """:class:`runtime.model.StreamSpec` for GPT-2 — the layer-group
+    decomposition the streamed-offload runner (cpu_offload_params)
+    drives. Composition equals ``lm_loss`` segment for segment: embed
+    (wte gather + wpe add), per-layer ``make_block_fn`` blocks, head
+    (ln_f + tied-wte CE). ``wte`` is shared between the embed and head
+    segments — ``split`` returns the SAME object in both so the runner
+    sums the two gradient contributions."""
+    from ..runtime.model import StreamSpec
+    if config.sequence_parallel or config.sparse_embedding_grads:
+        raise ValueError(
+            "streamed parameter offload does not compose with "
+            "sequence_parallel or sparse_embedding_grads")
+
+    def split(params):
+        blocks = params["blocks"]
+        if isinstance(blocks, dict):
+            # scan_blocks stacked layout: per-layer views (no copy)
+            n = np.shape(jax.tree_util.tree_leaves(blocks)[0])[0]
+            blocks = [jax.tree_util.tree_map(lambda t: t[i], blocks)
+                      for i in range(n)]
+        else:
+            blocks = list(blocks)
+        return ({"wte": params["wte"], "wpe": params["wpe"]},
+                blocks,
+                {"ln_f": params["ln_f"], "wte": params["wte"]})
+
+    def embed_apply(embed, batch, rng, train):
+        input_ids = batch[0]
+        s = input_ids.shape[1]
+        compute_dtype = embed["wte"].dtype
+        tok = jnp.take(embed["wte"], input_ids, axis=0)
+        return tok.astype(compute_dtype) + \
+            embed["wpe"][:s].astype(compute_dtype)
+
+    def block_apply(bp, x, rng, train):
+        return make_block_fn(config, train)(x, bp, rng=rng)
+
+    def head_apply(head, x, batch, rng, train):
+        labels = batch[1]
+        x = _layer_norm(x, head["ln_f"]["scale"], head["ln_f"]["bias"])
+        chunk = config.loss_chunk
+        if chunk and x.shape[1] % chunk == 0 and x.shape[1] > chunk:
+            return chunked_causal_lm_loss(x, head["wte"], labels, chunk)
+        logits = x @ head["wte"].astype(x.dtype).T
+        return causal_lm_cross_entropy(logits, labels)
+
+    return StreamSpec(split, embed_apply, block_apply, head_apply)
 
 
 def profile_spec(config, batch_size, seq=None, seed=0):
@@ -590,6 +649,11 @@ def make_gpt2_model(config=None, size="gpt2_small", seed=0, **overrides):
     model.config = config
     model.profile_spec_fn = lambda batch_size, seq=None: profile_spec(
         config, batch_size, seq=seq)
+    if not (config.sequence_parallel or config.sparse_embedding_grads):
+        # streamed-offload decomposition (cpu_offload_params); the
+        # incompatible configs simply don't attach one and the engine
+        # rejects the combination loudly
+        model.stream_spec = stream_spec_for(config)
     return model
 
 

@@ -692,6 +692,8 @@ class DeepSpeedConfig(object):
             # ZeRO++ comm-efficiency modes (docs/zeropp.md)
             "zero_quantized_weights", "zero_hierarchical_partition",
             "zero_quantized_gradients",
+            # no-silent-no-ops enforcement (docs/zero3_offload.md)
+            "strict",
             # short alias of stage3_param_persistence_threshold (the
             # zero.Init config-dict spelling)
             "param_persistence_threshold"},

@@ -7,6 +7,38 @@ params pytree. Flax modules are adapted automatically.
 import inspect
 
 
+class StreamSpec:
+    """Layer-group decomposition contract for streamed parameter offload
+    (``zero_optimization.cpu_offload_params``; runtime/zero/stream.py).
+
+    A model that can be trained beyond-HBM exposes its forward as three
+    jittable segments the runner streams parameters into one layer group
+    at a time:
+
+      ``split(params) -> (embed_tree, [block_tree, ...], head_tree)``
+        Restructure the params tree into an embedding segment, per-layer
+        block segments, and a head segment. Leaf VALUES must be the
+        original tree's objects — a tied weight appearing in two segments
+        (e.g. GPT-2's ``wte`` in embed and head) must be the SAME object,
+        so the runner can sum both gradient contributions and step the
+        master once.
+      ``embed_apply(embed_tree, batch, rng, train) -> x``
+      ``block_apply(block_tree, x, rng, train) -> x``      (one layer)
+      ``head_apply(head_tree, x, batch, rng, train) -> loss``  (fp32 scalar)
+
+    ``batch`` is the full input tuple the engine received (the spec picks
+    what each segment needs, e.g. ids for embed, labels for head). The
+    composition ``head(blocks(embed(batch)))`` must equal the model's
+    ``apply_fn`` loss so the streamed step matches the monolithic one.
+    """
+
+    def __init__(self, split, embed_apply, block_apply, head_apply):
+        self.split = split
+        self.embed_apply = embed_apply
+        self.block_apply = block_apply
+        self.head_apply = head_apply
+
+
 class Model:
     """(apply_fn, params) pair.
 
@@ -24,6 +56,9 @@ class Model:
         self.apply_fn = apply_fn
         self.params = params
         self.partition_spec_fn = partition_spec_fn
+        # optional StreamSpec for streamed parameter offload
+        # (cpu_offload_params); models attach it post-construction
+        self.stream_spec = None
         self.name = name or getattr(apply_fn, "__name__", "model")
         sig_params = _signature_params(apply_fn)
         self.accepts_rng = "rng" in sig_params or "rngs" in sig_params

@@ -47,7 +47,8 @@ from ..engine import DeepSpeedEngine
 from ..model import Model
 from . import p2p
 from .module import PipelineModule
-from .schedule import interleaved_train_schedule_tables
+from .schedule import (interleaved_train_schedule_tables,
+                       packed_inference_schedule_tables)
 
 
 class PipelineError(Exception):
@@ -209,23 +210,22 @@ class PipelineEngine(DeepSpeedEngine):
         InferenceSchedule, schedule.py:129-179): the embedding streams in
         at the first virtual stage's cycles and the head + loss run at the
         last virtual stage's — nothing M-sized is materialized, so eval
-        keeps the pipeline's memory partitioning. Interleaved models walk
-        the same forward tables as training (chunk hops wrap S-1 -> 0).
-        Known overhead at num_virtual_stages > 1: the training tables
-        space forwards for 1F1B interleaving, so eval executes the
-        bubble cycles a packed forward-only schedule would skip — all
-        masked (correctness unaffected), costing up to ~2x eval wall at
-        v=2 on small M. Eval is not a steady-state cost; a packed
-        InferenceSchedule table generator is the fix if it becomes one.
-        Dropout is off (no rng reaches the stage bodies)."""
+        keeps the pipeline's memory partitioning. The loop walks the
+        PACKED forward-only tables
+        (schedule.packed_inference_schedule_tables): M*v + S - 1 cycles
+        when S | M (optimal for the one-hop ppermute structure; chunk
+        hops wrap S-1 -> 0), fully decoupled from the training tables'
+        1F1B cycle range. Dropout is off (no rng reaches the stage
+        bodies)."""
         module = self.pipe_module
         num_stages = self.num_stages
         M = self.micro_batches
         mesh = self.mesh
-        v, tabs = self._pipe_tables()
+        v = getattr(module, "num_virtual", 1)
+        tabs = packed_inference_schedule_tables(M, num_stages, v)
         fwd_m = jnp.asarray(tabs["fwd_m"])
         fwd_c = jnp.asarray(tabs["fwd_c"])
-        SE = tabs["steady_end"]
+        SE = tabs["total_cycles"]
         depths_2d = jnp.asarray(self._depths_2d())
 
         def eval_loss(params, inputs_stack, labels_stack):

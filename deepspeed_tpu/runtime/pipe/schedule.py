@@ -428,6 +428,56 @@ def interleaved_train_schedule_tables(micro_batches, stages, num_chunks=1):
     }
 
 
+def packed_inference_schedule_tables(micro_batches, stages, num_chunks=1):
+    """Packed forward-only cycle tables for the SPMD eval/inference loop
+    (the interleaved analogue of the reference InferenceSchedule,
+    schedule.py:129-179).
+
+    Forward of (chunk c, microbatch m = g*S + q) on rank r at cycle
+
+        g*vS + c*S + q + r
+
+    — microbatch groups of S stream back-to-back through the vS virtual
+    stages with no 1F1B spacing and no backward cycles. Total cycles:
+
+        T = M*v + S - 1                      when S | M
+        T = vS*ceil(M/S) + (M-1) % S - S + 1 + S - 1   (ragged tail)
+
+    and T is OPTIMAL for the executor's one-hop-per-cycle ppermute
+    structure: each rank does M*v forwards, chunk hops force S-cycle
+    spacing between a microbatch's chunks, and the construction tiles
+    every rank's cycle lattice with no internal gaps (the ragged tail
+    adds (v-1)*(S - M%S) unavoidable bubble cycles; pick M a multiple of
+    S for the advertised count). The tables satisfy the same hop
+    alignment as the training tables — stage s+1 consumes at s's cycle
+    +1, chunk transitions wrap S-1 -> 0 — which
+    tests/unit/test_pipe_schedule.py asserts.
+
+    Returns {fwd_m, fwd_c ((S, T) int32, -1 = bubble), total_cycles}.
+    Eval walks ONLY these T cycles instead of slicing the training
+    tables (whose array width is the full fwd+bwd cycle range).
+    """
+    M, S, v = micro_batches, stages, num_chunks
+    assert v >= 1 and S >= 1 and M >= 1
+    g, q = np.arange(M) // S, np.arange(M) % S
+    T = 0
+    t_f = np.empty((S, v, M), np.int64)
+    for r in range(S):
+        for c in range(v):
+            t_f[r, c] = g * v * S + c * S + q + r
+    T = int(t_f.max()) + 1
+    fwd_m = -np.ones((S, T), np.int32)
+    fwd_c = -np.ones((S, T), np.int32)
+    for r in range(S):
+        for c in range(v):
+            for m in range(M):
+                k = t_f[r, c, m]
+                assert fwd_m[r, k] < 0, "schedule collision"
+                fwd_m[r, k] = m
+                fwd_c[r, k] = c
+    return {"fwd_m": fwd_m, "fwd_c": fwd_c, "total_cycles": T}
+
+
 class DataParallelSchedule(PipeSchedule):
     """Degenerate single-stage schedule (reference :476)."""
 

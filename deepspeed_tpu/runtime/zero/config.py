@@ -36,6 +36,7 @@ class DeepSpeedZeroConfig(object):
         self.quantized_weights = None
         self.hierarchical_partition = None
         self.quantized_gradients = None
+        self.strict = None
 
         if ZERO_OPTIMIZATION in param_dict:
             zero_config_dict = param_dict[ZERO_OPTIMIZATION]
@@ -120,6 +121,10 @@ class DeepSpeedZeroConfig(object):
         self.quantized_gradients = bool(g(
             ZERO_OPTIMIZATION_QUANTIZED_GRADIENTS,
             ZERO_OPTIMIZATION_QUANTIZED_GRADIENTS_DEFAULT))
+        # strict: unimplementable keys raise instead of warning (the
+        # engine's _validate_zero_keys enforces it)
+        self.strict = bool(g(ZERO_OPTIMIZATION_STRICT,
+                             ZERO_OPTIMIZATION_STRICT_DEFAULT))
 
     def repr(self):
         return self.__dict__

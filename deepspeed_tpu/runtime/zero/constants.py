@@ -15,6 +15,7 @@ ZeRO optimization should be enabled as:
   "cpu_offload": [true|false],
   "cpu_offload_params": [true|false],
   "cpu_offload_use_pin_memory": [true|false],
+  "strict": [true|false],
   "sub_group_size": 1000000000000,
   "stage3_max_live_parameters": 1000000000,
   "stage3_max_reuse_distance": 1000000000,
@@ -60,6 +61,12 @@ ZERO_OPTIMIZATION_CPU_OFFLOAD_DEFAULT = False
 
 ZERO_OPTIMIZATION_CPU_OFFLOAD_PARAMS = "cpu_offload_params"
 ZERO_OPTIMIZATION_CPU_OFFLOAD_PARAMS_DEFAULT = False
+
+# Strict mode: a zero_optimization key this runtime cannot give real
+# semantics to (see runtime/engine.py _validate_zero_keys) RAISES instead
+# of warning — no silent config no-ops.
+ZERO_OPTIMIZATION_STRICT = "strict"
+ZERO_OPTIMIZATION_STRICT_DEFAULT = False
 
 ZERO_OPTIMIZATION_CPU_OFFLOAD_USE_PIN_MEMORY = "cpu_offload_use_pin_memory"
 ZERO_OPTIMIZATION_CPU_OFFLOAD_USE_PIN_MEMORY_DEFAULT = False

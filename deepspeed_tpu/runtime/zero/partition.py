@@ -45,10 +45,20 @@ class ZeroShardingPlan:
     """
 
     def __init__(self, mesh, stage=0, param_persistence_threshold=100000,
-                 model_spec_fn=None):
+                 model_spec_fn=None, max_live_parameters=None):
         self.mesh = mesh
         self.stage = stage
         self.persist_threshold = param_persistence_threshold
+        # stage3_max_live_parameters: an element budget on the stage-3
+        # leaves that stay PERSISTENTLY gathered (replicated) in HBM.
+        # configure_live_budget() demotes persistent leaves to data-sharded
+        # until the persistent set fits; per-use gather liveness inside a
+        # step is XLA's memory-aware schedule (the reference's
+        # fetch/release coordinator is compiler scheduling here), and the
+        # streamed-offload runner sizes its layer groups by the same
+        # budget (runtime/zero/stream.py).
+        self.max_live_parameters = max_live_parameters
+        self._demoted = set()
         if DATA_AXIS in mesh.shape:
             self.data_axes = (DATA_AXIS,)
             self.param_data_axes = (DATA_AXIS,)
@@ -124,13 +134,66 @@ class ZeroShardingPlan:
                 return P(*base)
         return P(*base)
 
+    def _effective_threshold(self, path):
+        """Persistence threshold for a leaf, honoring live-budget
+        demotions (a demoted leaf shards regardless of its size)."""
+        return 0 if path in self._demoted else self.persist_threshold
+
+    def _can_data_shard(self, path, shape):
+        """Whether any free dim divides the param shard degree (the
+        only leaves the budget can demote)."""
+        ways = self.param_shard_size
+        if ways <= 1 or not shape:
+            return False
+        spec = self._zero_spec(path, shape, threshold=0,
+                               data_axes=self.param_data_axes)
+        wanted = set(self.param_data_axes)
+        for entry in spec:
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            if any(ax in wanted for ax in axes):
+                return True
+        return False
+
+    def configure_live_budget(self, tree):
+        """Honor ``stage3_max_live_parameters``: demote persistent
+        (below-threshold) stage-3 leaves to data-sharded, largest first,
+        until the persistently-gathered element count fits the budget.
+
+        Returns (persistent_elements, demoted_paths). Leaves with no
+        shardable dim cannot be demoted; if they alone exceed the budget
+        the caller warns (or raises under strict) — the budget is then
+        unsatisfiable rather than silently ignored."""
+        self._demoted = set()
+        budget = self.max_live_parameters
+        if budget is None or self.stage < 3 or not self.param_data_axes:
+            return None, ()
+        persistent = []   # (numel, path, demotable)
+        def visit(kp, leaf):
+            path = _path_str(kp)
+            shape = np.shape(leaf)
+            if not self.param_is_data_sharded(path, shape):
+                persistent.append(
+                    (int(np.prod(shape)) if shape else 1, path,
+                     self._can_data_shard(path, shape)))
+            return leaf
+        jax.tree_util.tree_map_with_path(visit, tree)
+        total = sum(n for n, _, _ in persistent)
+        for numel, path, demotable in sorted(persistent, reverse=True):
+            if total <= budget:
+                break
+            if not demotable:
+                continue
+            self._demoted.add(path)
+            total -= numel
+        return total, tuple(sorted(self._demoted))
+
     # --- public sharding queries -------------------------------------------
     def param_sharding(self, path, shape):
         """Compute-dtype parameters: sharded only at stage 3 (over the
         secondary-partition sub-axis when the plan is hierarchical)."""
         if self.stage >= 3:
             return self._named(self._zero_spec(
-                path, shape, self.persist_threshold,
+                path, shape, self._effective_threshold(path),
                 data_axes=self.param_data_axes))
         tp_spec = self._tp_spec(path, shape)
         return self._named(tp_spec if tp_spec is not None else P())
@@ -149,7 +212,7 @@ class ZeroShardingPlan:
         data_axes = self.data_axes if flat else self.param_data_axes
         if self.stage < 3 or not data_axes:
             return False
-        spec = self._zero_spec(path, shape, self.persist_threshold,
+        spec = self._zero_spec(path, shape, self._effective_threshold(path),
                                data_axes=data_axes)
         wanted = set(data_axes)
         for entry in spec:

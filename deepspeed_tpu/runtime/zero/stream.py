@@ -1,0 +1,598 @@
+"""Streamed ZeRO-3 parameter offload: train beyond-HBM models on one chip.
+
+Reference parity: ZeRO-3 Offload's parameter offload
+(`deepspeed/runtime/zero/stage3.py:2281`, `partition_parameters.py:341`)
+— the machinery behind the reference's 13B/40B-params-on-one-32GB-V100
+story. There, parameters live in CPU memory and are fetched into device
+memory per-submodule by the PartitionedParameterCoordinator. Here the
+same discipline is re-founded for the jit world:
+
+  * the fp32 master (and Adam moments) live in HOST memory
+    (``engine.host_state``), exactly like classic ZeRO-Offload;
+  * compute parameters have NO resident device copy at all. Each step
+    streams them into HBM one LAYER GROUP at a time through the
+    coalesced-transfer batcher (transfer.py), double-buffered: group
+    k+1's H2D rides the upload worker while group k's jitted segment
+    computes (async dispatch);
+  * the forward runs segment-by-segment (embed -> block groups -> head)
+    keeping only the group-boundary activations; the backward re-streams
+    each group in reverse and computes its VJP (recomputing the group
+    forward — the streaming analogue of activation checkpointing, ~1
+    extra forward of compute for O(boundary) activation memory);
+  * gradients leave the device as ONE packed fp32 buffer per segment
+    (async D2H), are split into per-leaf host views, and accumulated —
+    tied leaves (GPT-2's wte in embed AND head) sum their contributions;
+  * the optimizer step is the host Adam, chunked by ``sub_group_size``.
+
+HBM high-water mark: ~2 layer groups of parameters (current + prefetch)
++ the largest of the embed/head segments + boundary activations + one
+segment's gradients — governed by ``stage3_max_live_parameters`` (the
+live-parameter budget sizes the groups), NOT by total model size. That
+raises the trainable ceiling past params+grads <= HBM
+(docs/zero3_offload.md; demonstrated by tests/perf/bench_beyond_hbm.py).
+"""
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...utils.logging import logger, log_dist
+from .transfer import H2DBatcher, chunk_rows, host_adam_chunk
+
+
+def _full_index(shape):
+    """The whole-leaf shard index (streamed masters are unsharded)."""
+    return tuple(slice(0, d, None) for d in shape)
+
+
+def _numel(tree):
+    return sum(int(np.prod(np.shape(leaf))) if np.shape(leaf) else 1
+               for leaf in jax.tree_util.tree_leaves(tree))
+
+
+class StreamedOffloadRunner:
+    """Drives the streamed train/eval step for one engine.
+
+    The engine owns the host master/moment registry
+    (``host_state["shard_leaves"]``, one full-leaf entry per master
+    leaf); the runner re-derives its segment views from it each step, so
+    a checkpoint load (which replaces the arrays) needs no rebinding
+    hook.
+    """
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.spec = engine.model.stream_spec
+        if self.spec is None:
+            raise ValueError(
+                "zero_optimization.cpu_offload_params needs a model with "
+                "a stream_spec (runtime/model.py StreamSpec); {} does "
+                "not expose one".format(engine.model.name))
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "streamed parameter offload is single-process (multi-"
+                "process runs keep classic cpu_offload)")
+        self.mesh = engine.mesh
+        self.cdtype = np.dtype(engine.compute_dtype)
+        self._devices = list(self.mesh.devices.flat)
+        self._replicated = NamedSharding(self.mesh, P())
+        self._jit_cache = {}
+        self._grad_bufs = None
+        self._micro_finites = []
+        self._micro_sumsqs = []
+        self._micros_in_step = 0
+        self.phase_times = {}
+        self._plan_groups()
+
+    # ------------------------------------------------------------ planning
+    def _host_trees(self):
+        """(master, exp_avg, exp_avg_sq) fp32 numpy trees, views of the
+        engine's host_state registry."""
+        hs = self.engine.host_state
+        td = hs["treedef"]
+        return (td.unflatten([s[0][1] for s in hs["shard_leaves"]]),
+                td.unflatten([s[0][2] for s in hs["shard_leaves"]]),
+                td.unflatten([s[0][3] for s in hs["shard_leaves"]]))
+
+    def _plan_groups(self):
+        """Size layer groups so ~2 groups (live + prefetched) plus the
+        larger terminal segment fit ``stage3_max_live_parameters``."""
+        masters, _, _ = self._host_trees()
+        embed_t, blocks, head_t = self.spec.split(masters)
+        self.n_layers = len(blocks)
+        block_elems = [_numel(b) for b in blocks]
+        terminal = max(_numel(embed_t), _numel(head_t))
+        budget = self.engine.zero_plan.max_live_parameters
+        if budget is None:
+            budget = 10 ** 9
+        per_group = max((budget - terminal) // 2, 1)
+        groups, start, acc = [], 0, 0
+        for i, n in enumerate(block_elems):
+            if i > start and acc + n > per_group:
+                groups.append((start, i))
+                start, acc = i, 0
+            acc += n
+        groups.append((start, len(blocks)))
+        self.groups = groups
+        min_live = 2 * max(block_elems) + terminal
+        if budget < min_live:
+            logger.warning(
+                "stage3_max_live_parameters=%d is below the streamed "
+                "minimum for this model (~%d: two 1-layer groups + the "
+                "largest terminal segment); streaming proceeds at that "
+                "minimum", budget, min_live)
+        log_dist(
+            "streamed offload: {} layers in {} groups (budget {:,} "
+            "elements, terminal {:,})".format(
+                self.n_layers, len(groups), budget, terminal), ranks=[0])
+
+    # ------------------------------------------------------------- uploads
+    def _start_upload(self, leaves):
+        """Queue a segment's host leaves for coalesced upload to every
+        mesh device (replicated); packing+device_put ride the background
+        upload worker so they overlap the current segment's compute."""
+        eng = self.engine
+        batcher = H2DBatcher(eng._h2d_bucket_elems, self.cdtype,
+                             pool=eng._upload_pool(),
+                             jit_cache=eng._h2d_split_cache())
+        for li, arr in enumerate(leaves):
+            for dev in self._devices:
+                batcher.add(li, arr, dev)
+        batcher.flush()
+        return batcher, [np.shape(a) for a in leaves]
+
+    def _finish_upload(self, pending):
+        """Block on a queued upload; return replicated global arrays."""
+        t0 = time.time()
+        batcher, shapes = pending
+        res = batcher.finish()
+        out = []
+        for li, shape in enumerate(shapes):
+            singles = list(res[li].values())
+            out.append(jax.make_array_from_single_device_arrays(
+                shape, self._replicated, singles))
+        self.phase_times["h2d_wait_s"] = \
+            self.phase_times.get("h2d_wait_s", 0.0) + (time.time() - t0)
+        return tuple(out)
+
+    # ------------------------------------------------------------ jit fns
+    def _jit(self, key, builder):
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(builder())
+        return self._jit_cache[key]
+
+    @staticmethod
+    def _pack_grads(grad_leaves, finite, sumsq):
+        """Segment gradients -> ONE fp32 vector [grads..., finite,
+        sumsq]: a single D2H fetch carries the grads and the overflow/
+        norm reductions."""
+        flats = [g.astype(jnp.float32).ravel() for g in grad_leaves]
+        return jnp.concatenate(
+            flats + [finite.astype(jnp.float32)[None], sumsq[None]])
+
+    @staticmethod
+    def _finite_sumsq(grad_leaves, inv_scale):
+        finite = jnp.bool_(True)
+        sumsq = jnp.float32(0)
+        for g in grad_leaves:
+            finite = jnp.logical_and(finite, jnp.isfinite(g).all())
+            g32 = g.astype(jnp.float32) * inv_scale
+            sumsq = sumsq + jnp.sum(g32 * g32)
+        return finite, sumsq
+
+    def _embed_fwd_fn(self, e_def, has_rng):
+        spec = self.spec
+
+        def fn(e_leaves, batch, key):
+            et = jax.tree_util.tree_unflatten(e_def, list(e_leaves))
+            return spec.embed_apply(et, batch,
+                                    key if has_rng else None, True)
+
+        return fn
+
+    def _group_fwd_fn(self, b_defs, has_rng):
+        spec = self.spec
+
+        def fn(b_leaves_tuple, x, keys):
+            for i, (bdef, bl) in enumerate(zip(b_defs, b_leaves_tuple)):
+                bt = jax.tree_util.tree_unflatten(bdef, list(bl))
+                x = spec.block_apply(bt, x,
+                                     keys[i] if has_rng else None, True)
+            return x
+
+        return fn
+
+    def _group_bwd_fn(self, b_defs, has_rng):
+        fwd = self._group_fwd_fn(b_defs, has_rng)
+        pack = self._pack_grads
+        fs = self._finite_sumsq
+
+        def fn(b_leaves_tuple, x_in, dx, keys, inv_scale):
+            _, vjp = jax.vjp(lambda bl, xi: fwd(bl, xi, keys),
+                             b_leaves_tuple, x_in)
+            d_bl, d_xi = vjp(dx)
+            leaves = [g for bl in d_bl for g in bl]
+            finite, sumsq = fs(leaves, inv_scale)
+            return d_xi, pack(leaves, finite, sumsq)
+
+        return fn
+
+    def _head_grad_fn(self, h_def, has_rng):
+        spec = self.spec
+        pack = self._pack_grads
+        fs = self._finite_sumsq
+
+        def fn(h_leaves, x, batch, key, scale, inv_scale):
+            def loss_fn(hl, xx):
+                ht = jax.tree_util.tree_unflatten(h_def, list(hl))
+                loss = spec.head_apply(ht, xx, batch,
+                                       key if has_rng else None, True)
+                return loss.astype(jnp.float32) * scale, loss
+
+            (_, loss), (d_h, d_x) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True)(h_leaves, x)
+            finite, sumsq = fs(list(d_h), inv_scale)
+            return loss, d_x, pack(list(d_h), finite, sumsq)
+
+        return fn
+
+    def _embed_bwd_fn(self, e_def, has_rng):
+        spec = self.spec
+        pack = self._pack_grads
+        fs = self._finite_sumsq
+
+        def fn(e_leaves, batch, dx, key, inv_scale):
+            _, vjp = jax.vjp(
+                lambda el: spec.embed_apply(
+                    jax.tree_util.tree_unflatten(e_def, list(el)), batch,
+                    key if has_rng else None, True), e_leaves)
+            (d_el,) = vjp(dx)
+            finite, sumsq = fs(list(d_el), inv_scale)
+            return pack(list(d_el), finite, sumsq)
+
+        return fn
+
+    def _eval_fn(self, e_def, b_defs_by_k, h_def):
+        """Segment-streamed eval loss (dropout off, no grads)."""
+        spec = self.spec
+
+        def embed(e_leaves, batch):
+            et = jax.tree_util.tree_unflatten(e_def, list(e_leaves))
+            return spec.embed_apply(et, batch, None, False)
+
+        def group(b_defs):
+            def fn(b_leaves_tuple, x):
+                for bdef, bl in zip(b_defs, b_leaves_tuple):
+                    bt = jax.tree_util.tree_unflatten(bdef, list(bl))
+                    x = spec.block_apply(bt, x, None, False)
+                return x
+            return fn
+
+        def head(h_leaves, x, batch):
+            ht = jax.tree_util.tree_unflatten(h_def, list(h_leaves))
+            return spec.head_apply(ht, x, batch, None, False)
+
+        return embed, group, head
+
+    # ------------------------------------------------------------ binding
+    def _bind(self):
+        """Per-step registry: segment views of the host master/moments
+        plus the slot map that dedupes shared (tied) leaves."""
+        masters, ms, vs = self._host_trees()
+        e_m, b_m, h_m = self.spec.split(masters)
+        e_mm, b_mm, h_mm = self.spec.split(ms)
+        e_mv, b_mv, h_mv = self.spec.split(vs)
+
+        self._slots = []            # (param, exp_avg, exp_avg_sq)
+        slot_of = {}
+        def register(tree, m_tree, v_tree):
+            leaves, tdef = jax.tree_util.tree_flatten(tree)
+            m_leaves = tdef.flatten_up_to(m_tree)
+            v_leaves = tdef.flatten_up_to(v_tree)
+            idxs = []
+            for p, m, v in zip(leaves, m_leaves, v_leaves):
+                if id(p) not in slot_of:
+                    slot_of[id(p)] = len(self._slots)
+                    self._slots.append((p, m, v))
+                idxs.append(slot_of[id(p)])
+            return leaves, tdef, idxs
+
+        self._e_leaves, self._e_def, self._e_slots = register(
+            e_m, e_mm, e_mv)
+        self._b_leaves, self._b_defs, self._b_slots = [], [], []
+        for bt, bmt, bvt in zip(b_m, b_mm, b_mv):
+            lv, td, ix = register(bt, bmt, bvt)
+            self._b_leaves.append(lv)
+            self._b_defs.append(td)
+            self._b_slots.append(ix)
+        self._h_leaves, self._h_def, self._h_slots = register(
+            h_m, h_mm, h_mv)
+        # tied leaves (one slot referenced from 2+ segments): their
+        # per-segment sumsq shortcut is invalid (||a||^2+||b||^2 !=
+        # ||a+b||^2), so apply_step must price the accumulated buffers
+        n_refs = (len(self._e_slots) + len(self._h_slots)
+                  + sum(len(ix) for ix in self._b_slots))
+        self._has_shared_slots = n_refs > len(self._slots)
+        if self._grad_bufs is None or \
+                len(self._grad_bufs) != len(self._slots):
+            self._grad_bufs = [None] * len(self._slots)
+
+    def _group_leaves(self, g):
+        start, stop = self.groups[g]
+        return [leaf for i in range(start, stop)
+                for leaf in self._b_leaves[i]]
+
+    # ------------------------------------------------------------- fetch
+    def _queue_grad_fetch(self, packed, slot_idxs, shapes, fetches):
+        """Async D2H of a segment's packed grad vector; resolution
+        splits it into host views and accumulates per slot."""
+        try:
+            packed.copy_to_host_async()
+        except Exception:  # noqa: BLE001 - plugin without async copy
+            pass
+        fetches.append((packed, slot_idxs, shapes))
+
+    def _resolve_fetches(self, fetches):
+        t0 = time.time()
+        finite_all, sumsq_all = True, 0.0
+        for packed, slot_idxs, shapes in fetches:
+            host = np.asarray(packed)
+            off = 0
+            for slot, shape in zip(slot_idxs, shapes):
+                n = int(np.prod(shape)) if shape else 1
+                view = host[off:off + n].reshape(shape)
+                off += n
+                if self._grad_bufs[slot] is None:
+                    # adopt the fetched view without copying — jax host
+                    # buffers are read-only, so a later accumulation
+                    # into this slot (tied leaf / gas>1) copies lazily
+                    self._grad_bufs[slot] = view
+                elif self._grad_bufs[slot].flags.writeable:
+                    self._grad_bufs[slot] += view
+                else:
+                    self._grad_bufs[slot] = self._grad_bufs[slot] + view
+            finite_all = finite_all and bool(host[off] > 0.5)
+            sumsq_all += float(host[off + 1])
+        self.phase_times["d2h_grads_s"] = \
+            self.phase_times.get("d2h_grads_s", 0.0) + (time.time() - t0)
+        return finite_all, sumsq_all
+
+    # ------------------------------------------------------------- steps
+    def micro_step(self, batch, rng):
+        """One streamed micro-step: forward + backward with grads
+        accumulated into the host buffers. Returns the (unscaled) loss
+        as a device scalar."""
+        eng = self.engine
+        self._bind()
+        gas = eng.gradient_accumulation_steps()
+        scaler = eng.state["scaler"]
+        scale = np.float32(float(scaler.cur_scale) / gas)
+        inv_scale = np.float32(1.0 / float(scaler.cur_scale))
+        has_rng = eng.model.accepts_rng and rng is not None
+        keys_all = (jax.random.split(rng, self.n_layers)
+                    if has_rng else None)
+        G = len(self.groups)
+        e_def, b_defs, h_def = self._e_def, self._b_defs, self._h_def
+        fetches = []
+
+        # ---- forward: embed -> groups (double-buffered uploads) -> head
+        # section clocks exclude the h2d waits accumulated inside them
+        # (phases stay disjoint: h2d_wait + compute_fwd + compute_bwd +
+        # d2h_grads + host_adam ~ step wall)
+        w0 = self.phase_times.get("h2d_wait_s", 0.0)
+        t_fwd = time.time()
+        pending = self._start_upload(self._e_leaves)
+        e_dev = self._finish_upload(pending)
+        pending = self._start_upload(self._group_leaves(0)) if G else None
+        key0 = keys_all[0] if has_rng else None
+        embed_fwd = self._jit(("e_fwd", has_rng),
+                              lambda: self._embed_fwd_fn(e_def, has_rng))
+        x = embed_fwd(tuple(e_dev), batch, key0)
+        del e_dev
+        acts = [x]
+        group_devs = [None] * G
+        for g in range(G):
+            dev_g = self._split_group(self._finish_upload(pending), g)
+            if g + 1 < G:
+                pending = self._start_upload(self._group_leaves(g + 1))
+            else:
+                pending = self._start_upload(self._h_leaves)
+            start, stop = self.groups[g]
+            gkeys = keys_all[start:stop] if has_rng else None
+            fwd = self._jit(
+                ("g_fwd", tuple(b_defs[start:stop]), has_rng),
+                lambda: self._group_fwd_fn(tuple(b_defs[start:stop]),
+                                           has_rng))
+            x = fwd(dev_g, x, gkeys)
+            acts.append(x)
+            if g == G - 1:
+                group_devs[g] = dev_g  # reuse for the first backward
+            del dev_g
+        fwd_waits = self.phase_times.get("h2d_wait_s", 0.0) - w0
+        self.phase_times["compute_fwd_s"] = \
+            self.phase_times.get("compute_fwd_s", 0.0) + \
+            (time.time() - t_fwd) - fwd_waits
+
+        # ---- head loss + backward
+        w0 = self.phase_times.get("h2d_wait_s", 0.0)
+        t_bwd = time.time()
+        h_dev = self._finish_upload(pending)
+        head_grad = self._jit(
+            ("h_grad", has_rng),
+            lambda: self._head_grad_fn(h_def, has_rng))
+        loss, dx, h_packed = head_grad(tuple(h_dev), acts[-1], batch,
+                                       key0, scale, inv_scale)
+        del h_dev
+        self._queue_grad_fetch(
+            h_packed, self._h_slots,
+            [np.shape(p) for p in self._h_leaves], fetches)
+        pending = (self._start_upload(self._group_leaves(G - 2))
+                   if G >= 2 else None)
+        for g in reversed(range(G)):
+            if group_devs[g] is None:
+                bl = self._split_group(self._finish_upload(pending), g)
+                pending = (self._start_upload(self._group_leaves(g - 1))
+                           if g - 1 >= 0 else None)
+            else:
+                bl = group_devs[g]
+                group_devs[g] = None
+                pending = (self._start_upload(self._group_leaves(g - 1))
+                           if g - 1 >= 0 else None) \
+                    if pending is None else pending
+            start, stop = self.groups[g]
+            gkeys = keys_all[start:stop] if has_rng else None
+            bwd = self._jit(
+                ("g_bwd", tuple(b_defs[start:stop]), has_rng),
+                lambda: self._group_bwd_fn(tuple(b_defs[start:stop]),
+                                           has_rng))
+            dx, g_packed = bwd(bl, acts[g], dx, gkeys, inv_scale)
+            del bl
+            acts[g + 1] = None
+            slot_idxs = [s for i in range(start, stop)
+                         for s in self._b_slots[i]]
+            shapes = [np.shape(p) for p in self._group_leaves(g)]
+            self._queue_grad_fetch(g_packed, slot_idxs, shapes, fetches)
+            if g == 0:
+                pending = self._start_upload(self._e_leaves)
+        e_dev = self._finish_upload(pending) if pending is not None \
+            else self._finish_upload(self._start_upload(self._e_leaves))
+        embed_bwd = self._jit(
+            ("e_bwd", has_rng),
+            lambda: self._embed_bwd_fn(e_def, has_rng))
+        e_packed = embed_bwd(tuple(e_dev), batch, dx, key0, inv_scale)
+        del e_dev, dx
+        self._queue_grad_fetch(
+            e_packed, self._e_slots,
+            [np.shape(p) for p in self._e_leaves], fetches)
+        bwd_waits = self.phase_times.get("h2d_wait_s", 0.0) - w0
+        self.phase_times["compute_bwd_s"] = \
+            self.phase_times.get("compute_bwd_s", 0.0) + \
+            (time.time() - t_bwd) - bwd_waits
+
+        finite, sumsq = self._resolve_fetches(fetches)
+        self._micro_finites.append(finite)
+        self._micro_sumsqs.append(sumsq)
+        self._micros_in_step += 1
+        return loss
+
+    def apply_step(self):
+        """Host Adam over the accumulated grads (chunked by
+        sub_group_size), with classic offload's overflow-skip
+        semantics. Returns the metrics dict; the caller updates the
+        scaler."""
+        eng = self.engine
+        hs = eng.host_state
+        hyper = eng._hyper()
+        scaler = eng.state["scaler"]
+        cur_scale = float(scaler.cur_scale)
+        inv_scale = 1.0 / cur_scale
+        clip = eng.gradient_clipping()
+        phases = self.phase_times
+
+        finite = all(self._micro_finites) if self._micro_finites \
+            else False
+        if self._micros_in_step == 1 and \
+                not getattr(self, "_has_shared_slots", True):
+            # single micro, no tied leaves: the per-segment device
+            # reductions sum to the true norm
+            sumsq = sum(self._micro_sumsqs)
+        else:
+            # multi-micro windows price PARTIAL per-micro grads, and
+            # tied leaves (wte in embed+head) need the square of the
+            # SUM, not the sum of squares — recompute over the
+            # accumulated host buffers (one bandwidth pass)
+            sumsq = 0.0
+            if finite:
+                for buf in self._grad_bufs:
+                    if buf is None:
+                        continue
+                    flat = buf.ravel()
+                    if not np.all(np.isfinite(flat)):
+                        finite = False
+                        break
+                    scaled = flat.astype(np.float64) * inv_scale
+                    sumsq += float(np.dot(scaled, scaled))
+        overflow = (not finite) or not np.isfinite(sumsq)
+
+        grad_norm = 0.0
+        if not overflow:
+            grad_norm = float(np.sqrt(sumsq))
+            coef = inv_scale
+            if clip > 0 and grad_norm > clip:
+                coef *= clip / (grad_norm + 1e-6)
+            hs["step"] += 1
+            step = hs["step"]
+            beta1, beta2 = hyper["beta1"], hyper["beta2"]
+            bias_correction = getattr(eng.optimizer, "bias_correction",
+                                      True)
+            bc1 = 1.0 - beta1 ** step if bias_correction else 1.0
+            bc2 = 1.0 - beta2 ** step if bias_correction else 1.0
+            adam_w = 1 if getattr(eng.optimizer, "adam_w_mode", True) \
+                else 0
+            lib = eng._offload_lib()
+            t0 = time.time()
+            for slot, (p, m, v) in enumerate(self._slots):
+                g = self._grad_bufs[slot]
+                if g is None:
+                    continue
+                for r0, r1 in chunk_rows(np.shape(p),
+                                         eng._sub_group_size):
+                    if np.shape(p):
+                        pc, gc = p[r0:r1], g[r0:r1]
+                        mc, vc = m[r0:r1], v[r0:r1]
+                    else:
+                        pc, gc, mc, vc = p, g, m, v
+                    # fresh scratch: host_adam_chunk consumes g in place
+                    gc = gc * np.float32(coef)
+                    host_adam_chunk(lib, pc, gc, mc, vc, hyper, bc1,
+                                    bc2, adam_w)
+            phases["host_adam_s"] = phases.get("host_adam_s", 0.0) + \
+                (time.time() - t0)
+        self.zero_grads()
+        return {"overflow": overflow, "grad_norm": grad_norm,
+                "loss_scale": cur_scale}
+
+    def zero_grads(self):
+        self._grad_bufs = [None] * len(self._grad_bufs or [])
+        self._micro_finites = []
+        self._micro_sumsqs = []
+        self._micros_in_step = 0
+
+    # -------------------------------------------------------------- eval
+    def eval_loss(self, batch):
+        """Streamed forward-only loss (dropout off)."""
+        self._bind()
+        e_def, b_defs, h_def = self._e_def, self._b_defs, self._h_def
+        embed, group, head = self._eval_fn(e_def, b_defs, h_def)
+        G = len(self.groups)
+        pending = self._start_upload(self._e_leaves)
+        e_dev = self._finish_upload(pending)
+        pending = self._start_upload(self._group_leaves(0)) if G else None
+        x = self._jit(("e_eval",), lambda: embed)(tuple(e_dev), batch)
+        del e_dev
+        for g in range(G):
+            bl = self._finish_upload(pending)
+            pending = (self._start_upload(self._group_leaves(g + 1))
+                       if g + 1 < G
+                       else self._start_upload(self._h_leaves))
+            start, stop = self.groups[g]
+            fn = self._jit(("g_eval", tuple(b_defs[start:stop])),
+                           lambda: group(tuple(b_defs[start:stop])))
+            x = fn(self._split_group(bl, g), x)
+            del bl
+        h_dev = self._finish_upload(pending)
+        return self._jit(("h_eval",), lambda: head)(tuple(h_dev), x,
+                                                    batch)
+
+    def _split_group(self, flat_leaves, g):
+        """Flat uploaded leaf tuple -> tuple of per-block leaf tuples."""
+        start, stop = self.groups[g]
+        out, off = [], 0
+        for i in range(start, stop):
+            n = len(self._b_leaves[i])
+            out.append(tuple(flat_leaves[off:off + n]))
+            off += n
+        return tuple(out)

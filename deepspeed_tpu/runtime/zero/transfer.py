@@ -1,0 +1,180 @@
+"""Coalesced host<->HBM transfer machinery for the offload paths.
+
+The round-5 1.5B offload profile (`BENCH_XL_r05.json`) spent 116 s of a
+462 s step in `h2d_dispatch`: one `jax.device_put` per parameter leaf,
+each serializing its host buffer before returning — dispatch overhead,
+not transfer bandwidth (the T3 finding, arXiv:2401.16677, applied to the
+host<->HBM hop). The fix is the same discipline the reference implements
+with pinned buffers and dedicated streams (stage2.py:780-908): coalesce
+many small uploads into few large transfers and overlap them with host
+compute.
+
+:class:`H2DBatcher` packs queued host arrays into per-device flat
+buckets of at most ``bucket_elems`` elements (the now-live
+``stage3_prefetch_bucket_size``), uploads each bucket with ONE
+``device_put``, and splits it back into the original shapes with one
+jitted (donated, on-device) reshape program per bucket layout. Packing +
+upload run on a single background worker so the serialization cost rides
+behind the caller's host Adam.
+"""
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _split_fn_for(layout):
+    """Jitted flat-buffer -> tuple-of-reshaped-views program for one
+    bucket layout ((numel, shape) pairs). The buffer is donated so the
+    flat staging copy frees the moment the split lands."""
+    offsets = []
+    off = 0
+    for numel, shape in layout:
+        offsets.append((off, numel, shape))
+        off += numel
+
+    def split(flat):
+        return tuple(flat[o:o + n].reshape(s) for o, n, s in offsets)
+
+    # CPU can't alias the donated staging buffer into the split views and
+    # warns on every call; donation only pays on real accelerators
+    donate = () if jax.default_backend() == "cpu" else (0,)
+    return jax.jit(split, donate_argnums=donate)
+
+
+class H2DBatcher:
+    """Batch host->device uploads into few large transfers.
+
+    ``add(key, host_array, device)`` queues one compute-dtype host array
+    for one device; buckets flush automatically at ``bucket_elems``
+    queued elements per device (and on ``finish()``). Each flush is ONE
+    ``device_put`` of a packed flat buffer plus one jitted on-device
+    split. ``finish()`` blocks until every queued upload landed and
+    returns ``{key: {device: single-device array}}``.
+
+    When ``pool`` is given, packing+upload run on it (a serial worker),
+    overlapping the caller's host compute; otherwise flushes are
+    synchronous in the caller.
+    """
+
+    def __init__(self, bucket_elems, dtype, pool=None, jit_cache=None):
+        self.bucket_elems = max(int(bucket_elems), 1)
+        self.dtype = np.dtype(dtype)
+        self.pool = pool
+        # jitted splitters keyed by bucket layout; pass a shared dict so
+        # per-step batchers reuse compiles across steps
+        self._split_cache = jit_cache if jit_cache is not None else {}
+        self._pending = {}      # device -> [(key, np_array), ...]
+        self._pending_elems = {}
+        self._futures = []
+        self._results = {}      # key -> {device: array}
+        self.batches = 0        # device_put count (observable under test)
+
+    def add(self, key, host_array, device):
+        self._pending.setdefault(device, []).append((key, host_array))
+        n = self._pending_elems.get(device, 0) + int(host_array.size)
+        self._pending_elems[device] = n
+        if n >= self.bucket_elems:
+            self._flush_device(device)
+
+    def _flush_device(self, device):
+        items = self._pending.pop(device, [])
+        self._pending_elems.pop(device, None)
+        if not items:
+            return
+        self.batches += 1
+        if self.pool is not None:
+            self._futures.append(
+                self.pool.submit(self._upload, device, items))
+        else:
+            self._store(self._upload(device, items))
+
+    def _upload(self, device, items):
+        """Pack -> one device_put -> one jitted split (runs on the
+        worker when a pool is set)."""
+        cast = [np.ascontiguousarray(a, dtype=self.dtype).ravel()
+                for _, a in items]
+        layout = tuple((int(c.size), tuple(np.shape(a)))
+                       for c, (_, a) in zip(cast, items))
+        flat = cast[0] if len(cast) == 1 else np.concatenate(cast)
+        dev_flat = jax.device_put(flat, device)
+        if layout not in self._split_cache:
+            self._split_cache[layout] = _split_fn_for(layout)
+        parts = self._split_cache[layout](dev_flat)
+        return [(key, device, part)
+                for (key, _), part in zip(items, parts)]
+
+    def _store(self, uploaded):
+        for key, device, part in uploaded:
+            self._results.setdefault(key, {})[device] = part
+
+    def flush(self):
+        """Kick every pending bucket onto the worker WITHOUT waiting —
+        callers prefetching the next segment start the packing now and
+        ``finish()`` later."""
+        for device in list(self._pending):
+            self._flush_device(device)
+
+    def finish(self):
+        """Flush everything, wait for in-flight uploads, return the
+        ``{key: {device: array}}`` map."""
+        for device in list(self._pending):
+            self._flush_device(device)
+        for fut in self._futures:
+            self._store(fut.result())
+        self._futures = []
+        return self._results
+
+
+def make_upload_pool(name="offload-upload"):
+    """One serial background worker for pack+device_put (jax dispatch is
+    thread-safe; a single worker keeps uploads ordered)."""
+    return ThreadPoolExecutor(max_workers=1, thread_name_prefix=name)
+
+
+def host_adam_chunk(lib, p, g, m, v, hyper, bc1, bc2, adam_w):
+    """One in-place host Adam chunk on fp32 numpy arrays (native SIMD
+    kernel when built, numpy fallback otherwise) — shared by the classic
+    offload shard pipeline (engine._offload_update_loop) and the
+    streamed-offload runner (stream.py). ``g`` is consumed (the
+    classic-L2 mode folds decay into it in place)."""
+    beta1, beta2 = hyper["beta1"], hyper["beta2"]
+    if lib is not None:
+        lib.ds_cpu_adam_step(
+            p.ctypes.data, g.ctypes.data, m.ctypes.data, v.ctypes.data,
+            p.size, hyper["lr"], beta1, beta2, hyper["eps"],
+            hyper["weight_decay"], bc1, bc2, adam_w)
+        return
+    if not adam_w and hyper["weight_decay"]:
+        # classic-L2 mode folds decay into the gradient
+        # (matches csrc/cpu_adam.cpp adam_w_mode=0)
+        g += hyper["weight_decay"] * p
+    np.multiply(m, beta1, out=m)
+    m += (1.0 - beta1) * g
+    np.multiply(v, beta2, out=v)
+    v += (1.0 - beta2) * np.square(g)
+    update = (m / bc1) / (np.sqrt(v / bc2) + hyper["eps"])
+    if adam_w:
+        update += hyper["weight_decay"] * p
+    p -= hyper["lr"] * update
+
+
+def chunk_rows(shape, sub_group_size):
+    """Row-range chunks of a shard covering at most ``sub_group_size``
+    elements each — the now-live ``sub_group_size``: the element chunk
+    size of the offload shard pipeline's D2H -> host-Adam work items
+    (reference stage3.py sub-group-partitioned optimizer step). Returns
+    ``[(row_start, row_stop), ...]``; ``[(0, rows)]`` when one chunk
+    suffices. Scalars and tiny shards are a single chunk."""
+    if not shape:
+        return [(0, 1)]
+    rows = int(shape[0])
+    row_elems = int(np.prod(shape[1:], dtype=np.int64)) if len(shape) > 1 \
+        else 1
+    total = rows * row_elems
+    if total <= sub_group_size or rows <= 1:
+        return [(0, rows)]
+    rows_per = max(1, int(sub_group_size // max(row_elems, 1)))
+    return [(r, min(r + rows_per, rows)) for r in range(0, rows, rows_per)]

@@ -55,6 +55,7 @@ def _run_gpt2(config_dict, steps=10, seed=0, model_seed=0):
 
 
 # --- milestone 1: cifar10-style DP smoke (BASELINE config 1) ---------------
+@pytest.mark.slow
 def test_milestone1_dp_smoke_convergence():
     """SimpleModel-style conv-free classifier on random 'images', pure DP
     fp32 (the cifar10 smoke config)."""
@@ -89,6 +90,7 @@ def test_milestone1_dp_smoke_convergence():
 
 
 # --- milestone 2: GPT2 + ZeRO-1 (BASELINE config 2) -------------------------
+@pytest.mark.slow
 def test_milestone2_gpt2_zero1_run_equality():
     """Two identical runs produce identical loss curves (the reference's
     grep-and-compare-equal check)."""
@@ -105,6 +107,7 @@ def test_milestone2_gpt2_zero1_run_equality():
 
 # --- milestone 3: BERT + ZeRO-2, FusedAdam and Lamb (BASELINE config 3) ----
 @pytest.mark.parametrize("opt", ["Adam", "Lamb"])
+@pytest.mark.slow
 def test_milestone3_bert_zero2(opt):
     model = bert.make_bert_model(size="bert_base", n_layers=2, d_model=32,
                                  n_heads=2, d_intermediate=64, vocab_size=96,
@@ -147,6 +150,7 @@ def test_milestone4_gpt2_zero3_offload():
 
 
 # --- milestone 5: 3D parallel (BASELINE config 5) ---------------------------
+@pytest.mark.slow
 def test_milestone5_gpt2_3d_vs_dp():
     """pipe=2 x model=2 x data=2 vs pure-DP: same model seeds, loss curves
     close (the reference's Megatron mp/gpu matrix closeness check)."""
@@ -179,6 +183,7 @@ def test_milestone5_gpt2_3d_vs_dp():
 
 
 # --- checkpoint milestone: train -> save -> resume -> compare ---------------
+@pytest.mark.slow
 def test_checkpoint_resume_loss_equality(tmp_path):
     config = {"train_batch_size": 8,
               "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
@@ -215,6 +220,7 @@ def test_checkpoint_resume_loss_equality(tmp_path):
 
 
 # --- milestone 6: BingBertSquad-style fine-tune (reference tier-2 e2e) -----
+@pytest.mark.slow
 def test_milestone6_bert_squad_finetune():
     """Span-extraction fine-tuning e2e (reference tests/model/BingBertSquad
     test_e2e_squad.py: fine-tune, then check quality). Tiny memorizable
@@ -255,6 +261,7 @@ def test_milestone6_bert_squad_finetune():
 
 # --- milestone 7: sequence parallelism trains (ring + ulysses legs) --------
 @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+@pytest.mark.slow
 def test_milestone7_sequence_parallel_vs_dp(impl):
     """GPT-2 with sequence parallelism over a (data=2, sequence=4) mesh:
     loss curve must track the pure-DP run closely (same model/data; only
@@ -297,6 +304,7 @@ def test_milestone7_sequence_parallel_vs_dp(impl):
     np.testing.assert_allclose(sp_losses, dp_losses, rtol=0.08)
 
 
+@pytest.mark.slow
 def test_milestone5b_gpt2_3d_ragged_tied_gas4():
     """Milestone-5 hardening: UNEQUAL stage depths (3 layers over 2
     stages), tied embedding/head gradients under 3D, and deeper grad

@@ -1,0 +1,146 @@
+"""Beyond-HBM training anchor: a >=4B-parameter GPT trained on ONE chip
+via streamed parameter offload (zero_optimization.cpu_offload_params).
+
+The point being demonstrated (the analogue of the reference's
+13B/40B-params-on-one-32GB-V100 ZeRO-3 Offload story): bf16 params
+(~8.5 GB) + fp32 grads (~17 GB) of a 4.2B model CANNOT co-reside in a
+single v5e's 16 GB HBM — yet the streamed step trains it with finite
+loss, because HBM only ever holds ~2 layer groups of parameters
+(budgeted by stage3_max_live_parameters), the boundary activations, and
+one group's gradients. Master+moments (~51 GB fp32) live in host RAM.
+
+    python tests/perf/bench_beyond_hbm.py [--layers 36] [--d 3072]
+        [--seq 128] [--mb 1] [--steps 1]
+
+Writes tests/perf/BENCH_BEYOND_HBM.json (params, sec/step, phase split,
+losses, group plan). On a CPU-only box the run is a correctness + memory
+-shape demonstration (the "device" is host RAM); the JSON records the
+backend honestly.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--layers", type=int, default=36)
+    parser.add_argument("--d", type=int, default=3072)
+    parser.add_argument("--heads", type=int, default=24)
+    parser.add_argument("--seq", type=int, default=128)
+    parser.add_argument("--mb", type=int, default=1)
+    parser.add_argument("--steps", type=int, default=1)
+    parser.add_argument("--max-live", type=int, default=10 ** 9,
+                        help="stage3_max_live_parameters (elements)")
+    args = parser.parse_args()
+
+    import jax
+    import deepspeed_tpu as deepspeed
+    from deepspeed_tpu.models import gpt2
+
+    cfg = gpt2.GPT2Config(max_seq_len=args.seq, n_layers=args.layers,
+                          n_heads=args.heads, d_model=args.d,
+                          use_flash_attention=False, remat=True,
+                          loss_chunk=128 if args.seq % 128 == 0 else 0)
+    n = gpt2.num_params(cfg)
+    print("model: {} layers x d={} -> {:,} params".format(
+        args.layers, args.d, n), flush=True)
+
+    t0 = time.time()
+    model = gpt2.make_gpt2_model(config=cfg)
+    print("init_params in {:.0f}s".format(time.time() - t0), flush=True)
+
+    t0 = time.time()
+    engine, _, _, _ = deepspeed.initialize(
+        model=model, config_params={
+            "train_micro_batch_size_per_gpu": args.mb,
+            "gradient_accumulation_steps": 1,
+            "bf16": {"enabled": True},
+            "zero_optimization": {
+                "stage": 3, "cpu_offload": True,
+                "cpu_offload_params": True,
+                "stage3_max_live_parameters": args.max_live,
+            },
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+            "steps_per_print": 10 ** 9,
+        })
+    print("engine ready in {:.0f}s; groups={}".format(
+        time.time() - t0, engine.stream_runner.groups), flush=True)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, size=(1, args.mb, args.seq)) \
+        .astype(np.int32)
+    batch = (ids, ids.copy())
+
+    t0 = time.time()
+    loss = engine.train_batch(batch=batch)      # compile + first step
+    compile_step_s = time.time() - t0
+    print("first step (compile) {:.0f}s loss={:.3f}".format(
+        compile_step_s, float(loss)), flush=True)
+
+    losses = [float(loss)]
+    phase_acc = {}      # measured steps only (not the compile step)
+    t0 = time.time()
+    for _ in range(args.steps):
+        losses.append(float(engine.train_batch(batch=batch)))
+        for k, v in engine.offload_phase_times.items():
+            phase_acc[k] = phase_acc.get(k, 0.0) + v
+    dt = (time.time() - t0) / max(args.steps, 1)
+    phases = {k: round(v / max(args.steps, 1), 2)
+              for k, v in phase_acc.items() if not k.startswith("_")}
+
+    live_elems = max(
+        sum(int(np.prod(np.shape(l)))
+            for i in range(*engine.stream_runner.groups[g])
+            for l in engine.stream_runner._b_leaves[i])
+        for g in range(len(engine.stream_runner.groups)))
+    hbm_resident_gb = round(
+        2 * live_elems * 2 / 2 ** 30, 2)   # 2 groups in flight, bf16
+    out = {
+        "metric": "beyond_hbm_streamed_offload_params_on_one_chip",
+        "value": n,
+        "unit": "params",
+        "extra": {
+            "params": n,
+            "params_plus_grads_gb_if_resident": round(
+                (2 * n + 4 * n) / 2 ** 30, 1),
+            "hbm_16gb_exceeded": bool((2 * n + 4 * n) / 2 ** 30 > 16.0),
+            "streamed_live_param_gb_peak": hbm_resident_gb,
+            "layer_groups": len(engine.stream_runner.groups),
+            "stage3_max_live_parameters": args.max_live,
+            "sec_per_step": round(dt, 1),
+            "compile_plus_first_step_s": round(compile_step_s, 1),
+            "phase_split_s": phases,
+            "losses": [round(x, 4) for x in losses],
+            "finite": bool(np.all(np.isfinite(losses))),
+            "micro_batch": args.mb,
+            "seq_len": args.seq,
+            "device": getattr(jax.devices()[0], "device_kind", "cpu"),
+            "backend": jax.default_backend(),
+            "note": "params stream host->HBM per layer group "
+                    "(double-buffered, coalesced); master+moments are "
+                    "host fp32; grads leave per segment as one packed "
+                    "buffer. Phases are disjoint driver-loop wall "
+                    "clocks; on async backends a later phase's sync "
+                    "absorbs earlier dispatched compute (d2h_grads is "
+                    "the step's hard sync point). On a CPU backend this "
+                    "demonstrates the memory shape and numerics; v5e "
+                    "gives the single-chip beyond-HBM capability the "
+                    "metric names.",
+        },
+    }
+    path = os.path.join(os.path.dirname(__file__),
+                        "BENCH_BEYOND_HBM.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

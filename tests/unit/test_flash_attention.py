@@ -403,6 +403,7 @@ def test_bwd_packed_dispatch_plan():
 
 
 @pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.slow
 def test_grouped_fused_bwd_matches_split(causal):
     """gpt2-xl-width backward (25 heads x 64 = 1600 > single-call cap):
     the per-head-group fused path is numerically identical to the split

@@ -65,6 +65,7 @@ def test_partition_specs():
     assert fn("wpe", (64, 64)) is None
 
 
+@pytest.mark.slow
 def test_tp_mesh_matches_dp_only():
     """2-way TP x 4-way DP must produce the same loss trajectory as 8-way DP."""
     from deepspeed_tpu.parallel.topology import (PipeModelDataParallelTopology,
@@ -170,6 +171,7 @@ def test_scan_blocks_tp_specs_place():
     assert qkv.sharding.spec == P(None, None, "model")
 
 
+@pytest.mark.slow
 def test_sparse_attention_through_engine():
     """The ds_config "sparse_attention" dict drives the model's attention
     (reference BingBertSquad flow: engine.sparse_attention_config() ->

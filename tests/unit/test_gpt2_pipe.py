@@ -35,6 +35,7 @@ def cfg(gas):
     }
 
 
+@pytest.mark.slow
 def test_gpt2_pipeline_trains():
     net = make_net(num_stages=2, num_dp=4)
     engine, _, _, _ = deepspeed.initialize(model=net, config_params=cfg(2))
@@ -46,6 +47,7 @@ def test_gpt2_pipeline_trains():
     assert "pipe" in str(body_w.sharding.spec)
 
 
+@pytest.mark.slow
 def test_gpt2_pipeline_3d():
     """PP=2 x DP=2 x TP=2 mesh: full 3D parallel one-step smoke."""
     net = make_net(num_stages=2, num_dp=2, num_mp=2)

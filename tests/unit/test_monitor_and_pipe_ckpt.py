@@ -60,6 +60,7 @@ def test_engine_writes_monitor_scalars(tmp_path):
     assert losses[0]["step"] == 8 and losses[-1]["step"] == 24
 
 
+@pytest.mark.slow
 def test_pipeline_per_layer_files_and_repartition(tmp_path):
     from deepspeed_tpu.models import gpt2_pipe, gpt2
     cfg = gpt2.GPT2Config(vocab_size=128, max_seq_len=32, n_layers=4,

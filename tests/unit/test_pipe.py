@@ -391,6 +391,7 @@ def test_interleaved_checkpoint_cross_layout(tmp_path):
     assert got == pytest.approx(ref, rel=1e-2, abs=1e-3)
 
 
+@pytest.mark.slow
 def test_save_stage_residuals_matches_default():
     """save_stage_residuals=True (no-recompute backward: fwd-phase vjp
     pullbacks buffered in the W-slot ring) trains identically to the

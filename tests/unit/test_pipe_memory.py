@@ -45,6 +45,7 @@ def _compiled_temp_bytes(gas, num_virtual=1):
     return stats.temp_size_in_bytes
 
 
+@pytest.mark.slow
 def test_pipeline_memory_flat_in_micro_batches():
     t4 = _compiled_temp_bytes(4)
     t16 = _compiled_temp_bytes(16)
@@ -53,6 +54,7 @@ def test_pipeline_memory_flat_in_micro_batches():
     assert t16 <= t4 * 1.10, (t4, t16)
 
 
+@pytest.mark.slow
 def test_interleaved_pipeline_memory_flat_in_micro_batches():
     """The interleaved executor keeps the 1F1B property too: its ring
     holds more slots ((v, W) per chunk) but the count is M-independent,

@@ -262,3 +262,62 @@ class TestInterleavedTables:
         # v=1 keeps the round-3 bound
         if v == 1:
             assert W <= max(1, min(2 * S - 1, M))
+
+
+class TestPackedInferenceTables:
+    """packed_inference_schedule_tables: the forward-only eval tables
+    (pipe/engine.py _pipeline_eval_fn walks exactly these cycles)."""
+
+    @pytest.mark.parametrize("M,S,v", [(8, 4, 1), (8, 4, 2), (8, 2, 4),
+                                       (16, 4, 2), (4, 2, 2)])
+    def test_cycle_count_packed(self, M, S, v):
+        """Eval cycle count is M*v + S - 1 when S | M — fill + every
+        rank's M*v forwards + drain, no 1F1B spacing."""
+        t = sch.packed_inference_schedule_tables(M, S, v)
+        assert t["total_cycles"] == M * v + S - 1
+
+    @pytest.mark.parametrize("M,S,v", [(7, 4, 2), (3, 2, 2), (5, 4, 1)])
+    def test_ragged_tail_bound(self, M, S, v):
+        """Ragged M adds exactly (v-1)*(S - M%S) bubble cycles over the
+        divisible count (the one-hop chunk spacing makes them
+        unavoidable)."""
+        t = sch.packed_inference_schedule_tables(M, S, v)
+        assert t["total_cycles"] == \
+            M * v + S - 1 + (v - 1) * (S - M % S)
+
+    @pytest.mark.parametrize("M,S,v", [(8, 4, 2), (7, 4, 2), (8, 2, 4),
+                                       (5, 3, 1)])
+    def test_hop_alignment_and_coverage(self, M, S, v):
+        """One hop per cycle: rank r+1 forwards (c, m) exactly one cycle
+        after rank r; chunk transitions wrap rank S-1 -> rank 0 one
+        cycle later; every (c, m) appears exactly once per rank."""
+        t = sch.packed_inference_schedule_tables(M, S, v)
+        fwd_m, fwd_c = t["fwd_m"], t["fwd_c"]
+        when = {}
+        for r in range(S):
+            seen = set()
+            for k in range(t["total_cycles"]):
+                if fwd_m[r, k] >= 0:
+                    key = (r, int(fwd_c[r, k]), int(fwd_m[r, k]))
+                    assert key[1:] not in seen
+                    seen.add(key[1:])
+                    when[key] = k
+            assert len(seen) == M * v
+        for (r, c, m), k in when.items():
+            if r + 1 < S:
+                assert when[(r + 1, c, m)] == k + 1
+            elif c + 1 < v:
+                assert when[(0, c + 1, m)] == k + 1
+
+    def test_matches_training_forward_tables(self):
+        """The training generator's forward half already packs optimally
+        (steady_end == packed total): pin the equivalence so the eval
+        path's decoupling can never silently diverge from the 1F1B
+        executor's forward placement."""
+        for M, S, v in [(8, 4, 2), (7, 4, 2), (6, 2, 3)]:
+            packed = sch.packed_inference_schedule_tables(M, S, v)
+            train = sch.interleaved_train_schedule_tables(M, S, v)
+            T = packed["total_cycles"]
+            assert T == train["steady_end"]
+            assert (packed["fwd_m"] == train["fwd_m"][:, :T]).all()
+            assert (packed["fwd_c"] == train["fwd_c"][:, :T]).all()

@@ -363,6 +363,7 @@ def test_extend_position_embedding():
     np.testing.assert_allclose(ext[4:], w)
 
 
+@pytest.mark.slow
 def test_per_head_different_layouts_match_reference():
     """different_layout_per_head=True exercises the NON-shared prefetch
     path (per-head SMEM index lists + hsel index maps) — every head's
@@ -521,6 +522,7 @@ def test_packed_heads_path_matches_dense(causal):
                                    err_msg=name)
 
 
+@pytest.mark.slow
 def test_packed_heads_path_with_masks_matches_per_head(monkeypatch):
     """kpm/bias handling is identical across the packed and per-head
     paths (DS_SPARSE_PACKED=0 forces per-head)."""

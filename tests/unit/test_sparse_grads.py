@@ -61,6 +61,7 @@ def test_sparse_lookup_falls_back_off_mesh():
     assert out2.shape == (3, 2, 4)
 
 
+@pytest.mark.slow
 def test_gpt2_sparse_embedding_grads_end_to_end(mesh):
     """GPT-2 with sparse_embedding_grads trains identically to the dense
     path through the engine, and the engine records the CSR module name."""

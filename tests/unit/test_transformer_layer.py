@@ -132,6 +132,7 @@ def _bert_batch(rs, config, batch=4, seq=32):
             jnp.asarray(mlm_labels), jnp.asarray(nsp))
 
 
+@pytest.mark.slow
 def test_bert_pretrain_engine_convergence():
     config_dict = {
         "train_batch_size": 8,

@@ -13,22 +13,28 @@ import time
 import numpy as np
 
 
-# Peak bf16 TFLOPS per chip by device kind (public cloud.google.com/tpu
-# specs; v2/v3 per-chip = 2 cores).
-PEAK_TFLOPS = {
-    "TPU v2": 45.0, "TPU v3": 123.0, "TPU v4": 275.0,
-    "TPU v5 lite": 197.0, "TPU v5e": 197.0, "TPU v5": 459.0,
-    "TPU v5p": 459.0, "TPU v6 lite": 918.0, "TPU v6e": 918.0,
-    "cpu": 0.1,
-}
-
-
 def peak_for(device):
-    kind = getattr(device, "device_kind", "cpu")
-    for name, tf in PEAK_TFLOPS.items():
-        if kind.lower().startswith(name.lower()):
-            return tf * 1e12
-    return 0.1e12
+    """Peak bf16 flops/s per chip — the table lives with the telemetry
+    subsystem now (deepspeed_tpu/telemetry/mfu.py) so the per-step
+    StepRecords and this bench price MFU identically."""
+    from deepspeed_tpu.telemetry.mfu import peak_flops_for
+    return peak_flops_for(device)
+
+
+def scratch_telemetry_dir(prefix):
+    """Disposable telemetry output dir: the rolling snapshot rides the
+    bench JSON line, so the JSONL dir is scratch — removed at process
+    exit (atexit runs LIFO, so the collector's own exit handler closes
+    the JSONL handle first). Shared by bench_inference.py and the
+    telemetry-overhead bench; __graft_entry__._tele_cfg inlines the same
+    pattern to stay importable without the repo root on sys.path.
+    Without this every run leaked a /tmp directory."""
+    import atexit
+    import shutil
+    import tempfile
+    d = tempfile.mkdtemp(prefix=prefix)
+    atexit.register(shutil.rmtree, d, ignore_errors=True)
+    return d
 
 
 def safe_default_backend(retries=3, backoff_s=2.0):
@@ -108,6 +114,12 @@ def main():
             "zero_optimization": {"stage": 2},
             "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
             "steps_per_print": 10 ** 9,
+            # per-step StepRecords; the final rolling snapshot lands in
+            # the JSON line below so BENCH_* files carry MFU/phase/comm
+            # trajectories from now on
+            "telemetry": {"enabled": True,
+                          "output_path": scratch_telemetry_dir(
+                              "bench_telemetry_")},
         }
         if bf16_state:
             ds_config["optimizer"]["params"]["moments_dtype"] = "bf16"
@@ -181,6 +193,10 @@ def main():
             "rung": {"micro_batch": micro_batch, "remat": remat,
                      "bf16_state": bf16_state},
             "comm": comm,
+            # omitted (not {}) on non-writer processes: the schema
+            # checker rejects an empty snapshot (bin/check_bench_schema)
+            **({"telemetry": engine.telemetry_snapshot()}
+               if engine.telemetry is not None else {}),
         },
     }))
 

@@ -15,7 +15,8 @@ import time
 
 import numpy as np
 
-from bench import emit_error_json, peak_for, safe_default_backend
+from bench import (emit_error_json, peak_for, safe_default_backend,
+                   scratch_telemetry_dir)
 
 
 def main():
@@ -42,8 +43,14 @@ def main():
 
     n_params = gpt2.num_params(cfg)
     model = gpt2.make_gpt2_model(config=cfg)
-    engine = deepspeed.init_inference(model=model,
-                                      config={"inference": inference})
+    engine = deepspeed.init_inference(
+        model=model,
+        config={"inference": inference,
+                # per-decode-step serving records; the final rolling
+                # snapshot rides extra.telemetry below
+                "telemetry": {"enabled": True,
+                              "output_path": scratch_telemetry_dir(
+                                  "bench_inf_telemetry_")}})
 
     rng = np.random.RandomState(0)
     prompts = [rng.randint(0, cfg.vocab_size,
@@ -87,6 +94,10 @@ def main():
             "kv_cache_mb": round(engine.kv.nbytes / 2 ** 20, 1),
             "device": getattr(jax.devices()[0], "device_kind", "cpu"),
             "backend": jax.default_backend(),
+            # omitted (not {}) on non-writer processes: the schema
+            # checker rejects an empty snapshot (bin/check_bench_schema)
+            **({"telemetry": engine.telemetry_snapshot()}
+               if engine.telemetry is not None else {}),
         },
     }))
 

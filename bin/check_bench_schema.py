@@ -1,0 +1,169 @@
+#!/usr/bin/env python3
+"""Validate BENCH_*.json artifact shape, including ``extra.telemetry``.
+
+Every bench artifact (bench.py / bench_inference.py and the perf
+scripts that mimic their shape) must be ONE parseable JSON object with:
+
+  metric (str), value (number|null), unit (str), vs_baseline
+  (number|null); "error" (str) required whenever value is null;
+  optional extra (dict). When ``extra.telemetry`` is present it must be
+  a telemetry snapshot: ``steps``/``serving_steps`` ints, and — when
+  steps > 0 — ``step_time_s``/``mfu``/``tokens_per_sec_per_chip`` dists
+  with last/mean/p50/p95 numbers (docs/telemetry.md).
+
+Usage: check_bench_schema.py [FILE...]; with no args, validates every
+BENCH_*.json in the repo root and tests/perf/. Exit 1 on any failure.
+"""
+import glob
+import json
+import os
+import sys
+
+_NUM = (int, float)
+
+
+def _is_num(val):
+    return isinstance(val, _NUM) and not isinstance(val, bool)
+
+
+def _check_dist(d, name, problems):
+    if not isinstance(d, dict):
+        problems.append("telemetry.{} is not a dict".format(name))
+        return
+    for key in ("last", "mean", "p50", "p95"):
+        if not _is_num(d.get(key)):
+            problems.append(
+                "telemetry.{}.{} is not a number: {!r}".format(
+                    name, key, d.get(key)))
+
+
+def check_telemetry_snapshot(snap):
+    """-> list of problems with one ``extra.telemetry`` payload."""
+    problems = []
+    if not isinstance(snap, dict):
+        return ["extra.telemetry is not a dict"]
+    if not snap:
+        return ["extra.telemetry is empty (telemetry was disabled — "
+                "drop the key instead)"]
+    steps = snap.get("steps", 0)
+    serving = snap.get("serving_steps", 0)
+    for key, val in (("steps", steps), ("serving_steps", serving)):
+        if not isinstance(val, int) or isinstance(val, bool) or val < 0:
+            problems.append(
+                "telemetry.{} is not an int >= 0: {!r}".format(key, val))
+            return problems
+    if steps == 0 and serving == 0:
+        problems.append("telemetry carries neither train nor serving steps")
+    if steps > 0:
+        for name in ("step_time_s", "mfu", "tokens_per_sec_per_chip"):
+            _check_dist(snap.get(name), name, problems)
+        if not isinstance(snap.get("phases_mean_s"), dict):
+            problems.append("telemetry.phases_mean_s is not a dict")
+    if serving > 0 and not isinstance(snap.get("serving"), dict):
+        problems.append("telemetry.serving is not a dict")
+    return problems
+
+
+def _unwrap_driver_record(payload):
+    """Repo-root BENCH_r*.json are DRIVER run records ({"cmd", "rc",
+    "tail"}): the bench's own JSON line is the last {"metric": ...} line
+    of the captured tail. Returns (inner_payload, problems)."""
+    tail = payload.get("tail", "")
+    inner = None
+    for line in tail.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                cand = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(cand, dict) and "metric" in cand:
+                inner = cand
+    if inner is None:
+        if payload.get("rc") != 0:
+            # historical failed run: the record honestly carries rc + the
+            # traceback tail; nothing further to validate
+            return None, []
+        return None, ["driver record has rc=0 but no bench JSON line "
+                      "in its tail"]
+    return inner, []
+
+
+def check_bench_payload(payload):
+    """-> list of problems with one parsed BENCH_*.json object. Accepts
+    the three artifact shapes in the repo: bench.py's single JSON line,
+    perf-table artifacts (metric + rows), and driver run records
+    (cmd/rc/tail with the bench line embedded)."""
+    problems = []
+    if not isinstance(payload, dict):
+        return ["top level is not a JSON object"]
+    if "rc" in payload and "cmd" in payload:
+        payload, problems = _unwrap_driver_record(payload)
+        if payload is None:
+            return problems
+    if "rows" in payload:
+        # perf-table shape (e.g. BENCH_BERT_*): non-empty rows; metric
+        # is a string when present (earliest artifacts predate it)
+        if "metric" in payload and not isinstance(payload["metric"], str):
+            problems.append("metric is not a string")
+        rows = payload.get("rows")
+        if not isinstance(rows, list) or not rows:
+            problems.append("rows is not a non-empty list")
+        return problems
+    if not isinstance(payload.get("metric"), str):
+        problems.append("metric is not a string")
+    if not isinstance(payload.get("unit"), str):
+        problems.append("unit is not a string")
+    value = payload.get("value")
+    if value is not None and not _is_num(value):
+        problems.append("value is neither a number nor null")
+    vs = payload.get("vs_baseline")
+    if vs is not None and not _is_num(vs):
+        problems.append("vs_baseline is neither a number nor null")
+    if value is None and not isinstance(payload.get("error"), str):
+        problems.append("value is null but no 'error' string names why")
+    extra = payload.get("extra")
+    if extra is not None:
+        if not isinstance(extra, dict):
+            problems.append("extra is not a dict")
+        elif "telemetry" in extra:
+            problems.extend(check_telemetry_snapshot(extra["telemetry"]))
+    return problems
+
+
+def check_file(path):
+    try:
+        with open(path) as fh:
+            payload = json.load(fh)
+    except (OSError, ValueError) as err:
+        return ["unreadable/unparseable: {}".format(err)]
+    return check_bench_payload(payload)
+
+
+def main(argv):
+    paths = argv[1:]
+    if not paths:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = sorted(glob.glob(os.path.join(root, "BENCH_*.json")) +
+                       glob.glob(os.path.join(root, "tests", "perf",
+                                              "BENCH_*.json")))
+    if not paths:
+        print("check_bench_schema: no BENCH_*.json files found")
+        return 1
+    failed = 0
+    for path in paths:
+        problems = check_file(path)
+        if problems:
+            failed += 1
+            print("FAIL {}".format(path))
+            for problem in problems:
+                print("  - {}".format(problem))
+        else:
+            print("OK   {}".format(path))
+    print("check_bench_schema: {}/{} files valid".format(
+        len(paths) - failed, len(paths)))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

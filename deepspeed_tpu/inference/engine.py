@@ -31,19 +31,23 @@ from .sampling import make_sampler
 _UNSET = object()    # "argument not given" (None means "no EOS token")
 
 
-def _as_inference_config(config, mesh=None):
+def _parse_configs(config, mesh=None):
+    """-> (inference_config, telemetry_config-or-None). One ds_config
+    drives both training and serving; the serving engine reads its own
+    section plus the shared telemetry section."""
     if isinstance(config, DeepSpeedInferenceConfig):
-        return config
+        return config, None
     from ..runtime.config import DeepSpeedConfig
     if isinstance(config, DeepSpeedConfig):
-        return config.inference_config
+        return config.inference_config, config.telemetry_config
     if config is None:
-        return DeepSpeedInferenceConfig({})
+        return DeepSpeedInferenceConfig({}), None
     if isinstance(config, dict):
-        return DeepSpeedConfig(None, param_dict=config, mesh=mesh,
-                               inference_only=True).inference_config
-    return DeepSpeedConfig(config, mesh=mesh,
-                           inference_only=True).inference_config
+        full = DeepSpeedConfig(None, param_dict=config, mesh=mesh,
+                               inference_only=True)
+    else:
+        full = DeepSpeedConfig(config, mesh=mesh, inference_only=True)
+    return full.inference_config, full.telemetry_config
 
 
 class InferenceEngine:
@@ -60,7 +64,8 @@ class InferenceEngine:
         assert model_config is not None and hasattr(model_config, "n_heads"), \
             "init_inference needs a model with a GPT2Config at .config " \
             "(e.g. models.gpt2.make_gpt2_model)"
-        self.inference_config = _as_inference_config(config, mesh=mesh)
+        self.inference_config, telemetry_config = _parse_configs(
+            config, mesh=mesh)
         # dtype override is engine-local state: the config object may be
         # shared with other engines (or the training engine) and must not
         # be mutated
@@ -110,11 +115,34 @@ class InferenceEngine:
         self._prefill_fns = {}       # (bucket, greedy, top_k) -> jit fn
         self._decode_fns = {}        # (greedy, top_k) -> jit fn
         self.compile_stats = {"prefill_traces": 0, "decode_traces": 0}
+
+        # serving telemetry (docs/telemetry.md): the continuous-batching
+        # scheduler emits one serving_step record per decode step through
+        # the same sink layer the training engine writes; None = off
+        from ..telemetry import TelemetryCollector
+        # engine-lifetime serving record index + counters: generate()
+        # builds a fresh scheduler per call but all records append to ONE
+        # telemetry.jsonl, so `step` must keep counting across calls for
+        # the join-on-step contract (docs/telemetry.md) — and the metrics
+        # the records embed must be cumulative over the same lifetime, or
+        # per-step deltas go negative at every generate() boundary
+        self.serving_record_steps = 0
+        from ..utils.monitor import ServingMetrics
+        self.serving_metrics = ServingMetrics()
+        self.telemetry = TelemetryCollector.from_section(
+            telemetry_config, job_name="serve",
+            enabled=jax.process_index() == 0)
         logger.info(
             "InferenceEngine: slots={} max_seq={} buckets={} dtype={} "
             "kv_cache={:.1f} MB".format(
                 self.num_slots, self.max_seq_len, self.prefill_buckets,
                 self.dtype_name, self.kv.nbytes / 2 ** 20))
+
+    def telemetry_snapshot(self):
+        """Rolling serving aggregate (occupancy/queue-depth p50/p95,
+        token rates) — ``{}`` when telemetry is disabled."""
+        return self.telemetry.snapshot() if self.telemetry is not None \
+            else {}
 
     # ---------------------------------------------------------- placement
 
@@ -283,6 +311,8 @@ class InferenceEngine:
         ``eos_token_id`` left unset falls through to the config default
         (``inference.eos_token_id``); pass None to disable early stop."""
         from .scheduler import ContinuousBatchingScheduler
+        if metrics is None:
+            metrics = self.serving_metrics
         sched = ContinuousBatchingScheduler(self, metrics=metrics,
                                             sampling=sampling)
         kwargs = ({} if eos_token_id is _UNSET

@@ -41,6 +41,13 @@ class ContinuousBatchingScheduler:
     def __init__(self, engine, metrics=None, sampling=None):
         self.engine = engine
         self.metrics = metrics if metrics is not None else ServingMetrics()
+        # telemetry records ALWAYS embed the engine-lifetime counters —
+        # a caller-supplied per-call `metrics` is accounted in parallel,
+        # never routed into the JSONL, or its zeroed counters would make
+        # join-on-step deltas go negative at the generate() boundary
+        self._record_metrics = getattr(engine, "serving_metrics", None)
+        if self._record_metrics is None:
+            self._record_metrics = self.metrics
         self.sampling = sampling
         self.queue = deque()
         self.slots = [None] * engine.num_slots
@@ -48,6 +55,13 @@ class ContinuousBatchingScheduler:
         self.timers = SynchronizedWallClockTimer()
         self._next_uid = 0
         self.steps = 0
+
+    def _account(self, method, *args, **kwargs):
+        """Apply one ServingMetrics update to the caller's object AND
+        the engine-lifetime one the telemetry records embed."""
+        getattr(self.metrics, method)(*args, **kwargs)
+        if self._record_metrics is not self.metrics:
+            getattr(self._record_metrics, method)(*args, **kwargs)
 
     # ------------------------------------------------------------- intake
 
@@ -97,7 +111,23 @@ class ContinuousBatchingScheduler:
 
     def step(self):
         """Admit -> one decode step -> retire. Returns uids retired now."""
+        if not self.queue and self.num_active == 0:
+            # idle poll: nothing to admit and no slot to decode — emit no
+            # zero-work serving record (a polling serve loop would grow
+            # telemetry.jsonl without bound and drag the snapshot's
+            # occupancy/queue p50/p95 down to the idle value)
+            return []
         retired = []
+        tel = getattr(self.engine, "telemetry", None)
+        # 0-based like the training engine's records (global_steps at
+        # window open) and ENGINE-lifetime (not per-generate-call), so
+        # joining the JSONLs on `step` and setting trace.start_step mean
+        # the same thing on both engines
+        record_step = getattr(self.engine, "serving_record_steps", 0)
+        if tel is not None:
+            # BEFORE the step's prefill/decode work so an armed xprof
+            # window opens around it, not after it (docs/telemetry.md)
+            tel.on_step_begin(record_step)
 
         # admit queued requests into free slots, one prefill each
         for slot in range(len(self.slots)):
@@ -111,8 +141,8 @@ class ContinuousBatchingScheduler:
             first = self.engine.prefill(slot, req.prompt,
                                         sampling=self.sampling)
             t.stop()
-            self.metrics.record_prefill(len(req.prompt),
-                                        t.elapsed(reset=True))
+            self._account("record_prefill", len(req.prompt),
+                          t.elapsed(reset=True))
             req.generated.append(first)
             if self._retire_if_done(req):
                 retired.append(req.uid)
@@ -130,7 +160,8 @@ class ContinuousBatchingScheduler:
             next_tokens = self.engine.decode_step(tokens,
                                                   sampling=self.sampling)
             t.stop()
-            self.metrics.record_decode(len(active), t.elapsed(reset=True))
+            self._account("record_decode", len(active),
+                          t.elapsed(reset=True))
             for r in active:
                 self.engine.advance(r.slot)
                 r.generated.append(int(next_tokens[r.slot]))
@@ -138,10 +169,18 @@ class ContinuousBatchingScheduler:
                     retired.append(r.uid)
 
         self.steps += 1
-        self.metrics.record_schedule(
-            occupancy=min(busy, self.engine.num_slots) /
-            self.engine.num_slots,
-            queue_depth=len(self.queue), step=self.steps)
+        self.engine.serving_record_steps = record_step + 1
+        occupancy = min(busy, self.engine.num_slots) / self.engine.num_slots
+        self._account("record_schedule",
+                      occupancy=occupancy,
+                      queue_depth=len(self.queue), step=self.steps)
+        if tel is not None:
+            # one serving_step record per scheduler step through the same
+            # sink layer the training engine writes (docs/telemetry.md)
+            tel.emit_serving_step(
+                step=record_step, metrics=self._record_metrics,
+                active_slots=self.num_active,
+                queue_depth=len(self.queue), occupancy=occupancy)
         return retired
 
     def run(self):

@@ -19,6 +19,8 @@ from .zero.constants import (ZERO_OPTIMIZATION, ZERO_OPTIMIZATION_DISABLED,
 from .activation_checkpointing.config import DeepSpeedActivationCheckpointingConfig
 from ..profiling.config import DeepSpeedFlopsProfilerConfig
 from ..inference.config import DeepSpeedInferenceConfig, INFERENCE
+from ..telemetry.config import (DeepSpeedTelemetryConfig, TELEMETRY,
+                                KNOWN_TELEMETRY_KEYS)
 from ..utils.logging import logger
 
 TENSOR_CORE_ALIGN_SIZE = 8
@@ -553,6 +555,7 @@ class DeepSpeedConfig(object):
             DeepSpeedActivationCheckpointingConfig(param_dict)
         self.flops_profiler_config = DeepSpeedFlopsProfilerConfig(param_dict)
         self.inference_config = DeepSpeedInferenceConfig(param_dict)
+        self.telemetry_config = DeepSpeedTelemetryConfig(param_dict)
 
         self.gradient_clipping = get_gradient_clipping(param_dict)
         self.grad_accum_dtype = get_grad_accum_dtype(param_dict)
@@ -671,7 +674,7 @@ class DeepSpeedConfig(object):
         "sparse_gradients", "prescale_gradients",
         "gradient_predivide_factor", "disable_allgather", "fp32_allreduce",
         "vocabulary_size", "config_validation", "data_types",
-        INFERENCE,
+        INFERENCE, TELEMETRY,
         # deprecated boolean form + its companion (read_zero_config_deprecated)
         "allgather_size",
     }
@@ -709,6 +712,7 @@ class DeepSpeedConfig(object):
                        "io_retry_backoff_seconds", "keep_last_n"},
         "data_types": {"grad_accum_dtype"},
         INFERENCE: DeepSpeedInferenceConfig.KNOWN_KEYS,
+        TELEMETRY: KNOWN_TELEMETRY_KEYS,
         "elasticity": {"enabled", "max_train_batch_size",
                        "micro_batch_sizes", "min_gpus", "max_gpus",
                        "min_time", "prefer_larger_batch",

@@ -20,6 +20,7 @@ internals are re-founded for TPU:
 No torch, no NCCL: collectives are inserted by XLA from shardings.
 """
 import os
+import time
 from typing import Any, Dict
 
 import numpy as np
@@ -141,6 +142,22 @@ class DeepSpeedEngine:
         # non-writer ranks never create files/handles
         self.monitor = SummaryMonitor.from_config(
             self._config, enabled=jax.process_index() == 0)
+
+        # unified per-step telemetry (docs/telemetry.md): None unless the
+        # "telemetry" config section enables it — the hot paths pay one
+        # `is not None` check when off
+        from ..telemetry import TelemetryCollector
+        self.telemetry = TelemetryCollector.from_config(
+            self._config, job_name="train", monitor=self.monitor,
+            enabled=jax.process_index() == 0)
+        self._tele_flops_cache = {}
+        self._tele_wire = "unset"
+        self._window_t0 = None
+        self._window_step = 0
+        self._window_tokens = 0
+        self._window_flops = 0.0
+        self._step_hbm = None
+        self._check_memory_breakdown()
 
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
@@ -377,12 +394,12 @@ class DeepSpeedEngine:
         """A zero_optimization key this runtime cannot honor: warn
         loudly, or raise when zero_optimization.strict is set — never a
         silent no-op (docs/zero3_offload.md)."""
-        msg = ("zero_optimization.{} has NO effect in this runtime: {}"
-               .format(key, why))
-        if getattr(self._config.zero_config, "strict", False):
-            raise ValueError(msg + " (raising because "
-                             "zero_optimization.strict=true)")
-        logger.warning(msg)
+        from ..telemetry.config import warn_or_raise_noop
+        warn_or_raise_noop(
+            "zero_optimization.{} has NO effect in this runtime: {}"
+            .format(key, why),
+            getattr(self._config.zero_config, "strict", False),
+            flag="zero_optimization.strict")
 
     def _validate_zero_keys(self, zc, stage):
         """Every parsed zero_optimization key either drives a mechanism
@@ -899,6 +916,208 @@ class DeepSpeedEngine:
             self._jit_cache[key] = jax.jit(builder(), **jit_kwargs)
         return self._jit_cache[key]
 
+    # -------------------------------------------------------------- telemetry
+    def _check_memory_breakdown(self):
+        """``memory_breakdown`` drives per-step HBM reporting (telemetry
+        records + monitor scalars + see_memory_usage at print
+        boundaries). A backend without ``memory_stats()`` cannot honor
+        it: warn loudly, raise under telemetry.strict — never a silent
+        no-op (the PR 4 stage-3 key policy)."""
+        if not self._config.memory_breakdown:
+            return
+        from ..telemetry.collector import collect_memory_stats
+        if collect_memory_stats()["available"]:
+            return
+        from ..telemetry.config import warn_or_raise_noop
+        warn_or_raise_noop(
+            "memory_breakdown=true but backend {!r} exposes no "
+            "memory_stats() — per-step HBM live/peak reporting is "
+            "unavailable on this runtime".format(jax.default_backend()),
+            getattr(self._config.telemetry_config, "strict", False))
+
+    def telemetry_snapshot(self):
+        """Rolling-window aggregate of the emitted StepRecords (p50/p95
+        step time, MFU, tokens/s/chip, phase means, wire bytes) — ``{}``
+        when telemetry is disabled. Benches embed this under
+        ``extra.telemetry``."""
+        return self.telemetry.snapshot() if self.telemetry is not None \
+            else {}
+
+    def _tele_flops(self, key, fn, *args):
+        """Executed flops of the jitted program behind ``key`` via XLA
+        cost_analysis, computed ONCE per key (training shapes are static
+        per program; a re-jit under the same key at new shapes keeps the
+        first estimate) and cached — so the per-step cost is one dict
+        lookup. Must be called BEFORE invoking fns that donate their
+        arguments."""
+        cached = self._tele_flops_cache.get(key)
+        if cached is not None:
+            return cached
+        from ..telemetry import flops_of_compiled
+        try:
+            flops = flops_of_compiled(fn, *args)
+        except Exception as err:  # noqa: BLE001 - never perturb the step
+            logger.info("telemetry: cost_analysis unavailable for %r (%s)",
+                        key, err)
+            flops = 0.0
+        self._tele_flops_cache[key] = flops
+        return flops
+
+    def _tele_add_flops(self, key, fn, *args):
+        """Accumulate ``fn``'s executed flops into the live step window
+        (no-op when telemetry is off) — the ONE accounting seam, also
+        used by runners that own their own jit caches (zero/stream.py's
+        ``_run``); the engine's window privates are never mutated from
+        another module."""
+        if self.telemetry is not None:
+            self._window_flops += self._tele_flops(key, fn, *args)
+
+    def _jit_priced(self, key, builder, *args, donate_argnums=(0,)):
+        """``_get_jit`` plus telemetry flops accounting in one place,
+        priced with ``args`` BEFORE the returned fn runs (it donates
+        them). Every jitted train path must obtain its fn through this
+        (zero/stream.py's ``_run`` is the offload twin) or
+        ``_window_flops`` silently undercounts and MFU deflates."""
+        fn = self._get_jit(key, builder, donate_argnums=donate_argnums)
+        self._tele_add_flops(key, fn, *args)
+        return fn
+
+    def _telemetry_wire(self):
+        """wire.py per-step bytes-on-wire estimate for the live config,
+        computed once (static across steps at fixed shapes)."""
+        if self._tele_wire == "unset":
+            try:
+                from .comm.wire import estimate_engine_comm_bytes
+                self._tele_wire = estimate_engine_comm_bytes(self)
+            except Exception as err:  # noqa: BLE001
+                logger.info("telemetry: wire estimate unavailable (%s)",
+                            err)
+                self._tele_wire = None
+        return self._tele_wire
+
+    def _telemetry_window_begin(self):
+        """Open the per-optimizer-step measurement window (wall clock,
+        token and flops accumulators) and advance the trace window."""
+        if self.telemetry is None:
+            return
+        self._window_t0 = time.time()
+        self._window_step = self.global_steps
+        self._window_tokens = 0
+        self._window_flops = 0.0
+        self.telemetry.on_step_begin(self._window_step)
+
+    def _telemetry_micro_begin(self, batch):
+        """Micro-path hook: open the window at the first micro of a
+        grad-accum window, and count this micro's tokens."""
+        if self.telemetry is None or self._mode != ROUTE_TRAIN:
+            return
+        if self.micro_steps % self.gradient_accumulation_steps() == 0:
+            self._telemetry_window_begin()
+        self._telemetry_add_tokens(batch)
+
+    def _telemetry_add_tokens(self, batch):
+        """Count the first input leaf's elements as this micro's tokens
+        (ids batches: batch x seq; the labels leaf is not re-counted)."""
+        if self.telemetry is None:
+            return
+        leaves = jax.tree_util.tree_leaves(batch)
+        if leaves:
+            shape = getattr(leaves[0], "shape", None)
+            self._window_tokens += int(np.prod(shape)) if shape else 1
+
+    def _telemetry_phases(self):
+        """The step's disjoint phase clocks: the synchronized micro
+        timers when wall_clock_breakdown is on, merged with the offload/
+        streamed phase dict when that path ran. Overlapping clocks are
+        excluded so phases stay disjoint: classic offload spans only the
+        optimizer apply (the step timer would double-bill it); the
+        STREAMED phase dict covers the whole step — fwd, bwd, and
+        transfers all run inside micro_step — so there the micro timers
+        are drained but not billed."""
+        phases = {}
+        offload = getattr(self, "offload_phase_times", None) or {}
+        streamed = self.stream_runner is not None
+        if self.wall_clock_breakdown():
+            for name in (FORWARD_MICRO_TIMER, BACKWARD_MICRO_TIMER,
+                         STEP_MICRO_TIMER):
+                t = self.timers.timers.get(name)
+                if t is not None and not t.started_:
+                    # drained on EVERY path so timer state stays
+                    # per-step; the value is only REPORTED where it is
+                    # not already covered (streamed phase dicts replace
+                    # the micro timers; the offload dict owns the step
+                    # phase — reporting both would double-bill the wall)
+                    val = t.elapsed(reset=True)
+                    if val > 0 and not streamed and not (
+                            offload and name == STEP_MICRO_TIMER):
+                        phases[name] = val
+        for key, val in offload.items():
+            phases[key] = phases.get(key, 0.0) + float(val)
+        return phases
+
+    def _telemetry_offload_stats(self):
+        if self.stream_runner is not None:
+            snap = self.stream_runner.transfer_snapshot()
+            self.stream_runner.reset_step_counters()
+            return snap
+        if self.host_state is not None:
+            occ = getattr(self, "h2d_bucket_occupancy", None)
+            return {
+                "h2d_batches": int(getattr(self, "h2d_batches", 0) or 0),
+                "work_chunks": int(getattr(self, "offload_work_chunks", 0)
+                                   or 0),
+                "bucket_occupancy": round(occ, 4) if occ else None,
+            }
+        return None
+
+    def _emit_train_telemetry(self, loss, pipe=None):
+        """Assemble and emit this optimizer step's StepRecord. NOTE:
+        reading grad_norm/overflow forces one device value fetch per
+        step on paths that otherwise defer it — part of telemetry's
+        documented <5% overhead budget (docs/telemetry.md)."""
+        tel = self.telemetry
+        if tel is None or self._window_t0 is None:
+            return
+        metrics = self._step_metrics or {}
+        grad_norm = metrics.get("grad_norm")
+        try:
+            grad_norm = None if grad_norm is None else float(grad_norm)
+        except Exception:  # noqa: BLE001
+            grad_norm = None
+        loss = None if loss is None else float(loss)
+        overflow = bool(metrics.get("overflow", False))
+        # the wall clock is read only AFTER the value fetches above:
+        # grad_norm/overflow are outputs of the step's jitted program on
+        # every device path, so on async backends the fetch blocks until
+        # the step actually finishes — otherwise step_time_s would price
+        # host dispatch only and overstate MFU/tokens-per-sec (paths with
+        # wall_clock_breakdown on are synced by the timers already)
+        dt = time.time() - self._window_t0
+        self._window_t0 = None
+        loss_scale = metrics.get("loss_scale")
+        loss_scale = float(loss_scale) if loss_scale is not None \
+            else float(self.state["scaler"].cur_scale)
+        # memory_breakdown's monitor mirror already polled memory_stats()
+        # this step; hand it over instead of polling every device twice
+        hbm = self._step_hbm
+        self._step_hbm = None
+        tel.emit_train_step(
+            step=self._window_step,
+            hbm=hbm,
+            step_time_s=dt,
+            loss=loss,
+            grad_norm=grad_norm,
+            loss_scale=loss_scale,
+            overflow=overflow,
+            skipped_steps=self.skipped_steps,
+            micro_steps=self.gradient_accumulation_steps(),
+            tokens_per_step=self._window_tokens,
+            model_flops_per_step=self._window_flops,
+            phases=self._telemetry_phases(),
+            wire=self._telemetry_wire(),
+            offload=self._telemetry_offload_stats(),
+            pipe=pipe)
+
     # -------------------------------------------------------------- train API
     def train(self, mode=True):
         self._mode = ROUTE_TRAIN if mode else "eval"
@@ -930,6 +1149,7 @@ class DeepSpeedEngine:
                 loss = self.stream_runner.eval_loss(batch)
                 self._last_loss = loss
                 return loss
+            self._telemetry_micro_begin(batch)
             if self.wall_clock_breakdown():
                 self.timers(FORWARD_MICRO_TIMER).start()
             self._rng, step_rng = jax.random.split(self._rng)
@@ -947,24 +1167,24 @@ class DeepSpeedEngine:
             self._last_loss = loss
             return loss
 
+        self._telemetry_micro_begin(batch)
         if self.wall_clock_breakdown():
             self.timers(FORWARD_MICRO_TIMER).start()
         self._rng, step_rng = jax.random.split(self._rng)
-        micro = self._get_jit("micro", self._micro_step_fn,
-                              donate_argnums=(0,))
+        micro = self._jit_priced("micro", self._micro_step_fn,
+                                 self.state, batch, step_rng,
+                                 self._pld_theta())
         if flops_profiler:
-            # cost-analyze the EXACT executable about to run (lowering and
-            # compile are cached by jax; cheap at unchanged shapes). Some
-            # jax builds only expose costs on the compiled object.
-            lowered = micro.lower(self.state, batch, step_rng,
-                                  self._pld_theta())
+            # cost-analyze the EXACT executable about to run, via the
+            # telemetry helper that owns the compiled-object fallback
+            from ..telemetry.collector import costs_of_compiled
             # actual profiled sequence length (per-module attribution must
             # price the run's shapes, not config.max_seq_len)
             leaf = jax.tree_util.tree_leaves(batch)[0]
             self._profile_seq = (int(leaf.shape[1])
                                  if getattr(leaf, "ndim", 0) >= 2 else None)
-            self._flops_costs = lowered.cost_analysis() or \
-                lowered.compile().cost_analysis() or {}
+            self._flops_costs = costs_of_compiled(
+                micro, self.state, batch, step_rng, self._pld_theta())
         self.state, loss = micro(self.state, batch, step_rng,
                                  self._pld_theta())
         if self.wall_clock_breakdown():
@@ -1028,6 +1248,8 @@ class DeepSpeedEngine:
             self._write_monitor_scalars(self._last_loss)
         if self.wall_clock_breakdown():
             self.timers(STEP_MICRO_TIMER).stop()
+        if boundary:
+            self._emit_train_telemetry(self._last_loss)
 
     def _write_monitor_scalars(self, loss):
         """Train/Samples/{lr,train_loss,loss_scale} at each global step
@@ -1042,6 +1264,20 @@ class DeepSpeedEngine:
         self.monitor.add_scalar("Train/Samples/loss_scale",
                                 float(self._step_metrics["loss_scale"]),
                                 self.global_samples)
+        if self.memory_breakdown():
+            # memory_breakdown wired to PER-STEP HBM reporting (telemetry
+            # records always carry hbm; this mirrors it into the monitor
+            # stream). Unavailable backends warned/raised at engine init.
+            from ..telemetry.collector import collect_memory_stats
+            stats = collect_memory_stats()
+            self._step_hbm = stats  # reused by this step's StepRecord
+            if stats["available"]:
+                self.monitor.add_scalar("Train/Samples/hbm_bytes_in_use",
+                                        stats["bytes_in_use"],
+                                        self.global_samples)
+                self.monitor.add_scalar(
+                    "Train/Samples/hbm_peak_bytes_in_use",
+                    stats["peak_bytes_in_use"], self.global_samples)
         self.monitor.flush()
 
     def _offload_check_fn(self):
@@ -1222,6 +1458,7 @@ class DeepSpeedEngine:
                 t0 = _time.time()
                 uploaded = batcher.finish()
                 self.h2d_batches = batcher.batches
+                self.h2d_bucket_occupancy = batcher.occupancy()
                 for i, sharding in enumerate(acc_shardings):
                     flat_params[i] = self._assemble_uploaded_leaf(
                         uploaded, i, acc_specs[i][0], sharding)
@@ -1517,8 +1754,8 @@ class DeepSpeedEngine:
         elif self.host_state is not None:
             metrics = self._host_apply_step()
         else:
-            apply_fn = self._get_jit("apply", self._apply_step_fn,
-                                     donate_argnums=(0,))
+            apply_fn = self._jit_priced("apply", self._apply_step_fn,
+                                        self.state, self._hyper())
             self.state, metrics = apply_fn(self.state, self._hyper())
         self._step_metrics = {k: v for k, v in metrics.items()}
         overflow = self._read_overflow(metrics)
@@ -1550,6 +1787,7 @@ class DeepSpeedEngine:
             micro_batches = [next(data_iter) for _ in range(gas)]
             batch = jax.tree_util.tree_map(
                 lambda *xs: np.stack(xs), *micro_batches)
+        self._telemetry_window_begin()
         if self.stream_runner is not None:
             # streamed parameter offload: the micro-steps stream layer
             # groups host->HBM; there is no fused lax.scan (params never
@@ -1558,25 +1796,31 @@ class DeepSpeedEngine:
             for i in range(gas):
                 micro = jax.tree_util.tree_map(
                     lambda x: np.asarray(x)[i], batch)
+                dev_micro = self._to_device(tuple(
+                    jax.tree_util.tree_leaves(micro)))
+                self._telemetry_add_tokens(dev_micro)
                 self._rng, step_rng = jax.random.split(self._rng)
-                losses.append(self.stream_runner.micro_step(
-                    self._to_device(tuple(
-                        jax.tree_util.tree_leaves(micro))), step_rng))
+                losses.append(self.stream_runner.micro_step(dev_micro,
+                                                            step_rng))
             mean_loss = float(np.mean([float(x) for x in losses]))
             metrics = self._stream_apply_step()
         elif self.host_state is not None:
             batch = self._to_device_stacked(batch)
+            self._telemetry_add_tokens(batch)
             self._rng, step_rng = jax.random.split(self._rng)
-            fused = self._get_jit("fused_micros", self._fused_micros_fn,
-                                  donate_argnums=(0,))
+            fused = self._jit_priced("fused_micros", self._fused_micros_fn,
+                                     self.state, batch, step_rng,
+                                     self._pld_theta())
             self.state, mean_loss = fused(self.state, batch, step_rng,
                                           self._pld_theta())
             metrics = self._host_apply_step()
         else:
             batch = self._to_device_stacked(batch)
+            self._telemetry_add_tokens(batch)
             self._rng, step_rng = jax.random.split(self._rng)
-            fused = self._get_jit("fused_train", self._fused_train_fn,
-                                  donate_argnums=(0,))
+            fused = self._jit_priced("fused_train", self._fused_train_fn,
+                                     self.state, batch, step_rng,
+                                     self._hyper(), self._pld_theta())
             self.state, (mean_loss, metrics) = fused(
                 self.state, batch, step_rng, self._hyper(),
                 self._pld_theta())
@@ -1595,6 +1839,7 @@ class DeepSpeedEngine:
         self._step_metrics = metrics
         self._last_loss = mean_loss
         self._write_monitor_scalars(mean_loss)
+        self._emit_train_telemetry(mean_loss)
         return mean_loss
 
     def _to_device_stacked(self, batch):

@@ -672,6 +672,34 @@ class PipelineEngine(DeepSpeedEngine):
 
         return fused
 
+    def _pipe_telemetry_stats(self, step_time_s=None):
+        """Pipeline section of the StepRecord: schedule-derived cycle
+        counts and the EXECUTED bubble fraction ((S-1)/(vM) — the
+        warmup/drain cycles each run only half a steady cycle's phases),
+        plus a per-cycle wall estimate when the step was timed. The pipe
+        loop is ONE jitted SPMD program, so per-stage wall inside it is
+        not separately observable; cycle counts x cycle time is the
+        honest per-stage attribution."""
+        v, tabs = self._pipe_tables()
+        T = tabs["total_cycles"]
+        WE = tabs["warmup_end"]
+        SE = tabs["steady_end"]
+        S = self.num_stages
+        M = self.micro_batches
+        out = {
+            "num_stages": S,
+            "micro_batches": M,
+            "num_virtual": v,
+            "total_cycles": int(T),
+            "warmup_cycles": int(WE),
+            "steady_cycles": int(SE - WE),
+            "drain_cycles": int(T - SE),
+            "bubble_fraction": round((S - 1) / float(v * M), 6),
+        }
+        if step_time_s:
+            out["cycle_time_s"] = round(step_time_s / T, 6) if T else None
+        return out
+
     def _stack_microbatches(self, data_iter):
         micro = [next(data_iter) for _ in range(self.micro_batches)]
         inputs = jax.tree_util.tree_map(lambda *xs: np.stack(xs),
@@ -686,20 +714,23 @@ class PipelineEngine(DeepSpeedEngine):
         if batch is None:
             assert data_iter is not None
             batch = self._stack_microbatches(data_iter)
+        self._telemetry_window_begin()
         batch = self._to_device_stacked(batch)
+        self._telemetry_add_tokens(batch)
 
         self._rng, step_rng = jax.random.split(self._rng)
         if self.host_state is not None:
             # ZeRO-Offload under pipelines: jit only the pipe loop's
             # grad accumulation; the optimizer step runs on host
             # (shard-wise D2H/H2D, same as the base engine's offload path)
-            micros = self._get_jit("pipe_micros", self._pipe_grads_fn,
-                                   donate_argnums=(0,))
+            micros = self._jit_priced("pipe_micros", self._pipe_grads_fn,
+                                      self.state, batch, step_rng)
             self.state, mean_loss = micros(self.state, batch, step_rng)
             metrics = self._host_apply_step()
         else:
-            fused = self._get_jit("pipe_train", self._fused_train_fn,
-                                  donate_argnums=(0,))
+            fused = self._jit_priced("pipe_train", self._fused_train_fn,
+                                     self.state, batch, step_rng,
+                                     self._hyper())
             self.state, (mean_loss, metrics) = fused(self.state, batch,
                                                      step_rng, self._hyper())
         overflow = bool(metrics["overflow"])
@@ -713,6 +744,12 @@ class PipelineEngine(DeepSpeedEngine):
         self._step_metrics = metrics
         self._last_loss = mean_loss
         self._write_monitor_scalars(mean_loss)
+        if self.telemetry is not None and self._window_t0 is not None:
+            import time as _time
+            self._emit_train_telemetry(
+                mean_loss,
+                pipe=self._pipe_telemetry_stats(
+                    _time.time() - self._window_t0))
         return mean_loss
 
     def eval_batch(self, data_iter=None, batch=None):

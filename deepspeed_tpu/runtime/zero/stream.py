@@ -85,6 +85,11 @@ class StreamedOffloadRunner:
         self._micro_sumsqs = []
         self._micros_in_step = 0
         self.phase_times = {}
+        # per-step upload accounting for telemetry (transfer_snapshot):
+        # bucket occupancy + live-param upload volume, T3-style
+        self._step_upload_batches = 0
+        self._step_upload_elems = 0
+        self._segment_upload_bytes_peak = 0
         self._plan_groups()
 
     # ------------------------------------------------------------ planning
@@ -156,6 +161,13 @@ class StreamedOffloadRunner:
                 shape, self._replicated, singles))
         self.phase_times["h2d_wait_s"] = \
             self.phase_times.get("h2d_wait_s", 0.0) + (time.time() - t0)
+        # upload accounting (per device replica; telemetry snapshot)
+        elems = sum(int(np.prod(s)) if s else 1 for s in shapes)
+        self._step_upload_batches += batcher.batches
+        self._step_upload_elems += elems * len(self._devices)
+        self._segment_upload_bytes_peak = max(
+            self._segment_upload_bytes_peak,
+            elems * self.cdtype.itemsize)
         return tuple(out)
 
     # ------------------------------------------------------------ jit fns
@@ -163,6 +175,52 @@ class StreamedOffloadRunner:
         if key not in self._jit_cache:
             self._jit_cache[key] = jax.jit(builder())
         return self._jit_cache[key]
+
+    def _run(self, key, builder, *args):
+        """Invoke one streamed-segment program, accumulating its
+        cost_analysis flops into the engine's step window when telemetry
+        is live (cached per key — one lowering, then a dict lookup)."""
+        fn = self._jit(key, builder)
+        self.engine._tele_add_flops(("stream",) + tuple(key), fn, *args)
+        return fn(*args)
+
+    def transfer_snapshot(self):
+        """Per-step upload/overlap stats for the telemetry record
+        (T3-style: how much of the step's wall the host<->HBM transfers
+        could not hide behind compute) + bucket occupancy of the
+        coalesced H2D batcher. Read-only — safe as a debugging probe;
+        the telemetry emit path resets the per-step counters afterwards
+        via reset_step_counters()."""
+        eng = self.engine
+        phases = getattr(eng, "offload_phase_times", None) or {}
+        compute = sum(phases.get(k, 0.0) for k in
+                      ("compute_fwd_s", "compute_bwd_s", "host_adam_s"))
+        waits = sum(phases.get(k, 0.0) for k in
+                    ("h2d_wait_s", "d2h_grads_s"))
+        bucket_elems = eng._h2d_bucket_elems
+        batches = self._step_upload_batches
+        snap = {
+            "upload_batches": batches,
+            "upload_elems": self._step_upload_elems,
+            "upload_bytes": self._step_upload_elems *
+            self.cdtype.itemsize,
+            "segment_upload_bytes_peak": self._segment_upload_bytes_peak,
+            "bucket_elems": bucket_elems,
+            "bucket_occupancy": round(
+                self._step_upload_elems / (batches * bucket_elems), 4)
+            if batches and bucket_elems else None,
+            "overlap_efficiency": round(compute / (compute + waits), 4)
+            if (compute + waits) > 0 else None,
+            "groups": len(self.groups),
+        }
+        return snap
+
+    def reset_step_counters(self):
+        """Open the next step's upload-accounting window (called by the
+        telemetry emit path after it embeds transfer_snapshot())."""
+        self._step_upload_batches = 0
+        self._step_upload_elems = 0
+        self._segment_upload_bytes_peak = 0
 
     @staticmethod
     def _pack_grads(grad_leaves, finite, sumsq):
@@ -388,9 +446,9 @@ class StreamedOffloadRunner:
         e_dev = self._finish_upload(pending)
         pending = self._start_upload(self._group_leaves(0)) if G else None
         key0 = keys_all[0] if has_rng else None
-        embed_fwd = self._jit(("e_fwd", has_rng),
-                              lambda: self._embed_fwd_fn(e_def, has_rng))
-        x = embed_fwd(tuple(e_dev), batch, key0)
+        x = self._run(("e_fwd", has_rng),
+                      lambda: self._embed_fwd_fn(e_def, has_rng),
+                      tuple(e_dev), batch, key0)
         del e_dev
         acts = [x]
         group_devs = [None] * G
@@ -402,11 +460,11 @@ class StreamedOffloadRunner:
                 pending = self._start_upload(self._h_leaves)
             start, stop = self.groups[g]
             gkeys = keys_all[start:stop] if has_rng else None
-            fwd = self._jit(
+            x = self._run(
                 ("g_fwd", tuple(b_defs[start:stop]), has_rng),
                 lambda: self._group_fwd_fn(tuple(b_defs[start:stop]),
-                                           has_rng))
-            x = fwd(dev_g, x, gkeys)
+                                           has_rng),
+                dev_g, x, gkeys)
             acts.append(x)
             if g == G - 1:
                 group_devs[g] = dev_g  # reuse for the first backward
@@ -420,11 +478,10 @@ class StreamedOffloadRunner:
         w0 = self.phase_times.get("h2d_wait_s", 0.0)
         t_bwd = time.time()
         h_dev = self._finish_upload(pending)
-        head_grad = self._jit(
+        loss, dx, h_packed = self._run(
             ("h_grad", has_rng),
-            lambda: self._head_grad_fn(h_def, has_rng))
-        loss, dx, h_packed = head_grad(tuple(h_dev), acts[-1], batch,
-                                       key0, scale, inv_scale)
+            lambda: self._head_grad_fn(h_def, has_rng),
+            tuple(h_dev), acts[-1], batch, key0, scale, inv_scale)
         del h_dev
         self._queue_grad_fetch(
             h_packed, self._h_slots,
@@ -444,11 +501,11 @@ class StreamedOffloadRunner:
                     if pending is None else pending
             start, stop = self.groups[g]
             gkeys = keys_all[start:stop] if has_rng else None
-            bwd = self._jit(
+            dx, g_packed = self._run(
                 ("g_bwd", tuple(b_defs[start:stop]), has_rng),
                 lambda: self._group_bwd_fn(tuple(b_defs[start:stop]),
-                                           has_rng))
-            dx, g_packed = bwd(bl, acts[g], dx, gkeys, inv_scale)
+                                           has_rng),
+                bl, acts[g], dx, gkeys, inv_scale)
             del bl
             acts[g + 1] = None
             slot_idxs = [s for i in range(start, stop)
@@ -459,10 +516,10 @@ class StreamedOffloadRunner:
                 pending = self._start_upload(self._e_leaves)
         e_dev = self._finish_upload(pending) if pending is not None \
             else self._finish_upload(self._start_upload(self._e_leaves))
-        embed_bwd = self._jit(
+        e_packed = self._run(
             ("e_bwd", has_rng),
-            lambda: self._embed_bwd_fn(e_def, has_rng))
-        e_packed = embed_bwd(tuple(e_dev), batch, dx, key0, inv_scale)
+            lambda: self._embed_bwd_fn(e_def, has_rng),
+            tuple(e_dev), batch, dx, key0, inv_scale)
         del e_dev, dx
         self._queue_grad_fetch(
             e_packed, self._e_slots,
@@ -564,6 +621,19 @@ class StreamedOffloadRunner:
     # -------------------------------------------------------------- eval
     def eval_loss(self, batch):
         """Streamed forward-only loss (dropout off)."""
+        # _finish_upload bills h2d waits and the per-step upload
+        # counters; an eval between optimizer steps must not leak them
+        # into the NEXT train record's phases/transfer stats
+        saved = (dict(self.phase_times), self._step_upload_batches,
+                 self._step_upload_elems, self._segment_upload_bytes_peak)
+        try:
+            return self._eval_loss(batch)
+        finally:
+            (self.phase_times, self._step_upload_batches,
+             self._step_upload_elems,
+             self._segment_upload_bytes_peak) = saved
+
+    def _eval_loss(self, batch):
         self._bind()
         e_def, b_defs, h_def = self._e_def, self._b_defs, self._h_def
         embed, group, head = self._eval_fn(e_def, b_defs, h_def)

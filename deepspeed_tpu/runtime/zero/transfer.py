@@ -71,13 +71,24 @@ class H2DBatcher:
         self._futures = []
         self._results = {}      # key -> {device: array}
         self.batches = 0        # device_put count (observable under test)
+        self.elems = 0          # total elements queued (bucket occupancy
+                                # = elems / (batches * bucket_elems))
 
     def add(self, key, host_array, device):
         self._pending.setdefault(device, []).append((key, host_array))
+        self.elems += int(host_array.size)
         n = self._pending_elems.get(device, 0) + int(host_array.size)
         self._pending_elems[device] = n
         if n >= self.bucket_elems:
             self._flush_device(device)
+
+    def occupancy(self):
+        """Mean fill fraction of the flushed buckets (telemetry: how
+        well ``stage3_prefetch_bucket_size`` matches the workload; can
+        exceed 1.0 when one queued array alone overflows a bucket)."""
+        if not self.batches or not self.bucket_elems:
+            return None
+        return self.elems / (self.batches * self.bucket_elems)
 
     def _flush_device(self, device):
         items = self._pending.pop(device, [])

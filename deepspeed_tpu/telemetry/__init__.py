@@ -1,0 +1,12 @@
+"""Unified per-step telemetry: StepRecords, sinks, MFU math, xprof
+trace windows (docs/telemetry.md)."""
+from .collector import (TelemetryCollector, collect_memory_stats,
+                        costs_of_compiled, flops_of_compiled)
+from .config import DeepSpeedTelemetryConfig, TELEMETRY
+from .mfu import PEAK_TFLOPS, mfu_of, peak_flops_for
+from .record import (KIND_SERVING, KIND_TRAIN, SERVING_STEP_KEYS,
+                     TRAIN_STEP_KEYS, make_serving_record,
+                     make_train_record, validate_step_record)
+from .sinks import (JsonlSink, TelemetrySinks, TensorBoardSink,
+                    WindowAggregator)
+from .trace import TraceWindow

@@ -1,0 +1,251 @@
+"""TelemetryCollector: assembles one StepRecord per optimizer step and
+fans it through the sink layer.
+
+Owned by the training engine (``engine.telemetry``), the pipeline
+engine, and the inference engine; ``None`` when the ``telemetry``
+config section is absent/disabled, so the hot paths pay literally one
+``is not None`` check — zero overhead off. Enabled, the per-step cost
+is a handful of ``time.time()`` reads, one ``memory_stats()`` poll, one
+JSON line, and (once per compiled program) an XLA ``cost_analysis``
+lowering — documented with measured numbers in docs/telemetry.md and
+tests/perf/bench_telemetry_overhead.py."""
+import os
+
+from ..utils.lifecycle import AtexitCloseMixin
+from ..utils.logging import logger
+from . import record as rec_mod
+from .mfu import mfu_of, peak_flops_for
+from .sinks import (JsonlSink, TelemetrySinks, TensorBoardSink,
+                    WindowAggregator)
+from .trace import TraceWindow
+
+JSONL_NAME = "telemetry.jsonl"
+
+# output dirs claimed by LIVE collectors in this process: an explicit
+# telemetry.job_name would otherwise point a train and a serving engine
+# sharing one ds_config at the SAME telemetry.jsonl, breaking the
+# "keeps multi-engine files apart" contract (released by close())
+_claimed_dirs = set()
+
+
+def costs_of_compiled(fn, *args):
+    """Full XLA ``cost_analysis`` dict of a jitted callable for ``args``
+    (exact for the program about to run). Some jax builds only expose
+    costs on the compiled object — the one home for that fallback (the
+    flops profiler and the telemetry collector both read it). Returns
+    ``{}`` when the backend exposes no costs."""
+    lowered = fn.lower(*args)
+    costs = lowered.cost_analysis()
+    if isinstance(costs, list):
+        costs = costs[0] if costs else {}
+    if not costs:
+        # LOUD: this AOT compile is NOT shared with the jit dispatch
+        # cache, so on builds that only expose costs on the compiled
+        # object each program is compiled twice when telemetry is on —
+        # a real startup cost on big models that the <5% step-time
+        # budget does not cover (it only prices the steady state)
+        logger.info(
+            "telemetry: lowered cost_analysis empty; compiling the "
+            "program a second time (AOT) to price its flops — expect "
+            "extra one-time compile latency per program")
+        costs = lowered.compile().cost_analysis()
+        if isinstance(costs, list):
+            costs = costs[0] if costs else {}
+        if costs:
+            # the compiled executable is ONE SPMD partition, so its
+            # extensive costs (flops, transcendentals, bytes accessed)
+            # are per device, while lower()'s module has global shapes —
+            # normalize ALL of them to the global scale every consumer
+            # expects (mfu_of divides by n_devices; the flops profiler
+            # reads flops AND "bytes accessed", which must share a
+            # scale or its arithmetic intensity is off by n)
+            try:
+                import jax
+                n = jax.device_count()
+            except Exception:  # noqa: BLE001
+                n = 1
+            if n > 1:
+                costs = {k: (float(v) * n
+                             if k in ("flops", "transcendentals")
+                             or k.startswith("bytes accessed") else v)
+                         for k, v in costs.items()}
+    return costs or {}
+
+
+def flops_of_compiled(fn, *args):
+    """Executed-program flops of a jitted callable for ``args``; 0.0
+    when the backend exposes no costs."""
+    return float(costs_of_compiled(fn, *args).get("flops", 0.0) or 0.0)
+
+
+def collect_memory_stats():
+    """Per-process HBM live/peak from ``memory_stats()``: max over the
+    local devices (the governing chip). ``available=False`` when the
+    backend exposes none (e.g. XLA:CPU)."""
+    out = {"available": False, "bytes_in_use": None,
+           "peak_bytes_in_use": None}
+    try:
+        import jax
+        live = peak = None
+        for dev in jax.local_devices():
+            stats = dev.memory_stats() or None
+            if not stats:
+                continue
+            b = int(stats.get("bytes_in_use", 0))
+            p = int(stats.get("peak_bytes_in_use", b))
+            live = b if live is None else max(live, b)
+            peak = p if peak is None else max(peak, p)
+        if live is not None:
+            out = {"available": True, "bytes_in_use": live,
+                   "peak_bytes_in_use": peak}
+    except Exception:  # noqa: BLE001 - never perturb the step
+        pass
+    return out
+
+
+class TelemetryCollector(AtexitCloseMixin):
+
+    def __init__(self, tconfig, job_name="train", monitor=None):
+        self.config = tconfig
+        def claim_key(n):
+            # normalized so two spellings of one directory ("runs/t",
+            # "./runs/t/", an absolute path) cannot slip past the guard
+            # and interleave two engines' records in one JSONL
+            return os.path.abspath(os.path.join(tconfig.output_path, n))
+
+        base = tconfig.job_name or job_name
+        name = base
+        if claim_key(name) in _claimed_dirs:
+            # second engine colliding under one name: suffix the engine
+            # role first (explicit shared job_name), then number — every
+            # live collector keeps its own JSONL
+            if tconfig.job_name and job_name != base:
+                base = "{}-{}".format(tconfig.job_name, job_name)
+            name, n = base, 2
+            while claim_key(name) in _claimed_dirs:
+                name = "{}-{}".format(base, n)
+                n += 1
+            logger.info(
+                "telemetry: job_name %r already claimed by a live "
+                "collector in this process — writing as %r to keep the "
+                "JSONLs apart", tconfig.job_name or job_name, name)
+        self.job_name = name
+        self.output_dir = os.path.join(tconfig.output_path, self.job_name)
+        self._claim_key = claim_key(name)
+        _claimed_dirs.add(self._claim_key)
+        self.jsonl_path = os.path.join(self.output_dir, JSONL_NAME)
+        self.aggregator = WindowAggregator(tconfig.window)
+        sinks = [JsonlSink(self.jsonl_path), self.aggregator]
+        tb = TensorBoardSink(monitor)
+        if tb.live:
+            sinks.append(tb)
+        self.sinks = TelemetrySinks(sinks)
+        self.trace = None
+        if tconfig.trace_enabled:
+            self.trace = TraceWindow(
+                tconfig.trace_output_path or
+                os.path.join(self.output_dir, "trace"),
+                start_step=tconfig.trace_start_step,
+                num_steps=tconfig.trace_num_steps,
+                trigger_file=tconfig.trace_trigger_file)
+        try:
+            import jax
+            self._device = getattr(jax.devices()[0], "device_kind", "cpu")
+            self._n_devices = jax.device_count()
+        except Exception:  # noqa: BLE001
+            self._device = "cpu"
+            self._n_devices = 1
+        self.peak_flops_per_chip = peak_flops_for(self._device)
+        # same lifecycle contract as SummaryMonitor (utils/lifecycle.py):
+        # the exit handler closes an active trace window and the JSONL
+        # handle at process end, deregistered by close()
+        self._register_atexit_close()
+        logger.info("telemetry: records -> %s (window=%d%s)",
+                    self.jsonl_path, tconfig.window,
+                    ", xprof trace armed" if self.trace else "")
+
+    @classmethod
+    def from_config(cls, config, job_name="train", monitor=None,
+                    enabled=True):
+        """``None`` unless the config's telemetry section is enabled and
+        this process is the writer — the zero-overhead-off contract."""
+        return cls.from_section(getattr(config, "telemetry_config", None),
+                                job_name=job_name, monitor=monitor,
+                                enabled=enabled)
+
+    @classmethod
+    def from_section(cls, tconfig, job_name="train", monitor=None,
+                     enabled=True):
+        """The ONE home for the enable/writer gate (training and serving
+        both route through it): ``None`` unless the section exists, is
+        enabled, and ``enabled`` (the caller's writer-process check)
+        holds."""
+        if tconfig is None or not tconfig.enabled or not enabled:
+            return None
+        return cls(tconfig, job_name=job_name, monitor=monitor)
+
+    # ------------------------------------------------------------- hooks
+    def on_step_begin(self, step):
+        if self.trace is not None:
+            self.trace.on_step_begin(step)
+
+    def emit_train_step(self, *, step, step_time_s, loss, grad_norm,
+                        loss_scale, overflow, skipped_steps, micro_steps,
+                        tokens_per_step, model_flops_per_step, phases,
+                        wire=None, offload=None, pipe=None, hbm=None):
+        n = max(self._n_devices, 1)
+        dt = max(float(step_time_s), 1e-12)
+        rec = rec_mod.make_train_record(
+            step=step, step_time_s=step_time_s, loss=loss,
+            grad_norm=grad_norm, loss_scale=loss_scale, overflow=overflow,
+            skipped_steps=skipped_steps, micro_steps=micro_steps,
+            tokens_per_step=tokens_per_step,
+            tokens_per_sec_per_chip=float(tokens_per_step) / dt / n,
+            model_flops_per_step=model_flops_per_step,
+            mfu=mfu_of(model_flops_per_step, dt, n,
+                       self.peak_flops_per_chip),
+            peak_flops_per_chip=self.peak_flops_per_chip,
+            device=self._device, n_devices=n,
+            phases=phases,
+            hbm=hbm if hbm is not None else collect_memory_stats(),
+            wire=wire, offload=offload, pipe=pipe)
+        self.sinks.emit(rec)
+        if self.trace is not None:
+            self.trace.on_step_end(step)
+        return rec
+
+    def emit_serving_step(self, *, step, metrics, active_slots,
+                          queue_depth, occupancy):
+        rec = rec_mod.make_serving_record(
+            step=step, slot_occupancy=occupancy, queue_depth=queue_depth,
+            active_slots=active_slots,
+            prefill_tokens=metrics.prefill_tokens,
+            prefill_tokens_per_sec=metrics.prefill_tokens_per_sec,
+            decode_tokens=metrics.decode_tokens,
+            decode_steps=metrics.decode_steps,
+            decode_tokens_per_sec=metrics.decode_tokens_per_sec)
+        self.sinks.emit(rec)
+        if self.trace is not None:
+            # on_step_begin ran at the top of the scheduler step (the
+            # window must wrap the decode work, not follow it)
+            self.trace.on_step_end(step)
+        return rec
+
+    def snapshot(self):
+        """Rolling-window aggregate (see sinks.WindowAggregator) — the
+        payload of ``engine.telemetry_snapshot()`` and of the benches'
+        ``extra.telemetry``."""
+        out = self.aggregator.snapshot()
+        if self.trace is not None:
+            out["trace_windows_completed"] = self.trace.windows_completed
+        return out
+
+    def close(self):
+        """Idempotent: the first call stops any active trace window,
+        closes the sinks, and drops the atexit registration."""
+        if self._finish_close():
+            return
+        if self.trace is not None:
+            self.trace.close()
+        self.sinks.close()
+        _claimed_dirs.discard(self._claim_key)

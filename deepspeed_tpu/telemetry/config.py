@@ -1,0 +1,149 @@
+"""``telemetry`` ds_config section.
+
+Validated with the same no-silent-no-ops policy as PR 4's stage-3 keys:
+every key either drives a mechanism or is loudly rejected; unknown keys
+inside the section (including the nested ``trace`` block) warn, and
+raise when ``telemetry.strict`` is set. ``telemetry.strict`` also
+hardens related observability keys elsewhere in the config — e.g.
+``memory_breakdown`` raises instead of warning when the backend exposes
+no ``memory_stats()``.
+
+Shape::
+
+    "telemetry": {
+      "enabled": true,
+      "output_path": "runs/telemetry",   // JSONL + trace root
+      "job_name": "train",               // subdir; keeps multi-engine files apart
+      "window": 50,                      // rolling-aggregate window (p50/p95)
+      "strict": false,                   // unknown/unhonorable keys raise
+      "trace": {                         // on-demand xprof windows
+        "start_step": 10,                // null = only the trigger file arms it
+        "num_steps": 2,
+        "trigger_file": null,            // touch this path -> trace next window
+        "output_path": null              // default <output_path>/<job>/trace
+      }
+    }
+"""
+from ..utils.logging import logger
+
+
+def warn_or_raise_noop(msg, strict, flag="telemetry.strict"):
+    """The no-silent-no-ops policy, in one place: a config key this
+    runtime cannot honor warns loudly, and raises when the section's
+    strict flag is set. Shared by the telemetry section, the engine's
+    memory_breakdown check, and the zero_optimization key validator."""
+    if strict:
+        raise ValueError(msg + " (raising because {}=true)".format(flag))
+    logger.warning(msg)
+
+TELEMETRY = "telemetry"
+
+TELEMETRY_ENABLED = "enabled"
+TELEMETRY_ENABLED_DEFAULT = False
+TELEMETRY_OUTPUT_PATH = "output_path"
+TELEMETRY_OUTPUT_PATH_DEFAULT = "runs/telemetry"
+TELEMETRY_JOB_NAME = "job_name"
+TELEMETRY_WINDOW = "window"
+TELEMETRY_WINDOW_DEFAULT = 50
+TELEMETRY_STRICT = "strict"
+TELEMETRY_TRACE = "trace"
+
+TRACE_START_STEP = "start_step"
+TRACE_NUM_STEPS = "num_steps"
+TRACE_NUM_STEPS_DEFAULT = 1
+TRACE_TRIGGER_FILE = "trigger_file"
+TRACE_OUTPUT_PATH = "output_path"
+
+KNOWN_TELEMETRY_KEYS = {
+    TELEMETRY_ENABLED, TELEMETRY_OUTPUT_PATH, TELEMETRY_JOB_NAME,
+    TELEMETRY_WINDOW, TELEMETRY_STRICT, TELEMETRY_TRACE,
+}
+KNOWN_TRACE_KEYS = {
+    TRACE_START_STEP, TRACE_NUM_STEPS, TRACE_TRIGGER_FILE,
+    TRACE_OUTPUT_PATH,
+}
+
+
+class DeepSpeedTelemetryConfig(object):
+    """Typed view of the ``telemetry`` section of a ds_config dict."""
+
+    def __init__(self, param_dict):
+        d = (param_dict or {}).get(TELEMETRY, {})
+        if d is None:
+            d = {}
+        if not isinstance(d, dict):
+            raise ValueError(
+                "telemetry section must be a dict, got {}".format(
+                    type(d).__name__))
+        self.strict = bool(d.get(TELEMETRY_STRICT, False))
+        self._reject_unknown(d, KNOWN_TELEMETRY_KEYS, TELEMETRY)
+
+        self.enabled = bool(d.get(TELEMETRY_ENABLED,
+                                  TELEMETRY_ENABLED_DEFAULT))
+        self.output_path = d.get(TELEMETRY_OUTPUT_PATH) or None
+        if self.enabled and not self.output_path:
+            # like the monitor's ./runs default: never silently drop
+            # records the user asked for
+            self.output_path = TELEMETRY_OUTPUT_PATH_DEFAULT
+            logger.info("telemetry enabled with no output_path; writing "
+                        "to ./%s", self.output_path)
+        self.job_name = d.get(TELEMETRY_JOB_NAME) or None
+
+        window = d.get(TELEMETRY_WINDOW, TELEMETRY_WINDOW_DEFAULT)
+        if isinstance(window, bool) or not isinstance(window, int) or \
+                window < 1:
+            raise ValueError(
+                "telemetry.{} must be an int >= 1, got {!r}".format(
+                    TELEMETRY_WINDOW, window))
+        self.window = window
+
+        trace = d.get(TELEMETRY_TRACE)
+        self.trace_enabled = trace is not None
+        self.trace_start_step = None
+        self.trace_num_steps = TRACE_NUM_STEPS_DEFAULT
+        self.trace_trigger_file = None
+        self.trace_output_path = None
+        if trace is not None:
+            if not isinstance(trace, dict):
+                raise ValueError(
+                    "telemetry.trace must be a dict, got {}".format(
+                        type(trace).__name__))
+            self._reject_unknown(trace, KNOWN_TRACE_KEYS,
+                                 "telemetry.trace")
+            start = trace.get(TRACE_START_STEP)
+            if start is not None and (isinstance(start, bool) or
+                                      not isinstance(start, int) or
+                                      start < 0):
+                raise ValueError(
+                    "telemetry.trace.{} must be an int >= 0 or null, got "
+                    "{!r}".format(TRACE_START_STEP, start))
+            self.trace_start_step = start
+            num = trace.get(TRACE_NUM_STEPS, TRACE_NUM_STEPS_DEFAULT)
+            if isinstance(num, bool) or not isinstance(num, int) or num < 1:
+                raise ValueError(
+                    "telemetry.trace.{} must be an int >= 1, got "
+                    "{!r}".format(TRACE_NUM_STEPS, num))
+            self.trace_num_steps = num
+            self.trace_trigger_file = trace.get(TRACE_TRIGGER_FILE) or None
+            self.trace_output_path = trace.get(TRACE_OUTPUT_PATH) or None
+            if self.trace_start_step is None and \
+                    self.trace_trigger_file is None:
+                self._noop(
+                    "trace",
+                    "neither start_step nor trigger_file is set, so the "
+                    "window can never arm")
+
+    def _reject_unknown(self, d, known, section):
+        unknown = sorted(k for k in d if k not in known)
+        if unknown:
+            self._noop(
+                ", ".join(unknown),
+                "unknown key(s) in the {!r} section (accepted: {})".format(
+                    section, sorted(known)))
+
+    def _noop(self, key, why):
+        """A telemetry key this runtime cannot honor: warn loudly, raise
+        under telemetry.strict — never a silent no-op (the PR 4 stage-3
+        key policy, docs/telemetry.md)."""
+        warn_or_raise_noop(
+            "telemetry.{} has NO effect: {}".format(key, why), self.strict)

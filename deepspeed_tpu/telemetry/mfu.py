@@ -1,0 +1,37 @@
+"""Peak-flops tables and MFU arithmetic.
+
+One home for the per-chip peak numbers every surface reads (bench.py,
+bench_inference.py, the per-step telemetry records): public
+cloud.google.com/tpu specs, bf16 peak TFLOPS per chip (v2/v3 per-chip =
+2 cores). The CPU entry is a nominal 0.1 TFLOPS so CPU-rung MFU numbers
+stay nonzero and comparable across runs of the same box, never
+meaningful in absolute terms.
+"""
+
+PEAK_TFLOPS = {
+    "TPU v2": 45.0, "TPU v3": 123.0, "TPU v4": 275.0,
+    "TPU v5 lite": 197.0, "TPU v5e": 197.0, "TPU v5": 459.0,
+    "TPU v5p": 459.0, "TPU v6 lite": 918.0, "TPU v6e": 918.0,
+    "cpu": 0.1,
+}
+
+
+def peak_flops_for(device):
+    """Peak flops/s for one chip of ``device`` (a jax Device or a
+    device-kind string); unknown kinds get the CPU nominal."""
+    kind = device if isinstance(device, str) \
+        else getattr(device, "device_kind", "cpu")
+    for name, tf in PEAK_TFLOPS.items():
+        if kind.lower().startswith(name.lower()):
+            return tf * 1e12
+    return 0.1e12
+
+
+def mfu_of(flops_per_step, step_time_s, n_devices, peak_flops_per_chip):
+    """Achieved model-flops utilization: executed flops rate per chip
+    over the chip's peak. Returns 0.0 on degenerate inputs."""
+    if not flops_per_step or not step_time_s or step_time_s <= 0 or \
+            not peak_flops_per_chip:
+        return 0.0
+    per_chip = flops_per_step / step_time_s / max(int(n_devices), 1)
+    return per_chip / peak_flops_per_chip

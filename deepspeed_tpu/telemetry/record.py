@@ -1,0 +1,160 @@
+"""StepRecord schema: the one per-step JSON object every engine emits.
+
+A train-step record joins, for ONE optimizer step, what previously
+lived in five silos: the synchronized phase breakdown (timer.py /
+offload phase dicts), achieved flops from XLA ``cost_analysis`` turned
+into MFU against the chip peak (mfu.py), per-device HBM live/peak from
+``memory_stats()``, the wire.py bytes-on-wire estimate per collective
+class, and the loss/grad-norm/loss-scale/overflow counters. Serving
+emits a sibling ``serving_step`` record per scheduler step.
+
+``validate_step_record`` is the golden-schema contract that
+tests/unit/test_telemetry.py and bin/check_bench_schema.py enforce.
+"""
+import time
+
+KIND_TRAIN = "train_step"
+KIND_SERVING = "serving_step"
+
+# every train_step record carries exactly these top-level keys
+TRAIN_STEP_KEYS = (
+    "kind", "step", "wall", "step_time_s",
+    "loss", "grad_norm", "loss_scale", "overflow", "skipped_steps",
+    "micro_steps",
+    "tokens_per_step", "tokens_per_sec_per_chip",
+    "model_flops_per_step", "mfu", "peak_flops_per_chip",
+    "device", "n_devices",
+    "phases", "phase_total_s",
+    "hbm", "wire", "offload", "pipe",
+)
+
+SERVING_STEP_KEYS = (
+    "kind", "step", "wall",
+    "slot_occupancy", "queue_depth", "active_slots",
+    "prefill_tokens", "prefill_tokens_per_sec",
+    "decode_tokens", "decode_steps", "decode_tokens_per_sec",
+)
+
+_NUMERIC = (int, float)
+
+
+def make_train_record(*, step, step_time_s, loss, grad_norm, loss_scale,
+                      overflow, skipped_steps, micro_steps,
+                      tokens_per_step, tokens_per_sec_per_chip,
+                      model_flops_per_step, mfu, peak_flops_per_chip,
+                      device, n_devices, phases, hbm, wire=None,
+                      offload=None, pipe=None, wall=None):
+    phases = {str(k): float(v) for k, v in (phases or {}).items()}
+    return {
+        "kind": KIND_TRAIN,
+        "step": int(step),
+        "wall": float(wall if wall is not None else time.time()),
+        "step_time_s": float(step_time_s),
+        "loss": None if loss is None else float(loss),
+        "grad_norm": None if grad_norm is None else float(grad_norm),
+        "loss_scale": float(loss_scale),
+        "overflow": bool(overflow),
+        "skipped_steps": int(skipped_steps),
+        "micro_steps": int(micro_steps),
+        "tokens_per_step": int(tokens_per_step),
+        "tokens_per_sec_per_chip": float(tokens_per_sec_per_chip),
+        "model_flops_per_step": float(model_flops_per_step),
+        "mfu": float(mfu),
+        "peak_flops_per_chip": float(peak_flops_per_chip),
+        "device": str(device),
+        "n_devices": int(n_devices),
+        "phases": phases,
+        "phase_total_s": float(sum(phases.values())),
+        "hbm": hbm,
+        "wire": wire,
+        "offload": offload,
+        "pipe": pipe,
+    }
+
+
+def make_serving_record(*, step, slot_occupancy, queue_depth, active_slots,
+                        prefill_tokens, prefill_tokens_per_sec,
+                        decode_tokens, decode_steps, decode_tokens_per_sec,
+                        wall=None):
+    return {
+        "kind": KIND_SERVING,
+        "step": int(step),
+        "wall": float(wall if wall is not None else time.time()),
+        "slot_occupancy": float(slot_occupancy),
+        "queue_depth": int(queue_depth),
+        "active_slots": int(active_slots),
+        "prefill_tokens": int(prefill_tokens),
+        "prefill_tokens_per_sec": float(prefill_tokens_per_sec),
+        "decode_tokens": int(decode_tokens),
+        "decode_steps": int(decode_steps),
+        "decode_tokens_per_sec": float(decode_tokens_per_sec),
+    }
+
+
+def validate_step_record(rec):
+    """Schema check for one record dict. Returns a list of problem
+    strings; empty list = valid."""
+    problems = []
+    if not isinstance(rec, dict):
+        return ["record is not a dict: {!r}".format(type(rec).__name__)]
+    kind = rec.get("kind")
+    if kind == KIND_TRAIN:
+        want = TRAIN_STEP_KEYS
+    elif kind == KIND_SERVING:
+        want = SERVING_STEP_KEYS
+    else:
+        return ["unknown record kind {!r}".format(kind)]
+    for key in want:
+        if key not in rec:
+            problems.append("missing key {!r}".format(key))
+    extra = sorted(set(rec) - set(want))
+    if extra:
+        problems.append("unexpected key(s) {}".format(extra))
+    if problems:
+        return problems
+
+    def num(key, allow_none=False):
+        val = rec[key]
+        if val is None and allow_none:
+            return
+        if isinstance(val, bool) or not isinstance(val, _NUMERIC):
+            problems.append("{} is not a number: {!r}".format(key, val))
+
+    for key in ("step", "wall"):
+        num(key)
+    if kind == KIND_TRAIN:
+        for key in ("step_time_s", "loss_scale", "micro_steps",
+                    "tokens_per_step", "tokens_per_sec_per_chip",
+                    "model_flops_per_step", "mfu", "peak_flops_per_chip",
+                    "n_devices", "phase_total_s", "skipped_steps"):
+            num(key)
+        for key in ("loss", "grad_norm"):
+            num(key, allow_none=True)
+        if not isinstance(rec["overflow"], bool):
+            problems.append("overflow is not a bool")
+        phases = rec["phases"]
+        if not isinstance(phases, dict):
+            problems.append("phases is not a dict")
+        else:
+            for name, val in phases.items():
+                if isinstance(val, bool) or not isinstance(val, _NUMERIC) \
+                        or val < 0:
+                    problems.append(
+                        "phase {!r} is not a nonnegative number: "
+                        "{!r}".format(name, val))
+            if phases and abs(sum(phases.values()) -
+                              rec["phase_total_s"]) > 1e-6:
+                problems.append("phase_total_s != sum(phases)")
+        hbm = rec["hbm"]
+        if not isinstance(hbm, dict) or "available" not in hbm:
+            problems.append("hbm is not a dict with 'available'")
+        for key in ("wire", "offload", "pipe"):
+            if rec[key] is not None and not isinstance(rec[key], dict):
+                problems.append("{} is neither null nor a dict".format(key))
+    else:
+        for key in ("slot_occupancy", "queue_depth", "active_slots",
+                    "prefill_tokens", "prefill_tokens_per_sec",
+                    "decode_tokens", "decode_steps",
+                    "decode_tokens_per_sec"):
+            num(key)
+    return problems

@@ -1,0 +1,170 @@
+"""Telemetry sink layer: JSONL always, TensorBoard when present, and a
+rolling-window aggregator served via ``engine.telemetry_snapshot()``.
+
+Each sink consumes the full StepRecord dict (record.py); a sink failure
+never kills the step (telemetry must observe, not perturb)."""
+import json
+import os
+
+import numpy as np
+
+from ..utils.logging import logger
+from .record import KIND_SERVING, KIND_TRAIN
+
+
+class JsonlSink:
+    """One JSON object per line, append mode, line-buffered — the always-
+    on sink (the same contract as the monitor's events.jsonl)."""
+
+    def __init__(self, path):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._fh = open(path, "a", buffering=1)
+
+    def emit(self, rec):
+        self._fh.write(json.dumps(rec) + "\n")
+
+    def close(self):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+class TensorBoardSink:
+    """Mirrors the headline scalars of each record into an existing
+    :class:`utils.monitor.SummaryMonitor`'s TensorBoard writer — only
+    when that writer exists (TensorBoard genuinely optional; the JSONL
+    sinks already carry everything)."""
+
+    SCALARS_TRAIN = ("step_time_s", "mfu", "tokens_per_sec_per_chip",
+                     "loss", "grad_norm", "loss_scale")
+    SCALARS_SERVING = ("slot_occupancy", "queue_depth",
+                       "prefill_tokens_per_sec", "decode_tokens_per_sec")
+
+    def __init__(self, monitor):
+        self.monitor = monitor
+
+    @property
+    def live(self):
+        return self.monitor is not None and \
+            getattr(self.monitor, "_tb", None) is not None
+
+    def emit(self, rec):
+        if not self.live:
+            return
+        names = self.SCALARS_TRAIN if rec["kind"] == KIND_TRAIN \
+            else self.SCALARS_SERVING
+        prefix = "Telemetry/" if rec["kind"] == KIND_TRAIN else "Serve/"
+        for name in names:
+            val = rec.get(name)
+            if val is None:
+                continue
+            self.monitor._tb.add_scalar(prefix + name, float(val),
+                                        rec["step"])
+
+    def close(self):
+        pass    # the monitor owns its writer's lifecycle
+
+
+def _dist(values):
+    vals = np.asarray(values, dtype=np.float64)
+    return {
+        "last": round(float(vals[-1]), 6),
+        "mean": round(float(vals.mean()), 6),
+        "p50": round(float(np.percentile(vals, 50)), 6),
+        "p95": round(float(np.percentile(vals, 95)), 6),
+    }
+
+
+class WindowAggregator:
+    """Rolling per-step aggregates (p50/p95 over the last ``window``
+    steps) — what ``telemetry_snapshot()`` serves and the benches embed
+    under ``extra.telemetry``."""
+
+    def __init__(self, window):
+        from collections import deque
+        self.window = int(window)
+        self.steps = 0
+        self.serving_steps = 0
+        self._train = deque(maxlen=self.window)
+        self._serving = deque(maxlen=self.window)
+        self._last_train = None
+        self._last_serving = None
+
+    def emit(self, rec):
+        if rec["kind"] == KIND_TRAIN:
+            self.steps += 1
+            self._train.append(rec)
+            self._last_train = rec
+        elif rec["kind"] == KIND_SERVING:
+            self.serving_steps += 1
+            self._serving.append(rec)
+            self._last_serving = rec
+
+    def snapshot(self):
+        out = {"steps": self.steps, "serving_steps": self.serving_steps,
+               "window": self.window}
+        if self._train:
+            recs = list(self._train)
+            out["step_time_s"] = _dist([r["step_time_s"] for r in recs])
+            out["mfu"] = _dist([r["mfu"] for r in recs])
+            out["tokens_per_sec_per_chip"] = _dist(
+                [r["tokens_per_sec_per_chip"] for r in recs])
+            phase_names = sorted({name for r in recs for name in r["phases"]})
+            out["phases_mean_s"] = {
+                name: round(float(np.mean(
+                    [r["phases"].get(name, 0.0) for r in recs])), 6)
+                for name in phase_names}
+            last = self._last_train
+            out["loss_last"] = last["loss"]
+            out["overflow_last"] = last["overflow"]
+            out["skipped_steps"] = last["skipped_steps"]
+            out["hbm_last"] = last["hbm"]
+            out["wire"] = last["wire"]
+            if last["offload"] is not None:
+                out["offload_last"] = last["offload"]
+            if last["pipe"] is not None:
+                out["pipe_last"] = last["pipe"]
+        if self._serving:
+            recs = list(self._serving)
+            last = self._last_serving
+            out["serving"] = {
+                "slot_occupancy": _dist([r["slot_occupancy"]
+                                         for r in recs]),
+                "queue_depth": _dist([r["queue_depth"] for r in recs]),
+                "prefill_tokens_per_sec": last["prefill_tokens_per_sec"],
+                "decode_tokens_per_sec": last["decode_tokens_per_sec"],
+                "decode_tokens": last["decode_tokens"],
+            }
+        return out
+
+    def close(self):
+        pass
+
+
+class TelemetrySinks:
+    """Fan one record out to every sink; a failing sink logs once and is
+    dropped rather than poisoning the training loop."""
+
+    def __init__(self, sinks):
+        self.sinks = list(sinks)
+
+    def emit(self, rec):
+        dead = []
+        for sink in self.sinks:
+            try:
+                sink.emit(rec)
+            except Exception as err:  # noqa: BLE001
+                logger.warning(
+                    "telemetry sink %s failed (%s); disabling it",
+                    type(sink).__name__, err)
+                dead.append(sink)
+        for sink in dead:
+            self.sinks.remove(sink)
+
+    def close(self):
+        for sink in self.sinks:
+            try:
+                sink.close()
+            except Exception:  # noqa: BLE001
+                pass

@@ -1,0 +1,44 @@
+"""Close-at-exit lifecycle shared by long-lived writer objects.
+
+SummaryMonitor and TelemetryCollector both hold open file handles (and
+possibly an active xprof trace window) that must be released at process
+end, while long-lived multi-engine processes (train + inference, test
+suites) must not accumulate one atexit handler per instance. The pattern
+is subtle enough to keep in one place: the exact bound-method OBJECT
+must be retained, because each ``self.close`` attribute access creates a
+fresh method object and ``atexit.unregister`` matches by identity.
+"""
+import atexit
+
+
+class AtexitCloseMixin:
+    """Run ``self.close()`` at interpreter exit, at most once.
+
+    Call :meth:`_register_atexit_close` once the instance owns live
+    resources, and start ``close()`` with ``if self._finish_close():
+    return`` — that makes close idempotent and drops the atexit
+    registration on the first call.
+    """
+
+    _closed = False
+    _atexit_handler = None
+
+    def _register_atexit_close(self):
+        self._closed = False
+        self._atexit_handler = self.close
+        atexit.register(self._atexit_handler)
+
+    def _finish_close(self):
+        """True when already closed; otherwise marks this instance
+        closed, deregisters the atexit handler, and returns False so
+        the caller runs its release body exactly once."""
+        if self._closed:
+            return True
+        self._closed = True
+        if self._atexit_handler is not None:
+            try:
+                atexit.unregister(self._atexit_handler)
+            except Exception:  # noqa: BLE001 - interpreter teardown etc.
+                pass
+            self._atexit_handler = None
+        return False

@@ -11,10 +11,11 @@ import json
 import os
 import time
 
+from .lifecycle import AtexitCloseMixin
 from .logging import logger
 
 
-class SummaryMonitor:
+class SummaryMonitor(AtexitCloseMixin):
     """SummaryWriter-shaped facade (add_scalar/flush/close)."""
 
     def __init__(self, output_path, job_name="DeepSpeedJobName",
@@ -29,11 +30,11 @@ class SummaryMonitor:
         self.output_path = os.path.join(output_path or "", job_name or "")
         self._tb = None
         self._jsonl = None
+        self._closed = not enabled
         if not self.enabled:
             return
         os.makedirs(self.output_path, exist_ok=True)
-        import atexit
-        atexit.register(self.close)
+        self._register_atexit_close()
         try:
             from torch.utils.tensorboard import SummaryWriter
             self._tb = SummaryWriter(log_dir=self.output_path)
@@ -64,8 +65,13 @@ class SummaryMonitor:
             self._tb.flush()
 
     def close(self):
+        """Idempotent: the first call releases the writers and drops the
+        atexit registration; later calls are no-ops."""
+        if self._finish_close():
+            return
         if self._tb is not None:
             self._tb.close()
+            self._tb = None
         if self._jsonl is not None:
             self._jsonl.close()
             self._jsonl = None

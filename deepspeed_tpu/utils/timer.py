@@ -10,14 +10,38 @@ import time
 from .logging import logger
 
 
+# cached scratch scalar for the fallback sync path: the old code
+# device_put a FRESH host scalar on every timer start/stop, so
+# wall_clock_breakdown perturbed exactly the transfer path it measured
+_sync_scratch = None
+
+
 def _device_synchronize():
+    """Block until all pending device work is done (closest analogue of
+    a CUDA sync); cheap when nothing is in flight. Enqueues a tiny op on
+    a CACHED device scalar and blocks on it — the op orders after
+    in-flight work on the stream, so blocking on it fences that work.
+    NOTE ``jax.effects_barrier()`` is NOT a substitute: it only blocks
+    on effect tokens (io_callback etc.), never on pending PURE jitted
+    programs, so it returns immediately for an ordinary train step."""
+    global _sync_scratch
     try:
         import jax
-        # Block until all pending device work is done (closest analogue of a
-        # CUDA sync); cheap when nothing is in flight.
-        (jax.device_put(0.0) + 0).block_until_ready()
-    except Exception:
-        pass
+    except Exception:  # noqa: BLE001 - timers must work without jax
+        return
+    for _ in range(2):
+        try:
+            if _sync_scratch is None:
+                _sync_scratch = jax.device_put(0.0)
+            # (x + 0) enqueues one op; block_until_ready on the bare
+            # cached array would return immediately without fencing
+            (_sync_scratch + 0).block_until_ready()
+            return
+        except Exception:  # noqa: BLE001
+            # the cached buffer can go stale (backend reset between
+            # tests) — rebuild and retry ONCE so this interval still
+            # fences; a second failure means no live backend to fence
+            _sync_scratch = None
 
 
 class SynchronizedWallClockTimer:

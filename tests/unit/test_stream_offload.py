@@ -134,9 +134,21 @@ def test_streamed_tracks_classic_offload():
     # eval parity too
     classic.eval()
     streamed.eval()
+    runner = streamed.stream_runner
+    # transfer_snapshot is a read-only probe: calling it twice (a user
+    # debugging mid-step) must not zero the counters the telemetry emit
+    # path will embed — only reset_step_counters() opens a new window
+    assert runner.transfer_snapshot() == runner.transfer_snapshot()
+    before = (dict(runner.phase_times), runner._step_upload_batches,
+              runner._step_upload_elems)
     ec, es = float(classic(ids, ids.copy())), float(streamed(ids,
                                                              ids.copy()))
     assert abs(es - ec) / abs(ec) < 2e-4
+    # eval uploads must not leak into the NEXT train step's telemetry
+    # (phase clocks and transfer counters are per-optimizer-step)
+    after = (dict(runner.phase_times), runner._step_upload_batches,
+             runner._step_upload_elems)
+    assert after == before
 
 
 # --------------------------------------------- double-buffer correctness

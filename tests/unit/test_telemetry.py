@@ -1,0 +1,649 @@
+"""Unified step telemetry (ISSUE 5): StepRecord golden schema, MFU math
+pinned against XLA cost_analysis, sinks, trace windows, monitor
+lifecycle, memory_breakdown strictness, and zero-overhead-off."""
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.model import Model
+from deepspeed_tpu.telemetry import (flops_of_compiled, mfu_of,
+                                     peak_flops_for, validate_step_record)
+from deepspeed_tpu.telemetry.config import DeepSpeedTelemetryConfig
+from deepspeed_tpu.telemetry.trace import TraceWindow
+from deepspeed_tpu.utils.monitor import SummaryMonitor
+
+pytestmark = pytest.mark.telemetry
+
+
+import contextlib  # noqa: E402
+import logging  # noqa: E402
+from deepspeed_tpu.utils.logging import logger as ds_logger  # noqa: E402
+
+
+@contextlib.contextmanager
+def _capture_warnings():
+    """The DS logger has propagate=False, so caplog can't see it; attach
+    a handler directly (the repo's test_flops_profiler idiom)."""
+    messages = []
+
+    class _Cap(logging.Handler):
+        def emit(self, record):
+            messages.append(record.getMessage())
+
+    cap = _Cap(level=logging.WARNING)
+    ds_logger.addHandler(cap)
+    try:
+        yield messages
+    finally:
+        ds_logger.removeHandler(cap)
+
+
+def _toy_model():
+    return Model(lambda p, x, y: jnp.mean((x @ p["w"] - y) ** 2),
+                 {"w": jnp.zeros((4, 2))})
+
+
+def _engine(tmp_path, extra=None, gas=1, telemetry=True):
+    config = {
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "wall_clock_breakdown": True,
+    }
+    if telemetry:
+        config["telemetry"] = {"enabled": True,
+                               "output_path": str(tmp_path)}
+    config.update(extra or {})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=_toy_model(),
+                                               config_params=config)
+    return engine
+
+
+def _records(engine):
+    return [json.loads(line) for line in open(engine.telemetry.jsonl_path)]
+
+
+def _batch():
+    return jnp.ones((8, 4)), jnp.ones((8, 2))
+
+
+# --------------------------------------------------------------- schema
+
+def test_step_record_golden_schema_and_phase_sum(tmp_path):
+    engine = _engine(tmp_path, gas=2)
+    x, y = _batch()
+    for _ in range(4):                     # 2 optimizer steps at gas=2
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+    recs = _records(engine)
+    assert len(recs) == 2                  # one record per OPTIMIZER step
+    for rec in recs:
+        assert validate_step_record(rec) == []
+        assert rec["kind"] == "train_step"
+        assert rec["micro_steps"] == 2
+        # 2 micros x (8 x 4) first-leaf elements
+        assert rec["tokens_per_step"] == 2 * 8 * 4
+        assert rec["model_flops_per_step"] > 0
+        assert rec["loss"] is not None and rec["loss_scale"] > 0
+        assert rec["overflow"] is False
+        # phase times are present (wall_clock_breakdown), disjoint, and
+        # sum to phase_total_s <= ~the measured window wall
+        assert rec["phases"] and rec["phase_total_s"] > 0
+        assert abs(sum(rec["phases"].values()) - rec["phase_total_s"]) \
+            < 1e-9
+        assert rec["phase_total_s"] <= rec["step_time_s"] * 1.05
+    assert recs[0]["step"] == 0 and recs[1]["step"] == 1
+    snap = engine.telemetry_snapshot()
+    assert snap["steps"] == 2
+    for dist_key in ("step_time_s", "mfu", "tokens_per_sec_per_chip"):
+        for stat in ("last", "mean", "p50", "p95"):
+            assert snap[dist_key][stat] >= 0
+    assert snap["hbm_last"]["available"] in (True, False)
+
+
+def test_step_time_clock_reads_after_device_fetches(tmp_path, monkeypatch):
+    """step_time_s prices device execution, not host dispatch: the
+    loss/grad_norm/overflow value fetches (which block on the async step
+    program) must ALL run before _emit_train_telemetry reads the wall
+    clock, or on async backends the record would stop the clock while
+    the step is still running and overstate MFU/tokens-per-sec."""
+    from deepspeed_tpu.runtime import engine as engine_mod
+
+    engine = _engine(tmp_path)
+    x, y = _batch()
+    loss = engine(x, y)
+    engine.backward(loss)
+    engine.step()
+
+    log = []
+
+    class _Fetch:
+        def __init__(self, val):
+            self._val = val
+
+        def __float__(self):
+            log.append("fetch")
+            return self._val
+
+        def __bool__(self):
+            log.append("fetch")
+            return False
+
+    class _Clock:
+        @staticmethod
+        def time():
+            log.append("clock")
+            return 123.0
+
+    monkeypatch.setattr(engine_mod, "time", _Clock)
+    engine._step_metrics = {"grad_norm": _Fetch(1.0),
+                            "overflow": _Fetch(0.0),
+                            "loss_scale": 1.0}
+    engine._window_t0 = 100.0
+    engine._emit_train_telemetry(_Fetch(0.5))
+    assert log.count("fetch") == 3 and log.count("clock") == 1
+    assert log.index("clock") > max(
+        i for i, entry in enumerate(log) if entry == "fetch")
+
+
+def test_mfu_pinned_against_cost_analysis(tmp_path):
+    engine = _engine(tmp_path)
+    x, y = _batch()
+    loss = engine(x, y)
+    engine.backward(loss)
+    engine.step()
+    rec = _records(engine)[-1]
+
+    # hand-compute the step's flops from the SAME compiled programs the
+    # engine ran: the micro (fwd+bwd) program + the optimizer apply
+    batch_dev = engine._to_device((x, y))
+    micro = engine._jit_cache["micro"]
+    apply_fn = engine._jit_cache["apply"]
+    expected = flops_of_compiled(micro, engine.state, batch_dev,
+                                 jax.random.PRNGKey(0),
+                                 engine._pld_theta()) + \
+        flops_of_compiled(apply_fn, engine.state, engine._hyper())
+    assert expected > 0
+    assert rec["model_flops_per_step"] == pytest.approx(expected)
+
+    # the record's MFU is exactly flops / (dt * n_devices * peak)
+    peak = peak_flops_for(jax.devices()[0])
+    assert rec["peak_flops_per_chip"] == peak
+    assert rec["mfu"] == pytest.approx(
+        rec["model_flops_per_step"] /
+        (rec["step_time_s"] * rec["n_devices"] * peak), rel=1e-6)
+    assert mfu_of(0.0, 1.0, 8, peak) == 0.0
+
+
+def test_train_batch_fused_path_emits_records(tmp_path):
+    engine = _engine(tmp_path, extra={"train_batch_size": 8})
+    x, y = np.ones((1, 8, 4), np.float32), np.ones((1, 8, 2), np.float32)
+    engine.train_batch(batch=(x, y))
+    engine.train_batch(batch=(x, y))
+    recs = _records(engine)
+    assert len(recs) == 2
+    for rec in recs:
+        assert validate_step_record(rec) == []
+        assert rec["model_flops_per_step"] > 0
+        assert rec["tokens_per_step"] == 8 * 4
+
+
+def test_telemetry_off_is_zero_overhead(tmp_path):
+    engine = _engine(tmp_path, telemetry=False)
+    assert engine.telemetry is None
+    assert engine.telemetry_snapshot() == {}
+    x, y = _batch()
+    loss = engine(x, y)
+    engine.backward(loss)
+    engine.step()
+    # no telemetry dirs, no flops lowering, no window accounting
+    assert engine._tele_flops_cache == {}
+    assert engine._window_t0 is None
+    assert not os.path.exists(str(tmp_path / "train"))
+
+
+# ------------------------------------------------------ monitor lifecycle
+
+def test_monitor_close_idempotent_and_atexit_deregistered(tmp_path,
+                                                          monkeypatch):
+    import atexit
+    # warm the torch/tensorboard imports first: their FIRST import
+    # registers their own atexit handlers, which would pollute the
+    # patched registry below
+    SummaryMonitor(str(tmp_path), "warmup").close()
+    registered, unregistered = [], []
+
+    def fake_register(fn, *args, **kwargs):
+        registered.append(fn)
+        return fn
+
+    monkeypatch.setattr(atexit, "register", fake_register)
+    monkeypatch.setattr(atexit, "unregister",
+                        lambda fn: unregistered.append(fn))
+    mon = SummaryMonitor(str(tmp_path), "job")
+    assert registered == [mon._atexit_handler]   # exactly one handler
+    mon.add_scalar("x", 1.0, 0)
+    mon.close()
+    mon.close()                            # idempotent
+    # the SAME object that was registered is unregistered, exactly once
+    assert unregistered == registered
+    # writes after close are silently dropped, not crashes
+    mon.add_scalar("y", 2.0, 1)
+    lines = open(tmp_path / "job" / "events.jsonl").readlines()
+    assert len(lines) == 1
+
+
+def test_multi_engine_monitors_write_distinct_files(tmp_path):
+    """Train + inference monitors in ONE process: distinct events.jsonl
+    files, independent close."""
+    train = SummaryMonitor(str(tmp_path), "train")
+    serve = SummaryMonitor(str(tmp_path), "serve")
+    train.add_scalar("Train/loss", 1.0, 1)
+    serve.add_scalar("Serve/queue_depth", 3.0, 1)
+    train.close()
+    serve.add_scalar("Serve/queue_depth", 2.0, 2)    # serve still live
+    serve.close()
+    t = [json.loads(l) for l in open(tmp_path / "train" / "events.jsonl")]
+    s = [json.loads(l) for l in open(tmp_path / "serve" / "events.jsonl")]
+    assert [e["tag"] for e in t] == ["Train/loss"]
+    assert [e["tag"] for e in s] == ["Serve/queue_depth"] * 2
+
+
+# -------------------------------------------------- memory_breakdown key
+
+def test_memory_breakdown_unavailable_warns(tmp_path):
+    """CPU backend has no memory_stats(): memory_breakdown=true warns
+    LOUDLY instead of silently no-oping."""
+    with _capture_warnings() as messages:
+        _engine(tmp_path, extra={"memory_breakdown": True})
+    assert any("memory_breakdown" in m and "memory_stats" in m
+               for m in messages)
+
+
+def test_memory_breakdown_raises_under_strict(tmp_path):
+    with pytest.raises(ValueError, match="memory_breakdown"):
+        _engine(tmp_path, extra={
+            "memory_breakdown": True,
+            "telemetry": {"enabled": True, "strict": True,
+                          "output_path": str(tmp_path)}})
+
+
+# ------------------------------------------------------- config section
+
+def test_telemetry_config_unknown_key_warns_and_strict_raises():
+    with _capture_warnings() as messages:
+        DeepSpeedTelemetryConfig({"telemetry": {"enabled": True,
+                                                "output_path": "x",
+                                                "bogus_key": 1}})
+    assert any("bogus_key" in m for m in messages)
+    with pytest.raises(ValueError, match="bogus_key"):
+        DeepSpeedTelemetryConfig({"telemetry": {"enabled": True,
+                                                "strict": True,
+                                                "output_path": "x",
+                                                "bogus_key": 1}})
+    with pytest.raises(ValueError, match="window"):
+        DeepSpeedTelemetryConfig({"telemetry": {"window": 0}})
+    with pytest.raises(ValueError, match="num_steps"):
+        DeepSpeedTelemetryConfig({"telemetry": {
+            "trace": {"start_step": 1, "num_steps": 0}}})
+    # a trace block that can never arm is a loud no-op, strict raises
+    with pytest.raises(ValueError, match="trace"):
+        DeepSpeedTelemetryConfig({"telemetry": {"strict": True,
+                                                "trace": {}}})
+
+
+def test_unknown_telemetry_key_hits_config_validation():
+    from deepspeed_tpu.runtime.config import (DeepSpeedConfig,
+                                              DeepSpeedConfigError)
+    with pytest.raises(DeepSpeedConfigError, match="telemetry"):
+        DeepSpeedConfig(None, param_dict={
+            "train_batch_size": 8,
+            "config_validation": "strict",
+            "telemetry": {"enabled": True, "output_path": "x",
+                          "not_a_key": True}})
+
+
+# --------------------------------------------------------- trace windows
+
+class _FakeProfiler:
+    def __init__(self, fail_start=False):
+        self.calls = []
+        self.fail_start = fail_start
+
+    def start_trace(self, path):
+        if self.fail_start:
+            raise RuntimeError("no profiler here")
+        self.calls.append(("start", path))
+
+    def stop_trace(self):
+        self.calls.append(("stop",))
+
+
+def test_trace_window_step_range(tmp_path, monkeypatch):
+    win = TraceWindow(str(tmp_path / "trace"), start_step=2, num_steps=2)
+    fake = _FakeProfiler()
+    monkeypatch.setattr(win, "_profiler", lambda: fake)
+    for step in range(6):
+        win.on_step_begin(step)
+        win.on_step_end(step)
+    assert fake.calls == [("start", str(tmp_path / "trace")), ("stop",)]
+    assert win.windows_completed == 1
+    assert not win.active
+
+
+def test_trace_window_trigger_file_consumed(tmp_path, monkeypatch):
+    trigger = tmp_path / "trace.now"
+    win = TraceWindow(str(tmp_path / "trace"), start_step=None,
+                      num_steps=1, trigger_file=str(trigger))
+    fake = _FakeProfiler()
+    monkeypatch.setattr(win, "_profiler", lambda: fake)
+    win.on_step_begin(0)
+    win.on_step_end(0)
+    assert fake.calls == []                # not armed yet
+    trigger.write_text("")
+    win.on_step_begin(1)
+    win.on_step_end(1)
+    assert fake.calls == [("start", str(tmp_path / "trace")), ("stop",)]
+    assert not trigger.exists()            # consumed: one touch, one window
+
+
+def test_trace_window_loud_noop_without_profiler(tmp_path, monkeypatch):
+    win = TraceWindow(str(tmp_path / "trace"), start_step=0, num_steps=1)
+    monkeypatch.setattr(win, "_profiler",
+                        lambda: _FakeProfiler(fail_start=True))
+    with _capture_warnings() as messages:
+        win.on_step_begin(0)
+        win.on_step_end(0)
+    assert not win.active and win.windows_completed == 0
+    assert any("profiler unavailable" in m for m in messages)
+
+
+def test_trace_window_process_global_ownership(tmp_path, monkeypatch):
+    """The jax profiler is process-global: with a train and a serving
+    window in one process, the second to open skips LOUDLY instead of
+    crashing or truncating the first's window."""
+    one = TraceWindow(str(tmp_path / "tr1"), start_step=0)
+    two = TraceWindow(str(tmp_path / "tr2"), start_step=0)
+    f1, f2 = _FakeProfiler(), _FakeProfiler()
+    monkeypatch.setattr(one, "_profiler", lambda: f1)
+    monkeypatch.setattr(two, "_profiler", lambda: f2)
+    one.on_step_begin(0)
+    with _capture_warnings() as messages:
+        two.on_step_begin(0)
+    assert one.active and not two.active
+    assert any("process-global" in m for m in messages)
+    one.on_step_end(0)
+    assert one.windows_completed == 1 and f2.calls == []
+    # ownership released: the other engine may trace the NEXT window
+    two._armed_at = 1
+    two.on_step_begin(1)
+    two.on_step_end(1)
+    assert two.windows_completed == 1
+
+
+def test_explicit_job_name_multi_engine_files_stay_apart(tmp_path):
+    """An explicit telemetry.job_name shared by two engines in one
+    process must not point both at the same telemetry.jsonl."""
+    from deepspeed_tpu.telemetry.collector import TelemetryCollector
+
+    def tc():
+        return DeepSpeedTelemetryConfig({"telemetry": {
+            "enabled": True, "output_path": str(tmp_path),
+            "job_name": "myjob"}})
+
+    train = TelemetryCollector(tc(), job_name="train")
+    serve = TelemetryCollector(tc(), job_name="serve")
+    twin = TelemetryCollector(tc(), job_name="train")   # same-role dup
+    try:
+        assert len({train.jsonl_path, serve.jsonl_path,
+                    twin.jsonl_path}) == 3
+        assert train.job_name == "myjob"
+        assert serve.job_name == "myjob-serve"
+    finally:
+        train.close()
+        serve.close()
+        twin.close()
+    # close() releases the claim: a fresh engine gets the bare name back
+    fresh = TelemetryCollector(tc(), job_name="train")
+    try:
+        assert fresh.job_name == "myjob"
+        # a different SPELLING of the same directory must still collide
+        # (the guard compares normalized paths, not raw strings)
+        spelled = TelemetryCollector(
+            DeepSpeedTelemetryConfig({"telemetry": {
+                "enabled": True,
+                "output_path": os.path.join(str(tmp_path), "."),
+                "job_name": "myjob"}}),
+            job_name="train")
+        try:
+            assert (os.path.realpath(spelled.output_dir)
+                    != os.path.realpath(fresh.output_dir))
+        finally:
+            spelled.close()
+    finally:
+        fresh.close()
+
+
+def test_device_synchronize_rebuilds_stale_scratch():
+    """A stale cached sync scalar (backend reset) must be rebuilt and
+    the fence retried — not silently skipped for that interval."""
+    from deepspeed_tpu.utils import timer as timer_mod
+
+    class Dead:
+        def __add__(self, other):
+            raise RuntimeError("buffer on a dead backend")
+
+    old = timer_mod._sync_scratch
+    try:
+        timer_mod._sync_scratch = Dead()
+        timer_mod._device_synchronize()     # must not raise
+        assert not isinstance(timer_mod._sync_scratch, Dead)
+        assert timer_mod._sync_scratch is not None
+    finally:
+        timer_mod._sync_scratch = old
+
+
+# ------------------------------------------------------------- serving
+
+def test_serving_records_through_same_sinks(tmp_path):
+    from deepspeed_tpu.models import gpt2
+    cfg = gpt2.GPT2Config(vocab_size=64, max_seq_len=32, n_layers=1,
+                          n_heads=2, d_model=16, use_flash_attention=False,
+                          remat=False)
+    engine = deepspeed_tpu.init_inference(
+        model=gpt2.make_gpt2_model(config=cfg),
+        config={"inference": {"max_batch_size": 2, "prefill_buckets": [8],
+                              "dtype": "fp32", "greedy": True,
+                              "max_new_tokens": 3},
+                "telemetry": {"enabled": True,
+                              "output_path": str(tmp_path)}})
+    outs = engine.generate([[1, 2, 3], [4, 5]])
+    assert all(len(o) == 3 for o in outs)
+    recs = [json.loads(line) for line in open(engine.telemetry.jsonl_path)]
+    assert recs and all(r["kind"] == "serving_step" for r in recs)
+    for rec in recs:
+        assert validate_step_record(rec) == []
+    # 0-based like train records, so the two JSONLs join on `step`
+    assert [r["step"] for r in recs] == list(range(len(recs)))
+    # the index is ENGINE-lifetime: a second generate() call (fresh
+    # scheduler) must keep counting, not restart at 0
+    engine.generate([[6, 7]])
+    recs = [json.loads(line) for line in open(engine.telemetry.jsonl_path)]
+    assert [r["step"] for r in recs] == list(range(len(recs)))
+    # ... and the embedded counters share that lifetime (cumulative
+    # across generate() calls): per-step deltas must never go negative
+    # at a call boundary
+    toks = [r["decode_tokens"] for r in recs]
+    assert toks == sorted(toks) and toks[-1] > toks[0]
+    snap = engine.telemetry_snapshot()
+    assert snap["serving_steps"] == len(recs) >= 2
+    assert snap["serving"]["decode_tokens_per_sec"] > 0
+    assert 0 < snap["serving"]["slot_occupancy"]["mean"] <= 1
+
+
+def test_idle_scheduler_steps_emit_no_records(tmp_path):
+    """A polling serve loop drives step() while idle; zero-work steps
+    (empty queue, no active slots) must not append serving records or
+    advance the engine-lifetime record index — otherwise the JSONL
+    grows without bound and the snapshot's occupancy/queue p50/p95
+    collapse to the idle value."""
+    from deepspeed_tpu.inference.scheduler import ContinuousBatchingScheduler
+    from deepspeed_tpu.models import gpt2
+    cfg = gpt2.GPT2Config(vocab_size=64, max_seq_len=32, n_layers=1,
+                          n_heads=2, d_model=16, use_flash_attention=False,
+                          remat=False)
+    engine = deepspeed_tpu.init_inference(
+        model=gpt2.make_gpt2_model(config=cfg),
+        config={"inference": {"max_batch_size": 2, "prefill_buckets": [8],
+                              "dtype": "fp32", "greedy": True,
+                              "max_new_tokens": 2},
+                "telemetry": {"enabled": True,
+                              "output_path": str(tmp_path)}})
+    engine.generate([[1, 2, 3]])
+    n_records = len(open(engine.telemetry.jsonl_path).readlines())
+    assert n_records > 0
+    step_index = engine.serving_record_steps
+    sched = ContinuousBatchingScheduler(engine)
+    for _ in range(5):
+        assert sched.step() == []
+    assert len(open(engine.telemetry.jsonl_path).readlines()) == n_records
+    assert engine.serving_record_steps == step_index
+
+
+def test_serving_trace_window_wraps_decode_work(tmp_path, monkeypatch):
+    """An armed serving trace must OPEN before the scheduler step's
+    prefill/decode work and CLOSE after it — begin/end back-to-back at
+    emit time would trace an empty window."""
+    from deepspeed_tpu.models import gpt2
+    cfg = gpt2.GPT2Config(vocab_size=64, max_seq_len=32, n_layers=1,
+                          n_heads=2, d_model=16, use_flash_attention=False,
+                          remat=False)
+    engine = deepspeed_tpu.init_inference(
+        model=gpt2.make_gpt2_model(config=cfg),
+        config={"inference": {"max_batch_size": 2, "prefill_buckets": [8],
+                              "dtype": "fp32", "greedy": True,
+                              "max_new_tokens": 3},
+                "telemetry": {"enabled": True,
+                              "output_path": str(tmp_path),
+                              "trace": {"start_step": 1, "num_steps": 1}}})
+    events = []
+    fake = _FakeProfiler()
+    real_start, real_stop = fake.start_trace, fake.stop_trace
+    fake.start_trace = lambda p: (events.append("start"), real_start(p))
+    fake.stop_trace = lambda: (events.append("stop"), real_stop())
+    monkeypatch.setattr(engine.telemetry.trace, "_profiler", lambda: fake)
+    real_decode = engine.decode_step
+
+    def logging_decode(*args, **kwargs):
+        events.append("decode")
+        return real_decode(*args, **kwargs)
+
+    monkeypatch.setattr(engine, "decode_step", logging_decode)
+    engine.generate([[1, 2, 3], [4, 5]])
+    assert engine.telemetry.trace.windows_completed == 1
+    i_start, i_stop = events.index("start"), events.index("stop")
+    assert any(i_start < i < i_stop
+               for i, e in enumerate(events) if e == "decode"), events
+
+
+# ------------------------------------------------------------- pipeline
+
+def test_pipeline_bubble_stats():
+    from deepspeed_tpu.models import gpt2, gpt2_pipe
+    cfg = gpt2.GPT2Config(vocab_size=64, max_seq_len=16, n_layers=2,
+                          n_heads=2, d_model=16, use_flash_attention=False,
+                          remat=False)
+    net = gpt2_pipe.make_gpt2_pipeline(config=cfg, num_stages=2, num_dp=4,
+                                       num_mp=1)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=net, config_params={
+            "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": 4,
+            "bf16": {"enabled": True},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        })
+    stats = engine._pipe_telemetry_stats(step_time_s=1.0)
+    assert stats["num_stages"] == 2 and stats["micro_batches"] == 4
+    # executed bubble (S-1)/(vM) = 1/4
+    assert stats["bubble_fraction"] == pytest.approx(0.25)
+    assert stats["warmup_cycles"] + stats["steady_cycles"] + \
+        stats["drain_cycles"] == stats["total_cycles"]
+    assert stats["cycle_time_s"] == pytest.approx(
+        1.0 / stats["total_cycles"], abs=1e-6)
+
+
+# ----------------------------------------------------- transfer metrics
+
+def test_h2d_batcher_occupancy():
+    from deepspeed_tpu.runtime.zero.transfer import H2DBatcher
+    dev = jax.local_devices()[0]
+    batcher = H2DBatcher(bucket_elems=8, dtype=np.float32)
+    assert batcher.occupancy() is None
+    for i in range(4):
+        batcher.add(i, np.ones((4,), np.float32), dev)
+    res = batcher.finish()
+    assert set(res) == {0, 1, 2, 3}
+    assert batcher.elems == 16
+    assert batcher.batches == 2            # two full 8-element buckets
+    assert batcher.occupancy() == pytest.approx(1.0)
+
+
+# --------------------------------------------------------- timer fix
+
+def test_device_synchronize_no_fresh_transfer(monkeypatch):
+    """The sync used by wall_clock_breakdown must not device_put a fresh
+    scalar per call (the measurement perturbing the measured)."""
+    from deepspeed_tpu.utils import timer as timer_mod
+    calls = {"n": 0}
+    real_put = jax.device_put
+
+    def counting_put(*args, **kwargs):
+        calls["n"] += 1
+        return real_put(*args, **kwargs)
+
+    monkeypatch.setattr(jax, "device_put", counting_put)
+    timer_mod._sync_scratch = None         # fresh cache for this test
+    for _ in range(5):
+        timer_mod._device_synchronize()
+    assert calls["n"] <= 1                 # cached scratch only
+
+
+# ------------------------------------------------- bench schema checker
+
+def _load_checker():
+    path = os.path.join(os.path.dirname(__file__), "..", "..", "bin",
+                        "check_bench_schema.py")
+    spec = importlib.util.spec_from_file_location("check_bench_schema",
+                                                  os.path.abspath(path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_check_bench_schema_validates_shapes(tmp_path):
+    checker = _load_checker()
+    good = {"metric": "m", "value": 1.0, "unit": "tokens/s/chip",
+            "vs_baseline": 0.5,
+            "extra": {"telemetry": {
+                "steps": 2, "serving_steps": 0, "window": 50,
+                "phases_mean_s": {"forward_microstep": 0.1},
+                "step_time_s": {"last": 1, "mean": 1, "p50": 1, "p95": 1},
+                "mfu": {"last": .1, "mean": .1, "p50": .1, "p95": .1},
+                "tokens_per_sec_per_chip": {"last": 1, "mean": 1,
+                                            "p50": 1, "p95": 1}}}}
+    assert checker.check_bench_payload(good) == []
+    assert checker.check_bench_payload({"metric": 7, "unit": "u",
+                                        "value": None})
+    bad_tele = dict(good)
+    bad_tele["extra"] = {"telemetry": {}}
+    assert checker.check_bench_payload(bad_tele)
+    # end-to-end over the repo's committed artifacts
+    assert checker.main(["check_bench_schema.py"]) == 0

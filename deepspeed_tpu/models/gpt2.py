@@ -56,6 +56,14 @@ class GPT2Config:
     # global mesh (same contract as sp_mesh).
     sparse_embedding_grads: bool = False
     embedding_grad_mesh: object = None
+    # Collective matmul (comm.collective_matmul): a
+    # parallel.collective_matmul.CollectiveMatmulBinding attached by the
+    # engine when fusion is enabled and the mesh carries a >1 ``model``
+    # axis. The TP matmul sites (qkv/fc column-parallel gathers,
+    # attn-proj/fc2 row-parallel scatters) then run the ring-decomposed
+    # fused GEMMs; None (default) keeps the plain XLA matmuls — the
+    # numerics oracle.
+    collective_matmul: object = None
     # Block-sparse attention: the parsed ds_config "sparse_attention"
     # dict (mode/block/...), e.g. engine.sparse_attention_config().
     # When set, _attn_ctx runs the Pallas block-sparse kernels
@@ -160,13 +168,34 @@ def _layer_norm(x, scale, bias, eps=1e-5):
     return fused_layer_norm(x, scale, bias, eps)
 
 
+def _column_matmul(x, w, config):
+    """x @ w at a column-parallel site (qkv/fc): the ring-fused
+    allgather-matmul when the engine attached a collective_matmul
+    binding, the plain matmul otherwise."""
+    if config.collective_matmul is not None:
+        from ..parallel.collective_matmul import tp_column_matmul
+        return tp_column_matmul(x, w, config.collective_matmul)
+    return x @ w
+
+
+def _row_matmul(x, w, config):
+    """x @ w at a row-parallel site (attn proj/fc2): the ring-fused
+    matmul-reducescatter when the binding is live (the partial-sum
+    reduction hides inside the GEMM; only the consumer's gather stays
+    exposed), the plain matmul otherwise."""
+    if config.collective_matmul is not None:
+        from ..parallel.collective_matmul import tp_row_matmul
+        return tp_row_matmul(x, w, config.collective_matmul)
+    return x @ w
+
+
 def _attn_ctx(x, block, config, train):
     """QKV projection + attention mixing -> (b, s, d) context, BEFORE the
     output projection (which lives in _block_rest so the fused and unfused
     paths share one copy of everything downstream of the context)."""
     b, s, d = x.shape
     h, dh = config.n_heads, config.d_head
-    qkv = x @ block["qkv_kernel"].astype(x.dtype) + \
+    qkv = _column_matmul(x, block["qkv_kernel"].astype(x.dtype), config) + \
         block["qkv_bias"].astype(x.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     reshape = lambda t: t.reshape(b, s, h, dh)
@@ -207,9 +236,10 @@ def _attn_ctx(x, block, config, train):
 
 def _mlp(x, block, config, rng, train):
     from ..ops.transformer.fused_ops import fused_bias_gelu
-    h = fused_bias_gelu(x @ block["fc_kernel"].astype(x.dtype),
-                        block["fc_bias"].astype(x.dtype))
-    out = h @ block["proj_kernel"].astype(x.dtype) + \
+    h = fused_bias_gelu(
+        _column_matmul(x, block["fc_kernel"].astype(x.dtype), config),
+        block["fc_bias"].astype(x.dtype))
+    out = _row_matmul(h, block["proj_kernel"].astype(x.dtype), config) + \
         block["proj_bias"].astype(x.dtype)
     if train and config.dropout > 0.0 and rng is not None:
         keep = jax.random.bernoulli(rng, 1.0 - config.dropout, out.shape)
@@ -274,7 +304,7 @@ def _block_rest(x, ctx, block_params, config, rng, train):
     is the single biggest avoidable cost at bench shapes)."""
     r1, r2 = (None, None) if rng is None else jax.random.split(rng)
     attn = block_params["attn"]
-    out = ctx @ attn["proj_kernel"].astype(x.dtype) + \
+    out = _row_matmul(ctx, attn["proj_kernel"].astype(x.dtype), config) + \
         attn["proj_bias"].astype(x.dtype)
     if train and config.dropout > 0.0 and r1 is not None:
         keep = jax.random.bernoulli(r1, 1.0 - config.dropout, out.shape)
@@ -568,7 +598,11 @@ def profile_spec(config, batch_size, seq=None, seed=0):
     Each node prices one forward sub-function via XLA cost_analysis.
     ``seq`` should be the ACTUAL training sequence length (attention is
     quadratic in it); defaults to config.max_seq_len."""
+    import dataclasses
     import jax
+    # per-module pricing stays on the dense math (cost_analysis cannot
+    # attribute flops inside a shard_map'd fused collective-matmul)
+    config = dataclasses.replace(config, collective_matmul=None)
     s, d, v, L = (seq or config.max_seq_len, config.d_model,
                   config.vocab_size, config.n_layers)
     dt = jnp.bfloat16
@@ -588,7 +622,7 @@ def profile_spec(config, batch_size, seq=None, seed=0):
         ln1 = _layer_norm(xv, bp["ln1"]["scale"], bp["ln1"]["bias"])
         # jnp reference attention: cost_analysis cannot see inside a
         # pallas custom call, and the dense math IS the flop count
-        import dataclasses
+        # (collective_matmul already stripped at function entry)
         cfg_ref = dataclasses.replace(config, use_flash_attention=False,
                                       sequence_parallel=None,
                                       sparse_attention=None)

@@ -1,0 +1,451 @@
+"""Collective matmul: ring-decomposed all-gather/reduce-scatter GEMMs.
+
+The last exposed collectives on the training hot path are the ones that
+feed or drain a GEMM: tensor-parallel activation gathers before the
+column-parallel projections, the partial-sum reductions after the
+row-parallel ones, and ZeRO-3's per-layer weight all-gathers. XLA
+schedules each as ONE collective before/after the matmul it serves, so
+its full ICI latency sits on the critical path. Decomposing the
+collective into per-chunk ``ppermute`` hops interleaved with partial
+matmuls lets the latency-hiding scheduler sink each hop under the GEMM
+consuming the previous chunk — "Fused Computation-Collective
+Operations" (arXiv:2305.06942) and T3 (arXiv:2401.16677); the same
+overlap discipline PR 4's streamed-offload upload worker proved out for
+host transfers, applied to the training collectives themselves.
+
+Three fused ops, all riding the shared ring idiom (``parallel/ring.py``,
+the same perm/double-buffer machinery as ring attention):
+
+* ``allgather_matmul(x, w)`` — column parallel. ``x`` enters sharded
+  over the ring axis on its second-to-last dim; each step multiplies the
+  resident chunk by the local weight shard while the next chunk rotates.
+  Output is the full-length product against this device's weight shard.
+* ``matmul_reducescatter(x, w)`` — row parallel. Each step computes the
+  partial product for ONE output chunk, adds it to the accumulator that
+  just arrived, and sends the accumulator onward — each output shard is
+  emitted the moment its last partial lands, and the rotation of the
+  other shards hides behind the remaining partial GEMMs.
+* ``zero3_ring_gather(p, ...)`` — the ZeRO-3 per-layer weight all-gather
+  as an explicit ring: the data-sharded parameter's chunks rotate via
+  ``ppermute`` (optionally as int8 blocks + scales — the qwZ codec of
+  ``runtime/comm/quantize.py`` — so the wire stays quantized) and
+  dequantize into the gathered buffer chunk by chunk. Because layer
+  k+1's gather shares no data dependency with layer k's compute, the
+  per-chunk grains let XLA overlap parameter materialization with the
+  previous layer's GEMMs.
+
+``allgather_matmul``/``matmul_reducescatter`` are ``custom_vjp``
+functions whose backward is the DUAL fused op: d(allgather_matmul)/dx
+is a matmul_reducescatter (partial cotangent GEMMs with the output
+shards emitted around the ring) and d(matmul_reducescatter)/dx is an
+allgather_matmul; the weight cotangent is a ring gather-contract (the
+rotating operand is re-gathered chunk-wise into the dW accumulation).
+``zero3_ring_gather``'s backward constrains the cotangent to the
+sharded layout — inside the GSPMD program that IS the gradient
+reduce-scatter (a manual ring there would force XLA to materialize the
+replicated cotangent first, i.e. a full all-reduce before our
+scatter — strictly worse; same reasoning as ``qwz_gather``).
+
+Wire accounting: an n-chunk ring decomposition moves exactly the bytes
+of the one-shot collective — ``(g-1)/g * payload`` per device
+(``runtime/comm/wire.py`` prices both identically; pinned by test).
+
+Everything is gated behind the strict-validated ``comm.collective_matmul``
+ds_config section (``runtime/comm/config.py``); the unfused XLA path
+stays the default and the numerics oracle (docs/collective_matmul.md).
+"""
+import functools
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .ring import ring_context, ring_rotate
+from .topology import (DATA_AXIS, DATA_REPLICA_AXIS, DATA_SHARD_AXIS,
+                       MODEL_AXIS, shard_map_compat)
+from ..utils.logging import logger
+
+# wire dtype policy names (comm.collective_matmul.dtype)
+DTYPE_COMPUTE = "compute"     # rotate in the input dtype (bit-exact wire)
+DTYPE_BF16 = "bf16"           # cast payload to bf16 for the hop (lossy)
+
+
+def _wire_dtype(policy):
+    return jnp.bfloat16 if policy == DTYPE_BF16 else None
+
+
+@dataclass(frozen=True)
+class CollectiveMatmulBinding:
+    """What a model needs to run its TP matmuls fused: the mesh, the
+    ring axis, and the decomposition knobs. Frozen (hashable) so the
+    jitted shard_map wrappers cache per binding. The engine attaches one
+    to the model config when ``comm.collective_matmul`` is enabled and
+    the mesh carries a >1 ``model`` axis."""
+    mesh: object
+    axis: str = MODEL_AXIS
+    chunks: int = 1
+    dtype: str = DTYPE_COMPUTE
+
+
+# ------------------------------------------------------- per-device bodies
+def _ag_matmul_impl(x, w, axis_name, chunks, wire):
+    """Ring all-gather(x, dim=-2) @ w without ever materializing the
+    gathered x: at step t the resident chunk (originally from ring
+    position ``idx - t``) multiplies the local weight shard and lands in
+    its output block while the next chunk rotates.
+
+    x: [..., s_loc, d] (this device's ring-dim shard); w: [d, f_loc].
+    Returns [..., n*s_loc, f_loc].
+    """
+    n, idx, perm = ring_context(axis_name)
+    s_loc = x.shape[-2]
+    out_dtype = jnp.result_type(x.dtype, w.dtype)
+    out = jnp.zeros(x.shape[:-2] + (n * s_loc, w.shape[-1]), out_dtype)
+    cur = x
+    for t in range(n):
+        blk = (idx - t) % n
+        out = lax.dynamic_update_slice_in_dim(out, cur @ w, blk * s_loc,
+                                              axis=-2)
+        if t + 1 < n:
+            cur = ring_rotate(cur, axis_name, perm, chunks, axis=-1,
+                              wire_dtype=wire)
+    return out
+
+
+def _matmul_rs_impl(x, w, axis_name, chunks, wire):
+    """psum(x @ w) reduce-scattered over dim -2, as a ring: at step t
+    this device computes the partial product for the output block that
+    just arrived in the rotating accumulator and forwards the sum — the
+    block bound for ring position o starts at o+1 and collects one
+    partial per hop, arriving complete at its owner on the last step.
+
+    x: [..., n*s_loc, f_loc] (full-length partials); w: [f_loc, d].
+    Returns [..., s_loc, d] — this device's output shard of the sum.
+    """
+    n, idx, perm = ring_context(axis_name)
+    s = x.shape[-2]
+    s_loc = s // n
+    acc = None
+    for t in range(n):
+        blk = (idx - 1 - t) % n
+        xb = lax.dynamic_slice_in_dim(x, blk * s_loc, s_loc, axis=-2)
+        part = xb @ w
+        acc = part if acc is None else acc + part
+        if t + 1 < n:
+            acc = ring_rotate(acc, axis_name, perm, chunks, axis=-1,
+                              wire_dtype=wire)
+    return acc
+
+
+def _gather_contract_impl(rot, fixed, axis_name, chunks, wire, rot_is_lhs):
+    """The dW accumulation both fused ops' backwards share:
+    ``sum_j block_j(allgather(rot))^T-contract fixed[block_j]`` with the
+    rotating operand ring-gathered chunk by chunk into the running sum.
+
+    rot: [..., s_loc, a] (ring-dim shard); fixed: [..., n*s_loc, b].
+    Returns [a, b] when ``rot_is_lhs`` else [b, a] — contraction over
+    every leading dim plus the ring dim.
+    """
+    n, idx, perm = ring_context(axis_name)
+    s_loc = rot.shape[-2]
+    out_dtype = jnp.result_type(rot.dtype, fixed.dtype)
+    shape = (rot.shape[-1], fixed.shape[-1]) if rot_is_lhs \
+        else (fixed.shape[-1], rot.shape[-1])
+    acc = jnp.zeros(shape, out_dtype)
+    cur = rot
+    for t in range(n):
+        blk = (idx - t) % n
+        fb = lax.dynamic_slice_in_dim(fixed, blk * s_loc, s_loc, axis=-2)
+        if rot_is_lhs:
+            acc = acc + jnp.einsum("...sa,...sb->ab", cur, fb)
+        else:
+            acc = acc + jnp.einsum("...sa,...sb->ba", cur, fb)
+        if t + 1 < n:
+            cur = ring_rotate(cur, axis_name, perm, chunks, axis=-1,
+                              wire_dtype=wire)
+    return acc
+
+
+# -------------------------------------------- fused ops (call in shard_map)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def allgather_matmul(x, w, axis_name=MODEL_AXIS, chunks=1,
+                     dtype_policy=DTYPE_COMPUTE):
+    """Column-parallel fused GEMM (per-device body; call inside
+    shard_map over ``axis_name``): ``allgather(x, dim=-2) @ w`` with the
+    gather decomposed into ring hops hidden under the partial matmuls.
+
+    Backward is the dual pair of fused ops: ``dx`` is a
+    ``matmul_reducescatter`` of the cotangent against ``w^T`` and ``dw``
+    re-gathers ``x`` chunk-wise into the weight-cotangent accumulation.
+    """
+    return _ag_matmul_impl(x, w, axis_name, chunks, _wire_dtype(dtype_policy))
+
+
+def _ag_fwd(x, w, axis_name, chunks, dtype_policy):
+    y = _ag_matmul_impl(x, w, axis_name, chunks, _wire_dtype(dtype_policy))
+    return y, (x, w)
+
+
+def _ag_bwd(axis_name, chunks, dtype_policy, res, dy):
+    x, w = res
+    wire = _wire_dtype(dtype_policy)
+    dx = _matmul_rs_impl(dy, w.T, axis_name, chunks, wire)
+    dw = _gather_contract_impl(x, dy, axis_name, chunks, wire,
+                               rot_is_lhs=True)
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+allgather_matmul.defvjp(_ag_fwd, _ag_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def matmul_reducescatter(x, w, axis_name=MODEL_AXIS, chunks=1,
+                         dtype_policy=DTYPE_COMPUTE):
+    """Row-parallel fused GEMM (per-device body; call inside shard_map
+    over ``axis_name``): ``reduce_scatter(psum_partial(x @ w), dim=-2)``
+    with each output shard emitted as soon as its partial sums finish
+    and the accumulator rotation hidden under the remaining partials.
+
+    Backward is the dual pair: ``dx`` is an ``allgather_matmul`` of the
+    cotangent against ``w^T``; ``dw`` ring-gathers the cotangent into
+    the weight accumulation.
+    """
+    return _matmul_rs_impl(x, w, axis_name, chunks, _wire_dtype(dtype_policy))
+
+
+def _rs_fwd(x, w, axis_name, chunks, dtype_policy):
+    y = _matmul_rs_impl(x, w, axis_name, chunks, _wire_dtype(dtype_policy))
+    return y, (x, w)
+
+
+def _rs_bwd(axis_name, chunks, dtype_policy, res, dy):
+    x, w = res
+    wire = _wire_dtype(dtype_policy)
+    dx = _ag_matmul_impl(dy, w.T, axis_name, chunks, wire)
+    dw = _gather_contract_impl(dy, x, axis_name, chunks, wire,
+                               rot_is_lhs=False)
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+matmul_reducescatter.defvjp(_rs_fwd, _rs_bwd)
+
+
+# ------------------------------------------------ global (GSPMD) wrappers
+def _batch_entry(mesh):
+    """PartitionSpec entry for the batch dim: every nontrivial data
+    (sub-)axis the mesh carries, so DP x TP composes without an implicit
+    batch gather (mirrors ring_attention._make_sharded)."""
+    present = [a for a in (DATA_AXIS, DATA_REPLICA_AXIS, DATA_SHARD_AXIS)
+               if mesh.shape.get(a, 1) > 1]
+    if not present:
+        return None
+    return present[0] if len(present) == 1 else tuple(present)
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_tp_matmul(mesh, kind, axis, chunks, dtype_policy):
+    """Jitted shard_map wrapper for one fused TP matmul flavor, cached
+    per (mesh, options) — jit so the eager path (e.g. under an outer
+    jax.checkpoint) always compiles; under the engine's jit this inlines
+    for free (same contract as ring_attention._make_sharded)."""
+    batch = _batch_entry(mesh)
+    if kind == "column":
+        def body(x, w):
+            return allgather_matmul(x, w, axis, chunks, dtype_policy)
+        in_specs = (P(batch, axis, None), P(None, axis))
+        out_specs = P(batch, None, axis)
+    else:
+        def body(x, w):
+            return matmul_reducescatter(x, w, axis, chunks, dtype_policy)
+        in_specs = (P(batch, None, axis), P(axis, None))
+        out_specs = P(batch, axis, None)
+    return jax.jit(shard_map_compat(body, mesh=mesh, in_specs=in_specs,
+                                    out_specs=out_specs))
+
+
+@functools.lru_cache(maxsize=None)
+def _warn_fallback_once(reason):
+    logger.warning(
+        "collective_matmul: falling back to the unfused matmul: %s",
+        reason)
+
+
+def _tp_live(binding, x, w, kind):
+    """Trace-time check that the fused op applies to these shapes; a
+    miss falls back to the plain matmul with one loud warning per
+    reason (shapes are static, so this costs nothing at runtime)."""
+    if binding is None:
+        return False
+    n = int(dict(binding.mesh.shape).get(binding.axis, 1))
+    if n <= 1:
+        return False
+    if x.ndim != 3 or w.ndim != 2:
+        _warn_fallback_once(
+            "need x rank 3 / w rank 2, got {} / {}".format(x.ndim, w.ndim))
+        return False
+    b, s, _ = x.shape
+    feat = w.shape[1] if kind == "column" else w.shape[0]
+    if s % n or feat % n:
+        _warn_fallback_once(
+            "seq {} and the {}-parallel feature dim {} must divide the "
+            "'{}' axis size {}".format(s, kind, feat, binding.axis, n))
+        return False
+    batch = _batch_entry(binding.mesh)
+    if batch is not None:
+        axes = batch if isinstance(batch, tuple) else (batch,)
+        dp = int(np.prod([binding.mesh.shape[a] for a in axes]))
+        if b % dp:
+            _warn_fallback_once(
+                "batch {} does not divide the data degree {}".format(b, dp))
+            return False
+    return True
+
+
+def tp_column_matmul(x, w, binding):
+    """``x @ w`` with the activation all-gather ring-fused into the GEMM
+    when ``binding`` is live for these shapes; the plain (oracle) matmul
+    otherwise. Global arrays in, global arrays out — GSPMD reshards at
+    the shard_map boundary. x: [b, s, d]; w: [d, f] (f sharded over the
+    binding axis)."""
+    if not _tp_live(binding, x, w, "column"):
+        return x @ w
+    return _sharded_tp_matmul(binding.mesh, "column", binding.axis,
+                              int(binding.chunks), binding.dtype)(x, w)
+
+
+def tp_row_matmul(x, w, binding):
+    """``x @ w`` with the partial-sum reduce-scatter ring-fused into the
+    GEMM when ``binding`` is live; the plain matmul otherwise. The
+    output leaves sequence-sharded over the binding axis — the exposed
+    half of the unfused all-reduce (RS + AG) is then only the gather the
+    consumer actually needs, and the RS half hides inside the GEMM.
+    x: [b, s, f] (f sharded); w: [f, d]."""
+    if not _tp_live(binding, x, w, "row"):
+        return x @ w
+    return _sharded_tp_matmul(binding.mesh, "row", binding.axis,
+                              int(binding.chunks), binding.dtype)(x, w)
+
+
+# ------------------------------------------------- ZeRO-3 ring weight gather
+def _spec_dim(spec, axis_name):
+    """Index of the dim ``spec`` shards over ``axis_name`` (-1: none)."""
+    for i, entry in enumerate(spec):
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        if axis_name in axes:
+            return i
+    return -1
+
+
+def _zero3_gather_value(p, mesh, sharded_spec, gathered_spec, axis_name,
+                        dim, chunks, quantized, block_size):
+    from ..runtime.comm.quantize import dequantize_param, quantize_param
+
+    def body(local):
+        n, idx, perm = ring_context(axis_name)
+        shard = local.shape[dim]
+        full = local.shape[:dim] + (n * shard,) + local.shape[dim + 1:]
+        out = jnp.zeros(full, local.dtype)
+        if quantized:
+            # qwZ composition: the rotating chunks stay int8 blocks +
+            # per-block scales on the wire (the shape-preserving codec —
+            # scales share the sharded dim's layout, so they rotate on
+            # the same axis); each arriving chunk dequantizes straight
+            # into its slot of the gathered buffer.
+            cur_q, cur_s = quantize_param(local, block_size)
+            for t in range(n):
+                blk = (idx - t) % n
+                deq = dequantize_param(cur_q, cur_s, local.dtype)
+                out = lax.dynamic_update_slice_in_dim(
+                    out, deq.reshape(local.shape), blk * shard, axis=dim)
+                if t + 1 < n:
+                    cur_q = ring_rotate(cur_q, axis_name, perm, chunks,
+                                        axis=dim)
+                    cur_s = ring_rotate(cur_s, axis_name, perm,
+                                        axis=min(dim, cur_s.ndim - 1))
+        else:
+            cur = local
+            for t in range(n):
+                blk = (idx - t) % n
+                out = lax.dynamic_update_slice_in_dim(out, cur,
+                                                      blk * shard, axis=dim)
+                if t + 1 < n:
+                    cur = ring_rotate(cur, axis_name, perm, chunks,
+                                      axis=dim)
+        return out
+
+    fn = shard_map_compat(body, mesh=mesh, in_specs=(sharded_spec,),
+                          out_specs=gathered_spec)
+    return fn(p)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6, 7, 8))
+def zero3_ring_gather(p, mesh, sharded_spec, gathered_spec, axis_name, dim,
+                      chunks=1, quantized=False, block_size=256):
+    """The ZeRO-3 per-layer weight all-gather as an explicit ring of
+    per-chunk ``ppermute`` hops (optionally carrying the qwZ int8
+    blocks + scales), so parameter materialization for layer k+1 can
+    overlap layer k's compute at chunk granularity.
+
+    ``p``: the data-sharded compute param (``sharded_spec`` names
+    ``axis_name`` on dim ``dim``; TP axes pass through untouched).
+    Returns the gathered (data-replicated) param in ``p``'s dtype.
+
+    Backward constrains the cotangent to ``sharded_spec`` — inside the
+    surrounding GSPMD program XLA lowers that to the ZeRO gradient
+    reduce-scatter; a manual ring here would first force the replicated
+    cotangent (a full all-reduce) into existence, strictly worse (same
+    straight-through design as ``qwz_gather``).
+    """
+    return _zero3_gather_value(p, mesh, sharded_spec, gathered_spec,
+                               axis_name, dim, chunks, quantized,
+                               block_size)
+
+
+def _z3_fwd(p, mesh, sharded_spec, gathered_spec, axis_name, dim, chunks,
+            quantized, block_size):
+    return _zero3_gather_value(p, mesh, sharded_spec, gathered_spec,
+                               axis_name, dim, chunks, quantized,
+                               block_size), None
+
+
+def _z3_bwd(mesh, sharded_spec, gathered_spec, axis_name, dim, chunks,
+            quantized, block_size, _res, ct):
+    ct = jax.lax.with_sharding_constraint(
+        ct, NamedSharding(mesh, sharded_spec))
+    return (ct,)
+
+
+zero3_ring_gather.defvjp(_z3_fwd, _z3_bwd)
+
+
+def make_zero3_gather_fn(plan, mesh, chunks=1, quantized=False,
+                         block_size=256):
+    """params tree -> gathered-params tree: every stage-3 data-sharded
+    leaf goes through ``zero3_ring_gather`` (the engine's collective-
+    matmul twin of ``_qwz_gather_tree_fn``). Leaves whose sharded spec
+    does not actually name the param data axis (persistent/replicated)
+    pass through untouched."""
+    from ..runtime.zero.partition import _path_str
+    axis_name = plan.param_data_axes[0]
+
+    def gather(params):
+        def leaf(path, p):
+            shape = np.shape(p)
+            if not plan.param_is_data_sharded(path, shape):
+                return p
+            sharded = plan.param_sharding(path, shape).spec
+            gathered = plan.gather_sharding(path, shape).spec
+            dim = _spec_dim(sharded, axis_name)
+            if dim < 0:
+                return p
+            return zero3_ring_gather(p, mesh, sharded, gathered,
+                                     axis_name, dim, int(chunks),
+                                     bool(quantized), int(block_size))
+        return jax.tree_util.tree_map_with_path(
+            lambda kp, p: leaf(_path_str(kp), p), params)
+
+    return gather

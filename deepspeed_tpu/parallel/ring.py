@@ -1,0 +1,69 @@
+"""The ring idiom, in one place: perm construction + double-buffer hop.
+
+Every ring collective in this codebase (ring attention's K/V rotation,
+the collective-matmul decomposed all-gather/reduce-scatter GEMMs) moves
+a buffer one hop around a mesh axis per step with ``lax.ppermute`` while
+compute consumes the buffer that just arrived — the double-buffer swap
+is simply that ``ppermute`` returns a fresh value while the old one
+stays live for this step's math. Factoring the perm construction and
+the hop here keeps it ONE idiom instead of per-module copies.
+
+All helpers are per-device code: call them inside ``shard_map`` (or any
+context where ``axis_name`` is bound).
+"""
+import jax.numpy as jnp
+from jax import lax
+
+
+def ring_perm(n, reverse=False):
+    """The one-hop rotation permutation over a ring of ``n`` devices:
+    ``[(src, dst)]`` pairs moving every shard to its next neighbor
+    (``reverse=True`` rotates the other way)."""
+    if reverse:
+        return [(j, (j - 1) % n) for j in range(n)]
+    return [(j, (j + 1) % n) for j in range(n)]
+
+
+def ring_context(axis_name):
+    """``(n, idx, perm)`` for the ring over ``axis_name``: axis size,
+    this device's position, and the forward one-hop perm. ``n`` is a
+    trace-time constant (mesh axis sizes are static), so callers may
+    build python loops over the ring steps."""
+    n = lax.psum(1, axis_name)
+    return n, lax.axis_index(axis_name), ring_perm(n)
+
+
+def even_chunk_count(size, chunks):
+    """Largest divisor of ``size`` that is <= ``chunks`` — the actual
+    number of pieces a payload of ``size`` lanes splits into (a ragged
+    tail piece would change shapes across ring steps)."""
+    parts = max(1, min(int(chunks), int(size)))
+    while size % parts:
+        parts -= 1
+    return parts
+
+
+def ring_rotate(x, axis_name, perm, chunks=1, axis=0, wire_dtype=None):
+    """One ring hop of ``x``: ppermute to the next neighbor per ``perm``.
+
+    ``chunks > 1`` splits the payload along ``axis`` into that many
+    separately-ppermuted pieces — total bytes on the wire are identical
+    (wire.py prices the decomposition as exactly one collective), but
+    the finer grains give XLA's latency-hiding scheduler more freedom to
+    overlap the hops with whatever compute consumes the previous buffer.
+
+    ``wire_dtype`` (e.g. ``jnp.bfloat16``) casts the payload for the hop
+    only — the result is cast back to ``x``'s dtype. This is the lossy
+    half-width wire policy; leave ``None`` for bit-exact rotation.
+    """
+    orig_dtype = x.dtype
+    if wire_dtype is not None and jnp.dtype(wire_dtype) != orig_dtype:
+        x = x.astype(wire_dtype)
+    parts = even_chunk_count(x.shape[axis], chunks) if x.ndim else 1
+    if parts > 1:
+        pieces = jnp.split(x, parts, axis=axis)
+        pieces = [lax.ppermute(p, axis_name, perm) for p in pieces]
+        x = jnp.concatenate(pieces, axis=axis)
+    else:
+        x = lax.ppermute(x, axis_name, perm)
+    return x.astype(orig_dtype) if x.dtype != orig_dtype else x

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from .ring import ring_context, ring_rotate
 from .topology import DATA_AXIS, SEQUENCE_AXIS
 from ..ops.transformer.attention import NEG_INF
 
@@ -71,8 +72,7 @@ def ring_attention(q, k, v, axis_name=SEQUENCE_AXIS, causal=True,
     (deepspeed/runtime/pipe/p2p.py) but expressed as ``lax.ppermute`` inside
     jit so XLA overlaps the K/V rotation with the attention matmuls.
     """
-    n = lax.psum(1, axis_name)
-    idx = lax.axis_index(axis_name)
+    n, idx, perm = ring_context(axis_name)
     b, s_local, h, d = q.shape
     scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
 
@@ -85,7 +85,6 @@ def ring_attention(q, k, v, axis_name=SEQUENCE_AXIS, causal=True,
     m0 = jnp.full((b, h, s_local, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, s_local, 1), jnp.float32)
     o0 = jnp.zeros((b, h, s_local, d), jnp.float32)
-    perm = [(j, (j + 1) % n) for j in range(n)]
 
     def attend(step, m, l, o, k_cur, v_cur):
         # After `step` rotations each device holds the shard originally
@@ -102,8 +101,8 @@ def ring_attention(q, k, v, axis_name=SEQUENCE_AXIS, causal=True,
     def body(carry, step):
         m, l, o, k_cur, v_cur = carry
         m, l, o = attend(step, m, l, o, k_cur, v_cur)
-        k_nxt = lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        k_nxt = ring_rotate(k_cur, axis_name, perm)
+        v_nxt = ring_rotate(v_cur, axis_name, perm)
         return (m, l, o, k_nxt, v_nxt), None
 
     if n > 1:

@@ -1,0 +1,105 @@
+"""``comm`` ds_config section: collective-communication behavior.
+
+Currently one sub-section, ``comm.collective_matmul`` — the gate for
+the ring-decomposed all-gather/reduce-scatter GEMMs
+(``parallel/collective_matmul.py``). Off by default: the unfused XLA
+path stays the reference oracle, and fusion is an explicit opt-in.
+
+Shape::
+
+    "comm": {
+      "collective_matmul": {
+        "enabled": false,          // master switch
+        "tensor_parallel": true,   // fuse the TP qkv/fc gathers + proj/fc2 scatters
+        "zero_gather": true,       // ring-decompose the ZeRO-3 weight all-gather
+        "chunks": 1,               // ppermute pieces per ring hop (granularity only;
+                                   // bytes == the one-shot collective, wire.py)
+        "dtype": "compute",        // wire dtype policy: "compute" (bit-exact)
+                                   // or "bf16" (half-width, lossy hop)
+        "strict": false            // unknown/unhonorable keys raise instead of warn
+      }
+    }
+
+Validated with the PR 4/5 no-silent-no-ops policy: unknown keys warn,
+and raise when ``comm.collective_matmul.strict`` is set.
+"""
+from ...telemetry.config import warn_or_raise_noop
+
+COMM = "comm"
+COLLECTIVE_MATMUL = "collective_matmul"
+
+CM_ENABLED = "enabled"
+CM_ENABLED_DEFAULT = False
+CM_TENSOR_PARALLEL = "tensor_parallel"
+CM_TENSOR_PARALLEL_DEFAULT = True
+CM_ZERO_GATHER = "zero_gather"
+CM_ZERO_GATHER_DEFAULT = True
+CM_CHUNKS = "chunks"
+CM_CHUNKS_DEFAULT = 1
+CM_DTYPE = "dtype"
+CM_DTYPE_DEFAULT = "compute"
+CM_DTYPES = ("compute", "bf16")
+CM_STRICT = "strict"
+
+KNOWN_COMM_KEYS = {COLLECTIVE_MATMUL}
+KNOWN_COLLECTIVE_MATMUL_KEYS = {
+    CM_ENABLED, CM_TENSOR_PARALLEL, CM_ZERO_GATHER, CM_CHUNKS, CM_DTYPE,
+    CM_STRICT,
+}
+
+
+class CollectiveMatmulConfig(object):
+    """Typed view of ``comm.collective_matmul``."""
+
+    def __init__(self, d):
+        d = d or {}
+        if not isinstance(d, dict):
+            raise ValueError(
+                "comm.collective_matmul must be a dict, got {}".format(
+                    type(d).__name__))
+        self.strict = bool(d.get(CM_STRICT, False))
+        unknown = sorted(k for k in d
+                         if k not in KNOWN_COLLECTIVE_MATMUL_KEYS)
+        if unknown:
+            warn_or_raise_noop(
+                "comm.collective_matmul.{} has NO effect: unknown key(s) "
+                "(accepted: {})".format(
+                    ", ".join(unknown),
+                    sorted(KNOWN_COLLECTIVE_MATMUL_KEYS)),
+                self.strict, flag="comm.collective_matmul.strict")
+        self.enabled = bool(d.get(CM_ENABLED, CM_ENABLED_DEFAULT))
+        self.tensor_parallel = bool(d.get(CM_TENSOR_PARALLEL,
+                                          CM_TENSOR_PARALLEL_DEFAULT))
+        self.zero_gather = bool(d.get(CM_ZERO_GATHER,
+                                      CM_ZERO_GATHER_DEFAULT))
+        chunks = d.get(CM_CHUNKS, CM_CHUNKS_DEFAULT)
+        if isinstance(chunks, bool) or not isinstance(chunks, int) or \
+                chunks < 1:
+            raise ValueError(
+                "comm.collective_matmul.{} must be an int >= 1, got "
+                "{!r}".format(CM_CHUNKS, chunks))
+        self.chunks = chunks
+        dtype = str(d.get(CM_DTYPE, CM_DTYPE_DEFAULT)).lower()
+        if dtype not in CM_DTYPES:
+            raise ValueError(
+                "comm.collective_matmul.{} must be one of {}, got "
+                "{!r}".format(CM_DTYPE, CM_DTYPES, dtype))
+        self.dtype = dtype
+        if self.enabled and not (self.tensor_parallel or self.zero_gather):
+            warn_or_raise_noop(
+                "comm.collective_matmul.enabled has NO effect: both "
+                "tensor_parallel and zero_gather are disabled",
+                self.strict, flag="comm.collective_matmul.strict")
+
+
+class DeepSpeedCommConfig(object):
+    """Typed view of the ``comm`` section of a ds_config dict."""
+
+    def __init__(self, param_dict):
+        d = (param_dict or {}).get(COMM, {}) or {}
+        if not isinstance(d, dict):
+            raise ValueError(
+                "comm section must be a dict, got {}".format(
+                    type(d).__name__))
+        self.collective_matmul = CollectiveMatmulConfig(
+            d.get(COLLECTIVE_MATMUL))

@@ -33,9 +33,82 @@ from .quantize import DEFAULT_BLOCK_SIZE
 
 _FP32_BYTES = 4
 
+# Nominal aggregate per-chip ICI bandwidth (bytes/s) for the analytic
+# overlap model in overlap_report(): order-of-magnitude public figures,
+# one home like mfu.PEAK_TFLOPS. The CPU entry is a nominal 10 GB/s so
+# CPU-rung overlap numbers stay nonzero and comparable across runs of
+# the same box, never meaningful in absolute terms.
+ICI_GBPS = {
+    "TPU v2": 500.0, "TPU v3": 700.0, "TPU v4": 1200.0,
+    "TPU v5 lite": 400.0, "TPU v5e": 400.0, "TPU v5": 1200.0,
+    "TPU v5p": 1200.0, "TPU v6 lite": 700.0, "TPU v6e": 700.0,
+    "cpu": 10.0,
+}
+
+
+def ici_bytes_per_s_for(device):
+    """Nominal ICI bytes/s for one chip of ``device`` (a jax Device or a
+    device-kind string); unknown kinds get the CPU nominal."""
+    kind = device if isinstance(device, str) \
+        else getattr(device, "device_kind", "cpu")
+    for name, gbps in ICI_GBPS.items():
+        if kind.lower().startswith(name.lower()):
+            return gbps * 1e9
+    return ICI_GBPS["cpu"] * 1e9
+
 
 def _ring_factor(group):
     return (group - 1) / group if group > 1 else 0.0
+
+
+def decomposed_collective_bytes(payload_bytes, group, chunks=1):
+    """Per-device wire bytes of a ring-DECOMPOSED all-gather or
+    reduce-scatter of ``payload_bytes``: ``group - 1`` ppermute hops of
+    one shard each — in any number of ``chunks`` pieces per hop —
+    moving exactly ``payload * (g-1)/g`` bytes, IDENTICAL to the
+    one-shot collective's ring pricing. ``chunks`` only changes the
+    grain the scheduler can overlap, never the bytes (pinned by
+    tests/unit/test_collective_matmul.py), which is why
+    ``estimate_step_comm_bytes`` needs no fusion-aware branch: the
+    estimates stay honest with collective_matmul on."""
+    del chunks  # granularity, not volume
+    return int(round(payload_bytes * _ring_factor(group)))
+
+
+def overlap_report(wire_est, step_time_s, fused_classes, device):
+    """Per-collective-class overlap efficiency for ONE step — the
+    T3-style scoreboard ``compute / (compute + exposed_collective)``,
+    embedded in the StepRecord as ``comm_overlap``.
+
+    ANALYTIC estimate, not a measurement: each class's collective time
+    is its ``wire_est`` bytes over the chip's nominal ICI bandwidth
+    (``ici_bytes_per_s_for``); a ring-fused class exposes none of it
+    (the hops hide under the partial GEMMs), an unfused class exposes
+    all of it, and compute is the measured step wall minus the exposed
+    total. ``fused_classes``: {"allgather": bool, "reduce": bool}.
+    """
+    if wire_est is None or not step_time_s or step_time_s <= 0:
+        return None
+    bw = ici_bytes_per_s_for(device)
+    classes = {
+        "allgather": float(wire_est.get("allgather_bytes_per_step", 0) or 0),
+        "reduce": float(wire_est.get("reduce_bytes_per_step", 0) or 0),
+    }
+    est = {k: v / bw for k, v in classes.items()}
+    exposed = {k: (0.0 if fused_classes.get(k) else est[k])
+               for k in classes}
+    compute = max(float(step_time_s) - sum(exposed.values()), 1e-9)
+    out = {}
+    for k in classes:
+        out[k] = {
+            "bytes": int(classes[k]),
+            "fused": bool(fused_classes.get(k)),
+            "est_collective_s": round(est[k], 9),
+            "exposed_s": round(exposed[k], 9),
+            "overlap_efficiency": round(compute / (compute + exposed[k]),
+                                        6),
+        }
+    return out
 
 
 def _payload(numel, itemsize, quantized, scale_itemsize, block_size):
@@ -184,6 +257,18 @@ def estimate_engine_comm_bytes(engine):
         "total_reduction_x": ratio(base["total_bytes"],
                                    cur["total_bytes"]),
     }
+    cm = getattr(engine, "_cm", None)
+    if cm is not None and cm.enabled:
+        # marker only: a ring-decomposed collective moves the bytes of
+        # the one-shot collective (decomposed_collective_bytes), so the
+        # byte totals above hold verbatim with fusion on
+        out["collective_matmul"] = {
+            "enabled": True,
+            "zero_gather_fused": bool(getattr(engine, "_cm_zero3", False)),
+            "tensor_parallel_fused": bool(getattr(engine, "_cm_tp",
+                                                  False)),
+            "chunks": int(cm.chunks),
+        }
     if plan.dp_size <= 1:
         # single-device rung (the CPU bench fallback): nothing crosses a
         # wire, so also project the same config at a nominal pod scale to

@@ -13,6 +13,7 @@ import logging
 
 from .constants import *
 from .config_utils import (get_scalar_param, dict_raise_error_on_duplicate_keys)
+from .comm.config import COMM, KNOWN_COMM_KEYS, DeepSpeedCommConfig
 from .zero.config import DeepSpeedZeroConfig
 from .zero.constants import (ZERO_OPTIMIZATION, ZERO_OPTIMIZATION_DISABLED,
                              MAX_STAGE_ZERO_OPTIMIZATION)
@@ -430,6 +431,31 @@ def get_checkpoint_keep_last_n(checkpoint_params):
     return val
 
 
+TRANSFORMER = "transformer"
+TRANSFORMER_FLASH_ATTENTION = "flash_attention"
+
+
+def get_transformer_flash_attention(param_dict):
+    """``transformer.flash_attention``: tri-state gate for the Pallas
+    flash-attention kernel on the dense training path. ``None`` (key or
+    section absent) leaves the model config's own default; true/false
+    override it at engine init. The kernel itself falls back to the XLA
+    reference automatically off-TPU (ops/transformer/attention.py), so
+    enabling it in a config that also runs on CPU rigs is safe."""
+    sub = param_dict.get(TRANSFORMER) or {}
+    if not isinstance(sub, dict):
+        raise DeepSpeedConfigError(
+            "transformer must be a dict, got {}".format(type(sub).__name__))
+    val = sub.get(TRANSFORMER_FLASH_ATTENTION)
+    if val is None:
+        return None
+    if not isinstance(val, bool):
+        raise DeepSpeedConfigError(
+            "transformer.{} must be a bool or null, got {!r}".format(
+                TRANSFORMER_FLASH_ATTENTION, val))
+    return val
+
+
 def get_pld_enabled(param_dict):
     if PROGRESSIVE_LAYER_DROP in param_dict:
         return get_scalar_param(param_dict[PROGRESSIVE_LAYER_DROP], PLD_ENABLED,
@@ -556,6 +582,9 @@ class DeepSpeedConfig(object):
         self.flops_profiler_config = DeepSpeedFlopsProfilerConfig(param_dict)
         self.inference_config = DeepSpeedInferenceConfig(param_dict)
         self.telemetry_config = DeepSpeedTelemetryConfig(param_dict)
+        self.comm_config = DeepSpeedCommConfig(param_dict)
+        self.transformer_flash_attention = \
+            get_transformer_flash_attention(param_dict)
 
         self.gradient_clipping = get_gradient_clipping(param_dict)
         self.grad_accum_dtype = get_grad_accum_dtype(param_dict)
@@ -674,7 +703,7 @@ class DeepSpeedConfig(object):
         "sparse_gradients", "prescale_gradients",
         "gradient_predivide_factor", "disable_allgather", "fp32_allreduce",
         "vocabulary_size", "config_validation", "data_types",
-        INFERENCE, TELEMETRY,
+        INFERENCE, TELEMETRY, COMM, TRANSFORMER,
         # deprecated boolean form + its companion (read_zero_config_deprecated)
         "allgather_size",
     }
@@ -713,6 +742,10 @@ class DeepSpeedConfig(object):
         "data_types": {"grad_accum_dtype"},
         INFERENCE: DeepSpeedInferenceConfig.KNOWN_KEYS,
         TELEMETRY: KNOWN_TELEMETRY_KEYS,
+        # nested collective_matmul keys are validated (strict-aware) by
+        # CollectiveMatmulConfig itself (runtime/comm/config.py)
+        COMM: KNOWN_COMM_KEYS,
+        TRANSFORMER: {TRANSFORMER_FLASH_ATTENTION},
         "elasticity": {"enabled", "max_train_batch_size",
                        "micro_batch_sizes", "min_gpus", "max_gpus",
                        "min_time", "prefer_larger_batch",

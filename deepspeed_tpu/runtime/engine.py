@@ -112,6 +112,8 @@ class DeepSpeedEngine:
         self.model = as_model(model, model_parameters)
         self._configure_precision()
         self._configure_zero()
+        self._configure_comm()
+        self._apply_transformer_overrides()
         self._configure_optimizer(optimizer)
         self._configure_lr_scheduler(lr_scheduler)
         self._configure_pld()
@@ -389,6 +391,105 @@ class DeepSpeedEngine:
         # large device_puts instead of one per shard — see _H2DBatcher)
         self._h2d_bucket_elems = int(zc.prefetch_bucket_size) \
             if zc.prefetch_bucket_size else ZERO_PREFETCH_DEFAULT
+
+    def _configure_comm(self):
+        """comm.collective_matmul: ring-decomposed all-gather/reduce-
+        scatter GEMMs (parallel/collective_matmul.py). Resolves which
+        fusion sites are live on this mesh/config:
+
+          * ``_cm_zero3``: the stage-3 per-leaf weight all-gather runs
+            as an explicit ppermute ring (composing with qwZ so the
+            rotated chunks stay int8 blocks + scales on the wire);
+          * ``_cm_tp``: the model's TP matmul sites run the fused
+            column/row ops — communicated to the model by attaching a
+            CollectiveMatmulBinding to its config.
+
+        Off (the default) leaves every path exactly as before; the
+        unfused XLA program stays the numerics oracle."""
+        cm = self._config.comm_config.collective_matmul
+        self._cm = cm
+        self._cm_zero3 = False
+        self._cm_tp = False
+        model_cfg = getattr(self.model, "config", None)
+        if getattr(model_cfg, "collective_matmul", None) is not None and \
+                not (cm.enabled and cm.tensor_parallel):
+            # the binding lives on the (possibly shared) model config
+            # object because the model's apply_fn closed over it — a
+            # previous engine's attach leaks into this one. This engine
+            # would RUN fused TP GEMMs while reporting them unfused;
+            # A/B comparisons need models built from fresh configs.
+            logger.warning(
+                "model config already carries a collective_matmul "
+                "binding (attached by a caller or a previous engine) "
+                "but this engine's comm.collective_matmul does not "
+                "enable TP fusion — the fused GEMMs still run, and "
+                "this engine's telemetry will not flag them; build "
+                "models from fresh configs for fused-vs-unfused "
+                "comparisons")
+        if not cm.enabled:
+            return
+        from ..parallel.topology import PIPE_AXIS, MODEL_AXIS
+        from ..telemetry.config import warn_or_raise_noop
+        if PIPE_AXIS in self.mesh.shape:
+            raise ValueError(
+                "comm.collective_matmul is not a certified combination "
+                "with pipeline parallelism (the pipe loop owns its "
+                "shard_map specs)")
+        stage = self._config.zero_optimization_stage
+        zc = self._config.zero_config
+        self._cm_zero3 = bool(
+            cm.zero_gather and stage >= 3 and
+            self.zero_plan.param_data_axes != () and
+            not bool(zc.cpu_offload_params))
+        mp = int(self.mesh.shape.get(MODEL_AXIS, 1))
+        if cm.tensor_parallel and mp > 1:
+            if hasattr(model_cfg, "collective_matmul"):
+                from ..parallel.collective_matmul import \
+                    CollectiveMatmulBinding
+                model_cfg.collective_matmul = CollectiveMatmulBinding(
+                    mesh=self.mesh, axis=MODEL_AXIS,
+                    chunks=int(cm.chunks), dtype=cm.dtype)
+                self._cm_tp = True
+            else:
+                warn_or_raise_noop(
+                    "comm.collective_matmul.tensor_parallel has NO "
+                    "effect: model {!r} exposes no collective_matmul "
+                    "config field".format(self.model.name), cm.strict,
+                    flag="comm.collective_matmul.strict")
+        if not (self._cm_zero3 or self._cm_tp):
+            warn_or_raise_noop(
+                "comm.collective_matmul is enabled but no fusion site "
+                "is live (needs ZeRO stage >= 3 data-sharded params "
+                "without cpu_offload_params, and/or a model mesh axis "
+                "> 1 on a binding-aware model)", cm.strict,
+                flag="comm.collective_matmul.strict")
+        else:
+            log_dist(
+                "collective_matmul ON: zero3_ring_gather={} tp_fused={} "
+                "chunks={} dtype={}".format(
+                    self._cm_zero3, self._cm_tp, cm.chunks, cm.dtype),
+                ranks=[0])
+
+    def _apply_transformer_overrides(self):
+        """``transformer.flash_attention``: flip the model config's
+        dense-path flash-attention gate from ds_config (previously only
+        reachable by constructing the model with use_flash_attention
+        set). The kernel auto-falls-back to the XLA reference off-TPU
+        (ops/transformer/attention.py), so true is safe on CPU rigs."""
+        flash = self._config.transformer_flash_attention
+        if flash is None:
+            return
+        model_cfg = getattr(self.model, "config", None)
+        if hasattr(model_cfg, "use_flash_attention"):
+            model_cfg.use_flash_attention = bool(flash)
+            log_dist("transformer.flash_attention={} applied to model "
+                     "{!r}".format(bool(flash), self.model.name),
+                     ranks=[0])
+        else:
+            logger.warning(
+                "transformer.flash_attention has NO effect: model %r "
+                "exposes no use_flash_attention config field",
+                self.model.name)
 
     def _zero_key_noop(self, key, why):
         """A zero_optimization key this runtime cannot honor: warn
@@ -775,12 +876,27 @@ class DeepSpeedEngine:
 
         return gather
 
+    def _param_gather_tree_fn(self):
+        """The stage-3 weight-materialization seam of the jitted steps:
+        the collective-matmul ring gather when comm.collective_matmul
+        is live for ZeRO-3 (carrying qwZ's int8 blocks + scales on the
+        rotated chunks when both are on), else the qwZ sharding-
+        constraint gather, else None (plain GSPMD gathers)."""
+        if getattr(self, "_cm_zero3", False):
+            from ..parallel.collective_matmul import make_zero3_gather_fn
+            from .comm.quantize import DEFAULT_BLOCK_SIZE
+            return make_zero3_gather_fn(
+                self.zero_plan, self.mesh, chunks=self._cm.chunks,
+                quantized=getattr(self, "_qwz_enabled", False),
+                block_size=DEFAULT_BLOCK_SIZE)
+        return self._qwz_gather_tree_fn()
+
     def _micro_step_fn(self):
         apply_fn = self.model.apply_fn
         gas = self.gradient_accumulation_steps()
         plan = self.zero_plan
         model = self.model
-        qwz = self._qwz_gather_tree_fn()
+        qwz = self._param_gather_tree_fn()
         qgz = getattr(self, "_qgz_enabled", False)
         if qgz:
             from .comm.quantize import quantize_with_error_feedback
@@ -995,6 +1111,28 @@ class DeepSpeedEngine:
                 self._tele_wire = None
         return self._tele_wire
 
+    def _telemetry_comm_overlap(self, step_time_s):
+        """Per-class overlap efficiency for this step's StepRecord:
+        wire.py's analytic compute/(compute+exposed-collective) model
+        against the measured step wall, with each class marked fused
+        only when THIS config's decomposition actually hides it.
+        wire.py's classes are the ZeRO collectives: the allgather class
+        (stage-3 weight gathers / stage-1-2 re-replication) is fused
+        exactly by the zero3 ring gather; the reduce class (the DP
+        gradient reduce-scatter) is never fused here — the ring
+        gather's backward deliberately leaves it to GSPMD. The TP
+        activation gathers/scatters the row/column ops hide are not in
+        wire's classes at all: their scoreboard is step_time_s/MFU."""
+        if self.telemetry is None:
+            return None
+        from .comm.wire import overlap_report
+        fused = {
+            "allgather": bool(getattr(self, "_cm_zero3", False)),
+            "reduce": False,
+        }
+        return overlap_report(self._telemetry_wire(), step_time_s, fused,
+                              self.telemetry._device)
+
     def _telemetry_window_begin(self):
         """Open the per-optimizer-step measurement window (wall clock,
         token and flops accumulators) and advance the trace window."""
@@ -1115,6 +1253,7 @@ class DeepSpeedEngine:
             model_flops_per_step=self._window_flops,
             phases=self._telemetry_phases(),
             wire=self._telemetry_wire(),
+            comm_overlap=self._telemetry_comm_overlap(dt),
             offload=self._telemetry_offload_stats(),
             pipe=pipe)
 
@@ -1198,7 +1337,7 @@ class DeepSpeedEngine:
     def _eval_fn(self):
         apply_fn = self.model.apply_fn
         model = self.model
-        qwz = self._qwz_gather_tree_fn()
+        qwz = self._param_gather_tree_fn()
 
         def eval_step(params, batch):
             if qwz is not None:

@@ -90,6 +90,20 @@ class StreamedOffloadRunner:
         self._step_upload_batches = 0
         self._step_upload_elems = 0
         self._segment_upload_bytes_peak = 0
+        # comm.collective_matmul composes with streaming through the
+        # MODEL config, not the params: uploads land replicated, so the
+        # ZeRO-3 ring gather has nothing to do here (the engine resolves
+        # _cm_zero3 False under cpu_offload_params), but a TP model axis
+        # still routes the segments' qkv/fc/proj GEMMs through the fused
+        # ring ops — the segment programs built by _run pick the binding
+        # up from the config at trace time.
+        self.collective_matmul = getattr(
+            getattr(engine.model, "config", None), "collective_matmul",
+            None) is not None
+        if self.collective_matmul:
+            log_dist(
+                "streamed offload: collective_matmul binding live — "
+                "segment TP GEMMs run ring-fused", ranks=[0])
         self._plan_groups()
 
     # ------------------------------------------------------------ planning
@@ -212,6 +226,7 @@ class StreamedOffloadRunner:
             "overlap_efficiency": round(compute / (compute + waits), 4)
             if (compute + waits) > 0 else None,
             "groups": len(self.groups),
+            "collective_matmul": self.collective_matmul,
         }
         return snap
 

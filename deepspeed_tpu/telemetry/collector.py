@@ -192,7 +192,8 @@ class TelemetryCollector(AtexitCloseMixin):
     def emit_train_step(self, *, step, step_time_s, loss, grad_norm,
                         loss_scale, overflow, skipped_steps, micro_steps,
                         tokens_per_step, model_flops_per_step, phases,
-                        wire=None, offload=None, pipe=None, hbm=None):
+                        wire=None, comm_overlap=None, offload=None,
+                        pipe=None, hbm=None):
         n = max(self._n_devices, 1)
         dt = max(float(step_time_s), 1e-12)
         rec = rec_mod.make_train_record(
@@ -208,7 +209,8 @@ class TelemetryCollector(AtexitCloseMixin):
             device=self._device, n_devices=n,
             phases=phases,
             hbm=hbm if hbm is not None else collect_memory_stats(),
-            wire=wire, offload=offload, pipe=pipe)
+            wire=wire, comm_overlap=comm_overlap, offload=offload,
+            pipe=pipe)
         self.sinks.emit(rec)
         if self.trace is not None:
             self.trace.on_step_end(step)

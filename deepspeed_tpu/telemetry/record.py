@@ -25,7 +25,7 @@ TRAIN_STEP_KEYS = (
     "model_flops_per_step", "mfu", "peak_flops_per_chip",
     "device", "n_devices",
     "phases", "phase_total_s",
-    "hbm", "wire", "offload", "pipe",
+    "hbm", "wire", "comm_overlap", "offload", "pipe",
 )
 
 SERVING_STEP_KEYS = (
@@ -43,7 +43,8 @@ def make_train_record(*, step, step_time_s, loss, grad_norm, loss_scale,
                       tokens_per_step, tokens_per_sec_per_chip,
                       model_flops_per_step, mfu, peak_flops_per_chip,
                       device, n_devices, phases, hbm, wire=None,
-                      offload=None, pipe=None, wall=None):
+                      comm_overlap=None, offload=None, pipe=None,
+                      wall=None):
     phases = {str(k): float(v) for k, v in (phases or {}).items()}
     return {
         "kind": KIND_TRAIN,
@@ -67,6 +68,10 @@ def make_train_record(*, step, step_time_s, loss, grad_norm, loss_scale,
         "phase_total_s": float(sum(phases.values())),
         "hbm": hbm,
         "wire": wire,
+        # per-collective-class overlap efficiency (wire.overlap_report):
+        # compute/(compute + exposed-collective), the T3-style scoreboard
+        # for the collective-matmul fusions
+        "comm_overlap": comm_overlap,
         "offload": offload,
         "pipe": pipe,
     }
@@ -148,7 +153,7 @@ def validate_step_record(rec):
         hbm = rec["hbm"]
         if not isinstance(hbm, dict) or "available" not in hbm:
             problems.append("hbm is not a dict with 'available'")
-        for key in ("wire", "offload", "pipe"):
+        for key in ("wire", "comm_overlap", "offload", "pipe"):
             if rec[key] is not None and not isinstance(rec[key], dict):
                 problems.append("{} is neither null nor a dict".format(key))
     else:

@@ -121,6 +121,8 @@ class WindowAggregator:
             out["skipped_steps"] = last["skipped_steps"]
             out["hbm_last"] = last["hbm"]
             out["wire"] = last["wire"]
+            if last.get("comm_overlap") is not None:
+                out["comm_overlap_last"] = last["comm_overlap"]
             if last["offload"] is not None:
                 out["offload_last"] = last["offload"]
             if last["pipe"] is not None:

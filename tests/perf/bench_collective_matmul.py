@@ -1,0 +1,140 @@
+"""Collective-matmul microbench: fused vs unfused ZeRO-3 + TP step.
+
+Two engines of the same small GPT-2 on a (data x model) mesh — the
+unfused XLA oracle vs ``comm.collective_matmul`` (ring-decomposed
+stage-3 weight gathers + fused TP GEMMs) — measured in INTERLEAVED
+blocks like bench_telemetry_overhead.py (sequential whole-run blocks
+alias machine drift on a shared CPU box). Emits one JSON line in
+bench.py's shape (validated by bin/check_bench_schema.py) plus the
+committed artifact tests/perf/BENCH_COLLECTIVE_MATMUL.json.
+
+value = fused median step time; vs_baseline = unfused/fused (> 1 means
+fused is faster). On the CPU rung there is no ICI to hide, so the
+honest expectation is ~1.0 (the ring adds real ppermutes XLA's CPU
+lowering cannot overlap) — the artifact exists to pin the machinery,
+the wire-byte equality, and the per-class overlap_efficiency records;
+the latency win is a TPU claim priced by wire.overlap_report.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=4").strip()
+
+ROUNDS = 6
+BLOCK = 4
+WARMUP = 2
+
+
+def _engine(fused):
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.parallel.topology import build_mesh
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+    from bench import scratch_telemetry_dir
+    cfg = gpt2.GPT2Config(vocab_size=512, max_seq_len=128, n_layers=4,
+                          n_heads=4, d_model=256,
+                          use_flash_attention=False, remat=False)
+    ds = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 3,
+                              "stage3_param_persistence_threshold": 0},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "steps_per_print": 10 ** 9,
+        "telemetry": {"enabled": True,
+                      "output_path": scratch_telemetry_dir(
+                          "cm_bench_{}_".format("on" if fused
+                                                else "off"))},
+    }
+    if fused:
+        ds["comm"] = {"collective_matmul": {"enabled": True, "chunks": 2}}
+    engine = DeepSpeedEngine(model=gpt2.make_gpt2_model(config=cfg),
+                             mesh=build_mesh(data=2, model=2),
+                             config_params=ds)
+    return engine, cfg
+
+
+def main():
+    import jax
+    eng_off, cfg = _engine(False)
+    eng_on, _ = _engine(True)
+    assert eng_on._cm_tp and eng_on._cm_zero3
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size,
+                      size=(1, 2 * eng_off.dp_world_size,
+                            cfg.max_seq_len)).astype(np.int32)
+
+    def step(eng):
+        return eng.train_batch(batch=(ids, ids.copy()))
+
+    losses = {}
+    for name, eng in (("off", eng_off), ("on", eng_on)):
+        for _ in range(WARMUP):
+            losses[name] = float(step(eng))
+    times = {"off": [], "on": []}
+    ratios = []
+    for r in range(ROUNDS):
+        order = [("off", eng_off), ("on", eng_on)]
+        if r % 2:
+            order.reverse()
+        med = {}
+        for name, eng in order:
+            block = []
+            for _ in range(BLOCK):
+                t0 = time.time()
+                float(step(eng))
+                block.append(time.time() - t0)
+            times[name].extend(block)
+            med[name] = float(np.median(block))
+        ratios.append(med["off"] / med["on"])
+
+    off = float(np.median(times["off"]))
+    on = float(np.median(times["on"]))
+    snap = eng_on.telemetry_snapshot()
+    overlap = snap.get("comm_overlap_last")
+    rel_loss = abs(losses["on"] - losses["off"]) / \
+        max(abs(losses["off"]), 1e-9)
+    payload = {
+        "metric": "collective_matmul_fused_step_time",
+        "value": round(on, 6),
+        "unit": "s/step",
+        # unfused/fused median-of-paired-ratios: > 1 means fused faster
+        "vs_baseline": round(float(np.median(ratios)), 4),
+        "extra": {
+            "median_step_s_unfused": round(off, 6),
+            "median_step_s_fused": round(on, 6),
+            "per_round_off_on_ratios": [round(r, 4) for r in ratios],
+            "steps_per_engine": WARMUP + ROUNDS * BLOCK,
+            "warmup_loss_rel_diff": round(rel_loss, 6),
+            "comm_overlap_last": overlap,
+            "wire_collective_matmul":
+                (snap.get("wire") or {}).get("collective_matmul"),
+            "chunks": 2,
+            "mesh": {"data": 2, "model": 2},
+            "device": getattr(jax.devices()[0], "device_kind", "cpu"),
+            "backend": jax.default_backend(),
+            "telemetry": snap,
+        },
+    }
+    print(json.dumps(payload))
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_COLLECTIVE_MATMUL.json")
+    with open(out, "w") as fh:
+        json.dump(payload, fh, indent=2)
+        fh.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,354 @@
+"""Collective matmul: ring-decomposed all-gather/reduce-scatter GEMMs
+vs the unfused XLA oracle — forward, custom_vjp backward, the
+qwZ-composed int8 ZeRO-3 ring gather, wire pricing, and the config
+gate — on sub-meshes of the 8-device CPU mesh (world sizes 1/2/4).
+
+Tolerances: fp32 is near-bit (the column op's per-block GEMMs contract
+identically to the monolithic dot; the row op re-orders the n-way
+partial-sum reduction); bf16 engine runs inherit the usual half-width
+drift (documented in docs/collective_matmul.md).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.parallel.collective_matmul import (
+    CollectiveMatmulBinding, make_zero3_gather_fn, tp_column_matmul,
+    tp_row_matmul, zero3_ring_gather)
+from deepspeed_tpu.parallel.ring import even_chunk_count, ring_perm
+
+pytestmark = pytest.mark.comm
+
+# one mesh per world size, shared across tests so the lru-cached jitted
+# shard_map wrappers compile once per (mesh, options)
+_MESHES = {}
+
+
+def _model_mesh(n):
+    if n not in _MESHES:
+        _MESHES[n] = Mesh(np.array(jax.devices()[:n]).reshape(n),
+                          ("model",))
+    return _MESHES[n]
+
+
+def _binding(n, **kw):
+    return CollectiveMatmulBinding(mesh=_model_mesh(n), axis="model", **kw)
+
+
+def _xw(rng, b, s, d, f, dtype=np.float32):
+    x = jnp.asarray(rng.randn(b, s, d).astype(dtype))
+    w = jnp.asarray(rng.randn(d, f).astype(dtype))
+    return x, w
+
+
+TOL_F32 = dict(atol=5e-6, rtol=5e-6)
+TOL_GRAD = dict(atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("n", [1, 2, 4])
+def test_column_forward_matches_unfused(n):
+    rng = np.random.RandomState(0)
+    x, w = _xw(rng, 2, 8, 16, 8 * max(n, 1))
+    out = tp_column_matmul(x, w, _binding(n))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w),
+                               **TOL_F32)
+
+
+@pytest.mark.parametrize("n", [1, 2, 4])
+def test_row_forward_matches_unfused(n):
+    rng = np.random.RandomState(1)
+    f = 8 * max(n, 1)
+    x, w = _xw(rng, 2, 8, f, 16)
+    out = tp_row_matmul(x, w, _binding(n))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w),
+                               **TOL_F32)
+
+
+@pytest.mark.parametrize("n", [2, 4])
+@pytest.mark.parametrize("kind", ["column", "row"])
+def test_backward_matches_unfused(n, kind):
+    rng = np.random.RandomState(2)
+    if kind == "column":
+        x, w = _xw(rng, 1, 8, 8, 8 * n)
+        fused = lambda x, w: tp_column_matmul(x, w, _binding(n))
+    else:
+        x, w = _xw(rng, 1, 8, 8 * n, 8)
+        fused = lambda x, w: tp_row_matmul(x, w, _binding(n))
+    gf = jax.grad(lambda x, w: jnp.sum(fused(x, w) ** 2),
+                  argnums=(0, 1))(x, w)
+    gr = jax.grad(lambda x, w: jnp.sum((x @ w) ** 2),
+                  argnums=(0, 1))(x, w)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   **TOL_GRAD)
+
+
+def test_chunked_rotation_bit_matches_single_hop():
+    # chunks only changes ppermute granularity, never the math
+    rng = np.random.RandomState(3)
+    x, w = _xw(rng, 2, 8, 16, 16)
+    one = tp_column_matmul(x, w, _binding(4, chunks=1))
+    many = tp_column_matmul(x, w, _binding(4, chunks=3))
+    np.testing.assert_array_equal(np.asarray(one), np.asarray(many))
+
+
+def test_bf16_wire_policy_tolerance():
+    # "bf16" casts the rotated payload only: lossy at half-width drift,
+    # not a rounding catastrophe
+    rng = np.random.RandomState(4)
+    x, w = _xw(rng, 2, 8, 16, 16)
+    lossy = tp_column_matmul(x, w, _binding(4, dtype="bf16"))
+    np.testing.assert_allclose(np.asarray(lossy), np.asarray(x @ w),
+                               atol=0.3, rtol=0.05)
+
+
+def test_shape_fallback_is_plain_matmul():
+    # indivisible seq -> one loud fallback, bitwise the unfused product
+    rng = np.random.RandomState(5)
+    x, w = _xw(rng, 2, 7, 16, 16)
+    out = tp_column_matmul(x, w, _binding(4))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x @ w))
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("n", [2, 4])
+def test_zero3_ring_gather_roundtrip(n, dtype):
+    rng = np.random.RandomState(6)
+    mesh = Mesh(np.array(jax.devices()[:n]).reshape(n), ("data",))
+    p = jnp.asarray(rng.randn(8 * n, 8), dtype=dtype)
+    p_sh = jax.device_put(p, NamedSharding(mesh, P("data", None)))
+    out = jax.jit(lambda q: zero3_ring_gather(
+        q, mesh, P("data", None), P(None, None), "data", 0, 2, False,
+        256))(p_sh)
+    # an unquantized ring gather of the shards IS the original array
+    np.testing.assert_array_equal(np.asarray(out, np.float32),
+                                  np.asarray(p, np.float32))
+
+
+@pytest.mark.parametrize("n", [1, 2, 4])
+def test_zero3_ring_gather_quantized_matches_per_shard_codec(n):
+    from deepspeed_tpu.runtime.comm.quantize import (dequantize_param,
+                                                     quantize_param)
+    rng = np.random.RandomState(7)
+    mesh = Mesh(np.array(jax.devices()[:n]).reshape(n), ("data",))
+    p = jnp.asarray(rng.randn(8 * n, 16).astype(np.float32))
+    p_sh = jax.device_put(p, NamedSharding(mesh, P("data", None)))
+    out = jax.jit(lambda q: zero3_ring_gather(
+        q, mesh, P("data", None), P(None, None), "data", 0, 1, True,
+        256))(p_sh)
+    # the wire carries each SHARD's int8 blocks + scales: the gathered
+    # result is exactly the concat of per-shard codec round-trips
+    ref = jnp.concatenate(
+        [dequantize_param(*quantize_param(p[i * 8:(i + 1) * 8]),
+                          jnp.float32) for i in range(n)], axis=0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_zero3_ring_gather_backward_is_straight_through():
+    n = 4
+    mesh = Mesh(np.array(jax.devices()[:n]).reshape(n), ("data",))
+    p = jnp.asarray(np.random.RandomState(8).randn(8 * n, 8)
+                    .astype(np.float32))
+    p_sh = jax.device_put(p, NamedSharding(mesh, P("data", None)))
+    c = jnp.asarray(np.random.RandomState(9).randn(8 * n, 8)
+                    .astype(np.float32))
+
+    def loss(q):
+        return jnp.sum(zero3_ring_gather(
+            q, mesh, P("data", None), P(None, None), "data", 0, 1,
+            False, 256) * c)
+
+    g = jax.jit(jax.grad(loss))(p_sh)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(c), atol=1e-6)
+
+
+def test_make_zero3_gather_fn_skips_persistent_leaves():
+    from deepspeed_tpu.runtime.zero.partition import ZeroShardingPlan
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("data",))
+    plan = ZeroShardingPlan(mesh, stage=3, param_persistence_threshold=0)
+    gather = make_zero3_gather_fn(plan, mesh, chunks=1)
+    tree = {"w": jnp.ones((8, 8), jnp.float32),
+            "tiny": jnp.ones((3,), jnp.float32)}   # no dp-divisible dim
+    placed = {
+        "w": jax.device_put(tree["w"],
+                            plan.param_sharding("w", (8, 8))),
+        "tiny": jax.device_put(tree["tiny"],
+                               plan.param_sharding("tiny", (3,))),
+    }
+    out = jax.jit(gather)(placed)
+    np.testing.assert_array_equal(np.asarray(out["w"]),
+                                  np.asarray(tree["w"]))
+    np.testing.assert_array_equal(np.asarray(out["tiny"]),
+                                  np.asarray(tree["tiny"]))
+
+
+# ------------------------------------------------------------ ring helper
+def test_ring_perm_shapes():
+    assert ring_perm(4) == [(0, 1), (1, 2), (2, 3), (3, 0)]
+    assert ring_perm(4, reverse=True) == [(0, 3), (1, 0), (2, 1), (3, 2)]
+    assert even_chunk_count(12, 5) == 4     # largest divisor <= 5
+    assert even_chunk_count(7, 3) == 1
+
+
+def test_ring_attention_still_matches_dense():
+    # the refactor onto parallel/ring.py must not move ring attention
+    from deepspeed_tpu.parallel import build_mesh
+    from deepspeed_tpu.parallel.ring_attention import (
+        _dense_reference_attention, sequence_parallel_attention)
+    rng = np.random.RandomState(10)
+    mk = lambda: jnp.asarray(rng.randn(1, 32, 2, 8).astype(np.float32))
+    q, k, v = mk(), mk(), mk()
+    mesh = build_mesh(sequence=4)
+    out = sequence_parallel_attention(q, k, v, mesh, impl="ring")
+    ref = _dense_reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+# ---------------------------------------------------------- wire pricing
+def test_ring_decomposition_prices_as_one_collective():
+    from deepspeed_tpu.runtime.comm.wire import (
+        decomposed_collective_bytes, _ring_factor)
+    payload = 4 * 1024 * 1024
+    one = decomposed_collective_bytes(payload, group=8, chunks=1)
+    for chunks in (2, 3, 16):
+        assert decomposed_collective_bytes(payload, 8, chunks) == one
+    assert one == int(round(payload * _ring_factor(8)))
+    assert decomposed_collective_bytes(payload, group=1) == 0
+
+
+def test_overlap_report_classes():
+    from deepspeed_tpu.runtime.comm.wire import overlap_report
+    est = {"allgather_bytes_per_step": 10 ** 9,
+           "reduce_bytes_per_step": 10 ** 9}
+    unfused = overlap_report(est, 1.0, {}, "cpu")
+    fused = overlap_report(est, 1.0,
+                           {"allgather": True, "reduce": True}, "cpu")
+    for cls in ("allgather", "reduce"):
+        assert 0 < unfused[cls]["overlap_efficiency"] < 1
+        assert fused[cls]["overlap_efficiency"] == 1.0
+        assert fused[cls]["bytes"] == unfused[cls]["bytes"]
+        assert fused[cls]["exposed_s"] == 0.0
+    assert overlap_report(None, 1.0, {}, "cpu") is None
+    assert overlap_report(est, 0.0, {}, "cpu") is None
+
+
+# ------------------------------------------------------------ config gate
+def test_config_parses_and_validates():
+    from deepspeed_tpu.runtime.comm.config import DeepSpeedCommConfig
+    cc = DeepSpeedCommConfig({"comm": {"collective_matmul": {
+        "enabled": True, "chunks": 4, "dtype": "bf16"}}})
+    cm = cc.collective_matmul
+    assert cm.enabled and cm.chunks == 4 and cm.dtype == "bf16"
+    assert cm.tensor_parallel and cm.zero_gather     # defaults
+    off = DeepSpeedCommConfig({}).collective_matmul
+    assert not off.enabled
+
+    with pytest.raises(ValueError):
+        DeepSpeedCommConfig({"comm": {"collective_matmul": {
+            "enabled": True, "chunks": 0}}})
+    with pytest.raises(ValueError):
+        DeepSpeedCommConfig({"comm": {"collective_matmul": {
+            "enabled": True, "dtype": "fp8"}}})
+    # unknown keys: warn by default, raise under strict (PR 4/5 policy)
+    DeepSpeedCommConfig({"comm": {"collective_matmul": {
+        "enabled": True, "bogus": 1}}})
+    with pytest.raises(ValueError):
+        DeepSpeedCommConfig({"comm": {"collective_matmul": {
+            "enabled": True, "strict": True, "bogus": 1}}})
+
+
+def test_transformer_flash_attention_key():
+    from deepspeed_tpu.runtime.config import (
+        DeepSpeedConfigError, get_transformer_flash_attention)
+    assert get_transformer_flash_attention({}) is None
+    assert get_transformer_flash_attention(
+        {"transformer": {"flash_attention": True}}) is True
+    assert get_transformer_flash_attention(
+        {"transformer": {"flash_attention": False}}) is False
+    with pytest.raises(DeepSpeedConfigError):
+        get_transformer_flash_attention(
+            {"transformer": {"flash_attention": "yes"}})
+
+
+def test_engine_applies_transformer_and_cm_gates():
+    """Engine wiring: transformer.flash_attention flips the model
+    config; comm.collective_matmul attaches a binding on a TP mesh and
+    the fused loss tracks the unfused oracle."""
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.parallel.topology import build_mesh
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    def engine(cm, flash=None):
+        conf = {
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 1,
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 3,
+                                  "stage3_param_persistence_threshold": 0},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "steps_per_print": 10 ** 9,
+        }
+        if cm:
+            conf["comm"] = {"collective_matmul": {"enabled": True,
+                                                  "chunks": 2}}
+        if flash is not None:
+            conf["transformer"] = {"flash_attention": flash}
+        cfg = gpt2.GPT2Config(vocab_size=128, max_seq_len=16, n_layers=1,
+                              n_heads=2, d_model=32,
+                              use_flash_attention=False, remat=False,
+                              loss_chunk=0)
+        return DeepSpeedEngine(model=gpt2.make_gpt2_model(config=cfg),
+                               mesh=build_mesh(data=2, model=2),
+                               config_params=conf)
+
+    e_on = engine(cm=True, flash=True)
+    assert e_on._cm_tp and e_on._cm_zero3
+    assert e_on.model.config.collective_matmul is not None
+    # flash flipped ON via ds_config; the dense path falls back to the
+    # XLA kernel off-TPU inside causal_attention
+    assert e_on.model.config.use_flash_attention is True
+
+    e_off = engine(cm=False)
+    assert not e_off._cm_tp and not e_off._cm_zero3
+    ids = np.random.RandomState(0).randint(
+        0, 128, size=(1, 4, 16)).astype(np.int32)
+    loss_on = float(e_on.train_batch(batch=(ids, ids.copy())))
+    loss_off = float(e_off.train_batch(batch=(ids, ids.copy())))
+    assert np.isfinite(loss_on)
+    assert abs(loss_on - loss_off) / abs(loss_off) < 1e-2
+
+
+def test_engine_cm_noop_without_site_warns_and_strict_raises():
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.parallel.topology import build_mesh
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    def conf(strict):
+        return {
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 1,
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 1},   # no stage-3 gathers
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "comm": {"collective_matmul": {"enabled": True,
+                                           "strict": strict}},
+        }
+
+    def build(strict):
+        cfg = gpt2.GPT2Config(vocab_size=128, max_seq_len=16, n_layers=1,
+                              n_heads=2, d_model=32,
+                              use_flash_attention=False, remat=False,
+                              loss_chunk=0)
+        # DP-only mesh: no model axis, no stage-3 -> no fusion site
+        return DeepSpeedEngine(model=gpt2.make_gpt2_model(config=cfg),
+                               mesh=build_mesh(data=2),
+                               config_params=conf(strict))
+
+    eng = build(strict=False)     # warns, engine still comes up
+    assert not eng._cm_tp and not eng._cm_zero3
+    with pytest.raises(ValueError):
+        build(strict=True)

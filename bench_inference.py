@@ -1,6 +1,6 @@
 """Benchmark: GPT-2 serving throughput through the inference subsystem.
 
-Prints ONE JSON line in bench.py's shape:
+Default mode prints ONE JSON line in bench.py's shape:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
 
 value = decode tokens/s/chip through the continuous-batching scheduler
@@ -8,6 +8,16 @@ value = decode tokens/s/chip through the continuous-batching scheduler
 (2N flops/token, forward only) against a 5% target — decode is
 HBM-bandwidth bound, so single-digit MFU is the healthy regime and 0.05
 is the modest north star this harness tracks.
+
+``--serving-trace [--out PATH]`` runs the HEAVY-TRAFFIC synthetic trace
+instead (ISSUE 7): Zipf-distributed prompt/output lengths, bursty
+Poisson arrivals, a shared system prompt on part of the traffic — three
+engine configs at EQUAL KV HBM budget (slot baseline; paged; paged +
+prefix sharing + ngram speculative decoding + chunked prefill), run
+INTERLEAVED per the PR 5/6 microbench discipline, reporting p50/p95
+TTFT, p50/p95 per-output-token latency, and goodput (completed-request
+tokens/s). The artifact (default tests/perf/BENCH_SERVING.json) is
+validated by bin/check_bench_schema.py.
 """
 import json
 import sys
@@ -102,7 +112,218 @@ def main():
     }))
 
 
+# ---------------------------------------------------------------------
+# heavy-traffic synthetic trace (ISSUE 7): slot vs paged vs paged+spec
+# ---------------------------------------------------------------------
+
+TRACE_SEED = 17
+HBM_BUDGET_TOKENS = 1024          # slot baseline: 4 slots x 256 max_seq
+TRACE_MAX_SEQ = 256
+TRACE_PAGE = 16
+
+
+def _zipf_clipped(rng, a, lo, hi, size):
+    vals = rng.zipf(a, size=size) + lo - 1
+    return np.clip(vals, lo, hi)
+
+
+def build_trace(vocab, n_requests=56):
+    """One fixed workload every config replays: Zipf prompt/output
+    lengths, Poisson-burst arrival offsets (seconds), a shared system
+    prompt on ~half the traffic, and document-sliced prompt bodies (so
+    prompt-lookup drafting sees the repetitive structure real text
+    has). Arrivals are deliberately faster than the slot baseline can
+    drain — goodput must measure CAPACITY under backlog, not offered
+    load."""
+    rng = np.random.RandomState(TRACE_SEED)
+    prompt_lens = _zipf_clipped(rng, 1.4, 4, 160, n_requests)
+    output_lens = _zipf_clipped(rng, 1.3, 12, 96, n_requests)
+    # "document": patterned token stream — windows of it repeat n-grams
+    doc = np.tile(rng.randint(0, vocab, size=192), 4)
+    system = rng.randint(0, vocab, size=48).tolist()
+    requests, t = [], 0.0
+    i = 0
+    while i < n_requests:
+        t += rng.exponential(0.06)                 # burst inter-arrival
+        for _ in range(min(1 + rng.poisson(2.0), n_requests - i)):
+            n = int(prompt_lens[i])
+            if i % 2 == 0 and n > 16:
+                body_n = max(n - len(system), 4)
+                start = rng.randint(0, len(doc) - body_n)
+                prompt = system + doc[start:start + body_n].tolist()
+            else:
+                start = rng.randint(0, len(doc) - n)
+                prompt = doc[start:start + n].tolist()
+            requests.append({"arrival_s": t, "prompt": prompt,
+                             "max_new_tokens": int(output_lens[i])})
+            i += 1
+    return requests
+
+
+def _trace_configs():
+    """Three engine configs at EQUAL KV HBM budget. The slot baseline
+    spends it as 4 contiguous max_seq rows; the paged configs spend the
+    same bytes as a 64-page pool and raise CONCURRENCY instead (mixed
+    Zipf lengths leave contiguous rows mostly empty)."""
+    # minus one: the paged pool carries a reserved garbage page, and it
+    # pays for it INSIDE the budget (usable 63 + garbage 1 = 64 pages =
+    # exactly the slot layout's 1024 token-slots)
+    pages = HBM_BUDGET_TOKENS // TRACE_PAGE - 1
+    base = {"max_seq_len": TRACE_MAX_SEQ, "dtype": "fp32", "greedy": True,
+            "prefill_buckets": [32, 64, 128, 256]}
+    slot = dict(base, max_batch_size=HBM_BUDGET_TOKENS // TRACE_MAX_SEQ)
+    paged = dict(base, max_batch_size=12, kv_layout="paged",
+                 kv_block_size=TRACE_PAGE, num_pages=pages)
+    paged_spec = dict(paged, prefix_caching=True, prefill_chunk_tokens=64,
+                      speculative={"enabled": True, "method": "ngram",
+                                   "num_draft_tokens": 6})
+    return {"slot": slot, "paged": paged, "paged_spec": paged_spec}
+
+
+def run_trace(engine, requests):
+    """Replay the trace against one engine: submit each request when its
+    arrival offset elapses, stepping the scheduler continuously. Returns
+    the per-run metrics summary."""
+    from deepspeed_tpu.inference.scheduler import ContinuousBatchingScheduler
+    from deepspeed_tpu.utils.monitor import ServingMetrics
+    if engine.prefix_cache is not None:
+        # every round starts COLD: a warm prefix cache from the prior
+        # round would hand the treatment config an advantage the slot
+        # baseline has no analog of
+        engine.prefix_cache.clear()
+    metrics = ServingMetrics()
+    sched = ContinuousBatchingScheduler(engine, metrics=metrics)
+    pending = sorted(requests, key=lambda r: r["arrival_s"])
+    t0 = time.perf_counter()
+    idx = 0
+    while idx < len(pending) or sched.has_work:
+        now = time.perf_counter() - t0
+        while idx < len(pending) and pending[idx]["arrival_s"] <= now:
+            req = pending[idx]
+            sched.submit(req["prompt"],
+                         max_new_tokens=req["max_new_tokens"])
+            # anchor TTFT at the TRACE arrival, not the (slightly
+            # later) submit poll — queueing delay is the trace's point
+            sched.queue[-1].arrival_t = t0 + req["arrival_s"]
+            idx += 1
+        if sched.has_work:
+            sched.step()
+        elif idx < len(pending):
+            time.sleep(min(0.005, pending[idx]["arrival_s"] - now))
+    wall = time.perf_counter() - t0
+    snap = metrics.snapshot()
+    out = {
+        "wall_seconds": round(wall, 3),
+        "goodput_tokens_per_sec": round(snap["completed_tokens"] / wall, 2),
+        "completed_requests": snap["completed_requests"],
+        "completed_tokens": snap["completed_tokens"],
+        "decode_tokens_per_sec": snap["decode_tokens_per_sec"],
+        "decode_steps": snap["decode_steps"],
+        "ttft_p50_s": snap["ttft"]["p50_s"],
+        "ttft_p95_s": snap["ttft"]["p95_s"],
+        "tpot_p50_s": snap["tpot"]["p50_s"],
+        "tpot_p95_s": snap["tpot"]["p95_s"],
+        "mean_slot_occupancy": snap["mean_slot_occupancy"],
+        "peak_queue_depth": snap["peak_queue_depth"],
+        "preemptions": sched.preemptions,
+    }
+    if snap.get("speculative"):
+        out["spec_acceptance_rate"] = snap["speculative"]["acceptance_rate"]
+        out["tokens_per_decode_step"] = round(
+            snap["decode_tokens"] / max(snap["decode_steps"], 1), 3)
+    if engine.prefix_stats() is not None:
+        out["prefix_hit_rate"] = engine.prefix_stats()["hit_rate"]
+    return out
+
+
+def serving_trace_main(out_path):
+    import jax
+    import deepspeed_tpu as deepspeed
+    from deepspeed_tpu.models import gpt2
+
+    cfg = gpt2.GPT2Config(vocab_size=512, max_seq_len=TRACE_MAX_SEQ,
+                          n_layers=2, n_heads=4, d_model=128,
+                          use_flash_attention=False, remat=False)
+    model = gpt2.make_gpt2_model(config=cfg)
+    requests = build_trace(cfg.vocab_size)
+    engines = {}
+    for name, inf in _trace_configs().items():
+        engines[name] = deepspeed.init_inference(
+            model=model, config={"inference": inf})
+        # KV budget really is equal across configs
+        assert engines[name].kv.nbytes == \
+            engines["slot"].kv.nbytes, (name, engines[name].kv.nbytes)
+        # warmup: compile every bucket + decode/verify off the clock
+        engines[name].generate(
+            [r["prompt"] for r in requests[:len(inf["prefill_buckets"])]],
+            max_new_tokens=8)
+
+    rounds = 3                  # odd: the middle of the sort IS a median
+    results = {name: [] for name in engines}
+    for _ in range(rounds):
+        # interleaved rounds: machine drift hits every config equally
+        for name, engine in engines.items():
+            results[name].append(run_trace(engine, requests))
+
+    def median_run(runs):
+        return sorted(runs,
+                      key=lambda r: r["goodput_tokens_per_sec"])[
+                          len(runs) // 2]
+
+    configs = {name: median_run(runs) for name, runs in results.items()}
+    ratio = (configs["paged_spec"]["goodput_tokens_per_sec"] /
+             configs["slot"]["goodput_tokens_per_sec"])
+    payload = {
+        "metric": "gpt2_serving_goodput_ratio_paged_spec_vs_slot",
+        "value": round(ratio, 3),
+        "unit": "x",
+        # acceptance floor: >= 1.5x goodput at equal HBM budget
+        "vs_baseline": round(ratio / 1.5, 4),
+        "extra": {
+            "serving_trace": {
+                "trace": {"requests": len(requests), "seed": TRACE_SEED,
+                          "prompt_len_max": max(len(r["prompt"])
+                                                for r in requests),
+                          "output_len_max": max(r["max_new_tokens"]
+                                                for r in requests),
+                          "span_s": round(requests[-1]["arrival_s"], 2)},
+                "hbm_budget_tokens": HBM_BUDGET_TOKENS,
+                "kv_bytes_per_config": engines["slot"].kv.nbytes,
+                "rounds": rounds,
+                "configs": configs,
+            },
+            "goodput_ratio_paged_vs_slot": round(
+                configs["paged"]["goodput_tokens_per_sec"] /
+                configs["slot"]["goodput_tokens_per_sec"], 3),
+            "device": getattr(jax.devices()[0], "device_kind", "cpu"),
+            "backend": jax.default_backend(),
+        },
+    }
+    line = json.dumps(payload)
+    print(line)
+    if out_path:
+        with open(out_path, "w") as fh:
+            fh.write(line + "\n")
+    return 0
+
+
 if __name__ == "__main__":
+    if "--serving-trace" in sys.argv:
+        out = "tests/perf/BENCH_SERVING.json"
+        if "--out" in sys.argv:
+            idx = sys.argv.index("--out") + 1
+            if idx >= len(sys.argv):
+                emit_error_json(
+                    "gpt2_serving_goodput_ratio_paged_spec_vs_slot",
+                    ValueError("--out needs a path argument"))
+                sys.exit(1)
+            out = sys.argv[idx]
+        try:
+            sys.exit(serving_trace_main(out))
+        except Exception as err:  # noqa: BLE001 - parseable JSON always
+            emit_error_json("gpt2_serving_goodput_ratio_paged_spec_vs_slot",
+                            err)
+            sys.exit(1)
     try:
         sys.exit(main())
     except Exception as err:  # noqa: BLE001 - emit parseable JSON, not a trace

@@ -21,6 +21,18 @@ import sys
 
 _NUM = (int, float)
 
+# Local copy of telemetry/record.py SERVING_SUBDICT_KEYS: this checker
+# must stay runnable as a bare stdlib script (no deepspeed_tpu/jax
+# import from bin/). tests/unit/test_serving.py pins the two tables
+# equal so they cannot drift.
+SERVING_SUBDICT_KEYS = {
+    "ttft": ("count", "mean_s", "p50_s", "p95_s"),
+    "tpot": ("count", "mean_s", "p50_s", "p95_s"),
+    "page_pool": ("num_pages", "pages_in_use", "occupancy"),
+    "prefix": ("lookups", "hits", "hit_rate"),
+    "speculative": ("proposed", "accepted", "acceptance_rate"),
+}
+
 
 def _is_num(val):
     return isinstance(val, _NUM) and not isinstance(val, bool)
@@ -59,8 +71,60 @@ def check_telemetry_snapshot(snap):
             _check_dist(snap.get(name), name, problems)
         if not isinstance(snap.get("phases_mean_s"), dict):
             problems.append("telemetry.phases_mean_s is not a dict")
-    if serving > 0 and not isinstance(snap.get("serving"), dict):
-        problems.append("telemetry.serving is not a dict")
+    if serving > 0:
+        srv = snap.get("serving")
+        if not isinstance(srv, dict):
+            problems.append("telemetry.serving is not a dict")
+        else:
+            # serving-memory/latency gauges (ISSUE 7): optional — a
+            # slot-layout engine emits none — but when present they
+            # must carry their numeric fields
+            for key, want in SERVING_SUBDICT_KEYS.items():
+                sub = srv.get(key)
+                if sub is None:
+                    continue
+                if not isinstance(sub, dict):
+                    problems.append(
+                        "telemetry.serving.{} is not a dict".format(key))
+                    continue
+                for sub_key in want:
+                    if not _is_num(sub.get(sub_key)):
+                        problems.append(
+                            "telemetry.serving.{}.{} is not a number: "
+                            "{!r}".format(key, sub_key, sub.get(sub_key)))
+    return problems
+
+
+# per-config metrics every serving-trace artifact row must report
+SERVING_TRACE_CONFIG_KEYS = (
+    "goodput_tokens_per_sec", "completed_requests", "completed_tokens",
+    "wall_seconds", "ttft_p50_s", "ttft_p95_s", "tpot_p50_s", "tpot_p95_s",
+)
+
+
+def check_serving_trace(trace):
+    """-> list of problems with one ``extra.serving_trace`` payload
+    (bench_inference.py --serving-trace / tests/perf/BENCH_SERVING.json)."""
+    problems = []
+    if not isinstance(trace, dict):
+        return ["extra.serving_trace is not a dict"]
+    configs = trace.get("configs")
+    if not isinstance(configs, dict) or not configs:
+        return ["serving_trace.configs is not a non-empty dict"]
+    if "slot" not in configs:
+        problems.append("serving_trace.configs lacks the 'slot' baseline")
+    for name, cfg in configs.items():
+        if not isinstance(cfg, dict):
+            problems.append(
+                "serving_trace.configs.{} is not a dict".format(name))
+            continue
+        for key in SERVING_TRACE_CONFIG_KEYS:
+            if not _is_num(cfg.get(key)):
+                problems.append(
+                    "serving_trace.configs.{}.{} is not a number: "
+                    "{!r}".format(name, key, cfg.get(key)))
+    if not _is_num(trace.get("hbm_budget_tokens")):
+        problems.append("serving_trace.hbm_budget_tokens is not a number")
     return problems
 
 
@@ -126,8 +190,12 @@ def check_bench_payload(payload):
     if extra is not None:
         if not isinstance(extra, dict):
             problems.append("extra is not a dict")
-        elif "telemetry" in extra:
-            problems.extend(check_telemetry_snapshot(extra["telemetry"]))
+        else:
+            if "telemetry" in extra:
+                problems.extend(
+                    check_telemetry_snapshot(extra["telemetry"]))
+            if "serving_trace" in extra:
+                problems.extend(check_serving_trace(extra["serving_trace"]))
     return problems
 
 

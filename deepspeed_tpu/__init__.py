@@ -83,7 +83,7 @@ def initialize(args=None, model=None, optimizer=None, model_parameters=None,
 
 def init_inference(model=None, config=None, mp_size=1, mesh=None,
                    dtype=None, injection_policy=None,
-                   replace_method="auto", seed=0):
+                   replace_method="auto", seed=0, draft_model=None):
     """Initialize the DeepSpeed-TPU inference engine.
 
     Mirrors reference ``deepspeed.init_inference(model, mp_size, dtype,
@@ -104,6 +104,11 @@ def init_inference(model=None, config=None, mp_size=1, mesh=None,
     ``module_inject.hf_gpt2_to_gpt2_params`` using ``injection_policy``
     (default ``HFGPT2LayerPolicy``) — mirroring the reference's
     module-mutating injection.
+
+    ``inference.kv_layout: "paged"`` switches the engine to the paged KV
+    cache (+ ``prefix_caching``, ``speculative`` — docs/inference.md);
+    ``draft_model`` supplies the small GPT-2 drafter that
+    ``inference.speculative.method: "model"`` requires.
     """
     from .inference.engine import InferenceEngine
 
@@ -130,7 +135,7 @@ def init_inference(model=None, config=None, mp_size=1, mesh=None,
         mesh = build_mesh(data=jax.device_count() // mp_size, model=mp_size)
 
     return InferenceEngine(model, config=config, mesh=mesh, dtype=dtype,
-                           seed=seed)
+                           seed=seed, draft_model=draft_model)
 
 
 def _add_core_arguments(parser):

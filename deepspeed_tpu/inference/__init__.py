@@ -1,11 +1,15 @@
 """TPU-native inference serving: ``deepspeed_tpu.init_inference()``.
 
 Subsystem layout:
-  config.py    — the ds_config ``inference`` section
-  kv_cache.py  — preallocated slot-based KV cache, heads-sharded
-  engine.py    — InferenceEngine: jitted prefill + fused decode_step
-  sampling.py  — jit-compatible greedy/temperature/top-k/top-p
-  scheduler.py — continuous batching at decode-step granularity
+  config.py      — the ds_config ``inference`` section
+  kv_cache.py    — slot (contiguous) + paged (page-pool) KV caches,
+                   heads-sharded
+  paging.py      — host-side page allocator / prefix cache / chunk plans
+  engine.py      — InferenceEngine: jitted prefill + fused decode/verify
+  sampling.py    — jit-compatible greedy/temperature/top-k/top-p
+  speculative.py — ngram + small-model drafters
+  scheduler.py   — continuous batching at decode-step granularity with
+                   chunked-prefill admission and preemption
 
 ``runtime/config.py`` imports ``.config`` while it is itself still
 initializing, so the engine/scheduler classes (which import DeepSpeedConfig
@@ -15,17 +19,25 @@ from .config import DeepSpeedInferenceConfig, DeepSpeedInferenceConfigError
 
 __all__ = ["DeepSpeedInferenceConfig", "DeepSpeedInferenceConfigError",
            "InferenceEngine", "ContinuousBatchingScheduler",
-           "InferenceRequest", "KVCache"]
+           "InferenceRequest", "KVCache", "PagedKVCache", "PageAllocator",
+           "PrefixCache", "NGramDrafter", "ModelDrafter"]
+
+_LAZY = {
+    "InferenceEngine": "engine",
+    "ContinuousBatchingScheduler": "scheduler",
+    "InferenceRequest": "scheduler",
+    "KVCache": "kv_cache",
+    "PagedKVCache": "kv_cache",
+    "PageAllocator": "paging",
+    "PrefixCache": "paging",
+    "NGramDrafter": "speculative",
+    "ModelDrafter": "speculative",
+}
 
 
 def __getattr__(name):
-    if name == "InferenceEngine":
-        from .engine import InferenceEngine
-        return InferenceEngine
-    if name in ("ContinuousBatchingScheduler", "InferenceRequest"):
-        from . import scheduler
-        return getattr(scheduler, name)
-    if name == "KVCache":
-        from .kv_cache import KVCache
-        return KVCache
-    raise AttributeError(name)
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(name)
+    import importlib
+    return getattr(importlib.import_module("." + mod, __name__), name)

@@ -50,6 +50,46 @@ INFERENCE_TOP_K_DEFAULT = 0          # 0 disables top-k filtering
 INFERENCE_TOP_P = "top_p"
 INFERENCE_TOP_P_DEFAULT = 1.0        # 1.0 disables nucleus filtering
 
+# ---- paged KV cache (docs/inference.md "Paged KV cache") -------------
+# "slot": one contiguous [slots, layers, heads, max_seq, d_head] buffer
+# (the numerics oracle, default); "paged": global page pool + per-
+# sequence page tables — HBM scales with live tokens, enables prefix
+# sharing, admission beyond slots*max_seq worth of mixed lengths.
+INFERENCE_KV_LAYOUT = "kv_layout"
+INFERENCE_KV_LAYOUT_DEFAULT = "slot"
+_KV_LAYOUTS = ("slot", "paged")
+
+INFERENCE_KV_BLOCK_SIZE = "kv_block_size"       # tokens per page
+INFERENCE_KV_BLOCK_SIZE_DEFAULT = 16
+
+# pool size: explicit page count, OR a fraction of the slot layout's
+# footprint (num_pages = ceil(fraction * slots * max_seq / block)).
+# Setting both is a config error — one budget, stated once.
+INFERENCE_NUM_PAGES = "num_pages"
+INFERENCE_NUM_PAGES_DEFAULT = None
+INFERENCE_KV_POOL_FRACTION = "kv_pool_fraction"
+INFERENCE_KV_POOL_FRACTION_DEFAULT = 1.0
+
+# hash-matched shared prompt prefixes (system-prompt dedup); paged only
+INFERENCE_PREFIX_CACHING = "prefix_caching"
+INFERENCE_PREFIX_CACHING_DEFAULT = False
+
+# chunked prefill: admit long prompts in pieces of at most this many
+# tokens so one long prefill never stalls the decode batch; null = off
+INFERENCE_PREFILL_CHUNK_TOKENS = "prefill_chunk_tokens"
+INFERENCE_PREFILL_CHUNK_TOKENS_DEFAULT = None
+
+# ---- speculative decoding (docs/inference.md) ------------------------
+INFERENCE_SPECULATIVE = "speculative"
+SPEC_ENABLED = "enabled"
+SPEC_METHOD = "method"               # "ngram" | "model"
+SPEC_NUM_DRAFT_TOKENS = "num_draft_tokens"
+SPEC_NGRAM_MAX = "ngram_max"
+SPEC_NGRAM_MIN = "ngram_min"
+SPEC_KNOWN_KEYS = {SPEC_ENABLED, SPEC_METHOD, SPEC_NUM_DRAFT_TOKENS,
+                   SPEC_NGRAM_MAX, SPEC_NGRAM_MIN}
+_SPEC_METHODS = ("ngram", "model")
+
 
 class DeepSpeedInferenceConfigError(Exception):
     pass
@@ -69,6 +109,10 @@ class DeepSpeedInferenceConfig:
         INFERENCE_MAX_NEW_TOKENS, INFERENCE_EOS_TOKEN_ID,
         INFERENCE_GREEDY, INFERENCE_TEMPERATURE, INFERENCE_TOP_K,
         INFERENCE_TOP_P,
+        INFERENCE_KV_LAYOUT, INFERENCE_KV_BLOCK_SIZE,
+        INFERENCE_NUM_PAGES, INFERENCE_KV_POOL_FRACTION,
+        INFERENCE_PREFIX_CACHING, INFERENCE_PREFILL_CHUNK_TOKENS,
+        INFERENCE_SPECULATIVE,
     }
 
     def __init__(self, param_dict=None):
@@ -137,6 +181,111 @@ class DeepSpeedInferenceConfig:
         _require(0.0 < self.top_p <= 1.0,
                  "{} must be in (0, 1], got {!r}".format(INFERENCE_TOP_P,
                                                          self.top_p))
+
+        # ---- paged KV / prefix sharing / chunked prefill -------------
+        self.kv_layout = str(sub.get(INFERENCE_KV_LAYOUT,
+                                     INFERENCE_KV_LAYOUT_DEFAULT)).lower()
+        _require(self.kv_layout in _KV_LAYOUTS,
+                 "{} must be one of {}, got {!r}".format(
+                     INFERENCE_KV_LAYOUT, _KV_LAYOUTS, self.kv_layout))
+
+        self.kv_block_size = sub.get(INFERENCE_KV_BLOCK_SIZE,
+                                     INFERENCE_KV_BLOCK_SIZE_DEFAULT)
+        _require(isinstance(self.kv_block_size, int) and
+                 not isinstance(self.kv_block_size, bool) and
+                 self.kv_block_size >= 1,
+                 "{} must be an int >= 1, got {!r}".format(
+                     INFERENCE_KV_BLOCK_SIZE, self.kv_block_size))
+
+        self.num_pages = sub.get(INFERENCE_NUM_PAGES,
+                                 INFERENCE_NUM_PAGES_DEFAULT)
+        _require(self.num_pages is None or
+                 (isinstance(self.num_pages, int) and
+                  not isinstance(self.num_pages, bool) and
+                  self.num_pages >= 1),
+                 "{} must be an int >= 1 or null, got {!r}".format(
+                     INFERENCE_NUM_PAGES, self.num_pages))
+        _require(not (INFERENCE_NUM_PAGES in sub and
+                      INFERENCE_KV_POOL_FRACTION in sub),
+                 "set {} OR {}, not both (one HBM budget, stated "
+                 "once)".format(INFERENCE_NUM_PAGES,
+                                INFERENCE_KV_POOL_FRACTION))
+        self.kv_pool_fraction = float(
+            sub.get(INFERENCE_KV_POOL_FRACTION,
+                    INFERENCE_KV_POOL_FRACTION_DEFAULT))
+        _require(self.kv_pool_fraction > 0.0,
+                 "{} must be > 0, got {!r}".format(
+                     INFERENCE_KV_POOL_FRACTION, self.kv_pool_fraction))
+
+        self.prefix_caching = bool(sub.get(INFERENCE_PREFIX_CACHING,
+                                           INFERENCE_PREFIX_CACHING_DEFAULT))
+        _require(not (self.prefix_caching and self.kv_layout != "paged"),
+                 "{} requires {} \"paged\" (the slot layout has no pages "
+                 "to share)".format(INFERENCE_PREFIX_CACHING,
+                                    INFERENCE_KV_LAYOUT))
+
+        self.prefill_chunk_tokens = sub.get(
+            INFERENCE_PREFILL_CHUNK_TOKENS,
+            INFERENCE_PREFILL_CHUNK_TOKENS_DEFAULT)
+        _require(self.prefill_chunk_tokens is None or
+                 (isinstance(self.prefill_chunk_tokens, int) and
+                  not isinstance(self.prefill_chunk_tokens, bool) and
+                  self.prefill_chunk_tokens >= 1),
+                 "{} must be an int >= 1 or null, got {!r}".format(
+                     INFERENCE_PREFILL_CHUNK_TOKENS,
+                     self.prefill_chunk_tokens))
+
+        # ---- speculative decoding ------------------------------------
+        spec = sub.get(INFERENCE_SPECULATIVE, {})
+        _require(isinstance(spec, dict),
+                 "{} must be a dict, got {}".format(
+                     INFERENCE_SPECULATIVE, type(spec).__name__))
+        unknown = sorted(set(spec) - SPEC_KNOWN_KEYS)
+        _require(not unknown,
+                 "unknown key(s) {} in {!r} (known: {})".format(
+                     unknown, INFERENCE_SPECULATIVE,
+                     sorted(SPEC_KNOWN_KEYS)))
+        self.spec_enabled = bool(spec.get(SPEC_ENABLED, False))
+        self.spec_method = str(spec.get(SPEC_METHOD, "ngram")).lower()
+        _require(self.spec_method in _SPEC_METHODS,
+                 "{}.{} must be one of {}, got {!r}".format(
+                     INFERENCE_SPECULATIVE, SPEC_METHOD, _SPEC_METHODS,
+                     self.spec_method))
+        self.spec_num_draft_tokens = spec.get(SPEC_NUM_DRAFT_TOKENS, 4)
+        _require(isinstance(self.spec_num_draft_tokens, int) and
+                 not isinstance(self.spec_num_draft_tokens, bool) and
+                 self.spec_num_draft_tokens >= 1,
+                 "{}.{} must be an int >= 1, got {!r}".format(
+                     INFERENCE_SPECULATIVE, SPEC_NUM_DRAFT_TOKENS,
+                     self.spec_num_draft_tokens))
+        self.spec_ngram_max = spec.get(SPEC_NGRAM_MAX, 3)
+        self.spec_ngram_min = spec.get(SPEC_NGRAM_MIN, 1)
+        for key, val in ((SPEC_NGRAM_MAX, self.spec_ngram_max),
+                         (SPEC_NGRAM_MIN, self.spec_ngram_min)):
+            _require(isinstance(val, int) and not isinstance(val, bool)
+                     and val >= 1,
+                     "{}.{} must be an int >= 1, got {!r}".format(
+                         INFERENCE_SPECULATIVE, key, val))
+        _require(self.spec_ngram_min <= self.spec_ngram_max,
+                 "{}.{} must be <= {}".format(
+                     INFERENCE_SPECULATIVE, SPEC_NGRAM_MIN, SPEC_NGRAM_MAX))
+
+    def resolve_num_pages(self, slots, max_seq_len):
+        """Usable page-pool size for a concrete engine geometry: the
+        explicit ``num_pages``, else ``ceil(kv_pool_fraction * slots *
+        max_seq / kv_block_size)`` — fraction 1.0 = exactly the slot
+        layout's HBM footprint. Always at least one full sequence."""
+        pages_per_seq = -(-max_seq_len // self.kv_block_size)
+        if self.num_pages is not None:
+            n = self.num_pages
+        else:
+            n = -(-int(self.kv_pool_fraction * slots * max_seq_len)
+                  // self.kv_block_size)
+        _require(n >= pages_per_seq,
+                 "page pool of {} pages cannot hold one max_seq_len={} "
+                 "sequence ({} pages of {} tokens)".format(
+                     n, max_seq_len, pages_per_seq, self.kv_block_size))
+        return n
 
     def resolve_buckets(self, max_seq_len):
         """Final ascending bucket list for a concrete model max_seq_len:

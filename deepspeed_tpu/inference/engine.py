@@ -1,20 +1,34 @@
-"""InferenceEngine: slot-based KV-cache serving for GPT-2-family models.
+"""InferenceEngine: KV-cache serving for GPT-2-family models.
 
 The serving counterpart of ``runtime/engine.py``'s training engine,
-returned by ``deepspeed_tpu.init_inference()``. Two jitted hot paths:
+returned by ``deepspeed_tpu.init_inference()``. Jitted hot paths:
 
-  * ``prefill`` — embed one request's full prompt (padded to a length
-    bucket, so the number of jit traces is bounded by the bucket list),
-    write its K/V into the request's cache slot, sample the first token;
+  * ``prefill`` — embed one request's prompt (or one CHUNK of it, padded
+    to a length bucket so the number of jit traces is bounded by the
+    bucket list), write its K/V into the request's cache slot/pages,
+    sample the first token on the final chunk;
   * ``decode_step`` — one token for EVERY slot in a single fused step
     (slots, 1) -> logits -> sample, writing K/V at each slot's live
     length. Inactive slots compute garbage that the scheduler ignores;
-    their cache writes land past their live length and are masked out.
+    their cache writes are masked/garbage-paged out.
+  * ``verify_step`` — speculative decoding: score ``k`` drafted tokens
+    per slot in one fused (slots, k+1) pass; the scheduler accepts the
+    longest prefix the target agrees with (inference/speculative.py).
+
+Two KV layouts (``inference.kv_layout``):
+
+  * ``slot`` (default, the numerics oracle): one contiguous
+    ``(slots, layers, heads, max_seq, d_head)`` buffer pair;
+  * ``paged``: a pooled ``(pages, layers, heads, page_size, d_head)``
+    buffer pair plus host-side page tables (inference/paging.py) —
+    pages allocate on demand as sequences grow, shared prompt prefixes
+    map one set of pages into many tables (copy-on-write), and HBM
+    scales with live tokens instead of ``slots * max_seq``.
 
 Tensor parallelism: params are placed via the model's
-``partition_spec_fn`` (Megatron column/row layout) and the KV cache is
-sharded over its heads axis (kv_cache.KV_CACHE_SPEC), so XLA runs decode
-with each model shard attending over exactly the heads it owns.
+``partition_spec_fn`` (Megatron column/row layout) and both cache
+layouts shard their heads axis (kv_cache.KV_CACHE_SPEC), so XLA runs
+decode with each model shard attending over exactly the heads it owns.
 """
 import dataclasses
 
@@ -25,7 +39,8 @@ import jax.numpy as jnp
 
 from ..utils.logging import logger
 from .config import DeepSpeedInferenceConfig
-from .kv_cache import KVCache
+from .kv_cache import KVCache, PagedKVCache
+from .paging import GARBAGE_PAGE, PageAllocator, PrefixCache
 from .sampling import make_sampler
 
 _UNSET = object()    # "argument not given" (None means "no EOS token")
@@ -56,7 +71,8 @@ class InferenceEngine:
     attaches it). Prompt/token values are plain ints; all device state
     (params, KV cache) lives on ``mesh`` when one is given."""
 
-    def __init__(self, model, config=None, mesh=None, dtype=None, seed=0):
+    def __init__(self, model, config=None, mesh=None, dtype=None, seed=0,
+                 draft_model=None):
         from ..runtime.model import as_model
         self.module = as_model(model)
         model_config = getattr(self.module, "config", None) or \
@@ -103,17 +119,68 @@ class InferenceEngine:
                 jax.tree_util.tree_map(lambda t, i=i: t[i], blocks)
                 for i in range(model_config.n_layers)]
         self.params = self._place_params(params, self.dtype)
-        self.kv = KVCache.allocate(
-            self.num_slots, self.model_config.n_layers,
-            self.model_config.n_heads, self.max_seq_len,
-            self.model_config.d_head, self.dtype, mesh=mesh)
+
+        # ------------------------------------------------- KV cache layout
+        self.kv_layout = ic.kv_layout
+        self.page_size = ic.kv_block_size
+        if self.kv_layout == "paged":
+            self.max_pages = -(-self.max_seq_len // self.page_size)
+            num_pages = ic.resolve_num_pages(self.num_slots,
+                                             self.max_seq_len)
+            self.kv = PagedKVCache.allocate(
+                num_pages, self.model_config.n_layers,
+                self.model_config.n_heads, self.page_size,
+                self.model_config.d_head, self.dtype, mesh=mesh)
+            self.allocator = PageAllocator(num_pages)
+            # per-slot logical->physical map; GARBAGE_PAGE everywhere a
+            # slot has no allocation (jit writes there are redirected
+            # and reads position-masked)
+            self.page_tables = np.full((self.num_slots, self.max_pages),
+                                       GARBAGE_PAGE, np.int32)
+            self.page_counts = np.zeros((self.num_slots,), np.int32)
+            # pages matched at admission time per slot, so the first-
+            # chunk extension match knows where to resume
+            self._admit_matched = {}
+            self.prefix_cache = (
+                PrefixCache(self.allocator, self.page_size)
+                if ic.prefix_caching else None)
+        else:
+            self.max_pages = 0
+            self.kv = KVCache.allocate(
+                self.num_slots, self.model_config.n_layers,
+                self.model_config.n_heads, self.max_seq_len,
+                self.model_config.d_head, self.dtype, mesh=mesh)
+            self.allocator = None
+            self.page_tables = None
+            self.page_counts = None
+            self.prefix_cache = None
+
         # host mirror of each slot's live length (tokens whose K/V are in
         # the cache); the scheduler owns slot assignment on top of this
         self.lengths = np.zeros((self.num_slots,), np.int32)
 
+        # ------------------------------------------- speculative decoding
+        self.drafter = None
+        self.spec_k = 0
+        if ic.spec_enabled:
+            self.spec_k = ic.spec_num_draft_tokens
+            if ic.spec_method == "model":
+                from .speculative import ModelDrafter
+                assert draft_model is not None, \
+                    "inference.speculative.method 'model' needs " \
+                    "init_inference(..., draft_model=<small gpt2 Model>)"
+                self.drafter = ModelDrafter(
+                    draft_model, self.num_slots, self.max_seq_len,
+                    self.dtype, mesh=mesh)
+            else:
+                from .speculative import NGramDrafter
+                self.drafter = NGramDrafter(ic.spec_ngram_max,
+                                            ic.spec_ngram_min)
+
         self._rng = jax.random.PRNGKey(seed)
-        self._prefill_fns = {}       # (bucket, greedy, top_k) -> jit fn
-        self._decode_fns = {}        # (greedy, top_k) -> jit fn
+        self._prefill_fns = {}     # (bucket, greedy, top_k) -> jit fn
+        self._decode_fns = {}      # (width, greedy, top_k) -> jit fn
+        self._page_copy_fn = None
         self.compile_stats = {"prefill_traces": 0, "decode_traces": 0}
 
         # serving telemetry (docs/telemetry.md): the continuous-batching
@@ -134,9 +201,16 @@ class InferenceEngine:
             enabled=jax.process_index() == 0)
         logger.info(
             "InferenceEngine: slots={} max_seq={} buckets={} dtype={} "
-            "kv_cache={:.1f} MB".format(
+            "layout={} kv_cache={:.1f} MB{}{}".format(
                 self.num_slots, self.max_seq_len, self.prefill_buckets,
-                self.dtype_name, self.kv.nbytes / 2 ** 20))
+                self.dtype_name, self.kv_layout,
+                self.kv.nbytes / 2 ** 20,
+                " pages={}x{}".format(self.allocator.num_pages,
+                                      self.page_size)
+                if self.kv_layout == "paged" else "",
+                " spec_k={} drafter={}".format(
+                    self.spec_k, type(self.drafter).__name__)
+                if self.drafter is not None else ""))
 
     def telemetry_snapshot(self):
         """Rolling serving aggregate (occupancy/queue-depth p50/p95,
@@ -192,48 +266,88 @@ class InferenceEngine:
         from ..models import gpt2
         cfg = self.model_config
         sampler = make_sampler(greedy, top_k)
+        paged, ps = self.kv_layout == "paged", self.page_size
 
-        def prefill(params, k_cache, v_cache, ids, slot, length, rng,
-                    temperature, top_p):
-            # ids (1, bucket); slot/length scalar int32. The request's
-            # cache rows are sliced out, filled, and written back.
-            k_row = jax.lax.dynamic_slice_in_dim(k_cache, slot, 1, axis=0)
-            v_row = jax.lax.dynamic_slice_in_dim(v_cache, slot, 1, axis=0)
-            hidden, (k_row, v_row) = gpt2.forward_hidden(
-                params, ids, cfg, cache=(k_row, v_row),
-                positions=jnp.zeros((1,), jnp.int32))
-            k_cache = jax.lax.dynamic_update_slice_in_dim(
-                k_cache, k_row, slot, axis=0)
-            v_cache = jax.lax.dynamic_update_slice_in_dim(
-                v_cache, v_row, slot, axis=0)
-            last = jnp.take(hidden[0], length - 1, axis=0)    # (d,)
-            logits = self._last_logits(params, last[None])    # (1, V)
-            token = sampler(logits, rng, temperature, top_p)[0]
-            return k_cache, v_cache, token, logits[0]
+        if paged:
+            def prefill(params, k_cache, v_cache, ids, page_row, start,
+                        length, rng, temperature, top_p):
+                # ids (1, bucket); page_row (max_pages,); start/length
+                # scalar int32 — the chunk covers positions
+                # [start, start+length); padded tokens redirect to the
+                # garbage page via the masked scatter.
+                hidden, (k_cache, v_cache) = gpt2.forward_hidden(
+                    params, ids, cfg, cache=(k_cache, v_cache),
+                    positions=start[None], page_tables=page_row[None],
+                    valid_lens=length[None], page_size=ps)
+                last = jnp.take(hidden[0], length - 1, axis=0)     # (d,)
+                logits = self._last_logits(params, last[None])     # (1, V)
+                token = sampler(logits, rng, temperature, top_p)[0]
+                return k_cache, v_cache, token, logits[0]
+        else:
+            def prefill(params, k_cache, v_cache, ids, slot, start,
+                        length, rng, temperature, top_p):
+                # ids (1, bucket); slot/start/length scalar int32. The
+                # request's cache rows are sliced out, filled from
+                # position `start`, and written back.
+                k_row = jax.lax.dynamic_slice_in_dim(k_cache, slot, 1,
+                                                     axis=0)
+                v_row = jax.lax.dynamic_slice_in_dim(v_cache, slot, 1,
+                                                     axis=0)
+                hidden, (k_row, v_row) = gpt2.forward_hidden(
+                    params, ids, cfg, cache=(k_row, v_row),
+                    positions=start[None])
+                k_cache = jax.lax.dynamic_update_slice_in_dim(
+                    k_cache, k_row, slot, axis=0)
+                v_cache = jax.lax.dynamic_update_slice_in_dim(
+                    v_cache, v_row, slot, axis=0)
+                last = jnp.take(hidden[0], length - 1, axis=0)     # (d,)
+                logits = self._last_logits(params, last[None])     # (1, V)
+                token = sampler(logits, rng, temperature, top_p)[0]
+                return k_cache, v_cache, token, logits[0]
 
         fn = jax.jit(prefill, donate_argnums=(1, 2))
         self._prefill_fns[key] = fn
         self.compile_stats["prefill_traces"] += 1
         return fn
 
-    def _get_decode_fn(self, greedy, top_k):
-        key = (greedy, top_k)
+    def _get_decode_fn(self, greedy, top_k, width=1):
+        """The fused all-slot decode program: ``width`` new tokens per
+        slot (1 = plain decode; k+1 = the speculative verify pass —
+        one program family serves both)."""
+        key = (width, greedy, top_k)
         fn = self._decode_fns.get(key)
         if fn is not None:
             return fn
         from ..models import gpt2
         cfg = self.model_config
         sampler = make_sampler(greedy, top_k)
+        paged, ps = self.kv_layout == "paged", self.page_size
 
-        def decode(params, k_cache, v_cache, tokens, lengths, rng,
-                   temperature, top_p):
-            # tokens/lengths: (slots,) int32 — one new token per slot
-            hidden, (k_cache, v_cache) = gpt2.forward_hidden(
-                params, tokens[:, None], cfg, cache=(k_cache, v_cache),
-                positions=lengths)
-            logits = self._last_logits(params, hidden[:, 0])  # (slots, V)
-            next_tokens = sampler(logits, rng, temperature, top_p)
-            return k_cache, v_cache, next_tokens, logits
+        if paged:
+            def decode(params, k_cache, v_cache, tokens, lengths,
+                       page_tables, rng, temperature, top_p):
+                # tokens (slots, width); lengths (slots,) int32
+                hidden, (k_cache, v_cache) = gpt2.forward_hidden(
+                    params, tokens, cfg, cache=(k_cache, v_cache),
+                    positions=lengths, page_tables=page_tables,
+                    valid_lens=jnp.full_like(lengths, tokens.shape[1]),
+                    page_size=ps)
+                logits = self._last_logits(params, hidden)
+                flat = logits.reshape(-1, logits.shape[-1])
+                chosen = sampler(flat, rng, temperature,
+                                 top_p).reshape(tokens.shape)
+                return k_cache, v_cache, chosen, logits
+        else:
+            def decode(params, k_cache, v_cache, tokens, lengths, rng,
+                       temperature, top_p):
+                hidden, (k_cache, v_cache) = gpt2.forward_hidden(
+                    params, tokens, cfg, cache=(k_cache, v_cache),
+                    positions=lengths)
+                logits = self._last_logits(params, hidden)
+                flat = logits.reshape(-1, logits.shape[-1])
+                chosen = sampler(flat, rng, temperature,
+                                 top_p).reshape(tokens.shape)
+                return k_cache, v_cache, chosen, logits
 
         fn = jax.jit(decode, donate_argnums=(1, 2))
         self._decode_fns[key] = fn
@@ -243,6 +357,141 @@ class InferenceEngine:
     def _next_rng(self):
         self._rng, key = jax.random.split(self._rng)
         return key
+
+    # --------------------------------------------------- paged host state
+
+    def pages_for(self, n_tokens):
+        return -(-n_tokens // self.page_size)
+
+    def page_pool_stats(self):
+        """``{num_pages, pages_in_use, occupancy}`` — None on the slot
+        layout (it has no pool to meter)."""
+        return self.allocator.stats() if self.allocator is not None \
+            else None
+
+    def prefix_stats(self):
+        return self.prefix_cache.stats() if self.prefix_cache is not None \
+            else None
+
+    def try_admit(self, slot, context):
+        """Paged admission: match the prompt against the prefix cache
+        FIRST (mapping shared pages into this slot's table, refcounted)
+        and allocate fresh pages only for the unmatched suffix — under
+        pool pressure a second user of a 100-page system prompt needs
+        ~its private pages free, not the whole prompt's worth, and the
+        eviction ladder never has to eat the very entries the request
+        is about to use. Returns True, or False when the pool cannot
+        hold the suffix — the caller keeps the request queued. A second
+        match pass runs at first-chunk time (:meth:`match_prefix`) to
+        pick up pages a same-step burst sibling registers between
+        admission and prefill. Slot layout: always True."""
+        if self.kv_layout != "paged":
+            return True
+        n = len(context)
+        row = self.page_tables[slot]
+        matched = []
+        if self.prefix_cache is not None:
+            # cap the match below the full prompt: the first sampled
+            # token's logits must come from at least one real forward
+            matched, _ = self.prefix_cache.match(context, n - 1)
+        need = self.pages_for(n) - len(matched)
+        if not self.allocator.can_alloc(need) and \
+                self.prefix_cache is not None:
+            self.prefix_cache.evict(need)
+        if not self.allocator.can_alloc(need):
+            if self.prefix_cache is not None:
+                # refs AND stats roll back: a queued request retrying
+                # admission every step must not inflate the hit gauges
+                self.prefix_cache.unmatch(matched)
+            return False
+        for j, page in enumerate(matched):
+            row[j] = page
+        for j in range(len(matched), self.pages_for(n)):
+            row[j] = self.allocator.alloc()
+        self.page_counts[slot] = self.pages_for(n)
+        self._admit_matched[slot] = len(matched)
+        return True
+
+    def match_prefix(self, slot, context):
+        """Second match phase, at first-chunk time: extend the
+        admission match with pages a same-step burst sibling registered
+        in between (the burst's first member prefills and registers one
+        loop iteration before its siblings' first chunks). Newly
+        matched shared pages replace the slot's freshly-allocated ones,
+        which return to the pool. Returns the TOTAL number of leading
+        tokens already resident (the prefill start offset)."""
+        have = int(self._admit_matched.get(slot, 0)) \
+            if self.kv_layout == "paged" else 0
+        if self.prefix_cache is None:
+            return 0
+        extra, _ = self.prefix_cache.match(
+            context, len(context) - 1, skip_pages=have,
+            count_lookup=False)
+        row = self.page_tables[slot]
+        for j, page in enumerate(extra, start=have):
+            self.allocator.free(int(row[j]))
+            row[j] = page
+        return (have + len(extra)) * self.page_size
+
+    def ensure_pages(self, slot, upto_tokens):
+        """Grow ``slot``'s allocation to cover ``upto_tokens`` logical
+        positions. False when the pool is exhausted (after trying
+        prefix-cache eviction) — the scheduler preempts."""
+        if self.kv_layout != "paged":
+            return True
+        need = min(self.pages_for(upto_tokens), self.max_pages)
+        cur = int(self.page_counts[slot])
+        if need <= cur:
+            return True
+        if not self.allocator.can_alloc(need - cur) and \
+                self.prefix_cache is not None:
+            self.prefix_cache.evict(need - cur)
+        if not self.allocator.can_alloc(need - cur):
+            return False
+        for j in range(cur, need):
+            self.page_tables[slot, j] = self.allocator.alloc()
+        self.page_counts[slot] = need
+        return True
+
+    def register_prefix(self, slot, context):
+        """Record the prompt's FULL pages in the prefix cache once its
+        prefill completed (the cache takes its own refs; retiring this
+        sequence won't free them)."""
+        if self.prefix_cache is None:
+            return
+        full = len(context) // self.page_size
+        if full:
+            self.prefix_cache.register(
+                context, self.page_tables[slot, :full].tolist())
+
+    def _page_copy(self, src, dst):
+        if self._page_copy_fn is None:
+            def copy(k, v, src, dst):
+                return (k.at[dst].set(k[src]), v.at[dst].set(v[src]))
+            self._page_copy_fn = jax.jit(copy, donate_argnums=(0, 1))
+        k, v = self._page_copy_fn(self.kv.k, self.kv.v, jnp.int32(src),
+                                  jnp.int32(dst))
+        self.kv.update((k, v))
+
+    def _cow_writes(self, slot, first_pos, last_pos):
+        """Copy-on-write: fork any SHARED page the coming write range
+        ``[first_pos, last_pos]`` touches (refcount > 1 means a prefix
+        consumer or the prefix cache also maps it). Full-page prefix
+        sharing never appends into a shared page, so this is the safety
+        net that makes sharing granularity a policy choice rather than
+        a correctness constraint."""
+        if self.kv_layout != "paged":
+            return
+        lo = first_pos // self.page_size
+        hi = min(last_pos // self.page_size,
+                 int(self.page_counts[slot]) - 1)
+        for j in range(lo, hi + 1):
+            page = int(self.page_tables[slot, j])
+            if page != GARBAGE_PAGE and self.allocator.refcount(page) > 1:
+                new, forked = self.allocator.fork(page)
+                if forked:
+                    self._page_copy(page, new)
+                    self.page_tables[slot, j] = new
 
     # ------------------------------------------------------------ serving
 
@@ -255,53 +504,120 @@ class InferenceEngine:
             "(inference.prefill_buckets / max_seq_len)".format(
                 length, self.prefill_buckets[-1]))
 
-    def prefill(self, slot, prompt, sampling=None):
-        """Embed ``prompt`` (sequence of int token ids) into cache slot
-        ``slot`` and return the first sampled token (int)."""
+    def prefill_chunk(self, slot, tokens, start, sampling=None):
+        """Embed ``tokens`` (one prompt chunk) into ``slot`` at absolute
+        positions ``[start, start+len)`` and return the sampled token
+        from the chunk's last position (only meaningful on the FINAL
+        chunk — earlier chunks' callers discard it). Paged slots must
+        already hold pages covering the range (``try_admit``)."""
         assert 0 <= slot < self.num_slots
+        n = len(tokens)
+        assert n >= 1, "empty prefill chunk"
+        assert start + n < self.max_seq_len, \
+            "chunk end {} leaves no room to decode (max_seq_len " \
+            "{})".format(start + n, self.max_seq_len)
+        bucket = self.bucket_for(n)
+        greedy, top_k, temperature, top_p = self._sampling_key(sampling)
+        fn = self._get_prefill_fn(bucket, greedy, top_k)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :n] = np.asarray(tokens, np.int32)
+        if self.kv_layout == "paged":
+            self._cow_writes(slot, start, start + n - 1)
+            k, v, token, _ = fn(
+                self.params, self.kv.k, self.kv.v, jnp.asarray(ids),
+                jnp.asarray(self.page_tables[slot]), jnp.int32(start),
+                jnp.int32(n), self._next_rng(),
+                jnp.float32(temperature), jnp.float32(top_p))
+        else:
+            # the slot layout writes the padded bucket with one
+            # dynamic_update_slice — paging.plan_chunks guarantees
+            # start + bucket <= max_seq so XLA's start clamping can
+            # never shift the write over live positions
+            assert start + bucket <= self.max_seq_len, \
+                "chunk bucket {}@{} overruns max_seq_len {}".format(
+                    bucket, start, self.max_seq_len)
+            k, v, token, _ = fn(
+                self.params, self.kv.k, self.kv.v, jnp.asarray(ids),
+                jnp.int32(slot), jnp.int32(start), jnp.int32(n),
+                self._next_rng(), jnp.float32(temperature),
+                jnp.float32(top_p))
+        self.kv.update((k, v))
+        self.lengths[slot] = start + n
+        return int(token)
+
+    def prefill(self, slot, prompt, sampling=None):
+        """Single-shot prefill of a whole prompt (the unchunked path:
+        admission + one chunk). Returns the first sampled token."""
         n = len(prompt)
         assert n >= 1, "empty prompt"
         assert n < self.max_seq_len, \
             "prompt length {} leaves no room to decode (max_seq_len " \
             "{})".format(n, self.max_seq_len)
-        bucket = self.bucket_for(n)
-        greedy, top_k, temperature, top_p = self._sampling_key(sampling)
-        fn = self._get_prefill_fn(bucket, greedy, top_k)
-        ids = np.zeros((1, bucket), np.int32)
-        ids[0, :n] = np.asarray(prompt, np.int32)
-        k, v, token, _ = fn(
-            self.params, self.kv.k, self.kv.v, jnp.asarray(ids),
-            jnp.int32(slot), jnp.int32(n), self._next_rng(),
-            jnp.float32(temperature), jnp.float32(top_p))
-        self.kv.update((k, v))
-        self.lengths[slot] = n
-        return int(token)
+        if self.kv_layout == "paged" and \
+                int(self.page_counts[slot]) < self.pages_for(n):
+            assert self.ensure_pages(slot, n), "KV page pool exhausted"
+        return self.prefill_chunk(slot, prompt, 0, sampling=sampling)
 
     def decode_step(self, tokens, sampling=None):
-        """One decode step for ALL slots: ``tokens`` (slots,) are each
-        slot's most recent token (anything for inactive slots). Returns
-        the (slots,) int array of sampled next tokens; the caller decides
-        which slots' results are live and calls :meth:`advance` for them.
-        """
+        """One decode step for ALL slots: ``tokens`` (slots,) or
+        (slots, width) are each slot's pending token (+ drafted tokens
+        for the speculative verify pass; anything for inactive slots).
+        Returns the same-shaped int array of chosen tokens — for
+        width=1 the sampled next token per slot; the caller decides
+        which slots' results are live and calls :meth:`advance`."""
         tokens = np.asarray(tokens, np.int32)
-        assert tokens.shape == (self.num_slots,)
+        squeeze = tokens.ndim == 1
+        if squeeze:
+            tokens = tokens[:, None]
+        assert tokens.shape[0] == self.num_slots
+        width = tokens.shape[1]
         greedy, top_k, temperature, top_p = self._sampling_key(sampling)
-        fn = self._get_decode_fn(greedy, top_k)
-        k, v, next_tokens, _ = fn(
-            self.params, self.kv.k, self.kv.v, jnp.asarray(tokens),
-            jnp.asarray(self.lengths), self._next_rng(),
-            jnp.float32(temperature), jnp.float32(top_p))
+        fn = self._get_decode_fn(greedy, top_k, width=width)
+        if self.kv_layout == "paged":
+            for slot in range(self.num_slots):
+                if self.lengths[slot] > 0:
+                    self._cow_writes(slot, int(self.lengths[slot]),
+                                     int(self.lengths[slot]) + width - 1)
+            k, v, chosen, _ = fn(
+                self.params, self.kv.k, self.kv.v, jnp.asarray(tokens),
+                jnp.asarray(self.lengths), jnp.asarray(self.page_tables),
+                self._next_rng(), jnp.float32(temperature),
+                jnp.float32(top_p))
+        else:
+            k, v, chosen, _ = fn(
+                self.params, self.kv.k, self.kv.v, jnp.asarray(tokens),
+                jnp.asarray(self.lengths), self._next_rng(),
+                jnp.float32(temperature), jnp.float32(top_p))
         self.kv.update((k, v))
-        return np.asarray(next_tokens)
+        chosen = np.asarray(chosen)
+        return chosen[:, 0] if squeeze else chosen
 
-    def advance(self, slot):
-        """Account slot's decode-step cache write (its length grew by 1)."""
-        self.lengths[slot] += 1
+    def verify_step(self, tokens, sampling=None):
+        """Speculative verify: ``tokens`` (slots, k+1) = each slot's
+        pending token followed by its k drafts. Returns (slots, k+1)
+        ``chosen`` tokens — row i's entry j is the target's choice for
+        the position AFTER tokens[i, :j+1]; the scheduler accepts the
+        longest prefix with drafts[j] == chosen[j-1]."""
+        return self.decode_step(tokens, sampling=sampling)
+
+    def advance(self, slot, n=1):
+        """Account ``n`` committed cache writes for ``slot`` (its live
+        length grew by n: 1 per plain decode step, accepted+1 per
+        speculative verify step)."""
+        self.lengths[slot] += n
 
     def can_decode(self, slot):
         return self.lengths[slot] < self.max_seq_len
 
     def free_slot(self, slot):
+        """Retire a slot: release its pages back to the pool (shared
+        prefix pages just drop one reference) and zero its length."""
+        if self.kv_layout == "paged":
+            for j in range(int(self.page_counts[slot])):
+                self.allocator.free(int(self.page_tables[slot, j]))
+            self.page_tables[slot, :] = GARBAGE_PAGE
+            self.page_counts[slot] = 0
+            self._admit_matched.pop(slot, None)
         self.lengths[slot] = 0
 
     def generate(self, prompts, max_new_tokens=None, sampling=None,

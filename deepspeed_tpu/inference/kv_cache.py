@@ -1,17 +1,32 @@
-"""Preallocated slot-based KV cache for incremental decode.
+"""Preallocated KV caches for incremental decode: slot and paged layouts.
 
-One buffer pair ``(k, v)`` of shape ``(slots, layers, heads, max_seq,
+**Slot layout** (:class:`KVCache`, the numerics oracle and default): one
+buffer pair ``(k, v)`` of shape ``(slots, layers, heads, max_seq,
 d_head)`` holds every active request's attention state; a request owns one
 slot for its lifetime and its batch row in prefill/decode IS its slot
-index. Freed slots are reused without clearing — the absolute-position
-causal mask in the model's cached attention (models/gpt2.py
-``_cached_attn_ctx``) makes stale entries unreachable.
+index. Every admitted request pays ``max_seq`` worth of HBM regardless of
+its actual length.
 
-Sharding: the ``heads`` axis carries the tensor-parallel partition,
-matching ``models/gpt2.py::partition_spec_fn``'s Megatron layout on the
-``model`` mesh axis (QKV column-parallel => each model shard produces its
-own heads' K/V, so the cache rows it writes are exactly the rows it owns
-and decode inserts no cross-shard cache traffic).
+**Paged layout** (:class:`PagedKVCache`): a global pool of fixed-size
+pages ``(pages, layers, heads, page_size, d_head)`` plus host-side
+per-sequence page tables (inference/paging.py). Sequences allocate pages
+on demand as they grow, so HBM scales with LIVE tokens, not with
+``slots * max_seq`` — and shared prompt prefixes map one set of pages
+into many tables (prefix sharing). Physical page 0 is the reserved
+garbage page: never allocated, the target of every masked/padded write.
+
+Freed slots and recycled pages are reused WITHOUT clearing — the
+absolute-position causal mask in the model's cached attention
+(models/gpt2.py ``_attend_cache_rows``: ``k_pos <= q_pos``) makes stale
+entries unreachable in both layouts, for any garbage content including
+NaN (pinned by tests/unit/test_serving.py poison tests).
+
+Sharding: the ``heads`` axis carries the tensor-parallel partition in
+both layouts, matching ``models/gpt2.py::partition_spec_fn``'s Megatron
+layout on the ``model`` mesh axis (QKV column-parallel => each model
+shard produces its own heads' K/V, so the cache entries it writes are
+exactly the entries it owns and decode inserts no cross-shard cache
+traffic; page gathers index only replicated axes).
 """
 from dataclasses import dataclass
 
@@ -21,7 +36,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..parallel.topology import MODEL_AXIS
 
-# (slots, layers, heads, max_seq, d_head): heads sharded over the model axis
+# (slots, layers, heads, max_seq, d_head): heads sharded over the model
+# axis. The paged pool (pages, layers, heads, page_size, d_head) shards
+# the same axis index, so one spec serves both layouts.
 KV_CACHE_SPEC = P(None, None, MODEL_AXIS, None, None)
 
 
@@ -60,6 +77,56 @@ class KVCache:
     @property
     def max_seq_len(self):
         return self.k.shape[3]
+
+    @property
+    def nbytes(self):
+        return self.k.size * self.k.dtype.itemsize * 2
+
+    def buffers(self):
+        return self.k, self.v
+
+    def update(self, buffers):
+        self.k, self.v = buffers
+
+
+def _shard_heads(k, v, heads, mesh):
+    if mesh is not None and MODEL_AXIS in mesh.shape:
+        assert heads % mesh.shape[MODEL_AXIS] == 0, \
+            "n_heads {} not divisible by model-parallel degree {}".format(
+                heads, mesh.shape[MODEL_AXIS])
+        sharding = NamedSharding(mesh, KV_CACHE_SPEC)
+        k = jax.device_put(k, sharding)
+        v = jax.device_put(v, sharding)
+    return k, v
+
+
+@dataclass
+class PagedKVCache:
+    """The paged ``(k, v)`` pool: ``(num_pages + 1, layers, heads,
+    page_size, d_head)`` — physical page 0 is the reserved garbage page
+    (inference/paging.py), so ``num_pages`` counts USABLE pages. Buffers
+    are jax arrays updated functionally; the engine's jitted programs
+    donate them, so steady-state serving writes in place."""
+
+    k: object
+    v: object
+    page_size: int
+
+    @classmethod
+    def allocate(cls, num_pages, layers, heads, page_size, d_head, dtype,
+                 mesh=None):
+        shape = (num_pages + 1, layers, heads, page_size, d_head)
+        k, v = _shard_heads(jnp.zeros(shape, dtype),
+                            jnp.zeros(shape, dtype), heads, mesh)
+        return cls(k, v, int(page_size))
+
+    @property
+    def num_pages(self):
+        return self.k.shape[0] - 1          # minus the garbage page
+
+    @property
+    def num_layers(self):
+        return self.k.shape[1]
 
     @property
     def nbytes(self):

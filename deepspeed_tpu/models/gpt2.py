@@ -325,6 +325,54 @@ def _fused_attn_ctx(x, block_params, config):
         block_params["attn"]["qkv_bias"], config.n_heads)
 
 
+def _qkv_for_cache(x, block, config):
+    """Shared QKV projection for the cached (serving) attention paths:
+    -> q (b, s, h, dh), k/v (b, h, s, dh)."""
+    b, s, d = x.shape
+    h, dh = config.n_heads, config.d_head
+    qkv = x @ block["qkv_kernel"].astype(x.dtype) + \
+        block["qkv_bias"].astype(x.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, h, dh)
+    k = k.reshape(b, s, h, dh).transpose(0, 2, 1, 3)     # (b, h, s, dh)
+    v = v.reshape(b, s, h, dh).transpose(0, 2, 1, 3)
+    return q, k, v
+
+
+def _attend_cache_rows(q, k_rows, v_rows, positions, dh, valid_lens=None):
+    """Absolute-position causal attention of ``s`` new queries over the
+    full per-slot cache rows (b, h, S, dh). The ``k_pos <= q_pos`` mask
+    makes every entry past a slot's live length unreachable — stale K/V
+    from slot/page reuse and padded/garbage writes never contribute
+    (NaN-poison pinned by tests/unit/test_serving.py). Shared verbatim
+    by the slot and paged layouts so paged decode is bit-compatible
+    with the slot-cache oracle. ``valid_lens`` (b,) is how many of the
+    ``s`` input tokens are real per row (default: all — the slot
+    layout's padded-bucket write overwrites the whole span)."""
+    s = q.shape[1]
+    S = k_rows.shape[2]
+    qf = q.astype(jnp.float32) * (1.0 / math.sqrt(dh))
+    scores = jnp.einsum("bqhd,bhkd->bhqk", qf, k_rows.astype(jnp.float32))
+    k_pos = jnp.arange(S)[None, None, None, :]
+    q_pos = (positions[:, None] + jnp.arange(s)[None, :])[:, None, :, None]
+    scores = jnp.where(k_pos <= q_pos, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    # Zero V beyond the LIVE window — the last REAL token's position,
+    # not the padded bucket width: paged prefill redirects pad writes
+    # to the garbage page, so the row's own tail inside the bucket span
+    # keeps recycled-page content. Those lanes carry softmax weight
+    # exactly 0.0 for every real query, but 0 * NaN = NaN — non-finite
+    # stale V would contaminate the weighted sum despite the mask.
+    # Reachable positions are untouched, so finite-garbage numerics are
+    # bitwise unchanged (the K side needs no such guard: jnp.where
+    # REPLACES masked scores, it does not multiply them).
+    live = (positions + (valid_lens if valid_lens is not None else s) - 1)
+    live_v = jnp.arange(S)[None, :] <= live[:, None]
+    v_rows = jnp.where(live_v[:, None, :, None], v_rows, 0)
+    ctx = jnp.einsum("bhqk,bhkd->bqhd", probs, v_rows.astype(jnp.float32))
+    return ctx
+
+
 def _cached_attn_ctx(x, block, config, k_cache, v_cache, layer_idx,
                      positions):
     """Incremental attention against the slot-based KV cache.
@@ -334,18 +382,14 @@ def _cached_attn_ctx(x, block, config, k_cache, v_cache, layer_idx,
     ``positions[i] .. positions[i]+s`` and the query attends over the whole
     cache row under the absolute-position causal mask ``k_pos <= q_pos``
     (stale entries past a slot's live length are masked out, so slot reuse
-    needs no explicit cache clearing). One code path serves both prefill
-    (s = bucket, positions = 0) and decode (s = 1, positions = length).
+    needs no explicit cache clearing). One code path serves prefill
+    (s = bucket, positions = chunk start), decode (s = 1, positions =
+    length) and speculative verify (s = k+1, positions = length).
     Returns ``(ctx, k_cache, v_cache)`` — caches are functionally updated.
     """
     b, s, d = x.shape
-    h, dh = config.n_heads, config.d_head
-    qkv = x @ block["qkv_kernel"].astype(x.dtype) + \
-        block["qkv_bias"].astype(x.dtype)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(b, s, h, dh)
-    k = k.reshape(b, s, h, dh).transpose(0, 2, 1, 3)     # (b, h, s, dh)
-    v = v.reshape(b, s, h, dh).transpose(0, 2, 1, 3)
+    dh = config.d_head
+    q, k, v = _qkv_for_cache(x, block, config)
 
     def write_row(row, new, pos):
         # row (h, S, dh), new (h, s, dh): in-place update at seq offset pos
@@ -357,26 +401,74 @@ def _cached_attn_ctx(x, block, config, k_cache, v_cache, layer_idx,
                                  v.astype(v_cache.dtype), positions)
     k_cache = k_cache.at[:, layer_idx].set(k_rows)
     v_cache = v_cache.at[:, layer_idx].set(v_rows)
-
-    S = k_rows.shape[2]
-    qf = q.astype(jnp.float32) * (1.0 / math.sqrt(dh))
-    scores = jnp.einsum("bqhd,bhkd->bhqk", qf, k_rows.astype(jnp.float32))
-    k_pos = jnp.arange(S)[None, None, None, :]
-    q_pos = (positions[:, None] + jnp.arange(s)[None, :])[:, None, :, None]
-    scores = jnp.where(k_pos <= q_pos, scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1)
-    ctx = jnp.einsum("bhqk,bhkd->bqhd", probs, v_rows.astype(jnp.float32))
+    ctx = _attend_cache_rows(q, k_rows, v_rows, positions, dh)
     return ctx.astype(x.dtype).reshape(b, s, d), k_cache, v_cache
 
 
-def _forward_hidden_cached(params, input_ids, config, cache, positions):
+def _paged_attn_ctx(x, block, config, k_cache, v_cache, layer_idx,
+                    positions, page_tables, valid_lens, page_size):
+    """Incremental attention against the PAGED KV cache.
+
+    The cache is a global pool ``(pages, layers, heads, page_size,
+    d_head)``; ``page_tables`` (b, max_pages) int32 maps each slot's
+    logical page j to a physical page (entry 0 = the reserved garbage
+    page). Token i of row b writes at physical ``(page_tables[b, pos //
+    page_size], pos % page_size)`` via one masked scatter — padded
+    tokens (``i >= valid_lens[b]``) and positions past the logical
+    window redirect to the garbage page, so a bucket-padded prefill can
+    never touch another sequence's pages. Reads gather the slot's full
+    logical window back into contiguous (b, h, max_pages*page_size,
+    d_head) rows and run the same masked attention as the slot layout —
+    identical values in identical order, so paged decode is
+    bit-compatible with the slot-cache oracle.
+    """
+    b, s, d = x.shape
+    dh = config.d_head
+    max_pages = page_tables.shape[1]
+    q, k, v = _qkv_for_cache(x, block, config)
+
+    tok_pos = positions[:, None] + jnp.arange(s)[None, :]         # (b, s)
+    valid = (jnp.arange(s)[None, :] < valid_lens[:, None]) & \
+        (tok_pos < max_pages * page_size)
+    logical = jnp.clip(tok_pos // page_size, 0, max_pages - 1)
+    offset = tok_pos % page_size
+    page = jnp.take_along_axis(page_tables, logical, axis=1)
+    page = jnp.where(valid, page, 0)                # garbage-page redirect
+
+    # scatter the new K/V: value layout (b*s, h, dh) — the advanced
+    # (page, offset) indices broadcast to the front
+    flat_page, flat_off = page.reshape(-1), offset.reshape(-1)
+    k_new = k.transpose(0, 2, 1, 3).reshape(b * s, -1, dh)
+    v_new = v.transpose(0, 2, 1, 3).reshape(b * s, -1, dh)
+    k_cache = k_cache.at[flat_page, layer_idx, :, flat_off, :].set(
+        k_new.astype(k_cache.dtype))
+    v_cache = v_cache.at[flat_page, layer_idx, :, flat_off, :].set(
+        v_new.astype(v_cache.dtype))
+
+    def rows_of(cache):
+        # (P, h, ps, dh) --gather--> (b, max_pages, h, ps, dh)
+        # -> contiguous logical rows (b, h, max_pages*ps, dh)
+        gathered = jnp.take(cache[:, layer_idx], page_tables, axis=0)
+        return gathered.transpose(0, 2, 1, 3, 4).reshape(
+            b, gathered.shape[2], max_pages * page_size, dh)
+
+    ctx = _attend_cache_rows(q, rows_of(k_cache), rows_of(v_cache),
+                             positions, dh, valid_lens=valid_lens)
+    return ctx.astype(x.dtype).reshape(b, s, d), k_cache, v_cache
+
+
+def _forward_hidden_cached(params, input_ids, config, cache, positions,
+                           page_tables=None, valid_lens=None,
+                           page_size=None):
     """Cache-threaded variant of :func:`forward_hidden` for serving.
 
-    ``cache`` is ``(k, v)`` with shape (slots, layers, heads, max_seq,
-    d_head) — the inference KV cache (inference/kv_cache.py); input batch
-    size must equal the cache's slot count. ``positions`` (b,) int32 is the
-    absolute position of input_ids[:, 0] per slot. Returns
-    ``(hidden, (k, v))``.
+    ``cache`` is ``(k, v)``: the slot layout (slots, layers, heads,
+    max_seq, d_head) by default, or — when ``page_tables`` is given —
+    the paged pool (pages, layers, heads, page_size, d_head) indexed
+    per slot through ``page_tables`` (b, max_pages) with ``valid_lens``
+    (b,) masking padded writes (inference/kv_cache.py). ``positions``
+    (b,) int32 is the absolute position of input_ids[:, 0] per slot.
+    Returns ``(hidden, (k, v))``.
     """
     if config.scan_blocks or config.sequence_parallel or \
             config.sparse_attention:
@@ -393,8 +485,13 @@ def _forward_hidden_cached(params, input_ids, config, cache, positions):
     x = tok.astype(compute_dtype) + pos.astype(compute_dtype)
     for i, bp in enumerate(params["blocks"]):
         ln1 = _layer_norm(x, bp["ln1"]["scale"], bp["ln1"]["bias"])
-        ctx, k_cache, v_cache = _cached_attn_ctx(
-            ln1, bp["attn"], config, k_cache, v_cache, i, positions)
+        if page_tables is not None:
+            ctx, k_cache, v_cache = _paged_attn_ctx(
+                ln1, bp["attn"], config, k_cache, v_cache, i, positions,
+                page_tables, valid_lens, page_size)
+        else:
+            ctx, k_cache, v_cache = _cached_attn_ctx(
+                ln1, bp["attn"], config, k_cache, v_cache, i, positions)
         x = _block_rest(x, ctx, bp, config, rng=None, train=False)
     x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
     return x, (k_cache, v_cache)
@@ -433,18 +530,23 @@ def make_block_fn(config, train):
 
 
 def forward_hidden(params, input_ids, config, rng=None, train=False,
-                   cache=None, positions=None):
+                   cache=None, positions=None, page_tables=None,
+                   valid_lens=None, page_size=None):
     """Embedding + transformer stack -> final hidden states.
 
     With ``cache`` (a ``(k, v)`` KV-cache buffer pair) and ``positions``
     (per-row absolute offset of the first token) the stack runs the
-    incremental serving path and returns ``(hidden, cache)`` instead.
+    incremental serving path and returns ``(hidden, cache)`` instead;
+    ``page_tables``/``valid_lens``/``page_size`` switch the cache
+    indexing to the paged layout (see ``_paged_attn_ctx``).
     """
     if cache is not None:
         if positions is None:
             positions = jnp.zeros((input_ids.shape[0],), jnp.int32)
         return _forward_hidden_cached(params, input_ids, config, cache,
-                                      positions)
+                                      positions, page_tables=page_tables,
+                                      valid_lens=valid_lens,
+                                      page_size=page_size)
     b, s = input_ids.shape
     compute_dtype = params["ln_f"]["scale"].dtype
     if config.sparse_embedding_grads:

@@ -217,7 +217,8 @@ class TelemetryCollector(AtexitCloseMixin):
         return rec
 
     def emit_serving_step(self, *, step, metrics, active_slots,
-                          queue_depth, occupancy):
+                          queue_depth, occupancy, page_pool=None,
+                          prefix=None):
         rec = rec_mod.make_serving_record(
             step=step, slot_occupancy=occupancy, queue_depth=queue_depth,
             active_slots=active_slots,
@@ -225,7 +226,12 @@ class TelemetryCollector(AtexitCloseMixin):
             prefill_tokens_per_sec=metrics.prefill_tokens_per_sec,
             decode_tokens=metrics.decode_tokens,
             decode_steps=metrics.decode_steps,
-            decode_tokens_per_sec=metrics.decode_tokens_per_sec)
+            decode_tokens_per_sec=metrics.decode_tokens_per_sec,
+            ttft=metrics.ttft_dist(),
+            tpot=metrics.tpot_dist(),
+            page_pool=page_pool,
+            prefix=prefix,
+            speculative=metrics.spec_dist())
         self.sinks.emit(rec)
         if self.trace is not None:
             # on_step_begin ran at the top of the scheduler step (the

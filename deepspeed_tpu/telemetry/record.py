@@ -33,7 +33,23 @@ SERVING_STEP_KEYS = (
     "slot_occupancy", "queue_depth", "active_slots",
     "prefill_tokens", "prefill_tokens_per_sec",
     "decode_tokens", "decode_steps", "decode_tokens_per_sec",
+    # request-latency aggregates + the serving-memory/spec gauges
+    # (null until the engine feature producing them has fired):
+    # ttft/tpot {count, mean_s, p50_s, p95_s}; page_pool {num_pages,
+    # pages_in_use, occupancy} (paged layout only); prefix {lookups,
+    # hits, hit_rate, ...} (prefix_caching only); speculative
+    # {proposed, accepted, acceptance_rate} (speculative only)
+    "ttft", "tpot", "page_pool", "prefix", "speculative",
 )
+
+# nullable serving sub-dicts and the numeric keys each must carry
+SERVING_SUBDICT_KEYS = {
+    "ttft": ("count", "mean_s", "p50_s", "p95_s"),
+    "tpot": ("count", "mean_s", "p50_s", "p95_s"),
+    "page_pool": ("num_pages", "pages_in_use", "occupancy"),
+    "prefix": ("lookups", "hits", "hit_rate"),
+    "speculative": ("proposed", "accepted", "acceptance_rate"),
+}
 
 _NUMERIC = (int, float)
 
@@ -80,7 +96,8 @@ def make_train_record(*, step, step_time_s, loss, grad_norm, loss_scale,
 def make_serving_record(*, step, slot_occupancy, queue_depth, active_slots,
                         prefill_tokens, prefill_tokens_per_sec,
                         decode_tokens, decode_steps, decode_tokens_per_sec,
-                        wall=None):
+                        ttft=None, tpot=None, page_pool=None, prefix=None,
+                        speculative=None, wall=None):
     return {
         "kind": KIND_SERVING,
         "step": int(step),
@@ -93,6 +110,11 @@ def make_serving_record(*, step, slot_occupancy, queue_depth, active_slots,
         "decode_tokens": int(decode_tokens),
         "decode_steps": int(decode_steps),
         "decode_tokens_per_sec": float(decode_tokens_per_sec),
+        "ttft": ttft,
+        "tpot": tpot,
+        "page_pool": page_pool,
+        "prefix": prefix,
+        "speculative": speculative,
     }
 
 
@@ -162,4 +184,18 @@ def validate_step_record(rec):
                     "decode_tokens", "decode_steps",
                     "decode_tokens_per_sec"):
             num(key)
+        for key, want_sub in SERVING_SUBDICT_KEYS.items():
+            sub = rec[key]
+            if sub is None:
+                continue
+            if not isinstance(sub, dict):
+                problems.append(
+                    "{} is neither null nor a dict".format(key))
+                continue
+            for sub_key in want_sub:
+                val = sub.get(sub_key)
+                if isinstance(val, bool) or not isinstance(val, _NUMERIC):
+                    problems.append(
+                        "{}.{} is not a number: {!r}".format(
+                            key, sub_key, val))
     return problems

@@ -138,6 +138,14 @@ class WindowAggregator:
                 "decode_tokens_per_sec": last["decode_tokens_per_sec"],
                 "decode_tokens": last["decode_tokens"],
             }
+            # latency aggregates + paged/prefix/speculative gauges ride
+            # the LAST record (they are already cumulative/windowed);
+            # absent (null) gauges stay out of the snapshot so slot-
+            # layout engines keep their historical shape
+            for key in ("ttft", "tpot", "page_pool", "prefix",
+                        "speculative"):
+                if last.get(key) is not None:
+                    out["serving"][key] = last[key]
         return out
 
     def close(self):

@@ -88,7 +88,12 @@ class ServingMetrics:
     (``Serve/{prefill_tokens_per_sec,decode_tokens_per_sec,
     slot_occupancy,queue_depth}``)."""
 
+    # request-latency samples kept for p50/p95 (bounded so a long-lived
+    # serving engine cannot grow host memory without bound)
+    LATENCY_WINDOW = 4096
+
     def __init__(self, monitor=None):
+        from collections import deque
         self.monitor = monitor
         self.prefill_tokens = 0
         self.prefill_seconds = 0.0
@@ -100,6 +105,15 @@ class ServingMetrics:
         self.occupancy_sum = 0.0
         self.last_queue_depth = 0
         self.peak_queue_depth = 0
+        # request latency: time-to-first-token and per-output-token
+        self.ttfts = deque(maxlen=self.LATENCY_WINDOW)
+        self.tpots = deque(maxlen=self.LATENCY_WINDOW)
+        self.completed_requests = 0
+        self.completed_tokens = 0       # the goodput numerator
+        # speculative decoding
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_steps = 0
 
     def record_prefill(self, tokens, seconds):
         self.prefill_tokens += int(tokens)
@@ -107,11 +121,31 @@ class ServingMetrics:
         self.prefill_calls += 1
 
     def record_decode(self, tokens, seconds):
-        """One fused decode step: ``tokens`` = number of LIVE slots that
-        produced a token this step."""
+        """One fused decode step: ``tokens`` = tokens EMITTED this step
+        (live slots for plain decode; sum of accepted+1 for a
+        speculative verify step)."""
         self.decode_tokens += int(tokens)
         self.decode_seconds += float(seconds)
         self.decode_steps += 1
+
+    def record_ttft(self, seconds):
+        self.ttfts.append(float(seconds))
+
+    def record_completion(self, n_tokens, tpot_seconds):
+        """One retired request: ``tpot_seconds`` is its mean
+        time-per-output-token after the first (None for single-token
+        completions)."""
+        self.completed_requests += 1
+        self.completed_tokens += int(n_tokens)
+        if tpot_seconds is not None:
+            self.tpots.append(float(tpot_seconds))
+
+    def record_spec(self, proposed, accepted):
+        """One slot's verify outcome: ``proposed`` drafts scored,
+        ``accepted`` of them matched the target."""
+        self.spec_proposed += int(proposed)
+        self.spec_accepted += int(accepted)
+        self.spec_steps += 1
 
     def record_schedule(self, occupancy, queue_depth, step):
         self.schedule_steps += 1
@@ -141,8 +175,41 @@ class ServingMetrics:
         return (self.occupancy_sum / self.schedule_steps
                 if self.schedule_steps else 0.0)
 
+    @property
+    def spec_acceptance_rate(self):
+        return (self.spec_accepted / self.spec_proposed
+                if self.spec_proposed else 0.0)
+
+    @staticmethod
+    def _latency_dist(samples):
+        """{count, mean_s, p50_s, p95_s} over a latency deque — None
+        when no request has produced a sample yet."""
+        if not samples:
+            return None
+        import numpy as np
+        vals = np.asarray(samples, np.float64)
+        return {"count": len(samples),
+                "mean_s": round(float(vals.mean()), 6),
+                "p50_s": round(float(np.percentile(vals, 50)), 6),
+                "p95_s": round(float(np.percentile(vals, 95)), 6)}
+
+    def ttft_dist(self):
+        return self._latency_dist(self.ttfts)
+
+    def tpot_dist(self):
+        return self._latency_dist(self.tpots)
+
+    def spec_dist(self):
+        """{proposed, accepted, acceptance_rate} — None before the
+        first verify step (spec off, or still prefill-only)."""
+        if not self.spec_steps:
+            return None
+        return {"proposed": self.spec_proposed,
+                "accepted": self.spec_accepted,
+                "acceptance_rate": round(self.spec_acceptance_rate, 4)}
+
     def snapshot(self):
-        return {
+        out = {
             "prefill_tokens": self.prefill_tokens,
             "prefill_tokens_per_sec": round(self.prefill_tokens_per_sec, 2),
             "decode_tokens": self.decode_tokens,
@@ -150,4 +217,12 @@ class ServingMetrics:
             "decode_tokens_per_sec": round(self.decode_tokens_per_sec, 2),
             "mean_slot_occupancy": round(self.mean_occupancy, 4),
             "peak_queue_depth": self.peak_queue_depth,
+            "completed_requests": self.completed_requests,
+            "completed_tokens": self.completed_tokens,
         }
+        for name, dist in (("ttft", self.ttft_dist()),
+                           ("tpot", self.tpot_dist()),
+                           ("speculative", self.spec_dist())):
+            if dist is not None:
+                out[name] = dist
+        return out

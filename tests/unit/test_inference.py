@@ -81,7 +81,7 @@ def test_decode_logits_match_full_forward(shared):
     ids[0, :n] = prompt
     k, v, token, p_logits = fn(
         eng.params, eng.kv.k, eng.kv.v, jnp.asarray(ids), jnp.int32(0),
-        jnp.int32(n), jax.random.PRNGKey(0),
+        jnp.int32(0), jnp.int32(n), jax.random.PRNGKey(0),
         jnp.float32(1.0), jnp.float32(1.0))
     eng.kv.update((k, v))
     eng.lengths[0] = n
@@ -90,16 +90,16 @@ def test_decode_logits_match_full_forward(shared):
     step_logits = [np.asarray(p_logits)]
     dfn = eng._get_decode_fn(greedy, top_k)
     for _ in range(6):
-        tokens = np.zeros(eng.num_slots, np.int32)
-        tokens[0] = seq[-1]
+        tokens = np.zeros((eng.num_slots, 1), np.int32)
+        tokens[0, 0] = seq[-1]
         k, v, nxt, d_logits = dfn(
             eng.params, eng.kv.k, eng.kv.v, jnp.asarray(tokens),
             jnp.asarray(eng.lengths), jax.random.PRNGKey(0),
             jnp.float32(1.0), jnp.float32(1.0))
         eng.kv.update((k, v))
         eng.advance(0)
-        step_logits.append(np.asarray(d_logits[0]))
-        seq.append(int(nxt[0]))
+        step_logits.append(np.asarray(d_logits[0, 0]))
+        seq.append(int(nxt[0, 0]))
     eng.free_slot(0)
 
     ref = full_forward_logits(model, seq)      # one dense pass at the end

@@ -1,15 +1,22 @@
 #!/usr/bin/env python3
-"""Validate BENCH_*.json artifact shape, including ``extra.telemetry``.
+"""Validate telemetry/diagnostics artifact shapes.
 
-Every bench artifact (bench.py / bench_inference.py and the perf
-scripts that mimic their shape) must be ONE parseable JSON object with:
+Three artifact families, dispatched by shape:
 
-  metric (str), value (number|null), unit (str), vs_baseline
-  (number|null); "error" (str) required whenever value is null;
-  optional extra (dict). When ``extra.telemetry`` is present it must be
-  a telemetry snapshot: ``steps``/``serving_steps`` ints, and — when
-  steps > 0 — ``step_time_s``/``mfu``/``tokens_per_sec_per_chip`` dists
-  with last/mean/p50/p95 numbers (docs/telemetry.md).
+* **BENCH_*.json** — ONE parseable JSON object with metric (str), value
+  (number|null), unit (str), vs_baseline (number|null); "error" (str)
+  required whenever value is null; optional extra (dict). When
+  ``extra.telemetry`` is present it must be a telemetry snapshot:
+  ``steps``/``serving_steps`` ints, and — when steps > 0 —
+  ``step_time_s``/``mfu``/``tokens_per_sec_per_chip`` dists with
+  last/mean/p50/p95 numbers (docs/telemetry.md).
+* **crash bundles** (``kind: "crash_bundle"``, flight recorder —
+  docs/diagnostics.md): reason/wall, record+span+log rings, env report,
+  program registry.
+* **Chrome trace-event files** (a JSON array, telemetry.spans'
+  trace_events.json): parsed leniently (a crashed run may leave the
+  Perfetto-tolerated trailing-comma/unclosed-array form) and each event
+  checked for name/ph/ts/pid/tid.
 
 Usage: check_bench_schema.py [FILE...]; with no args, validates every
 BENCH_*.json in the repo root and tests/perf/. Exit 1 on any failure.
@@ -32,6 +39,14 @@ SERVING_SUBDICT_KEYS = {
     "prefix": ("lookups", "hits", "hit_rate"),
     "speculative": ("proposed", "accepted", "acceptance_rate"),
 }
+
+# Local copy of telemetry/recorder.py CRASH_BUNDLE_KEYS (same stdlib-
+# only constraint; pinned equal by tests/unit/test_diagnostics.py).
+CRASH_BUNDLE_KEYS = (
+    "kind", "reason", "wall", "job_name", "exception",
+    "records", "spans", "open_spans", "log_events",
+    "ds_config", "env", "programs", "watchdog", "state",
+)
 
 
 def _is_num(val):
@@ -199,12 +214,113 @@ def check_bench_payload(payload):
     return problems
 
 
+def check_crash_bundle(bundle):
+    """-> list of problems with one flight-recorder crash bundle. A
+    stdlib re-statement of telemetry/recorder.py's
+    ``validate_crash_bundle`` (the bundle writer's own checker is the
+    source of truth; test_diagnostics.py pins the key table equal)."""
+    problems = []
+    if not isinstance(bundle, dict):
+        return ["bundle is not a dict"]
+    for key in CRASH_BUNDLE_KEYS:
+        if key not in bundle:
+            problems.append("missing key {!r}".format(key))
+    if problems:
+        return problems
+    if not isinstance(bundle.get("reason"), str) or not bundle["reason"]:
+        problems.append("reason is not a non-empty string")
+    if not _is_num(bundle.get("wall")):
+        problems.append("wall is not a number")
+    for key in ("records", "spans", "open_spans", "log_events"):
+        val = bundle[key]
+        if not isinstance(val, list) or \
+                not all(isinstance(item, dict) for item in val):
+            problems.append("{} is not a list of objects".format(key))
+    for rec in bundle.get("records") or []:
+        if rec.get("kind") not in ("train_step", "serving_step"):
+            problems.append(
+                "records entry of kind {!r}".format(rec.get("kind")))
+            break
+    for key in ("env", "programs", "state"):
+        if not isinstance(bundle[key], dict):
+            problems.append("{} is not a dict".format(key))
+    if isinstance(bundle.get("programs"), dict) and \
+            "programs" not in bundle["programs"]:
+        problems.append("programs is not a registry snapshot "
+                        "(no 'programs' table)")
+    return problems
+
+
+# every Chrome trace event must carry these fields
+TRACE_EVENT_KEYS = ("name", "ph", "ts", "pid", "tid")
+
+
+def parse_trace_events(text):
+    """Parse a trace-event file LENIENTLY: a live/crashed run's file is
+    the Perfetto-tolerated array form with a trailing comma and no
+    closing bracket. Returns (events, problems)."""
+    text = text.strip()
+    try:
+        payload = json.loads(text)
+    except ValueError:
+        try:
+            payload = json.loads(text.rstrip(",\n\t ") + "]")
+        except ValueError as err:
+            return None, ["unparseable trace-event file: {}".format(err)]
+    if isinstance(payload, dict):
+        payload = payload.get("traceEvents")
+    if not isinstance(payload, list):
+        return None, ["trace-event payload is not an array"]
+    return payload, []
+
+
+def check_trace_events(text):
+    """-> list of problems with one Chrome trace-event file's text."""
+    events, problems = parse_trace_events(text)
+    if problems:
+        return problems
+    if not events:
+        return ["trace-event file holds no events"]
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            problems.append("event {} is not an object".format(i))
+            continue
+        for key in TRACE_EVENT_KEYS:
+            if key not in ev:
+                problems.append(
+                    "event {} is missing {!r}".format(i, key))
+        if not isinstance(ev.get("name"), str):
+            problems.append("event {} name is not a string".format(i))
+        if ev.get("ph") not in ("X", "i", "B", "E", "M"):
+            problems.append(
+                "event {} has unknown phase {!r}".format(i, ev.get("ph")))
+        if not _is_num(ev.get("ts")):
+            problems.append("event {} ts is not a number".format(i))
+        if ev.get("ph") == "X" and not _is_num(ev.get("dur")):
+            problems.append(
+                "event {} is complete ('X') without a dur".format(i))
+        if problems:
+            break                       # first bad event names the file
+    return problems
+
+
 def check_file(path):
     try:
         with open(path) as fh:
-            payload = json.load(fh)
-    except (OSError, ValueError) as err:
-        return ["unreadable/unparseable: {}".format(err)]
+            text = fh.read()
+    except OSError as err:
+        return ["unreadable: {}".format(err)]
+    if text.lstrip().startswith("["):
+        # only the span tracer's Chrome trace files are arrays
+        return check_trace_events(text)
+    try:
+        payload = json.loads(text)
+    except ValueError as err:
+        return ["unparseable: {}".format(err)]
+    if isinstance(payload, dict) and payload.get("kind") == "crash_bundle":
+        return check_crash_bundle(payload)
+    if isinstance(payload, dict) and "traceEvents" in payload:
+        return check_trace_events(text)
     return check_bench_payload(payload)
 
 

@@ -1,13 +1,19 @@
 """``ds_report``: environment / op compatibility report.
 
 Reference parity: deepspeed/env_report.py (main :~30-109) — prints the
-op install/compatibility matrix and framework versions. The CUDA columns
-become TPU platform columns: JAX/jaxlib versions, default backend, device
-inventory and (on TPU) the chip generation, plus the native-op build cache
-state.
+op install/compatibility matrix and framework versions. The CUDA-era
+columns (torch/cuda/nccl versions) become TPU platform columns:
+JAX/jaxlib versions, default backend, full device/mesh inventory with
+HBM per device, process count, plus the native-op build cache state.
+
+``collect_env()`` is the machine-readable form: one JSON-serializable
+dict that ``platform_report`` prints from and the flight recorder
+embeds as the ``env`` section of every crash bundle
+(telemetry/recorder.py, docs/diagnostics.md).
 """
 import importlib
 import os
+import platform as _platform
 import sys
 
 from .ops.op_builder import ALL_OPS, PALLAS_OPS, cache_dir
@@ -57,44 +63,106 @@ def op_report(out=sys.stdout):
     return out
 
 
-def platform_report(out=sys.stdout):
-    print("-" * 64, file=out)
-    print("DeepSpeed-TPU general environment info:", file=out)
-    print("-" * 64, file=out)
-    print("deepspeed_tpu install path ... {}".format(
-        os.path.dirname(os.path.abspath(__file__))), file=out)
-    print("deepspeed_tpu version ........ {}".format(__version__), file=out)
+def collect_env():
+    """Machine-readable environment report: JAX/jaxlib versions,
+    platform, device/mesh inventory and HBM per device — the ``env``
+    section of crash bundles. Every probe degrades to an ``error`` field
+    rather than raising (a crash dump must never fail on a dead
+    backend)."""
+    env = {
+        "deepspeed_tpu_version": __version__,
+        "install_path": os.path.dirname(os.path.abspath(__file__)),
+        "python_version": sys.version.split()[0],
+        "platform": _platform.platform(),
+    }
     try:
         import jax
         import jaxlib
-        print("jax version .................. {}".format(jax.__version__),
-              file=out)
-        print("jaxlib version ............... {}".format(
-            jaxlib.__version__), file=out)
-        try:
-            backend = jax.default_backend()
-            print("default backend .............. {}".format(backend),
-                  file=out)
-            devices = jax.devices()
-            print("device count ................. {}".format(len(devices)),
-                  file=out)
-            if devices:
-                d = devices[0]
-                kind = getattr(d, "device_kind", "unknown")
-                print("device kind .................. {}".format(kind),
-                      file=out)
-                coords = getattr(d, "coords", None)
-                if coords is not None:
-                    print("ICI coords (device 0) ........ {}".format(coords),
-                          file=out)
-            print("process count ................ {}".format(
-                jax.process_count()), file=out)
-        except Exception as err:  # noqa: BLE001 - plugin/backend probing
-            print("backend ...................... NOT AVAILABLE ({})".format(
-                str(err).splitlines()[0]), file=out)
+        env["jax_version"] = jax.__version__
+        env["jaxlib_version"] = jaxlib.__version__
     except Exception as err:  # noqa: BLE001
+        env["jax_error"] = str(err)
+        return env
+    try:
+        env["default_backend"] = jax.default_backend()
+        env["process_count"] = jax.process_count()
+        env["process_index"] = jax.process_index()
+        devices = jax.devices()
+        env["device_count"] = len(devices)
+        env["local_device_count"] = jax.local_device_count()
+        inventory = []
+        for dev in devices[:64]:           # bounded on huge meshes
+            entry = {
+                "id": int(getattr(dev, "id", -1)),
+                "kind": getattr(dev, "device_kind", "unknown"),
+                "platform": getattr(dev, "platform", "unknown"),
+                "process_index": int(getattr(dev, "process_index", 0)),
+            }
+            coords = getattr(dev, "coords", None)
+            if coords is not None:
+                entry["coords"] = list(coords)
+            try:
+                stats = dev.memory_stats() or {}
+            except Exception:  # noqa: BLE001
+                stats = {}
+            if stats:
+                # HBM per device: the limit + what is live right now
+                entry["hbm_bytes_limit"] = int(stats.get(
+                    "bytes_limit", stats.get("bytes_reservable_limit", 0)))
+                entry["hbm_bytes_in_use"] = int(
+                    stats.get("bytes_in_use", 0))
+            inventory.append(entry)
+        env["devices"] = inventory
+        env["device_kinds"] = sorted({d["kind"] for d in inventory})
+    except Exception as err:  # noqa: BLE001 - plugin/backend probing
+        env["backend_error"] = str(err).splitlines()[0]
+    return env
+
+
+def platform_report(out=sys.stdout):
+    env = collect_env()
+
+    def row(label, key):
+        if key in env:
+            print("{} {}".format((label + " ").ljust(30, "."),
+                                 env[key]), file=out)
+
+    print("-" * 64, file=out)
+    print("DeepSpeed-TPU general environment info:", file=out)
+    print("-" * 64, file=out)
+    row("deepspeed_tpu install path", "install_path")
+    row("deepspeed_tpu version", "deepspeed_tpu_version")
+    row("python version", "python_version")
+    row("platform", "platform")
+    if "jax_error" in env:
         print("jax ........................... NOT AVAILABLE ({})".format(
-            err), file=out)
+            env["jax_error"]), file=out)
+        return out
+    row("jax version", "jax_version")
+    row("jaxlib version", "jaxlib_version")
+    if "backend_error" in env:
+        print("backend ...................... NOT AVAILABLE ({})".format(
+            env["backend_error"]), file=out)
+        return out
+    row("default backend", "default_backend")
+    row("device count", "device_count")
+    row("process count", "process_count")
+    devices = env.get("devices") or []
+    if devices:
+        d = devices[0]
+        print("device kind .................. {}".format(d["kind"]),
+              file=out)
+        if "coords" in d:
+            print("ICI coords (device 0) ........ {}".format(
+                tuple(d["coords"])), file=out)
+        if "hbm_bytes_limit" in d:
+            print("HBM per device ............... {:.2f} GiB "
+                  "({:.2f} GiB in use on device 0)".format(
+                      d["hbm_bytes_limit"] / 2 ** 30,
+                      d["hbm_bytes_in_use"] / 2 ** 30), file=out)
+        else:
+            print("HBM per device ............... not reported "
+                  "(backend exposes no memory_stats)", file=out)
     return out
 
 

@@ -199,6 +199,14 @@ class InferenceEngine:
         self.telemetry = TelemetryCollector.from_section(
             telemetry_config, job_name="serve",
             enabled=jax.process_index() == 0)
+        if self.telemetry is not None and \
+                self.telemetry.recorder is not None:
+            # flight recorder context (docs/diagnostics.md): page-pool /
+            # allocator / compile state, resolved at dump time
+            self.telemetry.recorder.set_context(
+                "ds_config", lambda: vars(self.inference_config))
+            self.telemetry.recorder.set_context(
+                "engine", self._flight_state)
         logger.info(
             "InferenceEngine: slots={} max_seq={} buckets={} dtype={} "
             "layout={} kv_cache={:.1f} MB{}{}".format(
@@ -217,6 +225,37 @@ class InferenceEngine:
         token rates) — ``{}`` when telemetry is disabled."""
         return self.telemetry.snapshot() if self.telemetry is not None \
             else {}
+
+    # -------------------------------------------------------- diagnostics
+    def _flight_state(self):
+        """Serving-engine snapshot for crash bundles (resolved at dump
+        time): slot lengths, page-pool/allocator occupancy, prefix-cache
+        stats, and the prefill/decode trace counts."""
+        state = {
+            "role": "serve",
+            "kv_layout": self.kv_layout,
+            "num_slots": self.num_slots,
+            "max_seq_len": self.max_seq_len,
+            "lengths": [int(n) for n in self.lengths],
+            "compile_stats": dict(self.compile_stats),
+            "serving_record_steps": self.serving_record_steps,
+            "page_pool": self.page_pool_stats(),
+            "prefix": self.prefix_stats(),
+        }
+        if self.kv_layout == "paged":
+            state["page_counts"] = [int(n) for n in self.page_counts]
+        return state
+
+    def debug_dump(self, reason="debug_dump"):
+        """Write a flight-recorder crash bundle on demand; returns the
+        bundle path, or None (loudly) when the recorder is off."""
+        if self.telemetry is None or self.telemetry.recorder is None:
+            logger.warning(
+                "debug_dump: telemetry.flight_recorder is not enabled — "
+                "no bundle written (add the flight_recorder section to "
+                "the telemetry config)")
+            return None
+        return self.telemetry.recorder.dump(reason)
 
     # ---------------------------------------------------------- placement
 
@@ -308,6 +347,10 @@ class InferenceEngine:
         fn = jax.jit(prefill, donate_argnums=(1, 2))
         self._prefill_fns[key] = fn
         self.compile_stats["prefill_traces"] += 1
+        if self.telemetry is not None:
+            # compile observatory: every new trace is a distinct program;
+            # an unbounded bucket list shows up as a recompile storm
+            self.telemetry.programs.observe_trace("prefill", key)
         return fn
 
     def _get_decode_fn(self, greedy, top_k, width=1):
@@ -352,6 +395,8 @@ class InferenceEngine:
         fn = jax.jit(decode, donate_argnums=(1, 2))
         self._decode_fns[key] = fn
         self.compile_stats["decode_traces"] += 1
+        if self.telemetry is not None:
+            self.telemetry.programs.observe_trace("decode", key)
         return fn
 
     def _next_rng(self):

@@ -44,7 +44,7 @@ class InferenceRequest:
     __slots__ = ("uid", "prompt", "max_new_tokens", "eos_token_id",
                  "generated", "slot", "state", "context", "chunks",
                  "chunk_idx", "arrival_t", "first_token_t", "resumed",
-                 "admit_order")
+                 "admit_order", "span")
 
     def __init__(self, uid, prompt, max_new_tokens, eos_token_id):
         self.uid = uid
@@ -61,6 +61,7 @@ class InferenceRequest:
         self.first_token_t = None
         self.resumed = False         # re-admitted after preemption
         self.admit_order = -1        # preemption picks the youngest
+        self.span = None             # request trace (telemetry.spans)
 
 
 class ContinuousBatchingScheduler:
@@ -76,6 +77,11 @@ class ContinuousBatchingScheduler:
         if self._record_metrics is None:
             self._record_metrics = self.metrics
         self.sampling = sampling
+        # diagnostics seams (docs/diagnostics.md): one is-not-None check
+        # each when the spans / watchdog sections are off
+        tel = getattr(engine, "telemetry", None)
+        self._spans = tel.spans if tel is not None else None
+        self._watchdog = tel.watchdog if tel is not None else None
         self.queue = deque()
         self.slots = [None] * engine.num_slots
         self.results = {}
@@ -129,6 +135,9 @@ class ContinuousBatchingScheduler:
     def _finish(self, req):
         """Move a request's result out and release its slot + pages."""
         self.results[req.uid] = list(req.generated)
+        if req.span is not None:
+            req.span.event("retire", generated=len(req.generated))
+            req.span.end(generated=len(req.generated))
         req.state = "done"
         self.slots[req.slot] = None
         self.engine.free_slot(req.slot)
@@ -178,6 +187,11 @@ class ContinuousBatchingScheduler:
                 victim = req
         if victim is None:
             return False
+        if victim.span is not None:
+            victim.span.event("preempted", step=self.steps,
+                              generated=len(victim.generated))
+        if self._watchdog is not None:
+            self._watchdog.observe_pool_event("preemption")
         self.slots[victim.slot] = None
         self.engine.free_slot(victim.slot)
         if self.engine.drafter is not None:
@@ -201,6 +215,8 @@ class ContinuousBatchingScheduler:
                 continue
             req = self.queue[0]
             if not self.engine.try_admit(slot, req.context):
+                if self._watchdog is not None:
+                    self._watchdog.observe_pool_event("admission_blocked")
                 break                      # pool full: stay queued
             self.queue.popleft()
             req.slot = slot
@@ -208,6 +224,27 @@ class ContinuousBatchingScheduler:
             req.admit_order = self._admitted
             self._admitted += 1
             self.slots[slot] = req
+            if self._spans is not None:
+                if req.span is None:
+                    # one span tree per REQUEST — it survives preemption
+                    # (the re-admit lands as a second admit event on the
+                    # same trace)
+                    req.span = self._spans.begin(
+                        "serving_request", uid=req.uid,
+                        prompt_tokens=len(req.prompt))
+                req.span.event(
+                    "admit", slot=slot, resumed=req.resumed,
+                    queue_wait_s=round(
+                        time.perf_counter() - req.arrival_t, 6))
+                if self.engine.kv_layout == "paged":
+                    matched = int(
+                        self.engine._admit_matched.get(slot, 0))
+                    req.span.event(
+                        "page_alloc",
+                        pages=int(self.engine.page_counts[slot]),
+                        prefix_pages=matched)
+                    if matched:
+                        req.span.event("prefix_hit", pages=matched)
             # the chunk plan is built at FIRST-chunk time (below): the
             # prefix match runs there, after same-step siblings have
             # registered their pages, so bursts of one system prompt
@@ -230,6 +267,8 @@ class ContinuousBatchingScheduler:
                     # prefix-cache hit: the matched pages' tokens are
                     # already resident — only the suffix embeds
                     self.engine.lengths[req.slot] = start
+                    if req.span is not None:
+                        req.span.event("prefix_hit", tokens=start)
             start, ln = req.chunks[req.chunk_idx]
             chunk = req.context[start:start + ln]
             # no page check here: try_admit reserved the WHOLE context's
@@ -240,7 +279,12 @@ class ContinuousBatchingScheduler:
             token = self.engine.prefill_chunk(req.slot, chunk, start,
                                               sampling=self.sampling)
             t.stop()
-            self._account("record_prefill", ln, t.elapsed(reset=True))
+            dt = t.elapsed(reset=True)
+            self._account("record_prefill", ln, dt)
+            if req.span is not None:
+                now = time.time()
+                req.span.timed_child("prefill_chunk", now - dt, now,
+                                     start=start, tokens=ln)
             req.chunk_idx += 1
             # register the pages filled SO FAR (full pages only): a
             # same-burst sibling admitted this very step can match them
@@ -257,7 +301,10 @@ class ContinuousBatchingScheduler:
                 continue
             now = time.perf_counter()
             req.first_token_t = now
-            self._account("record_ttft", now - req.arrival_t)
+            ttft = now - req.arrival_t
+            self._account("record_ttft", ttft)
+            if self._watchdog is not None:
+                self._watchdog.observe_ttft(ttft)
             if self._append_tokens(req, [token])[1]:
                 retired.append(req.uid)
 
@@ -337,6 +384,7 @@ class ContinuousBatchingScheduler:
             t.stop()
             dt = t.elapsed(reset=True)
             emitted = 0
+            span_end = time.time()
             for req in active:
                 row, s = chosen[req.slot], req.slot
                 accepted = 0
@@ -348,6 +396,15 @@ class ContinuousBatchingScheduler:
                 if drafter.needs_model:
                     drafter.advance(s, accepted + 1)
                 self._account("record_spec", k_eff, accepted)
+                if req.span is not None:
+                    # the fused verify pass scored every slot at once:
+                    # each participant's child span shares its wall.
+                    # Added BEFORE _append_tokens — retiring exports the
+                    # tree, and a child added after export is lost
+                    req.span.timed_child(
+                        "spec_verify", span_end - dt, span_end,
+                        step=self.steps, drafted=k_eff,
+                        accepted=accepted, tokens=len(new))
                 appended, done = self._append_tokens(req, new)
                 emitted += appended
                 if done:
@@ -367,12 +424,16 @@ class ContinuousBatchingScheduler:
             next_tokens = self.engine.decode_step(pending,
                                                   sampling=self.sampling)
             t.stop()
-            self._account("record_decode", len(active),
-                          t.elapsed(reset=True))
+            dt = t.elapsed(reset=True)
+            self._account("record_decode", len(active), dt)
+            span_end = time.time()
             for req in active:
                 self.engine.advance(req.slot)
                 if drafter is not None and drafter.needs_model:
                     drafter.advance(req.slot, 1)
+                if req.span is not None:
+                    req.span.timed_child("decode", span_end - dt,
+                                         span_end, step=self.steps)
                 if self._append_tokens(req,
                                        [int(next_tokens[req.slot])])[1]:
                     retired.append(req.uid)
@@ -380,6 +441,20 @@ class ContinuousBatchingScheduler:
     def step(self):
         """Admit -> prefill chunks -> one decode/verify step -> retire.
         Returns uids retired this step."""
+        try:
+            return self._step_impl()
+        except BaseException as err:
+            # flight-recorder hook: dump (once per exception object;
+            # watchdog raise-trips are already dumped), re-raise
+            tel = getattr(self.engine, "telemetry", None)
+            if tel is not None and tel.recorder is not None:
+                try:
+                    tel.recorder.dump("exception:serving_step", exc=err)
+                except Exception:  # noqa: BLE001 - never mask the error
+                    pass
+            raise
+
+    def _step_impl(self):
         if not self.queue and self.num_active == 0:
             # idle poll: nothing to admit and no slot to decode — emit no
             # zero-work serving record (a polling serve loop would grow

@@ -1,13 +1,21 @@
 """FLOPS profiler.
 
 Reference parity: deepspeed/profiling/flops_profiler/profiler.py. The
-reference monkey-patches torch.nn.functional to count MACs per module; under
-XLA the compiler already knows — we read ``jit(...).lower().compile()
-.cost_analysis()`` for exact flops/bytes of the compiled program and derive
-utilization from step timing.
+reference monkey-patches torch.nn.functional to count MACs per module;
+under XLA the compiler already knows — pricing delegates to telemetry's
+``costs_of_compiled`` (telemetry/collector.py), the ONE home for
+reading ``cost_analysis`` off the exact compiled program (including the
+compiled-object fallback and its per-device -> global normalization),
+so the profiler, the StepRecord MFU, and the compile observatory all
+price identically.
+
+A backend that exposes no costs is NEVER a silent empty result: every
+pricing entry point warns loudly and raises under ``telemetry.strict``
+(the PR 4 no-silent-no-ops key policy).
 """
 import numpy as np
 
+from ...telemetry.config import warn_or_raise_noop
 from ...utils.logging import logger
 
 
@@ -18,15 +26,36 @@ def _fmt(n):
     return "{:.2f}".format(n)
 
 
-def cost_analysis_of(fn, *example_args, **example_kwargs):
-    """flops/bytes-accessed of a jitted callable for given example args."""
+def _engine_strict(engine):
+    """telemetry.strict of the engine's resolved config (False for bare
+    models / engines without one)."""
+    config = getattr(engine, "_config", None)
+    return bool(getattr(getattr(config, "telemetry_config", None),
+                        "strict", False))
+
+
+def _no_costs(what, strict):
+    warn_or_raise_noop(
+        "flops_profiler: XLA exposed no cost_analysis for {} — flops/"
+        "bytes report as 0 on this runtime".format(what), strict)
+
+
+def cost_analysis_of(fn, *example_args, strict=False, **example_kwargs):
+    """flops/bytes-accessed of a jitted callable for given example args,
+    via telemetry's ``costs_of_compiled``. Empty costs warn loudly
+    (raise when ``strict``) instead of silently returning ``{}``."""
     import jax
+    from ...telemetry.collector import costs_of_compiled
     jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
-    lowered = jitted.lower(*example_args, **example_kwargs)
-    compiled = lowered.compile()
-    costs = compiled.cost_analysis()
-    if isinstance(costs, list):
-        costs = costs[0] if costs else {}
+    if example_kwargs:
+        # costs_of_compiled is positional-only; bind kwargs here
+        costs = costs_of_compiled(
+            jax.jit(lambda *a: jitted(*a, **example_kwargs)),
+            *example_args)
+    else:
+        costs = costs_of_compiled(jitted, *example_args)
+    if not costs:
+        _no_costs("the profiled callable", strict)
     return costs or {}
 
 
@@ -40,14 +69,26 @@ class FlopsProfiler(object):
 
     def profile_engine_step(self):
         """Cost analysis of the engine's profiled step (recorded by the
-        engine at flops_profiler.profile_step — engine._flops_costs)."""
-        return getattr(self.engine, "_flops_costs", None) or {}
+        engine at flops_profiler.profile_step — engine._flops_costs).
+        Loud when nothing was recorded: either the profile step has not
+        run yet, or the backend priced it empty."""
+        costs = getattr(self.engine, "_flops_costs", None) or {}
+        if not costs:
+            _no_costs("the engine's profiled step (did the "
+                      "flops_profiler.profile_step train step run?)",
+                      _engine_strict(self.engine))
+        return costs
 
     def get_total_flops(self, fn=None, args=()):
         if fn is not None:
-            costs = cost_analysis_of(fn, *args)
+            costs = cost_analysis_of(fn, *args,
+                                     strict=_engine_strict(self.engine))
             self.flops = costs.get("flops", 0.0)
             self.bytes_accessed = costs.get("bytes accessed", 0.0)
+        elif self.flops is None:
+            _no_costs("get_total_flops() before any profiled step (pass "
+                      "fn= or run the engine's profile step first)",
+                      _engine_strict(self.engine))
         return self.flops
 
     def print_model_profile(self):

@@ -159,6 +159,15 @@ class DeepSpeedEngine:
         self._window_tokens = 0
         self._window_flops = 0.0
         self._step_hbm = None
+        self._step_path = "micro"
+        if self.telemetry is not None and \
+                self.telemetry.recorder is not None:
+            # flight recorder context (docs/diagnostics.md): resolved at
+            # DUMP time, so the bundle reflects the state at the crash
+            self.telemetry.recorder.set_context(
+                "ds_config", lambda: self._config._param_dict)
+            self.telemetry.recorder.set_context(
+                "engine", self._flight_state)
         self._check_memory_breakdown()
 
         self.timers = SynchronizedWallClockTimer()
@@ -1069,9 +1078,17 @@ class DeepSpeedEngine:
         cached = self._tele_flops_cache.get(key)
         if cached is not None:
             return cached
-        from ..telemetry import flops_of_compiled
+        from ..telemetry import costs_of_compiled
         try:
-            flops = flops_of_compiled(fn, *args)
+            t0 = time.time()
+            costs = costs_of_compiled(fn, *args)
+            price_wall = time.time() - t0
+            flops = float(costs.get("flops", 0.0) or 0.0)
+            # compile observatory: the registry keeps the FULL cost dict
+            # and the pricing wall (an honest compile-cost proxy on
+            # backends where pricing is an AOT compile)
+            self.telemetry.programs.price(key, costs,
+                                          price_wall_s=price_wall)
         except Exception as err:  # noqa: BLE001 - never perturb the step
             logger.info("telemetry: cost_analysis unavailable for %r (%s)",
                         key, err)
@@ -1084,9 +1101,11 @@ class DeepSpeedEngine:
         (no-op when telemetry is off) — the ONE accounting seam, also
         used by runners that own their own jit caches (zero/stream.py's
         ``_run``); the engine's window privates are never mutated from
-        another module."""
+        another module. The compile observatory rides the same seam:
+        every priced program is registered/counted here."""
         if self.telemetry is not None:
             self._window_flops += self._tele_flops(key, fn, *args)
+            self.telemetry.programs.observe_call(key, fn, args)
 
     def _jit_priced(self, key, builder, *args, donate_argnums=(0,)):
         """``_get_jit`` plus telemetry flops accounting in one place,
@@ -1240,6 +1259,7 @@ class DeepSpeedEngine:
         hbm = self._step_hbm
         self._step_hbm = None
         tel.emit_train_step(
+            path=self._resolved_step_path(),
             step=self._window_step,
             hbm=hbm,
             step_time_s=dt,
@@ -1256,6 +1276,59 @@ class DeepSpeedEngine:
             comm_overlap=self._telemetry_comm_overlap(dt),
             offload=self._telemetry_offload_stats(),
             pipe=pipe)
+
+    # ----------------------------------------------------------- diagnostics
+    def _resolved_step_path(self):
+        """The executing step path's label — shared by the span tree's
+        ``path`` attr and the crash bundle's ``step_path`` so the two
+        diagnostics surfaces cannot drift."""
+        if self.stream_runner is not None:
+            return "streamed"
+        if self.host_state is not None:
+            return "offload"
+        return self._step_path
+
+    def _flight_state(self):
+        """Engine snapshot for crash bundles (resolved at dump time)."""
+        return {
+            "role": "train",
+            "global_steps": self.global_steps,
+            "micro_steps": self.micro_steps,
+            "skipped_steps": self.skipped_steps,
+            "mode": self._mode,
+            "step_path": self._resolved_step_path(),
+            "zero_stage": self.zero_optimization_stage(),
+            "compute_dtype": str(np.dtype(self.compute_dtype)),
+            "mesh": {str(k): int(v) for k, v in self.mesh.shape.items()},
+            "jit_programs": sorted(str(k) for k in self._jit_cache),
+        }
+
+    def _tele_crash(self, where, err):
+        """Flight-recorder hook for unhandled step-path exceptions: dump
+        a crash bundle (once per exception object — nested wrappers and
+        watchdog raise-trips are deduplicated), never mask the error."""
+        tel = self.telemetry
+        if tel is None or tel.recorder is None:
+            return
+        try:
+            tel.recorder.dump("exception:" + where, exc=err)
+        except Exception:  # noqa: BLE001 - the real error must propagate
+            logger.warning("flight recorder dump failed during %s",
+                           where, exc_info=True)
+
+    def debug_dump(self, reason="debug_dump"):
+        """Write a flight-recorder crash bundle on demand (the operator
+        seam: inspect a LIVE run that looks wrong without killing it).
+        Returns the bundle path, or None (loudly) when
+        ``telemetry.flight_recorder`` is off."""
+        tel = self.telemetry
+        if tel is None or tel.recorder is None:
+            logger.warning(
+                "debug_dump: telemetry.flight_recorder is not enabled — "
+                "no bundle written (add the flight_recorder section to "
+                "the telemetry config)")
+            return None
+        return tel.recorder.dump(reason)
 
     # -------------------------------------------------------------- train API
     def train(self, mode=True):
@@ -1276,6 +1349,15 @@ class DeepSpeedEngine:
         """Run a micro-batch. In train mode also computes and accumulates
         gradients (the reference's separate autograd backward becomes part of
         the same XLA program; ``backward()`` is then bookkeeping)."""
+        try:
+            return self._forward_impl(*inputs, **kwargs)
+        except BaseException as err:
+            # BaseException on purpose: a SimulatedKill/KeyboardInterrupt
+            # mid-step is exactly when the flight recorder must fire
+            self._tele_crash("forward", err)
+            raise
+
+    def _forward_impl(self, *inputs, **kwargs):
         if len(inputs) == 1 and isinstance(inputs[0], (tuple, list)):
             inputs = tuple(inputs[0])
         batch = self._to_device(inputs)
@@ -1307,6 +1389,7 @@ class DeepSpeedEngine:
             return loss
 
         self._telemetry_micro_begin(batch)
+        self._step_path = "micro"
         if self.wall_clock_breakdown():
             self.timers(FORWARD_MICRO_TIMER).start()
         self._rng, step_rng = jax.random.split(self._rng)
@@ -1373,6 +1456,13 @@ class DeepSpeedEngine:
     def step(self, lr_kwargs=None):
         """Optimizer step at gradient-accumulation boundaries
         (reference engine.py:1088-1173)."""
+        try:
+            return self._step_impl(lr_kwargs)
+        except BaseException as err:
+            self._tele_crash("train_step", err)
+            raise
+
+    def _step_impl(self, lr_kwargs=None):
         if self.wall_clock_breakdown():
             self.timers(STEP_MICRO_TIMER).start()
 
@@ -1920,6 +2010,14 @@ class DeepSpeedEngine:
     def train_batch(self, data_iter=None, batch=None):
         """TPU-idiomatic fused path: all grad-accum micro-steps + the
         optimizer step in ONE jitted program (lax.scan over micro-batches)."""
+        try:
+            return self._train_batch_impl(data_iter=data_iter, batch=batch)
+        except BaseException as err:
+            self._tele_crash("train_batch", err)
+            raise
+
+    def _train_batch_impl(self, data_iter=None, batch=None):
+        self._step_path = "fused"
         gas = self.gradient_accumulation_steps()
         if batch is None:
             assert data_iter is not None

@@ -711,6 +711,16 @@ class PipelineEngine(DeepSpeedEngine):
     def train_batch(self, data_iter=None, batch=None):
         """Run one full batch = micro_batches microbatches through the
         pipeline + optimizer step (reference train_batch :244)."""
+        try:
+            return self._pipe_train_batch_impl(data_iter=data_iter,
+                                               batch=batch)
+        except BaseException as err:
+            # flight-recorder hook (docs/diagnostics.md): dump, re-raise
+            self._tele_crash("pipe_train_batch", err)
+            raise
+
+    def _pipe_train_batch_impl(self, data_iter=None, batch=None):
+        self._step_path = "pipe"
         if batch is None:
             assert data_iter is not None
             batch = self._stack_microbatches(data_iter)

@@ -1,12 +1,19 @@
 """Unified per-step telemetry: StepRecords, sinks, MFU math, xprof
-trace windows (docs/telemetry.md)."""
+trace windows (docs/telemetry.md) — plus the diagnostics layer
+(docs/diagnostics.md): span tracing, the flight recorder's crash
+bundles, run-doctor watchdogs, and the compile observatory."""
 from .collector import (TelemetryCollector, collect_memory_stats,
                         costs_of_compiled, flops_of_compiled)
 from .config import DeepSpeedTelemetryConfig, TELEMETRY
 from .mfu import PEAK_TFLOPS, mfu_of, peak_flops_for
+from .programs import ProgramRegistry
 from .record import (KIND_SERVING, KIND_TRAIN, SERVING_STEP_KEYS,
                      TRAIN_STEP_KEYS, make_serving_record,
                      make_train_record, validate_step_record)
-from .sinks import (JsonlSink, TelemetrySinks, TensorBoardSink,
-                    WindowAggregator)
+from .recorder import (CRASH_BUNDLE_KEYS, FlightRecorder,
+                       validate_crash_bundle)
+from .sinks import (ChromeTraceSink, JsonlSink, TelemetrySinks,
+                    TensorBoardSink, WindowAggregator)
+from .spans import SPAN_KEYS, Span, SpanTracer, validate_span
 from .trace import TraceWindow
+from .watchdog import Watchdog, WatchdogError

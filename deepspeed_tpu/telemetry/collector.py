@@ -15,11 +15,17 @@ from ..utils.lifecycle import AtexitCloseMixin
 from ..utils.logging import logger
 from . import record as rec_mod
 from .mfu import mfu_of, peak_flops_for
-from .sinks import (JsonlSink, TelemetrySinks, TensorBoardSink,
-                    WindowAggregator)
+from .programs import ProgramRegistry
+from .recorder import FlightRecorder
+from .sinks import (ChromeTraceSink, JsonlSink, TelemetrySinks,
+                    TensorBoardSink, WindowAggregator)
+from .spans import SpanTracer
 from .trace import TraceWindow
+from .watchdog import Watchdog
 
 JSONL_NAME = "telemetry.jsonl"
+SPANS_JSONL_NAME = "spans.jsonl"
+CHROME_TRACE_NAME = "trace_events.json"
 
 # output dirs claimed by LIVE collectors in this process: an explicit
 # telemetry.job_name would otherwise point a train and a serving engine
@@ -135,10 +141,54 @@ class TelemetryCollector(AtexitCloseMixin):
         _claimed_dirs.add(self._claim_key)
         self.jsonl_path = os.path.join(self.output_dir, JSONL_NAME)
         self.aggregator = WindowAggregator(tconfig.window)
-        sinks = [JsonlSink(self.jsonl_path), self.aggregator]
+        sinks = [JsonlSink(self.jsonl_path,
+                           max_bytes=tconfig.jsonl_max_bytes),
+                 self.aggregator]
         tb = TensorBoardSink(monitor)
         if tb.live:
             sinks.append(tb)
+
+        # ------------------------------------------- diagnostics subsystems
+        # (docs/diagnostics.md). The programs registry is alive whenever
+        # telemetry is — one dict update per jitted program; spans /
+        # flight recorder / watchdog exist only when their config
+        # section does, so the engines' hot paths keep one is-not-None
+        # check each when they are off.
+        self.programs = ProgramRegistry(
+            storm_threshold=tconfig.programs_storm_threshold,
+            replicated_leaf_bytes=tconfig.programs_replicated_leaf_bytes)
+        self.spans = None
+        if tconfig.spans_enabled:
+            span_sinks = [JsonlSink(
+                os.path.join(self.output_dir, SPANS_JSONL_NAME),
+                max_bytes=tconfig.jsonl_max_bytes)]
+            if tconfig.spans_chrome_trace:
+                span_sinks.append(ChromeTraceSink(
+                    os.path.join(self.output_dir, CHROME_TRACE_NAME),
+                    max_bytes=tconfig.jsonl_max_bytes))
+            self.spans = SpanTracer(span_sinks,
+                                    max_events=tconfig.spans_max_events,
+                                    job_name=self.job_name)
+        self.recorder = None
+        if tconfig.recorder_enabled:
+            self.recorder = FlightRecorder(
+                tconfig.recorder_output_path or
+                os.path.join(self.output_dir, "crash"),
+                job_name=self.job_name,
+                capacity=tconfig.recorder_capacity,
+                max_bundles=tconfig.recorder_max_bundles,
+                programs=self.programs,
+                spans=self.spans,
+                on_sigterm=tconfig.recorder_on_sigterm)
+            sinks.append(self.recorder)     # rings every StepRecord
+        self.watchdog = None
+        if tconfig.watchdog is not None:
+            self.watchdog = Watchdog(tconfig.watchdog,
+                                     recorder=self.recorder,
+                                     job_name=self.job_name)
+            if self.recorder is not None:
+                self.recorder.watchdog_state = self.watchdog.snapshot
+
         self.sinks = TelemetrySinks(sinks)
         self.trace = None
         if tconfig.trace_enabled:
@@ -188,12 +238,14 @@ class TelemetryCollector(AtexitCloseMixin):
     def on_step_begin(self, step):
         if self.trace is not None:
             self.trace.on_step_begin(step)
+        if self.watchdog is not None:
+            self.watchdog.step_begin(step)
 
     def emit_train_step(self, *, step, step_time_s, loss, grad_norm,
                         loss_scale, overflow, skipped_steps, micro_steps,
                         tokens_per_step, model_flops_per_step, phases,
                         wire=None, comm_overlap=None, offload=None,
-                        pipe=None, hbm=None):
+                        pipe=None, hbm=None, path=None):
         n = max(self._n_devices, 1)
         dt = max(float(step_time_s), 1e-12)
         rec = rec_mod.make_train_record(
@@ -212,6 +264,18 @@ class TelemetryCollector(AtexitCloseMixin):
             wire=wire, comm_overlap=comm_overlap, offload=offload,
             pipe=pipe)
         self.sinks.emit(rec)
+        if self.spans is not None:
+            # span tree for this step, derived from the SAME window/phase
+            # clocks the record carries (spans.py module docstring)
+            attrs = {"loss": rec["loss"], "mfu": rec["mfu"]}
+            if path:
+                attrs["path"] = str(path)
+            self.spans.emit_step_tree(
+                "train_step", step=step, t0=rec["wall"] - dt,
+                t1=rec["wall"], phases=rec["phases"], attrs=attrs)
+        if self.watchdog is not None:
+            self.watchdog.step_end()
+            self.watchdog.observe_train(rec)
         if self.trace is not None:
             self.trace.on_step_end(step)
         return rec
@@ -233,6 +297,9 @@ class TelemetryCollector(AtexitCloseMixin):
             prefix=prefix,
             speculative=metrics.spec_dist())
         self.sinks.emit(rec)
+        if self.watchdog is not None:
+            self.watchdog.step_end()
+            self.watchdog.observe_serving(rec)
         if self.trace is not None:
             # on_step_begin ran at the top of the scheduler step (the
             # window must wrap the decode work, not follow it)
@@ -246,14 +313,27 @@ class TelemetryCollector(AtexitCloseMixin):
         out = self.aggregator.snapshot()
         if self.trace is not None:
             out["trace_windows_completed"] = self.trace.windows_completed
+        if self.spans is not None:
+            out["span_trees"] = self.spans.trees_exported
+        if self.watchdog is not None and self.watchdog.trips:
+            out["watchdog_trips"] = len(self.watchdog.trips)
+        if self.programs.flags:
+            out["program_flags"] = [f["key"] for f in self.programs.flags]
         return out
 
     def close(self):
-        """Idempotent: the first call stops any active trace window,
-        closes the sinks, and drops the atexit registration."""
+        """Idempotent: the first call stops any active trace window and
+        the watchdog thread, detaches the flight recorder's log/signal
+        hooks, closes the sinks, and drops the atexit registration."""
         if self._finish_close():
             return
         if self.trace is not None:
             self.trace.close()
+        if self.watchdog is not None:
+            self.watchdog.close()
+        if self.recorder is not None:
+            self.recorder.close()
+        if self.spans is not None:
+            self.spans.close()
         self.sinks.close()
         _claimed_dirs.discard(self._claim_key)

@@ -16,15 +16,56 @@ Shape::
       "job_name": "train",               // subdir; keeps multi-engine files apart
       "window": 50,                      // rolling-aggregate window (p50/p95)
       "strict": false,                   // unknown/unhonorable keys raise
+      "jsonl_max_bytes": null,           // rotate telemetry/span JSONLs at this size
       "trace": {                         // on-demand xprof windows
         "start_step": 10,                // null = only the trigger file arms it
         "num_steps": 2,
         "trigger_file": null,            // touch this path -> trace next window
         "output_path": null              // default <output_path>/<job>/trace
+      },
+      "spans": {                         // span tracer (docs/diagnostics.md)
+        "enabled": true,
+        "chrome_trace": true,            // also write Perfetto-loadable trace_events.json
+        "max_events_per_span": 256
+      },
+      "flight_recorder": {               // crash bundles
+        "enabled": true,
+        "capacity": 256,                 // record/span/log ring size
+        "max_bundles": 8,                // retained bundle files
+        "output_path": null,             // default <output_path>/<job>/crash
+        "on_sigterm": false              // dump a bundle on SIGTERM/preemption
+      },
+      "watchdog": {                      // hang/anomaly alarms; each sub-key a
+                                         // dict (tune), true (defaults) or false (off)
+        "step_deadline": {"factor": 5.0, "min_steps": 5, "floor_s": 1.0,
+                          "poll_s": 0.05, "action": "warn"},
+        "nan_streak":    {"threshold": 3, "action": "warn"},
+        "loss_spike":    {"zscore": 8.0, "window": 50, "min_steps": 10,
+                          "action": "warn"},
+        "ttft_slo":      {"slo_s": null, "every": 1, "action": "warn"},
+        "pool_exhaustion": {"every": 100, "action": "warn"}
+      },
+      "programs": {                      // compile-observatory thresholds
+        "recompile_storm_threshold": 32,
+        "replicated_leaf_bytes": 1073741824
       }
     }
+
+The spans / flight_recorder / watchdog subsystems are OFF unless their
+section is present (an absent section keeps today's one is-not-None
+check on the hot paths); the programs registry is alive whenever
+telemetry is enabled (one dict update per program) and its section only
+tunes thresholds.
 """
 from ..utils.logging import logger
+from .programs import (RECOMPILE_STORM_THRESHOLD_DEFAULT,
+                       REPLICATED_LEAF_BYTES_DEFAULT)
+from .recorder import (RECORDER_CAPACITY_DEFAULT,
+                       RECORDER_MAX_BUNDLES_DEFAULT)
+from .spans import SPANS_MAX_EVENTS_DEFAULT
+from .watchdog import (LOSS_SPIKE_DEFAULTS, NAN_STREAK_DEFAULTS,
+                       POOL_EXHAUSTION_DEFAULTS, STEP_DEADLINE_DEFAULTS,
+                       TTFT_SLO_DEFAULTS, WATCHDOG_ACTIONS)
 
 
 def warn_or_raise_noop(msg, strict, flag="telemetry.strict"):
@@ -47,6 +88,11 @@ TELEMETRY_WINDOW = "window"
 TELEMETRY_WINDOW_DEFAULT = 50
 TELEMETRY_STRICT = "strict"
 TELEMETRY_TRACE = "trace"
+TELEMETRY_JSONL_MAX_BYTES = "jsonl_max_bytes"
+TELEMETRY_SPANS = "spans"
+TELEMETRY_FLIGHT_RECORDER = "flight_recorder"
+TELEMETRY_WATCHDOG = "watchdog"
+TELEMETRY_PROGRAMS = "programs"
 
 TRACE_START_STEP = "start_step"
 TRACE_NUM_STEPS = "num_steps"
@@ -57,11 +103,20 @@ TRACE_OUTPUT_PATH = "output_path"
 KNOWN_TELEMETRY_KEYS = {
     TELEMETRY_ENABLED, TELEMETRY_OUTPUT_PATH, TELEMETRY_JOB_NAME,
     TELEMETRY_WINDOW, TELEMETRY_STRICT, TELEMETRY_TRACE,
+    TELEMETRY_JSONL_MAX_BYTES, TELEMETRY_SPANS,
+    TELEMETRY_FLIGHT_RECORDER, TELEMETRY_WATCHDOG, TELEMETRY_PROGRAMS,
 }
 KNOWN_TRACE_KEYS = {
     TRACE_START_STEP, TRACE_NUM_STEPS, TRACE_TRIGGER_FILE,
     TRACE_OUTPUT_PATH,
 }
+KNOWN_SPANS_KEYS = {"enabled", "chrome_trace", "max_events_per_span"}
+KNOWN_FLIGHT_RECORDER_KEYS = {"enabled", "capacity", "max_bundles",
+                              "output_path", "on_sigterm"}
+KNOWN_WATCHDOG_KEYS = {"enabled", "step_deadline", "nan_streak",
+                       "loss_spike", "ttft_slo", "pool_exhaustion"}
+KNOWN_PROGRAMS_KEYS = {"recompile_storm_threshold",
+                       "replicated_leaf_bytes"}
 
 
 class DeepSpeedTelemetryConfig(object):
@@ -132,6 +187,146 @@ class DeepSpeedTelemetryConfig(object):
                     "trace",
                     "neither start_step nor trigger_file is set, so the "
                     "window can never arm")
+
+        max_bytes = d.get(TELEMETRY_JSONL_MAX_BYTES)
+        if max_bytes is not None and (isinstance(max_bytes, bool) or
+                                      not isinstance(max_bytes, int) or
+                                      max_bytes < 4096):
+            raise ValueError(
+                "telemetry.{} must be an int >= 4096 or null, got "
+                "{!r}".format(TELEMETRY_JSONL_MAX_BYTES, max_bytes))
+        self.jsonl_max_bytes = max_bytes
+
+        self._parse_spans(d.get(TELEMETRY_SPANS))
+        self._parse_flight_recorder(d.get(TELEMETRY_FLIGHT_RECORDER))
+        self._parse_watchdog(d.get(TELEMETRY_WATCHDOG))
+        self._parse_programs(d.get(TELEMETRY_PROGRAMS))
+
+    # ----------------------------------------------- diagnostics sections
+    def _section_dict(self, section, name):
+        if not isinstance(section, dict):
+            raise ValueError(
+                "telemetry.{} must be a dict, got {}".format(
+                    name, type(section).__name__))
+        return section
+
+    def _pos_int(self, section, name, key, default, minimum=1):
+        val = section.get(key, default)
+        if isinstance(val, bool) or not isinstance(val, int) or \
+                val < minimum:
+            raise ValueError(
+                "telemetry.{}.{} must be an int >= {}, got {!r}".format(
+                    name, key, minimum, val))
+        return val
+
+    def _parse_spans(self, section):
+        self.spans_enabled = False
+        self.spans_chrome_trace = True
+        self.spans_max_events = SPANS_MAX_EVENTS_DEFAULT
+        if section is None:
+            return
+        section = self._section_dict(section, TELEMETRY_SPANS)
+        self._reject_unknown(section, KNOWN_SPANS_KEYS, "telemetry.spans")
+        self.spans_enabled = bool(section.get("enabled", True))
+        self.spans_chrome_trace = bool(section.get("chrome_trace", True))
+        self.spans_max_events = self._pos_int(
+            section, TELEMETRY_SPANS, "max_events_per_span",
+            SPANS_MAX_EVENTS_DEFAULT)
+
+    def _parse_flight_recorder(self, section):
+        self.recorder_enabled = False
+        self.recorder_capacity = RECORDER_CAPACITY_DEFAULT
+        self.recorder_max_bundles = RECORDER_MAX_BUNDLES_DEFAULT
+        self.recorder_output_path = None
+        self.recorder_on_sigterm = False
+        if section is None:
+            return
+        section = self._section_dict(section, TELEMETRY_FLIGHT_RECORDER)
+        self._reject_unknown(section, KNOWN_FLIGHT_RECORDER_KEYS,
+                             "telemetry.flight_recorder")
+        self.recorder_enabled = bool(section.get("enabled", True))
+        self.recorder_capacity = self._pos_int(
+            section, TELEMETRY_FLIGHT_RECORDER, "capacity",
+            RECORDER_CAPACITY_DEFAULT)
+        self.recorder_max_bundles = self._pos_int(
+            section, TELEMETRY_FLIGHT_RECORDER, "max_bundles",
+            RECORDER_MAX_BUNDLES_DEFAULT)
+        self.recorder_output_path = section.get("output_path") or None
+        self.recorder_on_sigterm = bool(section.get("on_sigterm", False))
+
+    def _parse_watchdog(self, section):
+        """-> self.watchdog: None (section absent) or a dict of parsed
+        sub-configs for watchdog.Watchdog (a sub-key maps to None when
+        disabled with ``false``)."""
+        self.watchdog = None
+        if section is None:
+            return
+        section = self._section_dict(section, TELEMETRY_WATCHDOG)
+        self._reject_unknown(section, KNOWN_WATCHDOG_KEYS,
+                             "telemetry.watchdog")
+        if not section.get("enabled", True):
+            return
+        defaults = {
+            "step_deadline": STEP_DEADLINE_DEFAULTS,
+            "nan_streak": NAN_STREAK_DEFAULTS,
+            "loss_spike": LOSS_SPIKE_DEFAULTS,
+            "ttft_slo": TTFT_SLO_DEFAULTS,
+            "pool_exhaustion": POOL_EXHAUSTION_DEFAULTS,
+        }
+        parsed = {}
+        for name, base in defaults.items():
+            sub = section.get(name, True)
+            if sub is False:
+                parsed[name] = None
+                continue
+            if sub is True:
+                sub = {}
+            if not isinstance(sub, dict):
+                raise ValueError(
+                    "telemetry.watchdog.{} must be a dict or a bool, got "
+                    "{!r}".format(name, sub))
+            unknown = sorted(set(sub) - set(base))
+            if unknown:
+                self._noop(
+                    "watchdog.{}.{}".format(name, ", ".join(unknown)),
+                    "unknown key(s) (accepted: {})".format(sorted(base)))
+            merged = dict(base)
+            merged.update({k: v for k, v in sub.items() if k in base})
+            if merged["action"] not in WATCHDOG_ACTIONS:
+                raise ValueError(
+                    "telemetry.watchdog.{}.action must be one of {}, got "
+                    "{!r}".format(name, WATCHDOG_ACTIONS,
+                                  merged["action"]))
+            for key, val in merged.items():
+                if key == "action" or (key == "slo_s" and val is None):
+                    continue
+                if isinstance(val, bool) or \
+                        not isinstance(val, (int, float)) or val <= 0:
+                    raise ValueError(
+                        "telemetry.watchdog.{}.{} must be a positive "
+                        "number, got {!r}".format(name, key, val))
+            parsed[name] = merged
+        ttft = parsed.get("ttft_slo")
+        if ttft is not None and ttft["slo_s"] is None:
+            # no universal TTFT SLO exists: without slo_s the alarm can
+            # never trip — drop it (silently: it IS the default state)
+            parsed["ttft_slo"] = None
+        self.watchdog = parsed
+
+    def _parse_programs(self, section):
+        self.programs_storm_threshold = RECOMPILE_STORM_THRESHOLD_DEFAULT
+        self.programs_replicated_leaf_bytes = REPLICATED_LEAF_BYTES_DEFAULT
+        if section is None:
+            return
+        section = self._section_dict(section, TELEMETRY_PROGRAMS)
+        self._reject_unknown(section, KNOWN_PROGRAMS_KEYS,
+                             "telemetry.programs")
+        self.programs_storm_threshold = self._pos_int(
+            section, TELEMETRY_PROGRAMS, "recompile_storm_threshold",
+            RECOMPILE_STORM_THRESHOLD_DEFAULT)
+        self.programs_replicated_leaf_bytes = self._pos_int(
+            section, TELEMETRY_PROGRAMS, "replicated_leaf_bytes",
+            REPLICATED_LEAF_BYTES_DEFAULT)
 
     def _reject_unknown(self, d, known, section):
         unknown = sorted(k for k in d if k not in known)

@@ -1,0 +1,363 @@
+"""Flight recorder: a bounded in-memory ring of recent StepRecords,
+exported span trees, and warn-level log events that ``dump()``s one
+self-contained CRASH BUNDLE (JSON) when a run goes bad.
+
+Dump triggers (all wired by the engines when
+``telemetry.flight_recorder`` is enabled):
+
+* unhandled exceptions on a step path (``engine.forward/step/
+  train_batch``, the pipeline ``train_batch``, the serving scheduler's
+  ``step``) — the exception is re-raised untouched after the dump;
+* SIGTERM/preemption (``flight_recorder.on_sigterm``; the previous
+  handler is chained);
+* watchdog trips with the ``dump``/``raise`` action (watchdog.py);
+* an explicit ``engine.debug_dump()``.
+
+The bundle joins, in one file: the record/span/log rings, any OPEN span
+trees the crash interrupted, the resolved ds_config, an environment
+report (env_report.collect_env — jax/jaxlib versions, device/mesh
+inventory, HBM per device), the compile observatory's program registry,
+watchdog state, and whatever state providers the owning engine
+registered (e.g. the serving engine's page-pool/allocator occupancy).
+``validate_crash_bundle`` pins the schema; bin/check_bench_schema.py
+carries a stdlib-only copy of the key table (pinned equal by
+tests/unit/test_diagnostics.py) so CI can validate bundles without
+importing jax.
+"""
+import glob
+import json
+import logging
+import os
+import signal
+import threading
+import time
+import traceback
+from collections import deque
+
+from ..utils.logging import logger
+
+KIND_BUNDLE = "crash_bundle"
+
+# every crash bundle carries exactly these top-level keys
+CRASH_BUNDLE_KEYS = (
+    "kind", "reason", "wall", "job_name", "exception",
+    "records", "spans", "open_spans", "log_events",
+    "ds_config", "env", "programs", "watchdog", "state",
+)
+
+RECORDER_CAPACITY_DEFAULT = 256
+RECORDER_MAX_BUNDLES_DEFAULT = 8
+
+_MAX_JSON_DEPTH = 8
+
+
+def _jsonable(obj, depth=0):
+    """Best-effort conversion to JSON-serializable values: a crash
+    bundle must never fail to serialize because some provider handed it
+    a mesh or a device array — such values degrade to ``str(...)``."""
+    if depth > _MAX_JSON_DEPTH:
+        return str(obj)
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v, depth + 1) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set)):
+        return [_jsonable(v, depth + 1) for v in obj]
+    try:
+        return float(obj)           # numpy/device scalars
+    except Exception:  # noqa: BLE001
+        return str(obj)
+
+
+def validate_crash_bundle(bundle):
+    """Schema check for one crash-bundle dict. Returns a list of problem
+    strings; empty list = valid."""
+    problems = []
+    if not isinstance(bundle, dict):
+        return ["bundle is not a dict: {!r}".format(type(bundle).__name__)]
+    if bundle.get("kind") != KIND_BUNDLE:
+        return ["unknown bundle kind {!r}".format(bundle.get("kind"))]
+    for key in CRASH_BUNDLE_KEYS:
+        if key not in bundle:
+            problems.append("missing key {!r}".format(key))
+    if problems:
+        return problems
+    if not isinstance(bundle["reason"], str) or not bundle["reason"]:
+        problems.append("reason is not a non-empty string")
+    if isinstance(bundle["wall"], bool) or \
+            not isinstance(bundle["wall"], (int, float)):
+        problems.append("wall is not a number")
+    for key in ("records", "spans", "open_spans", "log_events"):
+        val = bundle[key]
+        if not isinstance(val, list):
+            problems.append("{} is not a list".format(key))
+        elif not all(isinstance(item, dict) for item in val):
+            problems.append("{} holds non-dict entries".format(key))
+    for rec in bundle.get("records") or []:
+        if rec.get("kind") not in ("train_step", "serving_step"):
+            problems.append("records entry of kind {!r}".format(
+                rec.get("kind")))
+            break
+    for key in ("env", "programs", "state"):
+        if not isinstance(bundle[key], dict):
+            problems.append("{} is not a dict".format(key))
+    for key in ("exception", "ds_config", "watchdog"):
+        if bundle[key] is not None and not isinstance(bundle[key], dict):
+            problems.append("{} is neither null nor a dict".format(key))
+    exc = bundle.get("exception")
+    if isinstance(exc, dict):
+        for key in ("type", "message"):
+            if not isinstance(exc.get(key), str):
+                problems.append("exception.{} is not a string".format(key))
+    if isinstance(bundle.get("programs"), dict) and \
+            "programs" not in bundle["programs"]:
+        problems.append("programs is not a registry snapshot "
+                        "(no 'programs' table)")
+    return problems
+
+
+class _LogRingHandler(logging.Handler):
+    """Captures warn-level (and up) log records into the recorder's
+    bounded ring (under the recorder's ring lock — a dump from the
+    watchdog thread snapshots these deques concurrently)."""
+
+    def __init__(self, ring, lock):
+        super().__init__(level=logging.WARNING)
+        self.ring = ring
+        self.ring_lock = lock
+
+    def emit(self, record):
+        try:
+            with self.ring_lock:
+                self.ring.append({
+                    "level": record.levelname,
+                    "message": record.getMessage(),
+                    "wall": record.created,
+                })
+        except Exception:  # noqa: BLE001 - never recurse into logging
+            pass
+
+
+class _SpanRingSink:
+    """Adapter: registered among the SpanTracer's sinks so every
+    exported span also lands in the recorder's ring."""
+
+    def __init__(self, recorder):
+        self.recorder = recorder
+
+    def emit(self, span_rec):
+        with self.recorder._lock:
+            self.recorder.spans.append(span_rec)
+
+    def close(self):
+        pass
+
+
+class FlightRecorder:
+    """See module docstring. Also a record sink: the collector registers
+    it in the StepRecord sink list, so ``emit()`` receives every record
+    the run produces."""
+
+    def __init__(self, output_dir, job_name="train",
+                 capacity=RECORDER_CAPACITY_DEFAULT,
+                 max_bundles=RECORDER_MAX_BUNDLES_DEFAULT,
+                 programs=None, spans=None, watchdog_state=None,
+                 on_sigterm=False):
+        self.output_dir = output_dir
+        self.job_name = job_name
+        self.capacity = int(capacity)
+        self.max_bundles = int(max_bundles)
+        self.records = deque(maxlen=self.capacity)
+        self.spans = deque(maxlen=self.capacity)
+        self.log_events = deque(maxlen=self.capacity)
+        self.programs = programs
+        self.tracer = spans
+        self.watchdog_state = watchdog_state    # callable or None
+        self._context = {}                       # name -> provider/value
+        self.bundles_written = 0
+        # adopt bundles a PREVIOUS process left in this directory: a
+        # crash-looping job must neither overwrite the prior crash's
+        # bundle (same bundle_000_<slug> name every restart) nor grow
+        # the directory past max_bundles with names retention never saw
+        self._bundle_paths = sorted(glob.glob(
+            os.path.join(self.output_dir, "bundle_*.json")))
+        for path in self._bundle_paths:
+            name = os.path.basename(path)
+            try:
+                self.bundles_written = max(self.bundles_written,
+                                           int(name.split("_")[1]) + 1)
+            except (IndexError, ValueError):
+                pass
+        # recently dumped exceptions, held by STRONG ref: the identity
+        # check below must never alias a new exception reallocated at a
+        # dead one's address (bounded, so tracebacks don't pile up)
+        self._recent_excs = deque(maxlen=32)
+        # set by the watchdog before interrupt_main(): the induced
+        # KeyboardInterrupt is a fresh exception object the step-path
+        # hooks would otherwise dump AGAIN for an already-dumped trip
+        self._interrupt_covered_until = 0.0
+        # RLock, not Lock: the SIGTERM handler dumps ON the main thread,
+        # and the signal can land while that same thread already holds
+        # the lock inside an emit — a plain Lock would self-deadlock the
+        # dying process instead of dumping
+        self._lock = threading.RLock()
+        self._closed = False
+        self._log_handler = _LogRingHandler(self.log_events, self._lock)
+        logger.addHandler(self._log_handler)
+        if self.tracer is not None:
+            self.tracer.sinks.append(_SpanRingSink(self))
+        self._sigterm_prev = None
+        self._sigterm_installed = False
+        if on_sigterm:
+            self._install_sigterm()
+
+    # ------------------------------------------------------- sink protocol
+    def emit(self, rec):
+        with self._lock:
+            self.records.append(rec)
+
+    # ---------------------------------------------------------- providers
+    def set_context(self, name, provider):
+        """Register a named provider (callable or plain value) resolved
+        at dump time into the bundle's ``state`` (or, for the reserved
+        names ``ds_config``, into its own section)."""
+        self._context[str(name)] = provider
+
+    def _resolve(self, provider):
+        try:
+            return _jsonable(provider() if callable(provider) else provider)
+        except Exception as err:  # noqa: BLE001 - a dump must never fail
+            return {"unavailable": str(err)}
+
+    # -------------------------------------------------------------- dump
+    def cover_interrupt(self, window_s=30.0):
+        """The next KeyboardInterrupt within ``window_s`` is a watchdog-
+        induced one (``_thread.interrupt_main`` after a raise-trip whose
+        bundle is already written) — ``dump`` skips it."""
+        self._interrupt_covered_until = time.monotonic() + window_s
+
+    def dump(self, reason, exc=None):
+        """Write one crash bundle; returns its path (None when this
+        exact exception object was already dumped — nested step-path
+        wrappers must not write duplicate bundles)."""
+        if exc is not None:
+            if getattr(exc, "_ds_dumped", False) or \
+                    any(e is exc for e in self._recent_excs):
+                return None
+            if isinstance(exc, KeyboardInterrupt) and \
+                    time.monotonic() < self._interrupt_covered_until:
+                # a watchdog raise-trip already dumped, then delivered
+                # this interrupt via _thread.interrupt_main()
+                return None
+            self._recent_excs.append(exc)
+            try:
+                exc._ds_dumped = True
+            except Exception:  # noqa: BLE001 - exceptions with __slots__
+                pass
+        exception = None
+        if exc is not None:
+            exception = {
+                "type": type(exc).__name__,
+                "message": str(exc),
+                "traceback": "".join(traceback.format_exception(
+                    type(exc), exc, exc.__traceback__)),
+            }
+        env = {}
+        try:
+            from ..env_report import collect_env
+            env = collect_env()
+        except Exception as err:  # noqa: BLE001
+            env = {"unavailable": str(err)}
+        context = dict(self._context)
+        ds_config = context.pop("ds_config", None)
+        with self._lock:
+            # ring snapshots under the lock: a dump from the watchdog
+            # deadline thread races the main thread's emit/log appends,
+            # and iterating a deque mid-mutation raises
+            records = list(self.records)
+            spans = list(self.spans)
+            log_events = list(self.log_events)
+        bundle = {
+            "kind": KIND_BUNDLE,
+            "reason": str(reason),
+            "wall": time.time(),
+            "job_name": self.job_name,
+            "exception": exception,
+            "records": [_jsonable(r) for r in records],
+            "spans": [_jsonable(s) for s in spans],
+            "open_spans": ([_jsonable(s)
+                            for s in self.tracer.open_snapshot()]
+                           if self.tracer is not None else []),
+            "log_events": log_events,
+            "ds_config": (self._resolve(ds_config)
+                          if ds_config is not None else None),
+            "env": _jsonable(env),
+            "programs": (_jsonable(self.programs.snapshot())
+                         if self.programs is not None else {}),
+            "watchdog": (self._resolve(self.watchdog_state)
+                         if self.watchdog_state is not None else None),
+            "state": {name: self._resolve(provider)
+                      for name, provider in context.items()},
+        }
+        with self._lock:
+            os.makedirs(self.output_dir, exist_ok=True)
+            slug = "".join(c if c.isalnum() or c in "-_" else "-"
+                           for c in str(reason))[:48]
+            path = os.path.join(self.output_dir, "bundle_{:03d}_{}.json"
+                                .format(self.bundles_written, slug))
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(bundle, fh)
+            os.replace(tmp, path)       # a bundle is whole or absent
+            self.bundles_written += 1
+            self._bundle_paths.append(path)
+            while len(self._bundle_paths) > self.max_bundles:
+                stale = self._bundle_paths.pop(0)
+                try:
+                    os.remove(stale)
+                except OSError:
+                    pass
+        logger.warning(
+            "flight recorder: crash bundle (%s) -> %s  [%d records, "
+            "%d spans, %d log events]", reason, path,
+            len(bundle["records"]), len(bundle["spans"]),
+            len(bundle["log_events"]))
+        return path
+
+    # ------------------------------------------------------------ signals
+    def _install_sigterm(self):
+        try:
+            self._sigterm_prev = signal.signal(signal.SIGTERM,
+                                               self._on_sigterm)
+            self._sigterm_installed = True
+        except (ValueError, OSError) as err:
+            # signal.signal only works from the main thread
+            logger.warning(
+                "flight_recorder.on_sigterm: cannot install handler "
+                "(%s) — SIGTERM will not produce a crash bundle", err)
+
+    def _on_sigterm(self, signum, frame):
+        self.dump("sigterm")
+        prev = self._sigterm_prev
+        if callable(prev):
+            prev(signum, frame)
+        elif prev == signal.SIG_DFL:
+            # restore + re-raise so the process still dies with the
+            # default SIGTERM disposition (exit code included)
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    # -------------------------------------------------------------- close
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        logger.removeHandler(self._log_handler)
+        if self._sigterm_installed:
+            try:
+                if signal.getsignal(signal.SIGTERM) == self._on_sigterm:
+                    signal.signal(signal.SIGTERM,
+                                  self._sigterm_prev or signal.SIG_DFL)
+            except (ValueError, OSError):
+                pass
+            self._sigterm_installed = False

@@ -5,6 +5,7 @@ Each sink consumes the full StepRecord dict (record.py); a sink failure
 never kills the step (telemetry must observe, not perturb)."""
 import json
 import os
+import zlib
 
 import numpy as np
 
@@ -14,19 +15,129 @@ from .record import KIND_SERVING, KIND_TRAIN
 
 class JsonlSink:
     """One JSON object per line, append mode, line-buffered — the always-
-    on sink (the same contract as the monitor's events.jsonl)."""
+    on sink (the same contract as the monitor's events.jsonl).
 
-    def __init__(self, path):
+    ``max_bytes`` (telemetry.jsonl_max_bytes) bounds the file for long
+    serving runs: when the NEXT line would push past the limit, the
+    current file rotates to ``<path>.1`` (replacing the previous
+    rotation) and a fresh file starts. Rotation happens only at line
+    boundaries, so both files always hold whole JSON lines and the
+    schema checkers keep passing on them."""
+
+    def __init__(self, path, max_bytes=None):
         self.path = path
+        self.max_bytes = max_bytes
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._fh = open(path, "a", buffering=1)
+        self._bytes = os.path.getsize(path)
+        self.rotations = 0
+
+    def _rotate(self):
+        self._fh.close()
+        os.replace(self.path, self.path + ".1")
+        self._fh = open(self.path, "a", buffering=1)
+        self._bytes = 0
+        self.rotations += 1
 
     def emit(self, rec):
-        self._fh.write(json.dumps(rec) + "\n")
+        line = json.dumps(rec) + "\n"
+        if self.max_bytes is not None and self._bytes > 0 and \
+                self._bytes + len(line) > self.max_bytes:
+            self._rotate()
+        self._fh.write(line)
+        self._bytes += len(line)
 
     def close(self):
         if self._fh is not None:
             self._fh.close()
+            self._fh = None
+
+
+class ChromeTraceSink:
+    """Chrome trace-event JSON for the span tracer, loadable in Perfetto
+    (ui.perfetto.dev) alongside telemetry.trace's xprof windows.
+
+    Uses the JSON *Array* Format: the file opens with ``[`` and each
+    span appends one complete-event line. Perfetto explicitly tolerates
+    a missing closing bracket, so a crashed run's file is still
+    loadable; ``close()`` writes the bracket for well-formed files.
+    Each span becomes a ``ph: "X"`` complete event (ts/dur in
+    microseconds) on a tid derived from its trace, so one request/step
+    tree renders as one track; span events ride along as ``ph: "i"``
+    instants."""
+
+    def __init__(self, path, max_bytes=None):
+        self.path = path
+        self.max_bytes = max_bytes
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._fh = open(path, "w", buffering=1)
+        self._fh.write("[\n")
+        self._bytes = 2
+        self.rotations = 0
+
+    @staticmethod
+    def _tid(trace_id):
+        # arithmetic, not memoized: a long serving run mints one trace
+        # per request, and a tid dict would grow without bound
+        return zlib.crc32(trace_id.encode()) % 512
+
+    def _finalize(self):
+        """Close the JSON array: strip the last event's trailing comma
+        (seek back over ",\\n") so the finished file is STRICT JSON;
+        only a crashed run leaves the lenient trailing-comma form, which
+        Perfetto still loads."""
+        if self._bytes > 2:
+            self._fh.seek(self._fh.tell() - 2)
+            self._fh.write("\n")
+        self._fh.write("]\n")
+        self._fh.close()
+
+    def _write(self, event):
+        line = json.dumps(event) + ",\n"
+        if self.max_bytes is not None and self._bytes > 2 and \
+                self._bytes + len(line) > self.max_bytes:
+            self._finalize()
+            os.replace(self.path, self.path + ".1")
+            self._fh = open(self.path, "w", buffering=1)
+            self._fh.write("[\n")
+            self._bytes = 2
+            self.rotations += 1
+        self._fh.write(line)
+        self._bytes += len(line)
+
+    def emit(self, span):
+        if span.get("start_s") is None:
+            return
+        tid = self._tid(span["trace_id"])
+        end = span.get("end_s")
+        self._write({
+            "name": span["name"],
+            "ph": "X",
+            "ts": span["start_s"] * 1e6,
+            "dur": ((end - span["start_s"]) * 1e6
+                    if end is not None else 0.0),
+            "pid": 0,
+            "tid": tid,
+            "cat": "span",
+            "args": dict(span.get("attrs") or {},
+                         trace_id=span["trace_id"],
+                         span_id=span["span_id"]),
+        })
+        for ev in span.get("events") or ():
+            self._write({
+                "name": ev["name"],
+                "ph": "i",
+                "ts": ev["wall"] * 1e6,
+                "pid": 0,
+                "tid": tid,
+                "s": "t",
+                "cat": "event",
+                "args": dict(ev.get("attrs") or {}),
+            })
+
+    def close(self):
+        if self._fh is not None:
+            self._finalize()
             self._fh = None
 
 

@@ -1,0 +1,268 @@
+"""Run doctor watchdogs: hang/anomaly alarms over the telemetry stream.
+
+Five alarms, each with a configurable action (``telemetry.watchdog``):
+
+* **step_deadline** — a background thread arms a deadline at every step
+  begin (``max(factor x rolling-median step time, floor_s)``, armed only
+  after ``min_steps`` completed steps so compiles never trip it) and
+  fires if the step does not COMPLETE in time: the only way to observe a
+  hung collective/transfer, which by definition never reaches the
+  end-of-step code;
+* **nan_streak** — ``threshold`` consecutive steps with a non-finite
+  loss or an overflow skip;
+* **loss_spike** — loss z-score over a rolling window exceeds
+  ``zscore``;
+* **ttft_slo** — a serving request's time-to-first-token exceeded
+  ``slo_s`` (off unless configured: there is no universal SLO);
+* **pool_exhaustion** — paged-KV admission blocked or a decoder was
+  preempted for pages (the serving engine is out of KV memory).
+
+Actions: ``warn`` logs; ``dump`` logs + writes a flight-recorder crash
+bundle; ``raise`` logs + dumps + raises :class:`WatchdogError` (from the
+deadline thread, where raising is impossible, it interrupts the main
+thread instead). Every trip is also kept in ``trips`` — bundled into
+crash bundles via ``snapshot()``.
+"""
+import threading
+import time
+from collections import deque
+
+from ..utils.logging import logger
+
+WATCHDOG_ACTIONS = ("warn", "dump", "raise")
+
+STEP_DEADLINE_DEFAULTS = {"factor": 5.0, "min_steps": 5, "floor_s": 1.0,
+                          "poll_s": 0.05, "action": "warn"}
+NAN_STREAK_DEFAULTS = {"threshold": 3, "action": "warn"}
+LOSS_SPIKE_DEFAULTS = {"zscore": 8.0, "window": 50, "min_steps": 10,
+                       "action": "warn"}
+TTFT_SLO_DEFAULTS = {"slo_s": None, "every": 1, "action": "warn"}
+POOL_EXHAUSTION_DEFAULTS = {"every": 100, "action": "warn"}
+
+_MAX_TRIPS = 64
+
+
+class WatchdogError(RuntimeError):
+    """Raised (action == "raise") when a watchdog trips."""
+
+
+class Watchdog:
+    """Owns the alarm state machines; fed by the telemetry collector
+    (records, step begin/end) and the serving scheduler (TTFT samples,
+    pool-pressure events)."""
+
+    def __init__(self, cfg, recorder=None, job_name="train"):
+        """``cfg``: dict of parsed sub-configs (telemetry/config.py) —
+        keys step_deadline / nan_streak / loss_spike / ttft_slo /
+        pool_exhaustion, each a dict or None (disabled)."""
+        self.cfg = cfg or {}
+        self.recorder = recorder
+        self.job_name = job_name
+        self.trips = []
+        self._nan_streak = 0
+        self._nan_tripped = False
+        spike = self.cfg.get("loss_spike")
+        self._losses = deque(maxlen=int(spike["window"])) if spike else None
+        self._ttft_violations = 0
+        self._pool_events = 0
+        # step-deadline thread state
+        self._dl_cfg = self.cfg.get("step_deadline")
+        self._durations = deque(maxlen=64)
+        self._step_t0 = None
+        self._armed_deadline = None        # monotonic deadline, or None
+        self._armed_step = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+
+    # ------------------------------------------------------------ tripping
+    def _trip(self, name, detail, action, from_thread=False):
+        trip = {"watchdog": name, "detail": detail, "action": action,
+                "wall": time.time()}
+        if len(self.trips) < _MAX_TRIPS:
+            self.trips.append(trip)
+        logger.warning("watchdog %s TRIPPED (%s): %s", name, action,
+                       detail)
+        if action in ("dump", "raise"):
+            if self.recorder is not None:
+                try:
+                    self.recorder.dump("watchdog:" + name)
+                except Exception:  # noqa: BLE001 - a failed dump must
+                    # never kill the deadline thread (it would silently
+                    # stop watching the NEXT hang)
+                    logger.warning("watchdog %s: crash-bundle dump "
+                                   "failed", name, exc_info=True)
+            else:
+                logger.warning(
+                    "watchdog %s action %r needs telemetry."
+                    "flight_recorder, which is off — no bundle written",
+                    name, action)
+        if action == "raise":
+            err = WatchdogError("watchdog {} tripped: {}".format(name,
+                                                                 detail))
+            # the bundle for this trip is already written; the step-path
+            # crash hook must not write a duplicate
+            err._ds_dumped = True
+            if from_thread:
+                # a thread cannot raise into the main thread; interrupt
+                # it (KeyboardInterrupt at the next bytecode boundary).
+                # That interrupt is a FRESH exception object the step-
+                # path hooks would dump again — mark it covered first.
+                import _thread
+                if self.recorder is not None:
+                    self.recorder.cover_interrupt()
+                logger.warning(
+                    "watchdog %s: interrupting the main thread (raise "
+                    "action from the deadline thread)", name)
+                _thread.interrupt_main()
+            else:
+                raise err
+
+    # -------------------------------------------------------- step deadline
+    def step_begin(self, step):
+        if self._dl_cfg is None:
+            return
+        with self._lock:
+            self._step_t0 = time.monotonic()
+            self._armed_step = step
+            if len(self._durations) >= int(self._dl_cfg["min_steps"]):
+                durs = sorted(self._durations)
+                median = durs[len(durs) // 2]
+                deadline = max(float(self._dl_cfg["factor"]) * median,
+                               float(self._dl_cfg["floor_s"]))
+                self._armed_deadline = self._step_t0 + deadline
+                self._ensure_thread()
+            else:
+                self._armed_deadline = None
+
+    def step_end(self):
+        if self._dl_cfg is None:
+            return
+        with self._lock:
+            if self._step_t0 is not None:
+                self._durations.append(time.monotonic() - self._step_t0)
+            self._step_t0 = None
+            self._armed_deadline = None
+
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._deadline_loop,
+                name="ds-watchdog-{}".format(self.job_name), daemon=True)
+            self._thread.start()
+
+    def _deadline_loop(self):
+        poll = float(self._dl_cfg["poll_s"])
+        while not self._stop.wait(poll):
+            with self._lock:
+                deadline = self._armed_deadline
+                step = self._armed_step
+                overdue = deadline is not None and \
+                    time.monotonic() > deadline
+                if overdue:
+                    waited = time.monotonic() - self._step_t0
+                    self._armed_deadline = None   # one trip per hang
+            if overdue:
+                self._trip(
+                    "step_deadline",
+                    "step {} has not completed after {:.2f}s (deadline "
+                    "{:.2f}x rolling median, floor {}s) — hung "
+                    "collective/transfer?".format(
+                        step, waited, float(self._dl_cfg["factor"]),
+                        self._dl_cfg["floor_s"]),
+                    self._dl_cfg["action"], from_thread=True)
+
+    # ------------------------------------------------------------- records
+    def observe_train(self, rec):
+        """One emitted train StepRecord: NaN-streak + loss-spike."""
+        loss = rec.get("loss")
+        finite = loss is not None and loss == loss and \
+            abs(loss) != float("inf")
+        bad = (not finite) or bool(rec.get("overflow"))
+        nan_cfg = self.cfg.get("nan_streak")
+        if nan_cfg is not None:
+            if bad:
+                self._nan_streak += 1
+                if not self._nan_tripped and \
+                        self._nan_streak >= int(nan_cfg["threshold"]):
+                    self._nan_tripped = True    # once per streak
+                    self._trip(
+                        "nan_streak",
+                        "{} consecutive steps with non-finite loss or "
+                        "overflow (step {}, loss {!r})".format(
+                            self._nan_streak, rec.get("step"), loss),
+                        nan_cfg["action"])
+            else:
+                self._nan_streak = 0
+                self._nan_tripped = False
+        spike_cfg = self.cfg.get("loss_spike")
+        if spike_cfg is not None and finite:
+            window = self._losses
+            if len(window) >= int(spike_cfg["min_steps"]):
+                mean = sum(window) / len(window)
+                var = sum((x - mean) ** 2 for x in window) / len(window)
+                std = var ** 0.5
+                if std > 0:
+                    z = (loss - mean) / std
+                    if z >= float(spike_cfg["zscore"]):
+                        window.clear()          # cooldown: refill first
+                        self._trip(
+                            "loss_spike",
+                            "loss {:.6g} at step {} is {:.1f} sigma above "
+                            "the rolling mean {:.6g}".format(
+                                loss, rec.get("step"), z, mean),
+                            spike_cfg["action"])
+                        return
+            window.append(loss)
+
+    def observe_serving(self, rec):
+        """One emitted serving StepRecord (pool gauge redundancy: the
+        explicit observe_pool_event covers the hard failures)."""
+
+    # ------------------------------------------------------------- serving
+    def observe_ttft(self, seconds):
+        cfg = self.cfg.get("ttft_slo")
+        if cfg is None or cfg.get("slo_s") is None:
+            return
+        if seconds <= float(cfg["slo_s"]):
+            return
+        self._ttft_violations += 1
+        if (self._ttft_violations - 1) % max(int(cfg["every"]), 1) == 0:
+            self._trip(
+                "ttft_slo",
+                "TTFT {:.3f}s exceeded the {:.3f}s SLO ({} violation(s) "
+                "so far)".format(seconds, float(cfg["slo_s"]),
+                                 self._ttft_violations),
+                cfg["action"])
+
+    def observe_pool_event(self, kind):
+        """``kind``: 'admission_blocked' | 'preemption' — the paged KV
+        pool could not serve a request's growth."""
+        cfg = self.cfg.get("pool_exhaustion")
+        if cfg is None:
+            return
+        self._pool_events += 1
+        if (self._pool_events - 1) % max(int(cfg["every"]), 1) == 0:
+            self._trip(
+                "pool_exhaustion",
+                "KV page pool pressure: {} ({} event(s) so far) — the "
+                "pool is undersized for this traffic".format(
+                    kind, self._pool_events),
+                cfg["action"])
+
+    # ------------------------------------------------------------ snapshot
+    def snapshot(self):
+        return {
+            "trips": list(self.trips),
+            "nan_streak": self._nan_streak,
+            "ttft_violations": self._ttft_violations,
+            "pool_events": self._pool_events,
+            "step_durations_tracked": len(self._durations),
+        }
+
+    def close(self):
+        self._stop.set()
+        thread = self._thread
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=1.0)
+        self._thread = None

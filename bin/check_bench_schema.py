@@ -13,6 +13,10 @@ Three artifact families, dispatched by shape:
 * **crash bundles** (``kind: "crash_bundle"``, flight recorder —
   docs/diagnostics.md): reason/wall, record+span+log rings, env report,
   program registry.
+* **analysis reports** (``kind: "analysis_report"``, the shard-lint
+  auditor / ``bin/ds_lint.py --json`` — docs/analysis.md): programs
+  map, findings/suppressed lists with rule/check/key/severity, summary
+  counters.
 * **Chrome trace-event files** (a JSON array, telemetry.spans'
   trace_events.json): parsed leniently (a crashed run may leave the
   Perfetto-tolerated trailing-comma/unclosed-array form) and each event
@@ -251,6 +255,70 @@ def check_crash_bundle(bundle):
     return problems
 
 
+# Local copy of analysis/findings.py ANALYSIS_REPORT_KEYS /
+# FINDING_KEYS / SEVERITIES (same stdlib-only constraint; pinned equal
+# by tests/unit/test_analysis.py).
+ANALYSIS_REPORT_KEYS = (
+    "kind", "version", "job", "programs", "findings", "suppressed",
+    "summary",
+)
+ANALYSIS_FINDING_KEYS = ("rule", "check", "program", "severity",
+                         "message", "key")
+ANALYSIS_SEVERITIES = ("error", "warn", "info")
+
+
+def check_analysis_report(payload):
+    """-> list of problems with one shard-lint analysis report. A
+    stdlib re-statement of analysis/findings.py's
+    ``validate_analysis_report`` (the writer-side checker is the source
+    of truth; test_analysis.py pins the key tables equal)."""
+    problems = []
+    if not isinstance(payload, dict):
+        return ["report is not a dict"]
+    for key in ANALYSIS_REPORT_KEYS:
+        if key not in payload:
+            problems.append("missing key {!r}".format(key))
+    if problems:
+        return problems
+    if not isinstance(payload.get("programs"), dict):
+        problems.append("programs is not a dict")
+    for section in ("findings", "suppressed"):
+        entries = payload.get(section)
+        if not isinstance(entries, list):
+            problems.append("{} is not a list".format(section))
+            continue
+        for i, ent in enumerate(entries):
+            if not isinstance(ent, dict):
+                problems.append(
+                    "{}[{}] is not an object".format(section, i))
+                break
+            for key in ANALYSIS_FINDING_KEYS:
+                if not isinstance(ent.get(key), str):
+                    problems.append("{}[{}].{} is not a string".format(
+                        section, i, key))
+            if ent.get("severity") not in ANALYSIS_SEVERITIES:
+                problems.append("{}[{}] has unknown severity "
+                                "{!r}".format(section, i,
+                                              ent.get("severity")))
+            if section == "suppressed" and \
+                    not ent.get("suppressed_reason"):
+                problems.append(
+                    "suppressed[{}] lacks a suppressed_reason".format(i))
+            if problems:
+                break
+    summary = payload.get("summary")
+    if not isinstance(summary, dict):
+        problems.append("summary is not a dict")
+    else:
+        for key in ("programs_audited", "findings", "suppressed"):
+            val = summary.get(key)
+            if not isinstance(val, int) or isinstance(val, bool) or \
+                    val < 0:
+                problems.append(
+                    "summary.{} is not an int >= 0".format(key))
+    return problems
+
+
 # every Chrome trace event must carry these fields
 TRACE_EVENT_KEYS = ("name", "ph", "ts", "pid", "tid")
 
@@ -319,6 +387,9 @@ def check_file(path):
         return ["unparseable: {}".format(err)]
     if isinstance(payload, dict) and payload.get("kind") == "crash_bundle":
         return check_crash_bundle(payload)
+    if isinstance(payload, dict) and \
+            payload.get("kind") == "analysis_report":
+        return check_analysis_report(payload)
     if isinstance(payload, dict) and "traceEvents" in payload:
         return check_trace_events(text)
     return check_bench_payload(payload)

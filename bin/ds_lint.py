@@ -1,0 +1,173 @@
+#!/usr/bin/env python3
+"""Shard-lint CLI: repo-wide AST hot-path lint + on-demand program audit.
+
+Repo lint (default; stdlib-only — the linter modules load under a
+synthetic package name so the path never imports jax and runs on boxes
+without it):
+
+    python bin/ds_lint.py                        # deepspeed_tpu/ vs baseline
+    python bin/ds_lint.py path/a path/b          # explicit roots
+    python bin/ds_lint.py --write-baseline       # accept current state
+    python bin/ds_lint.py --json report.json     # analysis-report artifact
+
+Exit 1 when any occurrence EXCEEDS its baselined count
+(bin/ds_lint_baseline.json — every accepted entry is a reviewed
+occurrence; new code must come in clean). Stale baseline keys are
+reported but do not fail, so refactors that REMOVE hazards never block.
+
+Program audit (imports jax; abstract-evals a demo GPT-2 training engine
+plus an inference engine and runs the full shard-lint rule set —
+docs/analysis.md; real models audit via ``engine.audit()`` /
+``init_inference(..., audit=True)``):
+
+    python bin/ds_lint.py --audit-demo [--hlo] [--json report.json]
+"""
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+DEFAULT_BASELINE = os.path.join(_REPO, "bin", "ds_lint_baseline.json")
+
+
+def _load_lint_modules():
+    """Load analysis.astlint + analysis.findings WITHOUT executing the
+    deepspeed_tpu package __init__ chain (which imports jax): both
+    modules are stdlib-only, so the repo-lint path stays runnable on a
+    box without jax. They mount under a synthetic package name so a
+    later real `import deepspeed_tpu` (e.g. --audit-demo) is untouched.
+    """
+    import importlib
+    import types
+    name = "_ds_lint_vendor"
+    if name not in sys.modules:
+        pkg = types.ModuleType(name)
+        pkg.__path__ = [os.path.join(_REPO, "deepspeed_tpu", "analysis")]
+        sys.modules[name] = pkg
+    return (importlib.import_module(name + ".astlint"),
+            importlib.import_module(name + ".findings"))
+
+
+def _report_payload(findings_map, baseline, stale, findings_mod):
+    """Serialize a repo-lint run in the analysis-report artifact shape
+    (bin/check_bench_schema.py validates it). Occurrence i of a key is
+    a finding only when i exceeds the key's baselined count — the same
+    per-occurrence split diff_baseline applies, so the artifact's
+    counters agree with the CLI's exit status."""
+    report = findings_mod.AnalysisReport(job="repo-lint")
+    files = sorted({f.program for items in findings_map.values()
+                    for f in items})
+    for path in files:
+        report.add_program(path, family="repo")
+    for key, items in sorted(findings_map.items()):
+        allowed = baseline.get(key, 0)
+        for i, f in enumerate(items):
+            if i < allowed:
+                report.suppressed.append((f, "baselined occurrence"))
+            else:
+                report.findings.append(f)
+    payload = report.to_dict()
+    payload["stale_baseline_keys"] = stale
+    return payload
+
+
+def run_repo_lint(paths, baseline_path, write_baseline, json_out):
+    astlint, findings_mod = _load_lint_modules()
+    findings = astlint.lint_paths(paths, base=_REPO)
+    if write_baseline:
+        path = astlint.write_baseline(baseline_path, findings)
+        total = sum(len(v) for v in findings.values())
+        print("ds_lint: baseline written to {} ({} accepted "
+              "occurrence(s) across {} key(s))".format(
+                  path, total, len(findings)))
+        return 0
+    baseline = astlint.load_baseline(baseline_path)
+    new, stale = astlint.diff_baseline(findings, baseline)
+    if json_out:
+        with open(json_out, "w") as fh:
+            json.dump(_report_payload(findings, baseline, stale,
+                                      findings_mod), fh,
+                      indent=2, sort_keys=True)
+        print("ds_lint: report written to {}".format(json_out))
+    for f in new:
+        print("NEW  {}".format(f.message))
+    for key in stale:
+        print("STALE baseline entry (no longer observed): {}".format(key))
+    total = sum(len(v) for v in findings.values())
+    print("ds_lint: {} occurrence(s) across {} file-rule key(s); "
+          "{} above baseline; {} stale baseline key(s)".format(
+              total, len(findings), len(new), len(stale)))
+    return 1 if new else 0
+
+
+def run_audit_demo(hlo, json_out):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import numpy as np
+    import deepspeed_tpu as deepspeed
+    from deepspeed_tpu.models import gpt2
+    cfg = gpt2.GPT2Config(vocab_size=256, max_seq_len=64, n_layers=2,
+                          n_heads=2, d_model=64,
+                          use_flash_attention=False, remat=False,
+                          loss_chunk=0)
+    engine, _, _, _ = deepspeed.initialize(
+        model=gpt2.make_gpt2_model(config=cfg), config_params={
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 1,
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 3,
+                                  "stage3_param_persistence_threshold": 0},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+            "steps_per_print": 10 ** 9,
+        })
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 256, size=(16, 64)).astype(np.int32)
+    report = engine.audit(batch=(ids, ids.copy()), hlo=hlo,
+                          report_path=json_out)
+    inf = deepspeed.init_inference(
+        model=gpt2.make_gpt2_model(config=cfg),
+        config={"inference": {"max_batch_size": 2,
+                              "prefill_buckets": [8, 16],
+                              "dtype": "fp32", "greedy": True}})
+    inf_report = inf.audit()
+    total = len(report.findings) + len(inf_report.findings)
+    print("ds_lint audit-demo: {} train + {} inference program(s) "
+          "audited, {} finding(s)".format(
+              len(report.programs), len(inf_report.programs), total))
+    for f in report.findings + inf_report.findings:
+        print("  - [{}] {}".format(f.key, f.message))
+    return 1 if total else 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="shard-lint: repo AST linter + program auditor")
+    parser.add_argument("paths", nargs="*",
+                        default=None, help="lint roots (default: "
+                        "deepspeed_tpu/)")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE)
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="accept the current violations as baseline")
+    parser.add_argument("--json", dest="json_out", default=None,
+                        help="write the analysis-report JSON artifact")
+    parser.add_argument("--audit-demo", action="store_true",
+                        help="abstract-eval + audit a demo engine pair")
+    parser.add_argument("--hlo", action="store_true",
+                        help="with --audit-demo: also compile + census "
+                             "the HLO collectives")
+    args = parser.parse_args(argv)
+    if args.audit_demo:
+        return run_audit_demo(args.hlo, args.json_out)
+    paths = args.paths or [os.path.join(_REPO, "deepspeed_tpu")]
+    return run_repo_lint(paths, args.baseline, args.write_baseline,
+                         args.json_out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

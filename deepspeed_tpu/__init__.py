@@ -83,7 +83,8 @@ def initialize(args=None, model=None, optimizer=None, model_parameters=None,
 
 def init_inference(model=None, config=None, mp_size=1, mesh=None,
                    dtype=None, injection_policy=None,
-                   replace_method="auto", seed=0, draft_model=None):
+                   replace_method="auto", seed=0, draft_model=None,
+                   audit=False):
     """Initialize the DeepSpeed-TPU inference engine.
 
     Mirrors reference ``deepspeed.init_inference(model, mp_size, dtype,
@@ -109,6 +110,11 @@ def init_inference(model=None, config=None, mp_size=1, mesh=None,
     cache (+ ``prefix_caching``, ``speculative`` — docs/inference.md);
     ``draft_model`` supplies the small GPT-2 drafter that
     ``inference.speculative.method: "model"`` requires.
+
+    ``audit=True`` runs the ahead-of-time shard-lint
+    (``engine.audit()``, docs/analysis.md) over the prefill/decode/
+    spec-verify programs before the engine is returned — findings warn,
+    or raise when the config sets ``analysis.strict``.
     """
     from .inference.engine import InferenceEngine
 
@@ -134,8 +140,11 @@ def init_inference(model=None, config=None, mp_size=1, mesh=None,
                 mp_size, jax.device_count())
         mesh = build_mesh(data=jax.device_count() // mp_size, model=mp_size)
 
-    return InferenceEngine(model, config=config, mesh=mesh, dtype=dtype,
-                           seed=seed, draft_model=draft_model)
+    engine = InferenceEngine(model, config=config, mesh=mesh, dtype=dtype,
+                             seed=seed, draft_model=draft_model)
+    if audit:
+        engine.audit()
+    return engine
 
 
 def _add_core_arguments(parser):

@@ -1,0 +1,264 @@
+"""Repo-wide AST hot-path linter (the ``bin/ds_lint.py`` core).
+
+Static rules for the anti-patterns that degrade step time without ever
+failing a test — each is a hazard the runtime telemetry can only see
+AFTER the cost is paid:
+
+  * **DSL001 time-in-traced-fn** — ``time.time()`` /
+    ``time.monotonic()`` / ``time.perf_counter()`` inside a function
+    NESTED in a ``*_fn`` builder (the repo's traced-program
+    convention). Wall-clock reads trace as constants: the timing is a
+    lie and the closure re-traces on nothing.
+  * **DSL002 device-put-in-loop** — ``jax.device_put`` inside a
+    ``for``/``while`` body: one un-jitted dispatch per leaf per
+    iteration (the T3 finding the coalesced H2D batcher exists to
+    kill; runtime/zero/transfer.py).
+  * **DSL003 telemetry-gate-missing** — a ``<x>.telemetry.<attr>``
+    read in a function with no ``telemetry``-None guard: the telemetry
+    object is None whenever the config section is off, so the ungated
+    access is a latent AttributeError on every production path.
+  * **DSL004 jit-in-loop** — ``jax.jit(...)`` called inside a loop
+    body: a fresh jit wrapper (and trace) per iteration; hoist the jit
+    (or cache by key, the ``_get_jit`` pattern).
+
+Violations key as ``DSL###:<relpath>::<qualname>`` and count per key —
+the committed baseline file maps keys to accepted counts, so existing
+(reviewed) occurrences stay green while any NEW occurrence fails.
+"""
+import ast
+import json
+import os
+
+from .findings import Finding
+
+LINT_RULES = {
+    "DSL001": "time-in-traced-fn",
+    "DSL002": "device-put-in-loop",
+    "DSL003": "telemetry-gate-missing",
+    "DSL004": "jit-in-loop",
+}
+
+_TIME_FNS = {"time", "monotonic", "perf_counter"}
+
+
+def _attr_chain(node):
+    """Attribute node -> dotted string tail ('self.telemetry.spans')."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+class _FunctionLint(ast.NodeVisitor):
+    """Per-function-body state: loop depth, telemetry guards/uses."""
+
+    def __init__(self, linter, qualname, in_builder):
+        self.linter = linter
+        self.qualname = qualname
+        self.in_builder = in_builder       # nested under a *_fn builder
+        self.loop_depth = 0
+        self.telemetry_guarded = False
+        self.telemetry_aliases = set()
+        self.telemetry_uses = []           # [lineno]
+
+    # ---- nested functions delegate back to the linter (fresh state)
+    def visit_FunctionDef(self, node):
+        self.linter.visit_function(
+            node, self.qualname,
+            self.in_builder or self.qualname.endswith("_fn"))
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_For(self, node):
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_While = visit_For
+
+    def visit_Assign(self, node):
+        # alias: tel = self.telemetry (guards on the alias count)
+        if isinstance(node.value, ast.Attribute) and \
+                node.value.attr == "telemetry":
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.telemetry_aliases.add(tgt.id)
+        self.generic_visit(node)
+
+    def _guards_telemetry(self, expr):
+        """Whether ``expr`` mentions telemetry (or an alias), through
+        ``not`` and boolean composition — a truthiness test like
+        ``if self.telemetry:`` IS a None-gate in idiomatic Python."""
+        if isinstance(expr, (ast.Attribute, ast.Name)):
+            chain = _attr_chain(expr)
+            return "telemetry" in chain or \
+                chain in self.telemetry_aliases
+        if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.Not):
+            return self._guards_telemetry(expr.operand)
+        if isinstance(expr, ast.BoolOp):
+            return any(self._guards_telemetry(v) for v in expr.values)
+        return False
+
+    def visit_Compare(self, node):
+        # <expr> is [not] None where <expr> mentions telemetry/an alias
+        if any(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            for operand in [node.left] + list(node.comparators):
+                if self._guards_telemetry(operand):
+                    self.telemetry_guarded = True
+        self.generic_visit(node)
+
+    def visit_If(self, node):
+        if self._guards_telemetry(node.test):
+            self.telemetry_guarded = True
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node):
+        if self._guards_telemetry(node.test):
+            self.telemetry_guarded = True
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        # <x>.telemetry.<attr> read
+        if isinstance(node.value, ast.Attribute) and \
+                node.value.attr == "telemetry":
+            self.telemetry_uses.append(node.lineno)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        fn = node.func
+        chain = _attr_chain(fn) if isinstance(fn, ast.Attribute) else ""
+        if chain.startswith("time.") and \
+                chain.split(".")[-1] in _TIME_FNS and self.in_builder:
+            self.linter.report("DSL001", self.qualname, node.lineno,
+                               "{}() inside a traced-fn builder body "
+                               "traces as a constant".format(chain))
+        if chain.endswith(".device_put") and self.loop_depth > 0:
+            self.linter.report("DSL002", self.qualname, node.lineno,
+                               "jax.device_put inside a loop body — one "
+                               "un-jitted dispatch per iteration "
+                               "(coalesce via the H2D batcher)")
+        if chain == "jax.jit" and self.loop_depth > 0:
+            self.linter.report("DSL004", self.qualname, node.lineno,
+                               "jax.jit inside a loop body — a fresh "
+                               "trace per iteration (hoist or cache by "
+                               "key)")
+        self.generic_visit(node)
+
+    def finish(self):
+        if self.telemetry_uses and not self.telemetry_guarded:
+            self.linter.report(
+                "DSL003", self.qualname, self.telemetry_uses[0],
+                "reads .telemetry.<attr> with no is-None gate in the "
+                "function — telemetry is None whenever the config "
+                "section is off")
+
+
+class FileLinter:
+    def __init__(self, relpath):
+        self.relpath = relpath
+        self.violations = []       # [(rule, qualname, lineno, message)]
+
+    def report(self, rule, qualname, lineno, message):
+        self.violations.append((rule, qualname, lineno, message))
+
+    def visit_function(self, node, parent_qual, in_builder):
+        qual = "{}.{}".format(parent_qual, node.name) if parent_qual \
+            else node.name
+        state = _FunctionLint(self, qual, in_builder)
+        for stmt in node.body:
+            state.visit(stmt)
+        state.finish()
+
+    def run(self, tree):
+        # walk module/class levels; functions get per-body state
+        def top(node, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    self.visit_function(child, prefix, False)
+                elif isinstance(child, ast.ClassDef):
+                    name = "{}.{}".format(prefix, child.name) if prefix \
+                        else child.name
+                    top(child, name)
+        top(tree, "")
+        return self.violations
+
+
+def lint_file(path, relpath=None):
+    relpath = relpath or path
+    with open(path) as fh:
+        source = fh.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as err:
+        return [("DSL000", "<module>", getattr(err, "lineno", 0),
+                 "unparseable: {}".format(err))]
+    return FileLinter(relpath).run(tree)
+
+
+def lint_paths(paths, base=None):
+    """-> {key: [Finding, ...]} over every .py file under ``paths``
+    (key = 'RULE:relpath::qualname'; ``base`` anchors the relpaths —
+    pass the repo root so baseline keys are stable under any cwd)."""
+    findings = {}
+    files = []
+    for root in paths:
+        if os.path.isfile(root):
+            files.append(root)
+            continue
+        for dirpath, _, names in os.walk(root):
+            files += [os.path.join(dirpath, n) for n in sorted(names)
+                      if n.endswith(".py")]
+    base = base or os.getcwd()
+    for path in sorted(files):
+        rel = os.path.relpath(path, base)
+        for rule, qual, lineno, message in lint_file(path, rel):
+            key = "{}:{}::{}".format(rule, rel.replace(os.sep, "/"), qual)
+            findings.setdefault(key, []).append(Finding(
+                rule=rule, check=LINT_RULES.get(rule, rule),
+                program=rel.replace(os.sep, "/"),
+                message="{}:{} [{}] {}".format(rel, lineno, rule, message),
+                key=key,
+                details={"line": lineno, "qualname": qual}))
+    return findings
+
+
+def load_baseline(path):
+    if path is None or not os.path.exists(path):
+        return {}
+    with open(path) as fh:
+        payload = json.load(fh)
+    if not isinstance(payload, dict) or \
+            not isinstance(payload.get("violations"), dict):
+        raise ValueError(
+            "{}: baseline must be an object with a 'violations' "
+            "map".format(path))
+    return {str(k): int(v) for k, v in payload["violations"].items()}
+
+
+def write_baseline(path, findings):
+    payload = {
+        "comment": "ds_lint baseline: accepted (reviewed) hot-path lint "
+                   "occurrences by key; regenerate with "
+                   "bin/ds_lint.py --write-baseline",
+        "violations": {k: len(v) for k, v in sorted(findings.items())},
+    }
+    with open(path, "w") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
+def diff_baseline(findings, baseline):
+    """-> (new, stale): findings above their baselined count, and
+    baseline keys no longer observed (candidates to prune)."""
+    new = []
+    for key, items in sorted(findings.items()):
+        allowed = baseline.get(key, 0)
+        if len(items) > allowed:
+            new.extend(items[allowed:])
+    stale = sorted(k for k in baseline if k not in findings)
+    return new, stale

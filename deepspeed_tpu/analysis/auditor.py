@@ -1,0 +1,263 @@
+"""Audit orchestration: ProgramSpecs -> AnalysisReport -> disposition.
+
+``audit_engine(engine, ...)`` is the one entry point both
+``DeepSpeedEngine.audit()`` and ``InferenceEngine.audit()`` (and the
+dryrun / CLI) call: it collects the engine's program specs
+(analysis/programs.py), runs every jaxpr-level rule
+(analysis/rules.py), optionally compiles each program for the HLO
+collective census + output-sharding drift (analysis/hlo.py), routes
+findings through the suppression file, and disposes per the
+``analysis`` config section — warn (default), RAISE under
+``analysis.strict``, and/or write the JSON report artifact
+(``bin/check_bench_schema.py`` validates its shape).
+"""
+import numpy as np
+
+import jax
+
+from ..utils.logging import logger
+from .findings import AnalysisReport, Finding, Suppressions
+from .hlo import collective_census, reconcile_wire
+from .ir import segment_summary
+from .rules import audit_program, sequence_findings
+
+
+class AuditFindingsError(RuntimeError):
+    """Raised under ``analysis.strict`` when unsuppressed findings
+    survive an audit."""
+
+    def __init__(self, report):
+        self.report = report
+        lines = ["shard-lint: {} unsuppressed finding(s) "
+                 "(analysis.strict=true):".format(len(report.findings))]
+        lines += ["  - [{}] {}".format(f.key, f.message)
+                  for f in report.findings]
+        super().__init__("\n".join(lines))
+
+
+def mesh_axis_labels(mesh):
+    """{label: [frozenset(device ids)]} for every nontrivial mesh axis,
+    plus the combined factored-data label when hpZ split the data axis."""
+    from ..parallel.topology import (DATA_REPLICA_AXIS, DATA_SHARD_AXIS,
+                                     mesh_axis_groups)
+    labels = {}
+    if mesh is None:
+        return labels
+    for ax in mesh.axis_names:
+        if int(mesh.shape[ax]) > 1:
+            labels[ax] = mesh_axis_groups(mesh, ax)
+    factored = tuple(ax for ax in (DATA_REPLICA_AXIS, DATA_SHARD_AXIS)
+                     if int(dict(mesh.shape).get(ax, 1)) > 1)
+    if len(factored) > 1:
+        labels["+".join(factored)] = mesh_axis_groups(mesh, factored)
+    return labels
+
+
+def data_axis_labels(mesh):
+    """The label subset that carries ZeRO (data-axis) wire traffic."""
+    from ..parallel.topology import (DATA_AXIS, DATA_REPLICA_AXIS,
+                                     DATA_SHARD_AXIS)
+    if mesh is None:
+        return set()
+    shape = dict(mesh.shape)
+    out = {ax for ax in (DATA_AXIS, DATA_REPLICA_AXIS, DATA_SHARD_AXIS)
+           if int(shape.get(ax, 1)) > 1}
+    factored = tuple(ax for ax in (DATA_REPLICA_AXIS, DATA_SHARD_AXIS)
+                     if int(shape.get(ax, 1)) > 1)
+    if len(factored) > 1:
+        out.add("+".join(factored))
+    return out
+
+
+def _output_drift_findings(spec, fn, compiled):
+    """Compiled output shardings vs. the plan: every output leaf the
+    spec expects data-sharded must not come back fully replicated."""
+    expects = spec.meta.get("out_expect") or ()
+    if not expects:
+        return []
+    try:
+        out_shardings = compiled.output_shardings
+        out_struct = jax.eval_shape(fn, *spec.args)
+    except Exception as err:  # noqa: BLE001 - census is best-effort
+        logger.info("shard-lint: output shardings unavailable for %r "
+                    "(%s)", spec.name, err)
+        return []
+    # join by PATH, never by zip: the two trees flatten differently
+    # around None leaves (offload state carries "master": None), and a
+    # positional pairing would silently shift every entry after one
+    from .rules import _kp_str, _spec_mentions
+    flat_sh, _ = jax.tree_util.tree_flatten_with_path(
+        out_shardings, is_leaf=lambda x: hasattr(x, "spec") or x is None)
+    flat_st, _ = jax.tree_util.tree_flatten_with_path(
+        out_struct, is_leaf=lambda x: x is None or hasattr(x, "shape"))
+    shardings_by_path = {_kp_str(kp): sh for kp, sh in flat_sh}
+    by_path = {}
+    for kp, st in flat_st:
+        path = _kp_str(kp)
+        if st is not None and path in shardings_by_path:
+            by_path[path] = (shardings_by_path[path], st)
+    findings = []
+    for path, axes in expects:
+        ent = by_path.get(path)
+        if ent is None:
+            continue
+        sh, st = ent
+        nbytes = int(np.prod(st.shape, dtype=np.int64) *
+                     np.dtype(st.dtype).itemsize) if st.shape else 0
+        if sh is None or _spec_mentions(sh, set(axes)):
+            continue
+        findings.append(Finding(
+            rule="sharding_drift", check="output_sharding_drift",
+            program=spec.name,
+            message="program {!r} output {} ({:.1f} MB) compiled back "
+                    "REPLICATED but the ZeroShardingPlan shards it over "
+                    "{} — the step un-shards state the plan paid to "
+                    "partition (HBM grows every step)".format(
+                        spec.name, path, nbytes / 2 ** 20, list(axes)),
+            key="output_sharding_drift:{}:{}".format(spec.name, path),
+            details={"path": path, "axes": list(axes),
+                     "nbytes": nbytes}))
+    return findings
+
+
+def audit_programs(specs, config, job="audit", suppressions=None,
+                   sequence=(), hlo=False, wire_est=None, mesh=None,
+                   report_path=None):
+    """Run the full rule set over ``specs`` and assemble the report.
+
+    ``hlo=True`` additionally compiles each spec whose meta carries a
+    ``wire_multiplier`` or ``out_expect`` and runs the collective
+    census / output-drift checks; the summed census reconciles against
+    ``wire_est`` when given.
+    """
+    report = AnalysisReport(job=job)
+    if isinstance(suppressions, str):
+        suppressions = Suppressions.load(suppressions)
+    axis_labels = mesh_axis_labels(mesh) if hlo else {}
+    data_labels = data_axis_labels(mesh)
+    census_list = []
+    for spec in specs:
+        closed, walk_result, findings = audit_program(spec, config)
+        report.extend(findings, suppressions)
+        meta = {"family": spec.family,
+                "donate_argnums": list(spec.donate_argnums)}
+        if walk_result is not None:
+            meta["segments"] = segment_summary(walk_result)
+        if hlo and closed is not None and (
+                spec.meta.get("wire_multiplier") or
+                spec.meta.get("out_expect")):
+            try:
+                fn = jax.jit(spec.build(),
+                             donate_argnums=spec.donate_argnums)
+                compiled = fn.lower(*spec.args).compile()
+            except Exception as err:  # noqa: BLE001 - report, don't die
+                report.add(Finding(
+                    rule="sharding_drift", check="audit_error",
+                    program=spec.name, severity="error",
+                    message="program {!r} could not be compiled for the "
+                            "HLO census: {}".format(spec.name, err),
+                    key="audit_error:hlo:{}".format(spec.name)),
+                    suppressions)
+            else:
+                report.extend(_output_drift_findings(spec, fn, compiled),
+                              suppressions)
+                mult = int(spec.meta.get("wire_multiplier") or 0)
+                if mult > 0:
+                    census = collective_census(
+                        compiled.as_text(), axis_groups=axis_labels,
+                        min_bytes=getattr(config, "census_min_bytes",
+                                          1024))
+                    for op in census["ops"]:
+                        op["wire_bytes"] *= mult
+                    census["total_bytes"] *= mult
+                    for slot in census["by_axis"].values():
+                        slot["wire_bytes"] *= mult
+                    meta["collective_census"] = {
+                        "total_bytes": census["total_bytes"],
+                        "by_axis": census["by_axis"],
+                    }
+                    census_list.append(census)
+        report.add_program(spec.name, **meta)
+    if sequence:
+        report.extend(sequence_findings(sequence), suppressions)
+    if hlo and census_list and wire_est is not None:
+        sharded_grads = any(
+            getattr(s.plan, "stage", 0) >= 2 for s in specs
+            if s.plan is not None)
+        payload, findings = reconcile_wire(
+            census_list, wire_est, data_labels,
+            program=job,
+            min_bytes=getattr(config, "census_min_bytes", 1024),
+            normalize_allreduce=sharded_grads and
+            jax.default_backend() != "tpu")
+        report.census = payload
+        report.extend(findings, suppressions)
+    if suppressions is not None:
+        # a suppression whose finding no longer exists is a latent mask
+        # for a future regression with the same key — surface it loudly
+        # (it lands in the report as stale_suppressions, non-failing)
+        report.stale_suppressions = suppressions.stale()
+        for key in report.stale_suppressions:
+            logger.warning(
+                "shard-lint: suppression %r matched nothing this audit "
+                "— prune it from %s", key,
+                suppressions.path or "the suppression list")
+    if report_path:
+        report.write(report_path)
+    return report
+
+
+def dispose(report, config, raise_on_findings=None):
+    """Warn each unsuppressed finding; raise under analysis.strict."""
+    for f in report.findings:
+        logger.warning("shard-lint: %s", f.message)
+    strict = raise_on_findings if raise_on_findings is not None \
+        else getattr(config, "strict", False)
+    if strict and report.findings:
+        raise AuditFindingsError(report)
+    return report
+
+
+def audit_engine(engine, batch=None, hlo=None, report_path=None,
+                 strict=None):
+    """Ahead-of-time shard-lint over one engine's resolved step
+    programs. ``engine`` is a DeepSpeedEngine (micro/fused/offload/
+    streamed/pipeline paths) or an InferenceEngine
+    (prefill/decode/spec-verify). Returns the
+    :class:`AnalysisReport`; raises :class:`AuditFindingsError` when
+    unsuppressed findings survive and strict is on (argument overrides
+    the config).
+
+    ``batch``: a sample micro-batch (arrays or ShapeDtypeStructs) for
+    training engines that have not seen a step yet; ``hlo`` overrides
+    ``analysis.hlo`` (compile + collective census + output drift).
+    """
+    from . import programs as collectors
+    if hasattr(engine, "prefill_buckets"):           # inference engine
+        config = engine.analysis_config
+        specs = collectors.collect_inference_programs(engine)
+        sequence = collectors.inference_step_sequence(engine)
+        mesh = engine.mesh
+        wire_est = None
+        job = "serve"
+    else:
+        config = engine._config.analysis_config
+        specs = collectors.collect_train_programs(engine, batch=batch)
+        sequence = collectors.train_step_sequence(engine)
+        mesh = engine.mesh
+        wire_est = None
+        try:
+            from ..runtime.comm.wire import estimate_engine_comm_bytes
+            if engine.zero_plan.dp_size > 1 and \
+                    engine.state.get("params") is not None:
+                wire_est = estimate_engine_comm_bytes(engine)
+        except Exception as err:  # noqa: BLE001 - estimator optional
+            logger.info("shard-lint: wire estimate unavailable (%s)", err)
+        job = "train"
+    use_hlo = bool(config.hlo if hlo is None else hlo)
+    report = audit_programs(
+        specs, config, job=job,
+        suppressions=config.suppressions, sequence=sequence,
+        hlo=use_hlo, wire_est=wire_est, mesh=mesh,
+        report_path=report_path or config.report_path)
+    return dispose(report, config, raise_on_findings=strict)

@@ -1,0 +1,89 @@
+"""``analysis`` ds_config section.
+
+Validated with the telemetry section's no-silent-no-ops policy: unknown
+keys warn, and raise under ``analysis.strict``. Shape::
+
+    "analysis": {
+      "strict": false,            // unsuppressed findings RAISE after an audit
+      "report_path": null,        // write the JSON analysis report here
+      "suppressions": null,       // path to the baseline-suppression file
+      "hlo": false,               // audits also compile + census the HLO
+      "donation_min_bytes": 1048576,   // donation findings below this stay quiet
+      "census_min_bytes": 1024,        // collectives below this are noise
+      "fp32_allowlist": []        // GEMM prims allowed to run fp32 off bf16
+    }
+
+The sharding/recompile thresholds are NOT duplicated here: the auditor
+reads ``telemetry.programs.replicated_leaf_bytes`` and
+``telemetry.programs.recompile_storm_threshold`` — the runtime compile
+observatory and the ahead-of-time auditor share one rule
+implementation (analysis/rules.py) and one threshold config, so the
+two paths cannot drift.
+"""
+from .rules import (CENSUS_MIN_BYTES_DEFAULT, DONATION_MIN_BYTES_DEFAULT,
+                    RECOMPILE_STORM_THRESHOLD_DEFAULT,
+                    REPLICATED_LEAF_BYTES_DEFAULT)
+
+ANALYSIS = "analysis"
+
+KNOWN_ANALYSIS_KEYS = {
+    "strict", "report_path", "suppressions", "hlo",
+    "donation_min_bytes", "census_min_bytes", "fp32_allowlist",
+}
+
+
+class DeepSpeedAnalysisConfig(object):
+    """Typed view of the ``analysis`` section of a ds_config dict.
+
+    ``telemetry_config`` (a ``DeepSpeedTelemetryConfig``) supplies the
+    shared observatory thresholds when given; otherwise the shared
+    defaults from ``analysis/rules.py`` apply."""
+
+    def __init__(self, param_dict, telemetry_config=None):
+        d = (param_dict or {}).get(ANALYSIS, {})
+        if d is None:
+            d = {}
+        if not isinstance(d, dict):
+            raise ValueError("analysis section must be a dict, got "
+                             "{}".format(type(d).__name__))
+        self.strict = bool(d.get("strict", False))
+        unknown = sorted(k for k in d if k not in KNOWN_ANALYSIS_KEYS)
+        if unknown:
+            from ..telemetry.config import warn_or_raise_noop
+            warn_or_raise_noop(
+                "analysis.{} has NO effect: unknown key(s) in the "
+                "'analysis' section (accepted: {})".format(
+                    ", ".join(unknown), sorted(KNOWN_ANALYSIS_KEYS)),
+                self.strict, flag="analysis.strict")
+
+        self.report_path = d.get("report_path") or None
+        self.suppressions = d.get("suppressions") or None
+        self.hlo = bool(d.get("hlo", False))
+        self.donation_min_bytes = self._pos_int(
+            d, "donation_min_bytes", DONATION_MIN_BYTES_DEFAULT)
+        self.census_min_bytes = self._pos_int(
+            d, "census_min_bytes", CENSUS_MIN_BYTES_DEFAULT)
+        allow = d.get("fp32_allowlist", [])
+        if not isinstance(allow, (list, tuple)) or \
+                not all(isinstance(x, str) for x in allow):
+            raise ValueError(
+                "analysis.fp32_allowlist must be a list of primitive "
+                "names, got {!r}".format(allow))
+        self.fp32_allowlist = tuple(allow)
+
+        # shared observatory thresholds (one config — see module doc)
+        self.storm_threshold = getattr(
+            telemetry_config, "programs_storm_threshold",
+            RECOMPILE_STORM_THRESHOLD_DEFAULT)
+        self.replicated_leaf_bytes = getattr(
+            telemetry_config, "programs_replicated_leaf_bytes",
+            REPLICATED_LEAF_BYTES_DEFAULT)
+
+    @staticmethod
+    def _pos_int(d, key, default):
+        val = d.get(key, default)
+        if isinstance(val, bool) or not isinstance(val, int) or val < 0:
+            raise ValueError(
+                "analysis.{} must be an int >= 0, got {!r}".format(
+                    key, val))
+        return val

@@ -1,0 +1,273 @@
+"""Findings, reports and suppressions for the shard-lint auditor.
+
+A :class:`Finding` is one structured defect the static auditor (or the
+repo AST linter) surfaced: which rule fired, on which program (or file),
+what the hazard is, and a stable ``key`` the suppression file matches
+against. An :class:`AnalysisReport` is the JSON-able artifact one audit
+run produces — ``bin/check_bench_schema.py`` validates its shape (a
+stdlib re-statement; tests/unit/test_analysis.py pins the key tables
+equal so they cannot drift).
+
+Suppression file (committed next to the config that owns the findings)::
+
+    {
+      "version": 1,
+      "suppressions": [
+        {"key": "replicated_leaf:prefill/*", "reason": "persistent ..."},
+        {"key": "DSL003:deepspeed_tpu/runtime/engine.py::*", "reason": "."}
+      ]
+    }
+
+``key`` patterns are ``fnmatch`` globs against ``Finding.key``
+(``<check>:<program>[:<detail>]`` for program findings,
+``<rule>:<path>::<qualname>`` for repo-lint findings). Every
+suppression must carry a non-empty ``reason`` — a silent suppression is
+the config smell this subsystem exists to kill.
+"""
+import dataclasses
+import fnmatch
+import json
+import os
+
+# the report artifact's required keys; check_bench_schema.py keeps a
+# stdlib copy (ANALYSIS_REPORT_KEYS there) pinned equal under test
+ANALYSIS_REPORT_KEYS = (
+    "kind", "version", "job", "programs", "findings", "suppressed",
+    "summary",
+)
+ANALYSIS_REPORT_KIND = "analysis_report"
+
+# required keys of one serialized finding (also mirrored in
+# check_bench_schema.py)
+FINDING_KEYS = ("rule", "check", "program", "severity", "message", "key")
+
+SEVERITIES = ("error", "warn", "info")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One structured defect.
+
+    ``rule``     the rule class ("sharding_drift", "donation",
+                 "dtype_promotion", "host_sync", or a DSL### repo-lint
+                 code);
+    ``check``    the specific check inside the class (e.g.
+                 "replicated_leaf", "donation_miss");
+    ``program``  the audited program's name (or the repo-relative file
+                 path for repo-lint findings);
+    ``key``      the stable suppression key;
+    ``details``  machine-readable extras (byte counts, leaf paths, line
+                 numbers) for the JSON report.
+    """
+    rule: str
+    check: str
+    program: str
+    message: str
+    severity: str = "warn"
+    key: str = ""
+    details: dict = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        if not self.key:
+            self.key = "{}:{}".format(self.check, self.program)
+        assert self.severity in SEVERITIES, self.severity
+
+    def to_dict(self):
+        out = {
+            "rule": self.rule,
+            "check": self.check,
+            "program": self.program,
+            "severity": self.severity,
+            "message": self.message,
+            "key": self.key,
+        }
+        if self.details:
+            out["details"] = _jsonable(self.details)
+        return out
+
+
+def _jsonable(val):
+    """Degrade arbitrary detail values to JSON-safe types (the flight
+    recorder's discipline: a report must never fail to serialize)."""
+    if isinstance(val, dict):
+        return {str(k): _jsonable(v) for k, v in val.items()}
+    if isinstance(val, (list, tuple, set, frozenset)):
+        return [_jsonable(v) for v in val]
+    if isinstance(val, (str, bool)) or val is None:
+        return val
+    if isinstance(val, (int, float)):
+        return val
+    try:
+        return int(val)
+    except (TypeError, ValueError):
+        pass
+    try:
+        return float(val)
+    except (TypeError, ValueError):
+        return repr(val)
+
+
+class Suppressions:
+    """Parsed suppression file. ``match(finding)`` returns the matching
+    entry (and counts the hit) or None."""
+
+    def __init__(self, entries=(), path=None):
+        self.path = path
+        self.entries = []
+        for ent in entries:
+            if not isinstance(ent, dict) or not ent.get("key") or \
+                    not str(ent.get("reason", "")).strip():
+                raise ValueError(
+                    "suppression entries need a 'key' glob and a non-empty "
+                    "'reason': {!r}".format(ent))
+            self.entries.append({"key": str(ent["key"]),
+                                 "reason": str(ent["reason"]), "hits": 0})
+
+    @classmethod
+    def load(cls, path):
+        with open(path) as fh:
+            payload = json.load(fh)
+        if not isinstance(payload, dict) or \
+                not isinstance(payload.get("suppressions"), list):
+            raise ValueError(
+                "{}: suppression file must be an object with a "
+                "'suppressions' list".format(path))
+        return cls(payload["suppressions"], path=path)
+
+    def match(self, finding):
+        for ent in self.entries:
+            if fnmatch.fnmatchcase(finding.key, ent["key"]):
+                ent["hits"] += 1
+                return ent
+        return None
+
+    def stale(self):
+        """Entries that matched nothing this run (candidates to delete)."""
+        return [ent["key"] for ent in self.entries if not ent["hits"]]
+
+
+class AnalysisReport:
+    """One audit run's result: the programs audited, the findings that
+    survived suppression, and what was suppressed (with reasons)."""
+
+    def __init__(self, job="audit"):
+        self.job = job
+        self.programs = {}          # name -> {family, ...meta}
+        self.findings = []          # [Finding]
+        self.suppressed = []        # [(Finding, reason)]
+        self.census = None          # optional wire-reconciliation payload
+        self.stale_suppressions = []  # suppression keys that matched 0
+
+    def add_program(self, name, **meta):
+        self.programs[name] = _jsonable(meta)
+
+    def add(self, finding, suppressions=None):
+        """Route one finding through the suppression file."""
+        if finding is None:
+            return None
+        ent = suppressions.match(finding) if suppressions is not None \
+            else None
+        if ent is not None:
+            self.suppressed.append((finding, ent["reason"]))
+        else:
+            self.findings.append(finding)
+        return finding
+
+    def extend(self, findings, suppressions=None):
+        for f in findings:
+            self.add(f, suppressions)
+
+    def errors(self):
+        return [f for f in self.findings if f.severity == "error"]
+
+    def by_check(self, check):
+        return [f for f in self.findings if f.check == check]
+
+    def summary(self):
+        counts = {}
+        for f in self.findings:
+            counts[f.check] = counts.get(f.check, 0) + 1
+        return {
+            "programs_audited": len(self.programs),
+            "findings": len(self.findings),
+            "suppressed": len(self.suppressed),
+            "by_check": counts,
+        }
+
+    def to_dict(self):
+        out = {
+            "kind": ANALYSIS_REPORT_KIND,
+            "version": 1,
+            "job": self.job,
+            "programs": dict(self.programs),
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": [dict(f.to_dict(), suppressed_reason=reason)
+                           for f, reason in self.suppressed],
+            "summary": self.summary(),
+        }
+        if self.census is not None:
+            out["census"] = _jsonable(self.census)
+        if self.stale_suppressions:
+            out["stale_suppressions"] = list(self.stale_suppressions)
+        return out
+
+    def write(self, path):
+        """Atomic JSON dump (tmp + rename, the checkpoint discipline)."""
+        payload = self.to_dict()
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(payload, fh, indent=2, sort_keys=True)
+        os.replace(tmp, path)
+        return path
+
+
+def validate_analysis_report(payload):
+    """-> list of problems with one serialized analysis report (the
+    writer-side source of truth; bin/check_bench_schema.py carries the
+    stdlib twin for CI artifact checking)."""
+    problems = []
+    if not isinstance(payload, dict):
+        return ["report is not a dict"]
+    for key in ANALYSIS_REPORT_KEYS:
+        if key not in payload:
+            problems.append("missing key {!r}".format(key))
+    if problems:
+        return problems
+    if payload.get("kind") != ANALYSIS_REPORT_KIND:
+        problems.append("kind is not {!r}".format(ANALYSIS_REPORT_KIND))
+    if not isinstance(payload.get("programs"), dict):
+        problems.append("programs is not a dict")
+    for section in ("findings", "suppressed"):
+        entries = payload.get(section)
+        if not isinstance(entries, list):
+            problems.append("{} is not a list".format(section))
+            continue
+        for i, ent in enumerate(entries):
+            if not isinstance(ent, dict):
+                problems.append("{}[{}] is not an object".format(section, i))
+                break
+            for key in FINDING_KEYS:
+                if not isinstance(ent.get(key), str):
+                    problems.append(
+                        "{}[{}].{} is not a string".format(section, i, key))
+            if ent.get("severity") not in SEVERITIES:
+                problems.append("{}[{}] has unknown severity {!r}".format(
+                    section, i, ent.get("severity")))
+            if section == "suppressed" and \
+                    not ent.get("suppressed_reason"):
+                problems.append(
+                    "suppressed[{}] lacks a suppressed_reason".format(i))
+            if problems:
+                break
+    summary = payload.get("summary")
+    if not isinstance(summary, dict):
+        problems.append("summary is not a dict")
+    else:
+        for key in ("programs_audited", "findings", "suppressed"):
+            val = summary.get(key)
+            if not isinstance(val, int) or isinstance(val, bool) or val < 0:
+                problems.append(
+                    "summary.{} is not an int >= 0".format(key))
+    return problems

@@ -1,0 +1,326 @@
+"""Jaxpr walker: the flat op-record IR every shard-lint rule reads.
+
+``walk(closed_jaxpr, taint_in)`` recursively flattens a (possibly
+deeply nested) jaxpr — through pjit/scan/while/cond/custom_vjp/remat/
+shard_map bodies — into a list of :class:`EqnInfo` records, each
+carrying:
+
+  * the primitive name and the eqn itself (params stay reachable);
+  * ``path``: the nesting chain ("scan/custom_vjp_call/…") for
+    diagnostics;
+  * ``trips``: the static execution multiplier (a ``scan`` body's eqns
+    run ``length`` times; ``None`` under a ``while`` whose trip count
+    is dynamic) — byte census math multiplies by it;
+  * ``tainted``: whether any operand is data-derived from a tainted
+    program input (the dtype-promotion rule seeds the taint at the
+    bf16 param leaves).
+
+``classify(prim_name)`` buckets a primitive into the small segment
+vocabulary (compute / collective / host / transfer / sharding) — the
+same vocabulary ROADMAP item 5's schedulable segment graph lowers onto;
+this walker is deliberately the first concrete piece of that IR.
+"""
+import dataclasses
+
+import numpy as np
+
+import jax
+
+# ---------------------------------------------------------------- vocab
+COLLECTIVE_PRIMS = frozenset({
+    "ppermute", "pshuffle", "psum", "psum_scatter", "pmax", "pmin",
+    "all_gather", "all_to_all", "pgather", "reduce_scatter",
+})
+HOST_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "debug_print",
+    "callback", "outside_call", "host_callback_call", "infeed", "outfeed",
+})
+TRANSFER_PRIMS = frozenset({"device_put", "copy"})
+SHARDING_PRIMS = frozenset({"sharding_constraint"})
+GEMM_PRIMS = frozenset({"dot_general", "conv_general_dilated"})
+CONVERT_PRIMS = frozenset({"convert_element_type"})
+
+SEGMENT_KINDS = ("compute", "collective", "host", "transfer", "sharding")
+
+# Primitives that carry a PARAM through unchanged-in-substance: casts,
+# layout moves, gathers/rings re-materializing a sharded weight. The
+# dtype-promotion rule's second taint channel ("this value IS a weight,
+# possibly cast") propagates only through these — a dot/add output is a
+# new activation, not a weight, which keeps intentional fp32 stability
+# islands (attention scores/softmax, loss, norms, Adam) naturally
+# exempt while a weight upcast into a GEMM still lights up.
+PARAM_PASSTHROUGH_PRIMS = frozenset({
+    "convert_element_type", "transpose", "reshape", "broadcast_in_dim",
+    "squeeze", "expand_dims", "slice", "dynamic_slice", "concatenate",
+    "rev", "copy", "sharding_constraint", "ppermute", "all_gather",
+    "gather", "mul", "add_any",
+    # qwZ codec ops re-materialize the SAME weight from int8+scales
+    "bitcast_convert_type",
+})
+
+
+def classify(prim_name):
+    """Primitive -> segment kind (the schedulable-segment vocabulary)."""
+    if prim_name in COLLECTIVE_PRIMS:
+        return "collective"
+    if prim_name in HOST_PRIMS:
+        return "host"
+    if prim_name in TRANSFER_PRIMS:
+        return "transfer"
+    if prim_name in SHARDING_PRIMS:
+        return "sharding"
+    return "compute"
+
+
+def dtype_itemsize(dtype):
+    """Itemsize that tolerates jax extended dtypes (key<fry> etc.)."""
+    try:
+        return int(np.dtype(dtype).itemsize)
+    except TypeError:
+        return int(getattr(dtype, "itemsize", 4))
+
+
+@dataclasses.dataclass
+class EqnInfo:
+    prim: str
+    eqn: object
+    path: str
+    trips: object          # int multiplier, or None when dynamic
+    tainted: bool
+    kind: str
+    # per-operand flags of the second (param-passthrough) taint
+    # channel, positionally aligned with eqn.invars
+    in_taint2: tuple = ()
+
+    def out_nbytes(self):
+        total = 0
+        for var in self.eqn.outvars:
+            aval = getattr(var, "aval", None)
+            if aval is not None and hasattr(aval, "shape") and \
+                    hasattr(aval, "dtype"):
+                numel = int(np.prod(aval.shape, dtype=np.int64)) \
+                    if aval.shape else 1
+                total += numel * dtype_itemsize(aval.dtype)
+        return total
+
+
+class WalkResult:
+    def __init__(self):
+        self.eqns = []          # [EqnInfo]
+        self.out_taint = []     # [bool] aligned with jaxpr.outvars
+        self.out_taint2 = []    # [bool] param-passthrough channel
+
+    def by_kind(self, kind):
+        return [e for e in self.eqns if e.kind == kind]
+
+    def by_prim(self, *prims):
+        prims = frozenset(prims)
+        return [e for e in self.eqns if e.prim in prims]
+
+
+def _inner_jaxprs(eqn):
+    """-> [(closed_or_open_jaxpr, invar_offset)] for one eqn's bodies.
+
+    ``invar_offset``: index into ``eqn.invars`` where the body's invars
+    start aligning (tail alignment — custom_* calls may carry leading
+    consts/tangent args the body does not see)."""
+    params = eqn.params
+    name = eqn.primitive.name
+    out = []
+    if name in ("cond", "switch"):
+        for br in params.get("branches", ()):
+            out.append((br, 1))                       # invars[0] = index
+        return out
+    if name == "while":
+        # cond sees (cond_consts, carry); body sees (body_consts,
+        # carry) — walk() handles the split itself (_while_taints);
+        # direct callers get the bodies tail-aligned
+        out.append((params["cond_jaxpr"], None))
+        out.append((params["body_jaxpr"], None))
+        return out
+    for key in ("jaxpr", "call_jaxpr"):
+        if key in params and params[key] is not None:
+            out.append((params[key], None))
+    return out
+
+
+def _jaxpr_of(obj):
+    """ClosedJaxpr | Jaxpr -> Jaxpr."""
+    return getattr(obj, "jaxpr", obj)
+
+
+def _map_taint_into(eqn, inner, taint_of):
+    """Taint flags for ``inner``'s invars, from the eqn's operand taint.
+
+    Tail-aligned: the last ``len(inner.invars)`` eqn operands map 1:1;
+    shorter bodies (consts baked into the ClosedJaxpr) still line up
+    because jax orders call operands (consts..., args...). When the
+    shapes make no sense, degrade conservatively: every inner invar
+    inherits "any operand tainted"."""
+    jx = _jaxpr_of(inner)
+    n_in = len(jx.invars)
+    op_taint = [taint_of(v) for v in eqn.invars]
+    if n_in <= len(op_taint):
+        return op_taint[len(op_taint) - n_in:]
+    any_t = any(op_taint)
+    return [any_t] * n_in
+
+
+def _while_taints(eqn, taint_of):
+    params = eqn.params
+    cn = int(params.get("cond_nconsts", 0))
+    bn = int(params.get("body_nconsts", 0))
+    op = [taint_of(v) for v in eqn.invars]
+    cond_in = op[:cn] + op[cn + bn:]
+    body_in = op[cn:cn + bn] + op[cn + bn:]
+    return cond_in, body_in
+
+
+def walk(closed_jaxpr, taint_in=None, taint2_in=None, _path="",
+         _trips=1, _result=None):
+    """Flatten ``closed_jaxpr`` into a :class:`WalkResult`.
+
+    ``taint_in``: bool per invar (default: none tainted) — the DEEP
+    data-derivation channel (any op output of a tainted input is
+    tainted). ``taint2_in``: the PARAM-PASSTHROUGH channel — only
+    :data:`PARAM_PASSTHROUGH_PRIMS` propagate it, so a flag means "this
+    value is still the weight itself (possibly cast/moved/gathered)".
+    ``trips`` multiplies through ``scan`` lengths and becomes None
+    inside ``while`` bodies (dynamic trip count).
+    """
+    result = _result if _result is not None else WalkResult()
+    jaxpr = _jaxpr_of(closed_jaxpr)
+    n_in = len(jaxpr.invars)
+    taint_in = list(taint_in) if taint_in is not None else [False] * n_in
+    taint2_in = list(taint2_in) if taint2_in is not None \
+        else [False] * n_in
+
+    tainted = {}                    # Var -> bool
+    tainted2 = {}
+    for var, t, t2 in zip(jaxpr.invars, taint_in, taint2_in):
+        tainted[var] = bool(t)
+        tainted2[var] = bool(t2)
+
+    def _of(table, var):
+        try:
+            return table.get(var, False)
+        except TypeError:           # jax.core.Literal is unhashable
+            return False
+
+    def taint_of(var):
+        return _of(tainted, var)
+
+    def taint2_of(var):
+        return _of(tainted2, var)
+
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        in_taint = any(taint_of(v) for v in eqn.invars)
+        in_taint2 = tuple(taint2_of(v) for v in eqn.invars)
+        trips = _trips
+        if name == "scan":
+            length = eqn.params.get("length")
+            if _trips is not None and isinstance(length, int):
+                trips = _trips * length
+            else:
+                trips = None
+        elif name == "while":
+            trips = None
+
+        inner_outs = []             # [(out_taint, out_taint2)]
+        if name == "while":
+            cond_in, body_in = _while_taints(eqn, taint_of)
+            _, body_in2 = _while_taints(eqn, taint2_of)
+            params = eqn.params
+            bn = int(params.get("body_nconsts", 0))
+            # one extra pass feeds carry-out taint back into carry-in
+            body_taint = list(body_in)
+            for _ in range(2):
+                sub = walk(params["body_jaxpr"], body_taint, body_in2,
+                           _path=_path + name + "/", _trips=None,
+                           _result=None)
+                carry_out = sub.out_taint
+                new_carry_in = [a or b for a, b in
+                                zip(body_taint[bn:], carry_out)]
+                if new_carry_in == body_taint[bn:]:
+                    break
+                body_taint = body_taint[:bn] + new_carry_in
+            # record the final body (and the cond) into the result
+            sub = walk(params["body_jaxpr"], body_taint, body_in2,
+                       _path=_path + name + "/", _trips=None,
+                       _result=result)
+            walk(params["cond_jaxpr"], cond_in, None,
+                 _path=_path + name + "/", _trips=None, _result=result)
+            inner_outs.append((sub.out_taint, sub.out_taint2))
+        else:
+            for inner, offset in _inner_jaxprs(eqn):
+                if offset is None:
+                    inner_taint = _map_taint_into(eqn, inner, taint_of)
+                    inner_taint2 = _map_taint_into(eqn, inner, taint2_of)
+                else:
+                    jx = _jaxpr_of(inner)
+                    ops = [taint_of(v) for v in eqn.invars[offset:]]
+                    ops2 = [taint2_of(v) for v in eqn.invars[offset:]]
+                    inner_taint = (ops + [False] * len(jx.invars)
+                                   )[:len(jx.invars)]
+                    inner_taint2 = (ops2 + [False] * len(jx.invars)
+                                    )[:len(jx.invars)]
+                sub = walk(inner, inner_taint, inner_taint2,
+                           _path=_path + name + "/", _trips=trips,
+                           _result=result)
+                inner_outs.append((sub.out_taint, sub.out_taint2))
+
+        # output taint: prefer positional mapping from an inner body
+        out_taint = None
+        out_taint2 = None
+        for sub_out, sub_out2 in inner_outs:
+            if len(sub_out) == len(eqn.outvars):
+                out_taint = sub_out if out_taint is None else \
+                    [a or b for a, b in zip(out_taint, sub_out)]
+                out_taint2 = sub_out2 if out_taint2 is None else \
+                    [a or b for a, b in zip(out_taint2, sub_out2)]
+        if out_taint is None:
+            any_inner = any(any(o) for o, _ in inner_outs)
+            out_taint = [in_taint or any_inner] * len(eqn.outvars)
+        if out_taint2 is None:
+            # channel 2 only flows through passthrough prims
+            passthrough = name in PARAM_PASSTHROUGH_PRIMS and \
+                any(in_taint2)
+            out_taint2 = [passthrough] * len(eqn.outvars)
+        for var, t, t2 in zip(eqn.outvars, out_taint, out_taint2):
+            tainted[var] = bool(t) or _of(tainted, var)
+            tainted2[var] = bool(t2) or _of(tainted2, var)
+
+        result.eqns.append(EqnInfo(
+            prim=name, eqn=eqn, path=_path + name, trips=trips,
+            tainted=in_taint, kind=classify(name),
+            in_taint2=in_taint2))
+
+    result.out_taint = [taint_of(v) for v in jaxpr.outvars]
+    result.out_taint2 = [taint2_of(v) for v in jaxpr.outvars]
+    return result
+
+
+def make_walk(fn, args, taint_in=None):
+    """``jax.make_jaxpr`` + :func:`walk` in one step. ``args`` may hold
+    ``ShapeDtypeStruct`` leaves — nothing executes."""
+    closed = jax.make_jaxpr(fn)(*args)
+    return closed, walk(closed, taint_in=taint_in)
+
+
+def segment_summary(walk_result):
+    """Aggregate the walked eqns into the segment vocabulary — the
+    embryonic schedulable-segment view (ROADMAP item 5): per-kind op
+    counts and output bytes (static trips multiplied in; dynamic-trip
+    ops counted once and flagged)."""
+    out = {kind: {"ops": 0, "out_bytes": 0} for kind in SEGMENT_KINDS}
+    dynamic = 0
+    for info in walk_result.eqns:
+        slot = out[info.kind]
+        trips = info.trips if info.trips is not None else 1
+        if info.trips is None:
+            dynamic += 1
+        slot["ops"] += trips
+        slot["out_bytes"] += trips * info.out_nbytes()
+    out["dynamic_trip_ops"] = dynamic
+    return out

@@ -47,22 +47,25 @@ _UNSET = object()    # "argument not given" (None means "no EOS token")
 
 
 def _parse_configs(config, mesh=None):
-    """-> (inference_config, telemetry_config-or-None). One ds_config
-    drives both training and serving; the serving engine reads its own
-    section plus the shared telemetry section."""
+    """-> (inference_config, telemetry_config-or-None,
+    analysis_config-or-None). One ds_config drives both training and
+    serving; the serving engine reads its own section plus the shared
+    telemetry and analysis sections."""
     if isinstance(config, DeepSpeedInferenceConfig):
-        return config, None
+        return config, None, None
     from ..runtime.config import DeepSpeedConfig
     if isinstance(config, DeepSpeedConfig):
-        return config.inference_config, config.telemetry_config
+        return (config.inference_config, config.telemetry_config,
+                config.analysis_config)
     if config is None:
-        return DeepSpeedInferenceConfig({}), None
+        return DeepSpeedInferenceConfig({}), None, None
     if isinstance(config, dict):
         full = DeepSpeedConfig(None, param_dict=config, mesh=mesh,
                                inference_only=True)
     else:
         full = DeepSpeedConfig(config, mesh=mesh, inference_only=True)
-    return full.inference_config, full.telemetry_config
+    return (full.inference_config, full.telemetry_config,
+            full.analysis_config)
 
 
 class InferenceEngine:
@@ -80,8 +83,12 @@ class InferenceEngine:
         assert model_config is not None and hasattr(model_config, "n_heads"), \
             "init_inference needs a model with a GPT2Config at .config " \
             "(e.g. models.gpt2.make_gpt2_model)"
-        self.inference_config, telemetry_config = _parse_configs(
-            config, mesh=mesh)
+        self.inference_config, telemetry_config, analysis_config = \
+            _parse_configs(config, mesh=mesh)
+        if analysis_config is None:
+            from ..analysis.config import DeepSpeedAnalysisConfig
+            analysis_config = DeepSpeedAnalysisConfig({})
+        self.analysis_config = analysis_config
         # dtype override is engine-local state: the config object may be
         # shared with other engines (or the training engine) and must not
         # be mutated
@@ -256,6 +263,19 @@ class InferenceEngine:
                 "the telemetry config)")
             return None
         return self.telemetry.recorder.dump(reason)
+
+    def audit(self, hlo=None, report_path=None, strict=None):
+        """Ahead-of-time shard-lint (docs/analysis.md) over the serving
+        programs — every prefill bucket, the fused decode and the
+        speculative verify pass — from their ShapeDtypeStructs: KV
+        donation audit, replicated-leaf/sharding drift, fp32 upcasts,
+        host callbacks, and the AOT recompile-storm bound on the bucket
+        list. ``init_inference(..., audit=True)`` runs this at engine
+        build. Findings warn (raise under ``analysis.strict``; the
+        ``strict`` argument overrides); returns the AnalysisReport."""
+        from ..analysis import audit_engine
+        return audit_engine(self, hlo=hlo, report_path=report_path,
+                            strict=strict)
 
     # ---------------------------------------------------------- placement
 

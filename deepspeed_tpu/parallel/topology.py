@@ -82,6 +82,31 @@ def shard_map_compat(fn, mesh, in_specs, out_specs, axis_names=None):
                       out_specs=out_specs, check_rep=False)
 
 
+def mesh_axis_groups(mesh, axes):
+    """Ground-truth device-id groups for a collective spanning ``axes``
+    (one axis name or a tuple): vary the named axes, fix every other —
+    each returned ``frozenset`` is one replica group a collective over
+    those axes addresses. The shard-lint HLO census
+    (``analysis/hlo.py``) matches XLA's ``replica_groups`` against
+    these to attribute each collective to its mesh axis."""
+    import numpy as np
+    if isinstance(axes, str):
+        axes = (axes,)
+    names = list(mesh.axis_names)
+    for ax in axes:
+        if ax not in names:
+            raise ValueError("mesh {} has no axis {!r}".format(
+                dict(mesh.shape), ax))
+    ids = np.vectorize(lambda d: d.id)(mesh.devices)
+    order = [i for i, n in enumerate(names) if n not in axes] + \
+        [names.index(ax) for ax in axes]
+    moved = ids.transpose(order)
+    group_elems = int(np.prod([mesh.shape[ax] for ax in axes],
+                              dtype=np.int64))
+    rows = moved.reshape(-1, group_elems)
+    return [frozenset(int(d) for d in row) for row in rows]
+
+
 def _prime_factors(N):
     """Prime factorization in ascending order (reference topology.py)."""
     if N <= 0:

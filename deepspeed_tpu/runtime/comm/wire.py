@@ -10,11 +10,23 @@ Ring-collective pricing (what GSPMD lowers to on a mesh axis of size g):
   an all-reduce is a reduce-scatter + all-gather: ``2 * payload * (g-1)/g``.
 
 Counted per optimizer step (gas = gradient-accumulation micro-steps):
-  * stage 3: each data-sharded param leaf is all-gathered twice per
-    micro-step (forward + backward re-gather) over its gather group —
-    the FULL data axis flat, only the ``data_shard`` sub-axis under hpZ;
-  * stage >= 2: each micro-step's gradients reduce-scatter over the full
-    data axis; stage 0-1 all-reduce instead;
+  * stage 3: each data-sharded param leaf is all-gathered
+    ``gathers_per_micro`` times per micro-step (default 2 — forward +
+    backward re-materialization; the shard-lint HLO census (PR 10,
+    analysis/hlo.py) confirmed XLA rematerializes the explicit ring
+    gathers for the backward rather than keeping the gathered weight
+    live) over its gather group — the FULL data axis flat, only the
+    ``data_shard`` sub-axis under hpZ. Tensor-parallel leaves move only
+    their model-axis SHARE per device (``numel / plan.tp_ways``) —
+    census ground truth the earlier estimate missed;
+  * stage >= 2: each micro-step's gradients reduce-scatter over the
+    full data axis; stage 0-1 all-reduce instead. The census also
+    ground-truthed the REDUCTION dtype: the wgrad matmuls accumulate in
+    fp32 and XLA reduces BEFORE the convert back to the grad dtype
+    lands, so the wire moves fp32 — except for leaves gathered through
+    an explicit custom-vjp ring (cm/qwZ), whose cotangent is
+    constrained at the compute dtype by the custom_vjp boundary
+    (``explicit_gather_grad_itemsize``);
   * stage 1-2: the updated params re-replicate once per step (the
     all-gather of updated partitions).
 
@@ -120,7 +132,8 @@ def _payload(numel, itemsize, quantized, scale_itemsize, block_size):
 
 def _price_tree(params, eligible_fn, stage, dp, gather_group, gas,
                 compute_itemsize, grad_itemsize, quantized_weights,
-                quantized_gradients, block_size):
+                quantized_gradients, block_size, gathers_per_micro=2,
+                explicit_gather_grad_itemsize=None, tp_ways_fn=None):
     """The one pricing body both entry points share.
 
     ``eligible_fn(path, shape, numel) -> bool``: is this leaf a stage-3
@@ -128,7 +141,13 @@ def _price_tree(params, eligible_fn, stage, dp, gather_group, gas,
     the shape-preserving codec (blocks tile the last dim — what
     ``qwz_gather`` actually ships); gradient reduces price the FLAT
     codec (``quantize_with_error_feedback`` uses ``block_size``-lane
-    flat blocks).
+    flat blocks). ``explicit_gather_grad_itemsize``: when set, eligible
+    stage-3 leaves' gradient reduces price THIS itemsize (the explicit
+    cm/qwZ ring cotangent stays in the compute dtype) while every other
+    leaf reduces at ``grad_itemsize``. ``tp_ways_fn(path, shape)``:
+    tensor-parallel split degree — per-device data-axis wire moves only
+    the leaf's model-axis share (census ground truth; eligibility still
+    judges the GLOBAL leaf).
     """
     from .quantize import _lastdim_block
     from ..zero.partition import _path_str
@@ -137,24 +156,30 @@ def _price_tree(params, eligible_fn, stage, dp, gather_group, gas,
     def leaf(path, p):
         shape = np.shape(p)
         numel = int(np.prod(shape)) if shape else 1
-        if stage >= 3 and eligible_fn(path, shape, numel):
+        wire_numel = numel
+        if tp_ways_fn is not None:
+            wire_numel = numel // max(int(tp_ways_fn(path, shape)), 1)
+        eligible = stage >= 3 and eligible_fn(path, shape, numel)
+        if eligible:
             wblk = _lastdim_block(shape[-1], block_size) if shape else 1
-            per_gather = _payload(numel, compute_itemsize,
+            per_gather = _payload(wire_numel, compute_itemsize,
                                   quantized_weights, compute_itemsize,
                                   wblk) * _ring_factor(gather_group)
-            # forward + backward re-gather, every micro-step
-            totals["allgather_bytes"] += 2 * gas * per_gather
+            totals["allgather_bytes"] += \
+                gathers_per_micro * gas * per_gather
         elif stage in (1, 2) and dp > 1 and numel >= dp and \
                 any(d % dp == 0 for d in shape):
             # updated-partition re-replication, once per step (the plan
             # only shards — and thus re-gathers — leaves with a
             # dp-divisible dim; others stay replicated)
-            totals["allgather_bytes"] += numel * compute_itemsize * \
+            totals["allgather_bytes"] += wire_numel * compute_itemsize * \
                 _ring_factor(dp)
         if dp > 1:
-            grad_payload = _payload(numel, grad_itemsize,
-                                    quantized_gradients, grad_itemsize,
-                                    block_size)
+            gi = grad_itemsize
+            if eligible and explicit_gather_grad_itemsize is not None:
+                gi = explicit_gather_grad_itemsize
+            grad_payload = _payload(wire_numel, gi, quantized_gradients,
+                                    gi, block_size)
             factor = _ring_factor(dp) if stage >= 2 \
                 else 2 * _ring_factor(dp)
             totals["reduce_bytes"] += gas * grad_payload * factor
@@ -170,18 +195,25 @@ def estimate_step_comm_bytes(plan, params, gas=1, compute_itemsize=4,
                              grad_itemsize=4, quantized_weights=False,
                              quantized_gradients=False,
                              block_size=DEFAULT_BLOCK_SIZE,
+                             gathers_per_micro=2,
+                             explicit_gather_grad_itemsize=None,
                              _force_flat_fp32=False):
     """Per-device collective bytes for ONE optimizer step under ``plan``.
 
     Returns ``{"allgather_bytes", "reduce_bytes", "total_bytes"}``.
-    ``_force_flat_fp32`` reprices as flat (full data axis) fp32 with no
-    quantization — the comparison baseline — INCLUDING flat-plan leaf
-    eligibility, so the baseline never bills gathers for a leaf flat
-    ZeRO-3 would keep replicated.
+    ``gathers_per_micro``: stage-3 weight materializations per
+    micro-step — 2 (forward + backward re-materialization, the census-
+    confirmed default). ``_force_flat_fp32`` reprices as flat (full data
+    axis) fp32 with no quantization — the comparison baseline —
+    INCLUDING flat-plan leaf eligibility, so the baseline never bills
+    gathers for a leaf flat ZeRO-3 would keep replicated (it keeps the
+    caller's gather count: the baseline compares wire FORMATS, not
+    schedules).
     """
     if _force_flat_fp32:
         compute_itemsize = grad_itemsize = _FP32_BYTES
         quantized_weights = quantized_gradients = False
+        explicit_gather_grad_itemsize = None
     return _price_tree(
         params,
         lambda path, shape, numel: plan.param_is_data_sharded(
@@ -192,7 +224,10 @@ def estimate_step_comm_bytes(plan, params, gas=1, compute_itemsize=4,
         gas=gas, compute_itemsize=compute_itemsize,
         grad_itemsize=grad_itemsize,
         quantized_weights=quantized_weights,
-        quantized_gradients=quantized_gradients, block_size=block_size)
+        quantized_gradients=quantized_gradients, block_size=block_size,
+        gathers_per_micro=gathers_per_micro,
+        explicit_gather_grad_itemsize=explicit_gather_grad_itemsize,
+        tp_ways_fn=plan.tp_ways)
 
 
 def project_comm_bytes(params, stage, dp, gas=1, compute_itemsize=4,
@@ -230,11 +265,22 @@ def estimate_engine_comm_bytes(engine):
         else engine.model.params
     compute_itemsize = jnp.dtype(engine.compute_dtype).itemsize
     gas = engine.gradient_accumulation_steps()
+    # census-ground-truthed step model (see module docstring): weights
+    # re-materialize in the backward (2 gathers/micro — XLA recomputes
+    # the ring chains rather than keeping gathered weights live);
+    # gradients reduce in the fp32 wgrad-accumulation dtype, except
+    # leaves routed through an explicit custom-vjp ring (cm/qwZ) whose
+    # cotangent the boundary pins to the compute dtype; TP leaves move
+    # only their model-axis share per device
+    explicit_gather = bool(getattr(engine, "_cm_zero3", False) or
+                           getattr(engine, "_qwz_enabled", False))
     cur = estimate_step_comm_bytes(
         plan, params, gas=gas, compute_itemsize=compute_itemsize,
-        grad_itemsize=compute_itemsize,
+        grad_itemsize=_FP32_BYTES,
         quantized_weights=engine.zero_quantized_weights(),
-        quantized_gradients=engine.zero_quantized_gradients())
+        quantized_gradients=engine.zero_quantized_gradients(),
+        explicit_gather_grad_itemsize=compute_itemsize
+        if explicit_gather else None)
     base = estimate_step_comm_bytes(plan, params, gas=gas,
                                     _force_flat_fp32=True)
 

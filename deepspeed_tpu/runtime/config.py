@@ -22,6 +22,8 @@ from ..profiling.config import DeepSpeedFlopsProfilerConfig
 from ..inference.config import DeepSpeedInferenceConfig, INFERENCE
 from ..telemetry.config import (DeepSpeedTelemetryConfig, TELEMETRY,
                                 KNOWN_TELEMETRY_KEYS)
+from ..analysis.config import (DeepSpeedAnalysisConfig, ANALYSIS,
+                               KNOWN_ANALYSIS_KEYS)
 from ..utils.logging import logger
 
 TENSOR_CORE_ALIGN_SIZE = 8
@@ -582,6 +584,9 @@ class DeepSpeedConfig(object):
         self.flops_profiler_config = DeepSpeedFlopsProfilerConfig(param_dict)
         self.inference_config = DeepSpeedInferenceConfig(param_dict)
         self.telemetry_config = DeepSpeedTelemetryConfig(param_dict)
+        # the auditor shares the observatory's thresholds (one config)
+        self.analysis_config = DeepSpeedAnalysisConfig(
+            param_dict, telemetry_config=self.telemetry_config)
         self.comm_config = DeepSpeedCommConfig(param_dict)
         self.transformer_flash_attention = \
             get_transformer_flash_attention(param_dict)
@@ -703,7 +708,7 @@ class DeepSpeedConfig(object):
         "sparse_gradients", "prescale_gradients",
         "gradient_predivide_factor", "disable_allgather", "fp32_allreduce",
         "vocabulary_size", "config_validation", "data_types",
-        INFERENCE, TELEMETRY, COMM, TRANSFORMER,
+        INFERENCE, TELEMETRY, COMM, TRANSFORMER, ANALYSIS,
         # deprecated boolean form + its companion (read_zero_config_deprecated)
         "allgather_size",
     }
@@ -742,6 +747,7 @@ class DeepSpeedConfig(object):
         "data_types": {"grad_accum_dtype"},
         INFERENCE: DeepSpeedInferenceConfig.KNOWN_KEYS,
         TELEMETRY: KNOWN_TELEMETRY_KEYS,
+        ANALYSIS: KNOWN_ANALYSIS_KEYS,
         # nested collective_matmul keys are validated (strict-aware) by
         # CollectiveMatmulConfig itself (runtime/comm/config.py)
         COMM: KNOWN_COMM_KEYS,

@@ -178,6 +178,11 @@ class DeepSpeedEngine:
             monitor_memory=False)
 
         self._jit_cache: Dict[Any, Any] = {}
+        # first-seen batch shapes, kept as ShapeDtypeStructs so
+        # engine.audit() can abstract-eval the step programs without a
+        # sample batch (one is-None check per _to_device call)
+        self._audit_batch_struct = None
+        self._audit_batch_struct_stacked = None
         self._mode = ROUTE_TRAIN
         self._last_loss = None
         self._step_metrics = {}
@@ -848,7 +853,16 @@ class DeepSpeedEngine:
             if jax.process_count() > 1:
                 return jax.make_array_from_process_local_data(sharding, x)
             return jax.device_put(x, sharding)
-        return jax.tree_util.tree_map(put, batch)
+        placed = jax.tree_util.tree_map(put, batch)
+        # TRAIN-mode forwards only: an eval batch (arbitrary rows, often
+        # replicated) must never stand in for the training micro-batch
+        # the audit abstract-evals the step programs with
+        if self._audit_batch_struct is None and self._mode == ROUTE_TRAIN:
+            self._audit_batch_struct = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                               sharding=a.sharding),
+                placed)
+        return placed
 
     # ------------------------------------------------------------- jitted fns
     def _hyper(self):
@@ -1329,6 +1343,23 @@ class DeepSpeedEngine:
                 "the telemetry config)")
             return None
         return tel.recorder.dump(reason)
+
+    def audit(self, batch=None, hlo=None, report_path=None, strict=None):
+        """Ahead-of-time shard-lint (docs/analysis.md): abstract-eval
+        this engine's resolved step programs from ShapeDtypeStructs +
+        the ZeroShardingPlan and walk the jaxpr for sharding drift,
+        donation misses, fp32 upcasts in the bf16 GEMM path, host
+        callbacks and recompile hazards — before anything compiles.
+
+        ``batch``: one sample micro-batch (arrays or structs); optional
+        after the first training step (the engine records the shapes).
+        ``hlo=True`` additionally compiles the step programs and
+        ground-truths the wire estimator against the HLO collective
+        census. Findings warn (raise under ``analysis.strict``; the
+        ``strict`` argument overrides); returns the AnalysisReport."""
+        from ..analysis import audit_engine
+        return audit_engine(self, batch=batch, hlo=hlo,
+                            report_path=report_path, strict=strict)
 
     # -------------------------------------------------------------- train API
     def train(self, mode=True):
@@ -2091,7 +2122,13 @@ class DeepSpeedEngine:
             if jax.process_count() > 1:
                 return jax.make_array_from_process_local_data(sharding, x)
             return jax.device_put(x, sharding)
-        return jax.tree_util.tree_map(put, batch)
+        placed = jax.tree_util.tree_map(put, batch)
+        if self._audit_batch_struct_stacked is None:
+            self._audit_batch_struct_stacked = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                               sharding=a.sharding),
+                placed)
+        return placed
 
     def _fused_micros_fn(self):
         """Offload variant of the fused path: scan the micro-steps on

@@ -221,6 +221,24 @@ class ZeroShardingPlan:
                 return True
         return False
 
+    def tp_ways(self, path, shape):
+        """How many ways the leaf's TENSOR-PARALLEL spec splits it (1 =
+        no TP). A TP-sharded leaf's per-device wire share for data-axis
+        collectives is ``numel / tp_ways`` — the wire estimator divides
+        by this (shard-lint census ground truth, PR 10)."""
+        spec = self._tp_spec(path, shape)
+        if spec is None:
+            return 1
+        data_axes = set(self.data_axes) | set(self.param_data_axes)
+        ways = 1
+        for entry in spec:
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            for ax in axes:
+                if ax is not None and ax not in data_axes and \
+                        ax in self.mesh.shape:
+                    ways *= int(self.mesh.shape[ax])
+        return ways
+
     def master_sharding(self, path, shape):
         """fp32 master + optimizer moments: sharded from stage 1 up."""
         if self.stage >= 1:

@@ -27,8 +27,16 @@ import jax.numpy as jnp
 
 def _split_fn_for(layout):
     """Jitted flat-buffer -> tuple-of-reshaped-views program for one
-    bucket layout ((numel, shape) pairs). The buffer is donated so the
-    flat staging copy frees the moment the split lands."""
+    bucket layout ((numel, shape) pairs).
+
+    NOT donated: jax matches donated inputs to outputs by exact aval,
+    and no reshaped slice matches the flat staging buffer — the
+    donation was silently dropped with a "donated buffers were not
+    usable" warning on every backend (the PR 10 shard-lint donation
+    audit surfaced this; ``donation_unhonored`` in docs/analysis.md).
+    The staging copy frees when the caller's reference drops after the
+    split returns, which is the same point the unusable donation freed
+    it."""
     offsets = []
     off = 0
     for numel, shape in layout:
@@ -38,10 +46,7 @@ def _split_fn_for(layout):
     def split(flat):
         return tuple(flat[o:o + n].reshape(s) for o, n, s in offsets)
 
-    # CPU can't alias the donated staging buffer into the split views and
-    # warns on every call; donation only pays on real accelerators
-    donate = () if jax.default_backend() == "cpu" else (0,)
-    return jax.jit(split, donate_argnums=donate)
+    return jax.jit(split)
 
 
 class H2DBatcher:

@@ -29,9 +29,17 @@ a memoized key lookup, one counter update, and the cache-size probe);
 import time
 
 from ..utils.logging import logger
+# the rule implementations (and their default thresholds) live in the
+# analysis package: the ahead-of-time auditor and this runtime registry
+# share ONE implementation and one threshold config
+# (``telemetry.programs``), so the two paths cannot drift. The names
+# are re-exported here for back-compat (telemetry/config.py imports
+# them from this module).
+from ..analysis.rules import (RECOMPILE_STORM_THRESHOLD_DEFAULT,
+                              REPLICATED_LEAF_BYTES_DEFAULT,
+                              recompile_storm_finding,
+                              replicated_leaf_finding)
 
-RECOMPILE_STORM_THRESHOLD_DEFAULT = 32
-REPLICATED_LEAF_BYTES_DEFAULT = 1 << 30
 _MAX_FLAGS = 64
 
 
@@ -118,13 +126,10 @@ class ProgramRegistry:
         if size is not None and size > entry["executables"]:
             entry["recompiles"] += size - entry["executables"]
             entry["executables"] = size
-            if size > self.storm_threshold:
-                self._flag(
-                    "recompile_storm:" + key_str,
-                    "program {!r} has compiled {} executables (threshold "
-                    "{}) — a recompile storm; its input shapes are not "
-                    "stabilizing".format(key_str, size,
-                                         self.storm_threshold))
+            finding = recompile_storm_finding(key_str, size,
+                                              self.storm_threshold)
+            if finding is not None:
+                self._flag(finding.key, finding.message)
         return entry
 
     def observe_trace(self, family, key):
@@ -138,13 +143,11 @@ class ProgramRegistry:
         entry = self.programs[key_str] = self._new_entry(family)
         entry["registered"] = True
         count = self._bump_family(family)
-        if count > self.storm_threshold:
-            self._flag(
-                "recompile_storm:" + family,
-                "program family {!r} holds {} distinct traces (threshold "
-                "{}) — a recompile storm; bound its key space (e.g. "
-                "inference.prefill_buckets)".format(
-                    family, count, self.storm_threshold))
+        finding = recompile_storm_finding(
+            family, count, self.storm_threshold,
+            hint="bound its key space (e.g. inference.prefill_buckets)")
+        if finding is not None:
+            self._flag(finding.key, finding.message)
         return entry
 
     def price(self, key, costs, price_wall_s=None):
@@ -180,22 +183,21 @@ class ProgramRegistry:
             import jax
             if jax.device_count() <= 1:
                 return
-            for leaf in jax.tree_util.tree_leaves(args):
+            for i, leaf in enumerate(jax.tree_util.tree_leaves(args)):
                 nbytes = getattr(leaf, "nbytes", 0) or 0
-                if nbytes < self.replicated_leaf_bytes:
-                    continue
                 sharding = getattr(leaf, "sharding", None)
-                if sharding is not None and \
-                        getattr(sharding, "is_fully_replicated", False):
-                    self._flag(
-                        "replicated_leaf:" + key_str,
-                        "program {!r} takes a fully REPLICATED "
-                        "{:.1f} MB leaf on a {}-device mesh — likely an "
-                        "accidental replication (missing partition "
-                        "rule); HBM pays {}x for it".format(
-                            key_str, nbytes / 2 ** 20,
-                            jax.device_count(), jax.device_count()))
-                    return      # one flag per program is enough
+                if sharding is None or \
+                        not getattr(sharding, "is_fully_replicated", False):
+                    continue
+                finding = replicated_leaf_finding(
+                    key_str, "arg{}".format(i), nbytes,
+                    jax.device_count(), self.replicated_leaf_bytes)
+                if finding is not None:
+                    # one flag per program is enough (the AOT auditor
+                    # reports per-leaf; the runtime registry dedupes)
+                    self._flag("replicated_leaf:" + key_str,
+                               finding.message)
+                    return
         except Exception:  # noqa: BLE001 - audit must never perturb a step
             pass
 

@@ -20,6 +20,11 @@ AFTER the cost is paid:
   * **DSL004 jit-in-loop** — ``jax.jit(...)`` called inside a loop
     body: a fresh jit wrapper (and trace) per iteration; hoist the jit
     (or cache by key, the ``_get_jit`` pattern).
+  * **DSL005 pallas-call-outside-ops** — a ``pl.pallas_call`` site
+    outside ``deepspeed_tpu/ops/``: hand-written kernels live in ONE
+    place (ops/pallas and the op packages; docs/pallas_kernels.md is
+    the inventory), so dispatch layers import kernels rather than
+    inlining them.
 
 Violations key as ``DSL###:<relpath>::<qualname>`` and count per key —
 the committed baseline file maps keys to accepted counts, so existing
@@ -36,7 +41,11 @@ LINT_RULES = {
     "DSL002": "device-put-in-loop",
     "DSL003": "telemetry-gate-missing",
     "DSL004": "jit-in-loop",
+    "DSL005": "pallas-call-outside-ops",
 }
+
+# DSL005: the one directory kernels may live in
+_OPS_PREFIX = "deepspeed_tpu/ops/"
 
 _TIME_FNS = {"time", "monotonic", "perf_counter"}
 
@@ -145,6 +154,13 @@ class _FunctionLint(ast.NodeVisitor):
                                "jax.jit inside a loop body — a fresh "
                                "trace per iteration (hoist or cache by "
                                "key)")
+        is_pallas_call = chain.endswith(".pallas_call") or (
+            isinstance(fn, ast.Name) and fn.id == "pallas_call")
+        if is_pallas_call and not self.linter.in_ops:
+            self.linter.report("DSL005", self.qualname, node.lineno,
+                               "pl.pallas_call outside deepspeed_tpu/"
+                               "ops/ — kernels live in one place "
+                               "(ops/pallas; docs/pallas_kernels.md)")
         self.generic_visit(node)
 
     def finish(self):
@@ -159,6 +175,7 @@ class _FunctionLint(ast.NodeVisitor):
 class FileLinter:
     def __init__(self, relpath):
         self.relpath = relpath
+        self.in_ops = relpath.replace(os.sep, "/").startswith(_OPS_PREFIX)
         self.violations = []       # [(rule, qualname, lineno, message)]
 
     def report(self, rule, qualname, lineno, message):

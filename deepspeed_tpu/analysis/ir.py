@@ -19,6 +19,13 @@ carrying:
 vocabulary (compute / collective / host / transfer / sharding) — the
 same vocabulary ROADMAP item 5's schedulable segment graph lowers onto;
 this walker is deliberately the first concrete piece of that IR.
+
+``pallas_call`` eqns are recorded as ONE opaque classified segment
+(``classify_pallas``: "collective" when the kernel body carries the
+remote-copy ring signature — axis_index / manual semaphores — else
+"compute") with the surrounding trip count; the kernel jaxpr itself is
+a mutable-Ref machine the value-semantics rules cannot read, so it is
+censused (``pallas_body_prims``) but never flattened.
 """
 import dataclasses
 
@@ -69,6 +76,53 @@ def classify(prim_name):
         return "transfer"
     if prim_name in SHARDING_PRIMS:
         return "sharding"
+    return "compute"
+
+
+# Kernel-body prims that mark a pallas_call as CROSS-DEVICE: the ring
+# GEMMs read their mesh position (axis_index) to address the remote
+# copies, and manual semaphore signaling only appears in collective
+# kernels. A body without them (flash attention, the paged-attention
+# page walk — local HBM->VMEM DMAs only) is a compute segment.
+PALLAS_COLLECTIVE_PRIMS = frozenset({
+    "axis_index", "semaphore_signal", "semaphore_wait",
+})
+
+
+def pallas_body_prims(eqn):
+    """Primitive-name census of a ``pallas_call`` eqn's kernel jaxpr
+    (recursive through nested control flow)."""
+    prims = set()
+
+    def collect(obj):
+        jx = _jaxpr_of(obj)
+        for inner_eqn in getattr(jx, "eqns", ()):
+            prims.add(inner_eqn.primitive.name)
+            for val in inner_eqn.params.values():
+                if hasattr(val, "eqns") or hasattr(val, "jaxpr"):
+                    collect(val)
+                elif isinstance(val, (list, tuple)):
+                    for item in val:
+                        if hasattr(item, "eqns") or hasattr(item, "jaxpr"):
+                            collect(item)
+
+    kernel = eqn.params.get("jaxpr")
+    if kernel is not None:
+        collect(kernel)
+    return prims
+
+
+def classify_pallas(eqn):
+    """Segment kind for one ``pallas_call``: "collective" when the
+    kernel body carries the remote-copy ring signature, else "compute".
+    The body itself is NOT flattened into the op-record IR — kernel
+    jaxprs operate on mutable Refs (get/swap/dma), a different register
+    machine than the value-semantics rules (donation, dtype taint,
+    sharding) are written against — so the call is recorded as ONE
+    opaque classified segment with the surrounding trip count
+    (docs/analysis.md "Pallas kernels")."""
+    if pallas_body_prims(eqn) & PALLAS_COLLECTIVE_PRIMS:
+        return "collective"
     return "compute"
 
 
@@ -217,6 +271,22 @@ def walk(closed_jaxpr, taint_in=None, taint2_in=None, _path="",
         name = eqn.primitive.name
         in_taint = any(taint_of(v) for v in eqn.invars)
         in_taint2 = tuple(taint2_of(v) for v in eqn.invars)
+        if name == "pallas_call":
+            # ONE opaque classified segment (compute, or collective for
+            # the remote-copy ring kernels) at the surrounding trip
+            # count. The kernel jaxpr is a Ref machine (get/swap/dma) —
+            # flattening it into the value-semantics op records would
+            # feed the rules ops they cannot read — so taint flows
+            # conservatively input->output and channel 2 stops (a
+            # kernel output is a new activation, never the weight).
+            for var in eqn.outvars:
+                tainted[var] = in_taint or _of(tainted, var)
+                tainted2[var] = _of(tainted2, var)
+            result.eqns.append(EqnInfo(
+                prim=name, eqn=eqn, path=_path + name, trips=_trips,
+                tainted=in_taint, kind=classify_pallas(eqn),
+                in_taint2=in_taint2))
+            continue
         trips = _trips
         if name == "scan":
             length = eqn.params.get("length")

@@ -74,6 +74,19 @@ INFERENCE_KV_POOL_FRACTION_DEFAULT = 1.0
 INFERENCE_PREFIX_CACHING = "prefix_caching"
 INFERENCE_PREFIX_CACHING_DEFAULT = False
 
+# paged-attention decode read path (docs/pallas_kernels.md):
+#   "auto"   - the Pallas in-kernel page walk on TPU, the XLA gather-back
+#              elsewhere (the interpreter is a testing vehicle, not a
+#              serving path);
+#   "pallas" - force the kernel (interpreter mode off-TPU — how tier-1
+#              pins parity);
+#   "xla"    - force the gather-back (the numerics oracle).
+# Decode-family only; prefill always runs the gather path. Loud no-op on
+# the slot layout or under a tensor-parallel mesh (engine resolves).
+INFERENCE_PAGED_ATTENTION_KERNEL = "paged_attention_kernel"
+INFERENCE_PAGED_ATTENTION_KERNEL_DEFAULT = "auto"
+_PAGED_ATTENTION_KERNELS = ("auto", "pallas", "xla")
+
 # chunked prefill: admit long prompts in pieces of at most this many
 # tokens so one long prefill never stalls the decode batch; null = off
 INFERENCE_PREFILL_CHUNK_TOKENS = "prefill_chunk_tokens"
@@ -112,7 +125,7 @@ class DeepSpeedInferenceConfig:
         INFERENCE_KV_LAYOUT, INFERENCE_KV_BLOCK_SIZE,
         INFERENCE_NUM_PAGES, INFERENCE_KV_POOL_FRACTION,
         INFERENCE_PREFIX_CACHING, INFERENCE_PREFILL_CHUNK_TOKENS,
-        INFERENCE_SPECULATIVE,
+        INFERENCE_PAGED_ATTENTION_KERNEL, INFERENCE_SPECULATIVE,
     }
 
     def __init__(self, param_dict=None):
@@ -223,6 +236,15 @@ class DeepSpeedInferenceConfig:
                  "{} requires {} \"paged\" (the slot layout has no pages "
                  "to share)".format(INFERENCE_PREFIX_CACHING,
                                     INFERENCE_KV_LAYOUT))
+
+        self.paged_attention_kernel = str(sub.get(
+            INFERENCE_PAGED_ATTENTION_KERNEL,
+            INFERENCE_PAGED_ATTENTION_KERNEL_DEFAULT)).lower()
+        _require(self.paged_attention_kernel in _PAGED_ATTENTION_KERNELS,
+                 "{} must be one of {}, got {!r}".format(
+                     INFERENCE_PAGED_ATTENTION_KERNEL,
+                     _PAGED_ATTENTION_KERNELS,
+                     self.paged_attention_kernel))
 
         self.prefill_chunk_tokens = sub.get(
             INFERENCE_PREFILL_CHUNK_TOKENS,

@@ -106,7 +106,8 @@ class InferenceEngine:
         self.model_config = dataclasses.replace(
             model_config, dropout=0.0, scan_blocks=False,
             sequence_parallel=None, sp_mesh=None, sparse_attention=None,
-            sparse_embedding_grads=False, embedding_grad_mesh=None)
+            sparse_embedding_grads=False, embedding_grad_mesh=None,
+            paged_attention_kernel="xla")
 
         ic = self.inference_config
         self.max_seq_len = ic.max_seq_len or model_config.max_seq_len
@@ -161,6 +162,13 @@ class InferenceEngine:
             self.page_tables = None
             self.page_counts = None
             self.prefix_cache = None
+
+        # paged-attention decode read path (docs/pallas_kernels.md):
+        # resolved once at engine build; the DECODE program family runs
+        # the Pallas page-walk kernel when "pallas", prefill and the
+        # slot layout always keep the XLA oracle path
+        self.paged_attention_kernel = \
+            self._resolve_paged_attention_kernel()
 
         # host mirror of each slot's live length (tokens whose K/V are in
         # the cache); the scheduler owns slot assignment on top of this
@@ -220,8 +228,9 @@ class InferenceEngine:
                 self.num_slots, self.max_seq_len, self.prefill_buckets,
                 self.dtype_name, self.kv_layout,
                 self.kv.nbytes / 2 ** 20,
-                " pages={}x{}".format(self.allocator.num_pages,
-                                      self.page_size)
+                " pages={}x{} paged_attn={}".format(
+                    self.allocator.num_pages, self.page_size,
+                    self.paged_attention_kernel)
                 if self.kv_layout == "paged" else "",
                 " spec_k={} drafter={}".format(
                     self.spec_k, type(self.drafter).__name__)
@@ -276,6 +285,43 @@ class InferenceEngine:
         from ..analysis import audit_engine
         return audit_engine(self, hlo=hlo, report_path=report_path,
                             strict=strict)
+
+    def _resolve_paged_attention_kernel(self):
+        """``inference.paged_attention_kernel`` tri-state -> the decode
+        family's concrete read path ("pallas" | "xla"). Fallbacks are
+        LOUD: a "pallas" request the engine cannot honor (slot layout,
+        tensor-parallel mesh) warns and runs the XLA oracle instead of
+        silently doing nothing."""
+        key = self.inference_config.paged_attention_kernel
+        if self.kv_layout != "paged":
+            if key == "pallas":
+                logger.warning(
+                    "inference.paged_attention_kernel='pallas' has NO "
+                    "effect: kv_layout is %r — the slot layout has no "
+                    "page tables to walk (set inference.kv_layout: "
+                    "\"paged\")", self.kv_layout)
+            return "xla"
+        if key == "xla":
+            return "xla"
+        from ..parallel.topology import MODEL_AXIS
+        tp = self.mesh is not None and \
+            int(dict(self.mesh.shape).get(MODEL_AXIS, 1)) > 1
+        if tp:
+            if key == "pallas":
+                logger.warning(
+                    "inference.paged_attention_kernel='pallas' is not "
+                    "certified under a tensor-parallel mesh (the jitted "
+                    "decode would need a shard_map wrapper around the "
+                    "kernel over the heads shards) — falling back to "
+                    "the XLA gather path")
+            return "xla"
+        if key == "pallas":
+            return "pallas"
+        # "auto": the kernel earns its keep on TPU; off-TPU the
+        # interpreter is a numerics-pinning vehicle, not a fast path
+        # (ops/pallas/common.py owns the one backend predicate)
+        from ..ops.pallas.common import default_interpret
+        return "xla" if default_interpret() else "pallas"
 
     # ---------------------------------------------------------- placement
 
@@ -382,7 +428,13 @@ class InferenceEngine:
         if fn is not None:
             return fn
         from ..models import gpt2
-        cfg = self.model_config
+        # decode is the ONE family that may run the Pallas paged-
+        # attention kernel (docs/pallas_kernels.md dispatch rules);
+        # self.model_config keeps "xla" so prefill and every oracle
+        # comparison stay on the gather path
+        cfg = dataclasses.replace(
+            self.model_config,
+            paged_attention_kernel=self.paged_attention_kernel)
         sampler = make_sampler(greedy, top_k)
         paged, ps = self.kv_layout == "paged", self.page_size
 

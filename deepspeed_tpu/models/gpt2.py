@@ -72,6 +72,14 @@ class GPT2Config:
     # the model-level capability). Causal; incompatible with
     # sequence_parallel.
     sparse_attention: object = None
+    # Paged-attention read path: "xla" (the jnp.take gather-back — the
+    # numerics oracle and default) or "pallas" (ops/pallas/
+    # paged_attention: in-kernel page-table walk with double-buffered
+    # page fetches and online softmax). The serving engine resolves the
+    # inference.paged_attention_kernel tri-state into this field on the
+    # DECODE program family only (docs/pallas_kernels.md); training and
+    # prefill never read it.
+    paged_attention_kernel: str = "xla"
 
     @property
     def d_head(self):
@@ -416,11 +424,17 @@ def _paged_attn_ctx(x, block, config, k_cache, v_cache, layer_idx,
     page_size], pos % page_size)`` via one masked scatter — padded
     tokens (``i >= valid_lens[b]``) and positions past the logical
     window redirect to the garbage page, so a bucket-padded prefill can
-    never touch another sequence's pages. Reads gather the slot's full
-    logical window back into contiguous (b, h, max_pages*page_size,
-    d_head) rows and run the same masked attention as the slot layout —
-    identical values in identical order, so paged decode is
-    bit-compatible with the slot-cache oracle.
+    never touch another sequence's pages. Reads: the default "xla" path
+    gathers the slot's full logical window back into contiguous (b, h,
+    max_pages*page_size, d_head) rows and runs the same masked
+    attention as the slot layout — identical values in identical order,
+    so paged decode is bit-compatible with the slot-cache oracle; with
+    ``config.paged_attention_kernel == "pallas"`` the read side runs
+    the ops/pallas/paged_attention kernel instead (in-kernel page walk,
+    double-buffered page fetches, online softmax — same masking
+    contract, ctx within 1e-5 of the gather path, greedy streams
+    byte-identical; docs/pallas_kernels.md). The WRITE scatter is
+    shared by both paths, so the cache bits never diverge.
     """
     b, s, d = x.shape
     dh = config.d_head
@@ -445,15 +459,21 @@ def _paged_attn_ctx(x, block, config, k_cache, v_cache, layer_idx,
     v_cache = v_cache.at[flat_page, layer_idx, :, flat_off, :].set(
         v_new.astype(v_cache.dtype))
 
-    def rows_of(cache):
-        # (P, h, ps, dh) --gather--> (b, max_pages, h, ps, dh)
-        # -> contiguous logical rows (b, h, max_pages*ps, dh)
-        gathered = jnp.take(cache[:, layer_idx], page_tables, axis=0)
-        return gathered.transpose(0, 2, 1, 3, 4).reshape(
-            b, gathered.shape[2], max_pages * page_size, dh)
+    if config.paged_attention_kernel == "pallas":
+        from ..ops.pallas.paged_attention import paged_attention
+        ctx = paged_attention(q, k_cache, v_cache, page_tables,
+                              positions, valid_lens,
+                              layer_idx=layer_idx, page_size=page_size)
+    else:
+        def rows_of(cache):
+            # (P, h, ps, dh) --gather--> (b, max_pages, h, ps, dh)
+            # -> contiguous logical rows (b, h, max_pages*ps, dh)
+            gathered = jnp.take(cache[:, layer_idx], page_tables, axis=0)
+            return gathered.transpose(0, 2, 1, 3, 4).reshape(
+                b, gathered.shape[2], max_pages * page_size, dh)
 
-    ctx = _attend_cache_rows(q, rows_of(k_cache), rows_of(v_cache),
-                             positions, dh, valid_lens=valid_lens)
+        ctx = _attend_cache_rows(q, rows_of(k_cache), rows_of(v_cache),
+                                 positions, dh, valid_lens=valid_lens)
     return ctx.astype(x.dtype).reshape(b, s, d), k_cache, v_cache
 
 

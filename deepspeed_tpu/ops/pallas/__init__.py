@@ -1,0 +1,38 @@
+"""Hand-written Pallas TPU kernels — the home for every ``pl.pallas_call``
+site that is not already an op package of its own (ops/transformer flash
+attention, ops/sparse_attention block-sparse, ops/adam|lamb fused
+optimizers). ``bin/ds_lint.py`` DSL005 enforces that kernels live under
+``deepspeed_tpu/ops/`` and nowhere else; docs/pallas_kernels.md is the
+inventory.
+
+Current residents:
+
+* :mod:`paged_attention` — the serving engine's decode-time paged
+  attention: walks each slot's page table inside the kernel with
+  double-buffered HBM->VMEM page fetches and online-softmax
+  accumulation, replacing the XLA ``jnp.take`` gather-back that
+  materialized every slot's full KV window per layer per decode step.
+* :mod:`ring_gemm` — the collective-matmul ring loops
+  (allgather-matmul / matmul-reducescatter / the dW gather-contract)
+  with the inter-chip hops expressed as ``pltpu.make_async_remote_copy``
+  + semaphore waits, so the next chunk's transfer is explicitly in
+  flight while the current partial GEMM runs (2305.06942, T3
+  2401.16677) instead of hoping XLA's latency-hiding scheduler finds
+  the overlap in a ppermute loop.
+
+Both kernels run under the Pallas interpreter on CPU (``interpret=True``
+whenever the default backend is not TPU), which is how tier-1 and the
+dryrun pin their numerics off-TPU — see docs/pallas_kernels.md for the
+testing contract.
+"""
+from .paged_attention import paged_attention
+from .ring_gemm import (ag_matmul_pallas, gather_contract_pallas,
+                        matmul_rs_pallas, pallas_ring_supported)
+
+__all__ = [
+    "paged_attention",
+    "ag_matmul_pallas",
+    "matmul_rs_pallas",
+    "gather_contract_pallas",
+    "pallas_ring_supported",
+]

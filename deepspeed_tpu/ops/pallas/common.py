@@ -1,0 +1,47 @@
+"""Shared runtime policy for the hand-written Pallas kernels: ONE home
+for backend detection and mesh-axis introspection, so the kernels, the
+dispatch layers and the serving engine cannot drift on when the
+interpreter runs or how remote copies are addressed."""
+import jax
+
+
+def default_interpret():
+    """Interpreter mode whenever the backend is not a real TPU — the
+    numerics-pinning vehicle for tier-1/dryrun, never a fast path. The
+    serving engine's ``auto`` resolution and both kernel families read
+    THIS predicate (docs/pallas_kernels.md)."""
+    return jax.default_backend() != "tpu"
+
+
+def bound_axes():
+    """Named mesh axes bound at this trace point (the shard_map scope),
+    in mesh order — what a remote copy must address. Returns None when
+    the introspection API is unavailable (a private-API move across jax
+    versions); callers must treat None as UNSUPPORTED, never as
+    single-axis — guessing the neighbor address on a multi-axis mesh
+    would corrupt results silently."""
+    try:
+        from jax._src import core as _core
+        return tuple(n for n in _core.get_axis_env().axis_sizes
+                     if n is not None)
+    except Exception:  # noqa: BLE001 - internal API; degrade LOUDLY via
+        return None    # the callers' fallback, not by guessing
+
+
+def pallas_ring_env_supported():
+    """Whether THIS trace environment can run the ring kernels:
+    ``(ok, reason)``. Two gates — the axis introspection must work (the
+    remote-copy address is derived from it), and off-TPU the jax
+    interpreter's remote-copy simulation addresses a single named axis
+    only (real hardware takes the full MESH device-id tuple)."""
+    axes = bound_axes()
+    if axes is None:
+        return False, ("cannot introspect the bound mesh axes on this "
+                       "jax version — remote-copy addressing would be "
+                       "a guess")
+    if default_interpret() and len(axes) > 1:
+        return False, ("multi-axis mesh (e.g. DP x TP) off-TPU: the "
+                       "interpreter's remote-copy simulation addresses "
+                       "a single named axis; the kernels run on real "
+                       "TPU there")
+    return True, None

@@ -1,0 +1,195 @@
+"""Pallas paged-attention: decode over the paged KV pool without the
+gather-back.
+
+The XLA paged path (``models/gpt2.py::_paged_attn_ctx``) reads the cache
+by gathering every slot's pages back into contiguous ``(b, h,
+max_pages * page_size, d_head)`` rows — ``jnp.take`` materializes each
+slot's FULL logical KV window in HBM per layer per decode step, then the
+dense masked attention reads it again. This kernel walks each slot's
+page table inside the kernel instead: physical pages stream
+HBM -> VMEM through double-buffered ``pltpu.make_async_copy`` fetches
+(page p+1's DMA is in flight while page p's scores are on the MXU), and
+an online-softmax accumulator (flash-attention style, fp32) folds each
+page in as it lands. Bytes touched per step drop from
+``2 * max_pages * page_size`` rows per slot to ``2 * ceil(live_len /
+page_size)`` pages — and nothing is ever re-materialized contiguously.
+
+Masking contract (bit-compatible with the slot oracle,
+``_attend_cache_rows``):
+
+* absolute-position causality: key position ``k_pos`` contributes to
+  query ``q_pos`` iff ``k_pos <= q_pos`` — stale K/V from recycled
+  pages past a slot's live window is unreachable, so page reuse needs
+  no clearing;
+* the V side is additionally ZEROED past the live window (``k_pos >
+  positions + valid_lens - 1``): masked scores give softmax weight
+  exactly 0.0, but ``0 * NaN = NaN`` — a NaN-poisoned recycled page
+  would contaminate the weighted sum despite the mask (the same guard
+  the oracle applies, pinned by tests/unit/test_pallas_kernels.py);
+* garbage-page-0 redirects are read-safe for free: a slot's page-table
+  entries are ``GARBAGE_PAGE`` only at logical pages past its live
+  window, and the page walk stops at ``ceil((positions + valid_lens) /
+  page_size)`` — the garbage page's content is only ever reached by
+  inactive slots, whose outputs the scheduler ignores (exactly as on
+  the oracle path).
+
+The kernel is grid-parallel over slots; the page-table row, position
+and valid length ride ``PrefetchScalarGridSpec`` scalar prefetch so the
+DMA source indices are known before the body runs. Off-TPU it runs
+under the Pallas interpreter (``interpret=True``) — the numerics-pinning
+vehicle for tier-1/dryrun, not a serving configuration
+(``inference.paged_attention_kernel: "auto"`` keeps CPU on the XLA
+gather path). Flops are pinned to the dense math via ``pl.CostEstimate``
+so the compile-observatory/cost-analysis pricing seam sees the same
+count the XLA path reports.
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .common import default_interpret
+
+NEG_INF = -1e30
+
+
+def _kernel(pt_ref, pos_ref, vlen_ref, q_ref, k_pool_ref, v_pool_ref,
+            o_ref, k_buf, v_buf, k_sem, v_sem, *, layer_idx, page_size,
+            num_heads, d_head, sm_scale, seq):
+    """One slot's page-table walk. Refs:
+
+    pt_ref (b, max_pages) / pos_ref (b,) / vlen_ref (b,): SMEM scalar
+    prefetch; q_ref (1, s, h, dh) VMEM block; k/v_pool_ref the whole
+    paged pools (pages+1, L, h, page_size, dh) left in HBM; o_ref
+    (1, s, h, dh) fp32; k/v_buf (2, h, page_size, dh) double buffers.
+    """
+    i = pl.program_id(0)
+    pos = pos_ref[i]
+    vlen = vlen_ref[i]
+    live = pos + vlen - 1                  # last live absolute position
+    n_pages = jnp.maximum(live, 0) // page_size + 1
+
+    def fetch(slot, p):
+        phys = pt_ref[i, p]
+        return (pltpu.make_async_copy(k_pool_ref.at[phys, layer_idx],
+                                      k_buf.at[slot], k_sem.at[slot]),
+                pltpu.make_async_copy(v_pool_ref.at[phys, layer_idx],
+                                      v_buf.at[slot], v_sem.at[slot]))
+
+    kd, vd = fetch(0, 0)
+    kd.start()
+    vd.start()
+
+    qf = q_ref[0].astype(jnp.float32) * sm_scale          # (s, h, dh)
+    q_pos = pos + jax.lax.broadcasted_iota(jnp.int32, (seq, page_size), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (seq, page_size), 1)
+    vcol = jax.lax.broadcasted_iota(jnp.int32, (page_size, 1), 0)
+
+    def body(p, carry):
+        acc, m, l = carry                  # (s,h,dh), (s,h), (s,h) fp32
+        slot = jax.lax.rem(p, 2)
+
+        @pl.when(p + 1 < n_pages)
+        def _prefetch():
+            kn, vn = fetch(jax.lax.rem(p + 1, 2), p + 1)
+            kn.start()
+            vn.start()
+
+        kw, vw = fetch(slot, p)
+        kw.wait()
+        vw.wait()
+        k_pg = k_buf[slot].astype(jnp.float32)            # (h, ps, dh)
+        v_pg = v_buf[slot].astype(jnp.float32)
+
+        k_pos = p * page_size + col                       # (s, ps)
+        mask = jnp.logical_and(k_pos <= q_pos, k_pos <= live)
+        vmask = (p * page_size + vcol) <= live            # (ps, 1)
+
+        new_acc, new_m, new_l = [], [], []
+        for hi in range(num_heads):
+            scores = jax.lax.dot_general(
+                qf[:, hi, :], k_pg[hi], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)       # (s, ps)
+            scores = jnp.where(mask, scores, NEG_INF)
+            vh = jnp.where(vmask, v_pg[hi], 0.0)
+            m_old = m[:, hi:hi + 1]
+            m_new = jnp.maximum(m_old,
+                                jnp.max(scores, axis=-1, keepdims=True))
+            pexp = jnp.exp(scores - m_new)
+            corr = jnp.exp(m_old - m_new)
+            new_m.append(m_new)
+            new_l.append(l[:, hi:hi + 1] * corr
+                         + jnp.sum(pexp, axis=-1, keepdims=True))
+            new_acc.append(acc[:, hi, :] * corr + jax.lax.dot_general(
+                pexp, vh, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))
+        return (jnp.stack(new_acc, axis=1),
+                jnp.concatenate(new_m, axis=1),
+                jnp.concatenate(new_l, axis=1))
+
+    acc0 = jnp.zeros((seq, num_heads, d_head), jnp.float32)
+    m0 = jnp.full((seq, num_heads), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((seq, num_heads), jnp.float32)
+    acc, _, l = jax.lax.fori_loop(0, n_pages, body, (acc0, m0, l0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = acc / l_safe[:, :, None]
+
+
+def paged_attention(q, k_pool, v_pool, page_tables, positions, valid_lens,
+                    *, layer_idx, page_size, interpret=None):
+    """Paged attention for ``s`` new queries per slot against the pool.
+
+    ``q``: (b, s, h, dh) — the new tokens' queries (cache writes for the
+    SAME tokens must already have landed via the masked scatter, exactly
+    as on the XLA gather path; this kernel replaces only the read side).
+    ``k_pool``/``v_pool``: (pages+1, layers, h, page_size, dh);
+    ``page_tables``: (b, max_pages) int32; ``positions``/``valid_lens``:
+    (b,) int32. ``layer_idx`` is trace-static (the model's python layer
+    loop). Returns fp32 ctx (b, s, h, dh) — within 1e-5 of the slot
+    oracle's dense masked softmax (same contributing entries, online
+    accumulation order).
+    """
+    if interpret is None:
+        interpret = default_interpret()
+    b, s, h, dh = q.shape
+    max_pages = page_tables.shape[1]
+    full_window = max_pages * page_size
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, s, h, dh), lambda i, *_: (i, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, s, h, dh), lambda i, *_: (i, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, h, page_size, dh), k_pool.dtype),
+            pltpu.VMEM((2, h, page_size, dh), v_pool.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ])
+    kernel = functools.partial(
+        _kernel, layer_idx=layer_idx, page_size=page_size, num_heads=h,
+        d_head=dh, sm_scale=1.0 / math.sqrt(dh), seq=s)
+    # flops pinned to the dense math over the full logical window (qk^T
+    # + p@v), the same count the XLA gather path's dots report — keeps
+    # the cost-analysis pricing seam (telemetry/programs.py) honest.
+    cost = pl.CostEstimate(
+        flops=4 * b * s * full_window * h * dh,
+        bytes_accessed=(q.size * q.dtype.itemsize
+                        + 2 * b * full_window * h * dh
+                        * k_pool.dtype.itemsize
+                        + b * s * h * dh * 4),
+        transcendentals=b * s * full_window * h)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, s, h, dh), jnp.float32),
+        cost_estimate=cost,
+        interpret=interpret,
+    )(page_tables.astype(jnp.int32), positions.astype(jnp.int32),
+      valid_lens.astype(jnp.int32), q, k_pool, v_pool)

@@ -73,6 +73,18 @@ from ..utils.logging import logger
 DTYPE_COMPUTE = "compute"     # rotate in the input dtype (bit-exact wire)
 DTYPE_BF16 = "bf16"           # cast payload to bf16 for the hop (lossy)
 
+# ring backends (comm.collective_matmul.backend): "ppermute" (the
+# lax.ppermute loops below — XLA's latency-hiding scheduler finds the
+# compute/comms overlap; the numerics oracle and default) or "pallas"
+# (ops/pallas/ring_gemm — the hop is an explicit
+# pltpu.make_async_remote_copy started before the partial GEMM and
+# semaphore-waited after it, so the overlap is constructed, not
+# scheduled; docs/pallas_kernels.md). Bytes on the wire are identical
+# (wire.py prices both as the one-shot collective).
+BACKEND_PPERMUTE = "ppermute"
+BACKEND_PALLAS = "pallas"
+BACKENDS = (BACKEND_PPERMUTE, BACKEND_PALLAS)
+
 
 def _wire_dtype(policy):
     return jnp.bfloat16 if policy == DTYPE_BF16 else None
@@ -89,10 +101,40 @@ class CollectiveMatmulBinding:
     axis: str = MODEL_AXIS
     chunks: int = 1
     dtype: str = DTYPE_COMPUTE
+    backend: str = BACKEND_PPERMUTE
 
 
 # ------------------------------------------------------- per-device bodies
-def _ag_matmul_impl(x, w, axis_name, chunks, wire):
+def _pallas_ring_live(x, w, axis_name, backend):
+    """Whether this call dispatches to the Pallas ring kernels: backend
+    requested, a real ring (n > 1 — the degenerate single-device case
+    is a plain local matmul on either backend), and the TP-site layout
+    the kernels handle. A shape the kernels cannot take falls back to
+    the ppermute loop with one loud warning (same policy as
+    ``_tp_live``)."""
+    if backend != BACKEND_PALLAS:
+        return False
+    n, _, _ = ring_context(axis_name)
+    if n <= 1:
+        return False
+    from ..ops.pallas.ring_gemm import (pallas_ring_env_supported,
+                                        pallas_ring_supported)
+    if not pallas_ring_supported(x, w):
+        _warn_fallback_once(
+            "pallas ring backend needs x rank 3 / w rank 2, got {} / {} "
+            "— running the ppermute loop".format(x.ndim, w.ndim))
+        return False
+    env_ok, reason = pallas_ring_env_supported()
+    if not env_ok:
+        _warn_fallback_once(
+            "pallas ring backend unavailable ({}) — running the "
+            "ppermute loop".format(reason))
+        return False
+    return True
+
+
+def _ag_matmul_impl(x, w, axis_name, chunks, wire,
+                    backend=BACKEND_PPERMUTE):
     """Ring all-gather(x, dim=-2) @ w without ever materializing the
     gathered x: at step t the resident chunk (originally from ring
     position ``idx - t``) multiplies the local weight shard and lands in
@@ -101,6 +143,9 @@ def _ag_matmul_impl(x, w, axis_name, chunks, wire):
     x: [..., s_loc, d] (this device's ring-dim shard); w: [d, f_loc].
     Returns [..., n*s_loc, f_loc].
     """
+    if _pallas_ring_live(x, w, axis_name, backend):
+        from ..ops.pallas.ring_gemm import ag_matmul_pallas
+        return ag_matmul_pallas(x, w, axis_name, wire_dtype=wire)
     n, idx, perm = ring_context(axis_name)
     s_loc = x.shape[-2]
     out_dtype = jnp.result_type(x.dtype, w.dtype)
@@ -116,7 +161,8 @@ def _ag_matmul_impl(x, w, axis_name, chunks, wire):
     return out
 
 
-def _matmul_rs_impl(x, w, axis_name, chunks, wire):
+def _matmul_rs_impl(x, w, axis_name, chunks, wire,
+                    backend=BACKEND_PPERMUTE):
     """psum(x @ w) reduce-scattered over dim -2, as a ring: at step t
     this device computes the partial product for the output block that
     just arrived in the rotating accumulator and forwards the sum — the
@@ -126,6 +172,9 @@ def _matmul_rs_impl(x, w, axis_name, chunks, wire):
     x: [..., n*s_loc, f_loc] (full-length partials); w: [f_loc, d].
     Returns [..., s_loc, d] — this device's output shard of the sum.
     """
+    if _pallas_ring_live(x, w, axis_name, backend):
+        from ..ops.pallas.ring_gemm import matmul_rs_pallas
+        return matmul_rs_pallas(x, w, axis_name, wire_dtype=wire)
     n, idx, perm = ring_context(axis_name)
     s = x.shape[-2]
     s_loc = s // n
@@ -141,7 +190,8 @@ def _matmul_rs_impl(x, w, axis_name, chunks, wire):
     return acc
 
 
-def _gather_contract_impl(rot, fixed, axis_name, chunks, wire, rot_is_lhs):
+def _gather_contract_impl(rot, fixed, axis_name, chunks, wire, rot_is_lhs,
+                          backend=BACKEND_PPERMUTE):
     """The dW accumulation both fused ops' backwards share:
     ``sum_j block_j(allgather(rot))^T-contract fixed[block_j]`` with the
     rotating operand ring-gathered chunk by chunk into the running sum.
@@ -150,6 +200,19 @@ def _gather_contract_impl(rot, fixed, axis_name, chunks, wire, rot_is_lhs):
     Returns [a, b] when ``rot_is_lhs`` else [b, a] — contraction over
     every leading dim plus the ring dim.
     """
+    if backend == BACKEND_PALLAS and ring_context(axis_name)[0] > 1:
+        from ..ops.pallas.ring_gemm import (gather_contract_pallas,
+                                            pallas_ring_env_supported)
+        env_ok, _ = pallas_ring_env_supported()
+        if rot.ndim == 3 and fixed.ndim == 3 and env_ok:
+            return gather_contract_pallas(rot, fixed, axis_name,
+                                          wire_dtype=wire,
+                                          rot_is_lhs=rot_is_lhs)
+        if env_ok:
+            _warn_fallback_once(
+                "pallas ring backend needs rank-3 dW operands, got "
+                "{} / {} — running the ppermute loop".format(
+                    rot.ndim, fixed.ndim))
     n, idx, perm = ring_context(axis_name)
     s_loc = rot.shape[-2]
     out_dtype = jnp.result_type(rot.dtype, fixed.dtype)
@@ -171,63 +234,72 @@ def _gather_contract_impl(rot, fixed, axis_name, chunks, wire, rot_is_lhs):
 
 
 # -------------------------------------------- fused ops (call in shard_map)
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
 def allgather_matmul(x, w, axis_name=MODEL_AXIS, chunks=1,
-                     dtype_policy=DTYPE_COMPUTE):
+                     dtype_policy=DTYPE_COMPUTE,
+                     backend=BACKEND_PPERMUTE):
     """Column-parallel fused GEMM (per-device body; call inside
     shard_map over ``axis_name``): ``allgather(x, dim=-2) @ w`` with the
-    gather decomposed into ring hops hidden under the partial matmuls.
+    gather decomposed into ring hops hidden under the partial matmuls
+    (``backend``: ppermute loop, or the Pallas explicit-overlap kernel).
 
     Backward is the dual pair of fused ops: ``dx`` is a
     ``matmul_reducescatter`` of the cotangent against ``w^T`` and ``dw``
-    re-gathers ``x`` chunk-wise into the weight-cotangent accumulation.
+    re-gathers ``x`` chunk-wise into the weight-cotangent accumulation —
+    both on the same backend.
     """
-    return _ag_matmul_impl(x, w, axis_name, chunks, _wire_dtype(dtype_policy))
+    return _ag_matmul_impl(x, w, axis_name, chunks,
+                           _wire_dtype(dtype_policy), backend)
 
 
-def _ag_fwd(x, w, axis_name, chunks, dtype_policy):
-    y = _ag_matmul_impl(x, w, axis_name, chunks, _wire_dtype(dtype_policy))
+def _ag_fwd(x, w, axis_name, chunks, dtype_policy, backend):
+    y = _ag_matmul_impl(x, w, axis_name, chunks,
+                        _wire_dtype(dtype_policy), backend)
     return y, (x, w)
 
 
-def _ag_bwd(axis_name, chunks, dtype_policy, res, dy):
+def _ag_bwd(axis_name, chunks, dtype_policy, backend, res, dy):
     x, w = res
     wire = _wire_dtype(dtype_policy)
-    dx = _matmul_rs_impl(dy, w.T, axis_name, chunks, wire)
+    dx = _matmul_rs_impl(dy, w.T, axis_name, chunks, wire, backend)
     dw = _gather_contract_impl(x, dy, axis_name, chunks, wire,
-                               rot_is_lhs=True)
+                               rot_is_lhs=True, backend=backend)
     return dx.astype(x.dtype), dw.astype(w.dtype)
 
 
 allgather_matmul.defvjp(_ag_fwd, _ag_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
 def matmul_reducescatter(x, w, axis_name=MODEL_AXIS, chunks=1,
-                         dtype_policy=DTYPE_COMPUTE):
+                         dtype_policy=DTYPE_COMPUTE,
+                         backend=BACKEND_PPERMUTE):
     """Row-parallel fused GEMM (per-device body; call inside shard_map
     over ``axis_name``): ``reduce_scatter(psum_partial(x @ w), dim=-2)``
     with each output shard emitted as soon as its partial sums finish
-    and the accumulator rotation hidden under the remaining partials.
+    and the accumulator rotation hidden under the remaining partials
+    (``backend`` as in :func:`allgather_matmul`).
 
     Backward is the dual pair: ``dx`` is an ``allgather_matmul`` of the
     cotangent against ``w^T``; ``dw`` ring-gathers the cotangent into
     the weight accumulation.
     """
-    return _matmul_rs_impl(x, w, axis_name, chunks, _wire_dtype(dtype_policy))
+    return _matmul_rs_impl(x, w, axis_name, chunks,
+                           _wire_dtype(dtype_policy), backend)
 
 
-def _rs_fwd(x, w, axis_name, chunks, dtype_policy):
-    y = _matmul_rs_impl(x, w, axis_name, chunks, _wire_dtype(dtype_policy))
+def _rs_fwd(x, w, axis_name, chunks, dtype_policy, backend):
+    y = _matmul_rs_impl(x, w, axis_name, chunks,
+                        _wire_dtype(dtype_policy), backend)
     return y, (x, w)
 
 
-def _rs_bwd(axis_name, chunks, dtype_policy, res, dy):
+def _rs_bwd(axis_name, chunks, dtype_policy, backend, res, dy):
     x, w = res
     wire = _wire_dtype(dtype_policy)
-    dx = _ag_matmul_impl(dy, w.T, axis_name, chunks, wire)
+    dx = _ag_matmul_impl(dy, w.T, axis_name, chunks, wire, backend)
     dw = _gather_contract_impl(dy, x, axis_name, chunks, wire,
-                               rot_is_lhs=False)
+                               rot_is_lhs=False, backend=backend)
     return dx.astype(x.dtype), dw.astype(w.dtype)
 
 
@@ -247,7 +319,8 @@ def _batch_entry(mesh):
 
 
 @functools.lru_cache(maxsize=64)
-def _sharded_tp_matmul(mesh, kind, axis, chunks, dtype_policy):
+def _sharded_tp_matmul(mesh, kind, axis, chunks, dtype_policy,
+                       backend=BACKEND_PPERMUTE):
     """Jitted shard_map wrapper for one fused TP matmul flavor, cached
     per (mesh, options) — jit so the eager path (e.g. under an outer
     jax.checkpoint) always compiles; under the engine's jit this inlines
@@ -255,12 +328,14 @@ def _sharded_tp_matmul(mesh, kind, axis, chunks, dtype_policy):
     batch = _batch_entry(mesh)
     if kind == "column":
         def body(x, w):
-            return allgather_matmul(x, w, axis, chunks, dtype_policy)
+            return allgather_matmul(x, w, axis, chunks, dtype_policy,
+                                    backend)
         in_specs = (P(batch, axis, None), P(None, axis))
         out_specs = P(batch, None, axis)
     else:
         def body(x, w):
-            return matmul_reducescatter(x, w, axis, chunks, dtype_policy)
+            return matmul_reducescatter(x, w, axis, chunks, dtype_policy,
+                                        backend)
         in_specs = (P(batch, None, axis), P(axis, None))
         out_specs = P(batch, axis, None)
     return jax.jit(shard_map_compat(body, mesh=mesh, in_specs=in_specs,
@@ -269,9 +344,7 @@ def _sharded_tp_matmul(mesh, kind, axis, chunks, dtype_policy):
 
 @functools.lru_cache(maxsize=None)
 def _warn_fallback_once(reason):
-    logger.warning(
-        "collective_matmul: falling back to the unfused matmul: %s",
-        reason)
+    logger.warning("collective_matmul fallback: %s", reason)
 
 
 def _tp_live(binding, x, w, kind):
@@ -314,7 +387,8 @@ def tp_column_matmul(x, w, binding):
     if not _tp_live(binding, x, w, "column"):
         return x @ w
     return _sharded_tp_matmul(binding.mesh, "column", binding.axis,
-                              int(binding.chunks), binding.dtype)(x, w)
+                              int(binding.chunks), binding.dtype,
+                              binding.backend)(x, w)
 
 
 def tp_row_matmul(x, w, binding):
@@ -327,7 +401,8 @@ def tp_row_matmul(x, w, binding):
     if not _tp_live(binding, x, w, "row"):
         return x @ w
     return _sharded_tp_matmul(binding.mesh, "row", binding.axis,
-                              int(binding.chunks), binding.dtype)(x, w)
+                              int(binding.chunks), binding.dtype,
+                              binding.backend)(x, w)
 
 
 # ------------------------------------------------- ZeRO-3 ring weight gather

@@ -16,6 +16,10 @@ Shape::
                                    // bytes == the one-shot collective, wire.py)
         "dtype": "compute",        // wire dtype policy: "compute" (bit-exact)
                                    // or "bf16" (half-width, lossy hop)
+        "backend": "ppermute",     // ring backend: "ppermute" (XLA schedules the
+                                   // overlap; the oracle) or "pallas" (explicit
+                                   // async remote copies + semaphore waits,
+                                   // ops/pallas/ring_gemm; docs/pallas_kernels.md)
         "strict": false            // unknown/unhonorable keys raise instead of warn
       }
     }
@@ -39,12 +43,15 @@ CM_CHUNKS_DEFAULT = 1
 CM_DTYPE = "dtype"
 CM_DTYPE_DEFAULT = "compute"
 CM_DTYPES = ("compute", "bf16")
+CM_BACKEND = "backend"
+CM_BACKEND_DEFAULT = "ppermute"
+CM_BACKENDS = ("ppermute", "pallas")
 CM_STRICT = "strict"
 
 KNOWN_COMM_KEYS = {COLLECTIVE_MATMUL}
 KNOWN_COLLECTIVE_MATMUL_KEYS = {
     CM_ENABLED, CM_TENSOR_PARALLEL, CM_ZERO_GATHER, CM_CHUNKS, CM_DTYPE,
-    CM_STRICT,
+    CM_BACKEND, CM_STRICT,
 }
 
 
@@ -85,6 +92,27 @@ class CollectiveMatmulConfig(object):
                 "comm.collective_matmul.{} must be one of {}, got "
                 "{!r}".format(CM_DTYPE, CM_DTYPES, dtype))
         self.dtype = dtype
+        backend = str(d.get(CM_BACKEND, CM_BACKEND_DEFAULT)).lower()
+        if backend not in CM_BACKENDS:
+            raise ValueError(
+                "comm.collective_matmul.{} must be one of {}, got "
+                "{!r}".format(CM_BACKEND, CM_BACKENDS, backend))
+        self.backend = backend
+        # backend="pallas" dispatches the TP ring kernels only — the
+        # ZeRO-3 weight gather deliberately stays a ppermute ring (its
+        # backward is a sharding constraint; docs/pallas_kernels.md).
+        # With tensor_parallel off the key is fully inert: say so.
+        # (chunks stays honored everywhere ppermute runs — the zero
+        # gather and every loud-fallback path — so it is NOT flagged.)
+        if backend == "pallas" and self.enabled and \
+                not self.tensor_parallel:
+            warn_or_raise_noop(
+                "comm.collective_matmul.backend='pallas' has NO effect: "
+                "tensor_parallel is disabled and the zero3 ring gather "
+                "always runs the ppermute backend (its backward is a "
+                "sharding constraint, not a ring — "
+                "docs/pallas_kernels.md)", self.strict,
+                flag="comm.collective_matmul.strict")
         if self.enabled and not (self.tensor_parallel or self.zero_gather):
             warn_or_raise_noop(
                 "comm.collective_matmul.enabled has NO effect: both "

@@ -462,7 +462,8 @@ class DeepSpeedEngine:
                     CollectiveMatmulBinding
                 model_cfg.collective_matmul = CollectiveMatmulBinding(
                     mesh=self.mesh, axis=MODEL_AXIS,
-                    chunks=int(cm.chunks), dtype=cm.dtype)
+                    chunks=int(cm.chunks), dtype=cm.dtype,
+                    backend=cm.backend)
                 self._cm_tp = True
             else:
                 warn_or_raise_noop(
@@ -480,8 +481,9 @@ class DeepSpeedEngine:
         else:
             log_dist(
                 "collective_matmul ON: zero3_ring_gather={} tp_fused={} "
-                "chunks={} dtype={}".format(
-                    self._cm_zero3, self._cm_tp, cm.chunks, cm.dtype),
+                "chunks={} dtype={} backend={}".format(
+                    self._cm_zero3, self._cm_tp, cm.chunks, cm.dtype,
+                    cm.backend),
                 ranks=[0])
 
     def _apply_transformer_overrides(self):

@@ -250,7 +250,13 @@ def audit_engine(engine, batch=None, hlo=None, report_path=None,
             from ..runtime.comm.wire import estimate_engine_comm_bytes
             if engine.zero_plan.dp_size > 1 and \
                     engine.state.get("params") is not None:
-                wire_est = estimate_engine_comm_bytes(engine)
+                # min_component: drop estimator components below the
+                # census threshold so the diff compares like-for-like
+                # (the 1-bit exchange's scalar-scale gathers are a few
+                # dozen bytes — below any census floor)
+                wire_est = estimate_engine_comm_bytes(
+                    engine, min_component=getattr(
+                        config, "census_min_bytes", 1024))
         except Exception as err:  # noqa: BLE001 - estimator optional
             logger.info("shard-lint: wire estimate unavailable (%s)", err)
         job = "train"

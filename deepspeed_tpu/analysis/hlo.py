@@ -42,8 +42,11 @@ COLLECTIVE_OPS = (
 )
 
 _SHAPE_RE = re.compile(r"([a-z]+[0-9]*)\[([0-9,]*)\]")
+# tuple result shapes stop at the first ')' — long tuples carry
+# '/*index=N*/' comments (so '[^=]*' would reject them), but never
+# nested parens
 _INSTR_RE = re.compile(
-    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(\([^=]*\)|[a-z]+[0-9]*\[[0-9,]*\]"
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(\([^()]*\)|[a-z]+[0-9]*\[[0-9,]*\]"
     r"(?:\{[^}]*\})?)\s+([a-z0-9\-]+)(?:-start)?\(", re.M)
 _GROUPS_RE = re.compile(
     r"replica_groups=(\{\{[\d,{}\s]*\}\}|\[[\d,]+\]<=\[[\d,]+\]"
@@ -207,6 +210,7 @@ def collective_census(hlo_text, axis_groups=None,
             below += wire
             continue
         name_m = _OP_NAME_RE.search(line)
+        op_name = name_m.group(1) if name_m else ""
         ops.append({
             "opcode": opcode,
             "wire_bytes": int(round(wire)),
@@ -214,7 +218,11 @@ def collective_census(hlo_text, axis_groups=None,
             "group_size": int(gsize),
             "axis": axis,
             "in_loop": bool(in_loop),
-            "op_name": name_m.group(1)[-80:] if name_m else "",
+            # hand-written shard_map collectives (the quantized/1-bit
+            # exchanges, ring bodies) — deterministic bytes the compiler
+            # cannot reshape, vs GSPMD-inserted resharding it can
+            "explicit": "shmap_body" in op_name,
+            "op_name": op_name[-80:],
         })
     by_axis = {}
     for op in ops:
@@ -244,8 +252,14 @@ def census_classes(census, data_labels, normalize_allreduce=False):
     model. The raw per-op list keeps the unnormalized bytes.
     """
     out = {"allgather_bytes": 0, "reduce_bytes": 0, "ring_bytes": 0,
-           "data_other_bytes": 0, "other_axis_bytes": 0}
+           "data_other_bytes": 0, "other_axis_bytes": 0,
+           "explicit_bytes": 0}
     for op in census["ops"]:
+        if op.get("explicit") and op["axis"] in data_labels:
+            # our hand-written shard_map collectives (quantized / 1-bit
+            # exchange bodies): tallied separately — their bytes are
+            # deterministic and must equal the estimator EXACTLY
+            out["explicit_bytes"] += op["wire_bytes"]
         if op["axis"] not in data_labels:
             out["other_axis_bytes"] += op["wire_bytes"]
             continue
@@ -286,7 +300,7 @@ def reconcile_wire(census_list, wire_est, data_labels, program="step",
     """
     classes = {"allgather_bytes": 0, "reduce_bytes": 0, "ring_bytes": 0,
                "data_other_bytes": 0, "other_axis_bytes": 0,
-               "data_total_bytes": 0}
+               "explicit_bytes": 0, "data_total_bytes": 0}
     for census in census_list:
         part = census_classes(census, data_labels,
                               normalize_allreduce=normalize_allreduce)
@@ -296,11 +310,22 @@ def reconcile_wire(census_list, wire_est, data_labels, program="step",
                               wire_est.get("allgather_bytes", 0)) or 0)
     est_rs = int(wire_est.get("reduce_bytes_per_step",
                               wire_est.get("reduce_bytes", 0)) or 0)
-    est_total = est_ag + est_rs
+    # the compressed-comm tier's classes (wire.py): the in-collective
+    # quantized gradient exchange reprices the reduce class (flat or the
+    # hierarchical two-level formula — quantized_allreduce_bytes); the
+    # 1-bit momentum exchange is its own class. Census-side these land
+    # as data-axis collective-permutes (ring hops -> ring_bytes),
+    # all-to-alls (the sign exchange -> data_other_bytes) and
+    # all-gathers, so only the TOTAL reconciles class-exactly.
+    est_opt = int(wire_est.get("optimizer_bytes_per_step", 0) or 0)
+    est_total = est_ag + est_rs + est_opt
     payload = {
         "program": program,
         "estimator": {"allgather_bytes": est_ag, "reduce_bytes": est_rs,
+                      "optimizer_bytes": est_opt,
                       "total_bytes": est_total},
+        "quantized": bool(est_opt or
+                          wire_est.get("quantized_collectives")),
         "hlo": classes,
         "delta_total_bytes": classes["data_total_bytes"] - est_total,
         "match_total": classes["data_total_bytes"] == est_total,
@@ -313,9 +338,23 @@ def reconcile_wire(census_list, wire_est, data_labels, program="step",
         # gathers run as OUR ppermute rings (collective_matmul), the
         # ring bytes are deterministic and must equal the estimator's
         # allgather class exactly — the byte-for-byte census contract
-        # the dryrun analysis leg pins (None when no rings ran)
+        # the dryrun analysis leg pins (None when no rings ran, or when
+        # the rings serve the QUANTIZED reduce class instead)
         "match_ring_allgather": (classes["ring_bytes"] == est_ag
-                                 if classes["ring_bytes"] else None),
+                                 if classes["ring_bytes"] and not est_opt
+                                 and not wire_est.get(
+                                     "quantized_collectives") else None),
+        # the compressed-comm contract: the hand-written shard_map
+        # exchanges (1-bit momentum + in-collective quantized reduce,
+        # incl. the hierarchical two-level decomposition) have
+        # deterministic instruction-level bytes — the census must equal
+        # the estimator's exchange classes EXACTLY. None when no
+        # quantized exchange is priced.
+        "match_exchange": (
+            classes["explicit_bytes"] == est_opt +
+            (est_rs if wire_est.get("quantized_collectives") else 0)
+            if (est_opt or wire_est.get("quantized_collectives"))
+            else None),
     }
     findings = []
     if classes["data_total_bytes"] > est_total and \

@@ -1,9 +1,15 @@
-from .compressed import (CompressedBackend, compressed_allreduce_local,
-                         masked_compress)
+from .compressed import CompressedBackend
+from .onebit import (compressed_allreduce_local, masked_compress,
+                     onebit_all_gather_local, onebit_padded_size,
+                     onebit_reduce_scatter_local)
 from .quantize import (DEFAULT_BLOCK_SIZE, QuantizedCollectives,
-                       dequantize_blockwise, dequantize_param, pack_signs,
-                       quantize_blockwise, quantize_dequantize,
-                       quantize_param, quantize_with_error_feedback,
+                       dequantize_blockwise, dequantize_param,
+                       hierarchical_all_reduce_local, pack_signs,
+                       qc_padded_size, quantize_blockwise,
+                       quantize_dequantize, quantize_param,
+                       quantize_with_error_feedback,
                        quantized_all_gather_local,
-                       quantized_reduce_scatter_local, qwz_gather,
+                       quantized_all_reduce_local,
+                       quantized_reduce_scatter_local,
+                       ring_reduce_scatter_inline, qwz_gather,
                        sign_scale, unpack_signs)

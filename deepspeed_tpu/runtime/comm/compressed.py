@@ -1,22 +1,11 @@
-"""Compressed (1-bit) collectives: sign-pack allreduce with error feedback.
+"""CompressedBackend: the 1-bit allreduce facade over a JAX mesh.
 
-Reference parity: deepspeed/runtime/comm/nccl.py:43-178 (NcclBackend.
-compressed_allreduce) and its MPI twin (comm/mpi.py). The reference's
-2-phase algorithm is kept exactly; the transport changes:
-
-  * cupy ``packbits`` -> a jnp bit-pack (uint8 dot with power-of-two
-    weights) that XLA vectorizes on-device;
-  * ``torch.distributed.all_to_all_single`` / ``all_gather`` ->
-    ``jax.lax.all_to_all`` / ``all_gather`` inside ``shard_map`` over the
-    ``data`` mesh axis, so the exchange rides ICI and XLA overlaps it;
-  * CUDA stream juggling disappears (XLA schedules).
-
-Phase 1 (worker): add worker error feedback, take one scale
-``||x||/sqrt(n)``, pack sign bits, update the worker error, all_to_all the
-sign chunks (+ all_gather scales).
-Phase 2 (server): each rank decompresses & averages its chunk across
-workers, adds server error feedback, re-compresses with a fresh scale,
-updates server error, all_gathers the result to everyone.
+Reference parity: deepspeed/runtime/comm/nccl.py (NcclBackend) and its
+MPI twin. The codec and the per-device collective bodies live in ONE
+place — runtime/comm/onebit.py (worker reduce-scatter + server
+all-gather phases, composed as ``compressed_allreduce_local``) — shared
+with the 1-bit Adam optimizer; this module only owns the host-side
+facade: padding, error-state defaulting, and the per-size jit cache.
 
 Compression ratio is 32x on the wire minus two scalar scales per buffer —
 the reference's "6.6x end-to-end at 40 Gb Ethernet" regime corresponds to
@@ -29,78 +18,12 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ...parallel.topology import DATA_AXIS, shard_map_compat
-# The bit-pack/scale primitives live with the blockwise codec —
-# re-exported here for the existing call sites (runtime.comm/__init__).
-from .quantize import pack_signs, sign_scale, unpack_signs
-
-
-def masked_compress(x, mask, count):
-    """Sign+scale quantize the lanes selected by ``mask`` (1.0/0.0 floats,
-    ``count`` = number of real lanes). Pad lanes must carry zero value AND
-    zero error feedback — quantizing a 0 lane to +scale would make its
-    error oscillate at ±scale and pollute ``||x||/sqrt(n)`` (torch's
-    sign(0)=0 gives the reference this for free). Returns (packed signs,
-    scale, decompressed, error residual). Everything stays in ``x``'s
-    dtype — a bf16 buffer gets a bf16 scale, no mid-pipeline upcast."""
-    mask = mask.astype(x.dtype)
-    masked = x * mask
-    scale = sign_scale(masked, count)
-    packed = pack_signs(x)
-    signs = jnp.where(x >= 0, 1.0, -1.0).astype(x.dtype)
-    decompressed = scale * signs * mask
-    return packed, scale, decompressed, (x - decompressed) * mask
-
-
-def _compress(x):
-    """One full buffer -> (packed signs, scalar scale, error residual)."""
-    mask = jnp.ones(x.size, dtype=jnp.float32)
-    packed, scale, _, err = masked_compress(x, mask, float(x.size))
-    return packed, scale, err
-
-
-def compressed_allreduce_local(x, worker_error, server_error, axis_name,
-                               world_size, real_size=None):
-    """The per-device body: call inside shard_map/pmap over ``axis_name``.
-
-    ``x``: this device's local buffer (flat fp32, size divisible by
-    8*world_size; lanes >= ``real_size`` are padding). Returns (averaged
-    buffer, new worker_error, new server_error) — errors have the same
-    shapes as the inputs (server_error is 1/world_size of the buffer).
-    """
-    n = x.size
-    chunk = n // world_size
-    if real_size is None:
-        real_size = n
-    mask = (jnp.arange(n) < real_size).astype(jnp.float32)
-
-    # ---- phase 1: worker compression + exchange
-    corrected = x + worker_error
-    packed, scale, _, new_worker_error = masked_compress(
-        corrected, mask, float(real_size))
-    # rows: chunk destined to each server rank
-    packed_rows = packed.reshape(world_size, chunk // 8)
-    recv = jax.lax.all_to_all(packed_rows, axis_name, split_axis=0,
-                              concat_axis=0, tiled=False)
-    scales = jax.lax.all_gather(scale, axis_name)
-
-    # ---- phase 2: server decompress, average, re-compress, broadcast
-    # recv[w] = my chunk's sign bytes from worker w; my chunk's lane mask
-    # and real-lane count depend on my position in the gather order
-    rank = jax.lax.axis_index(axis_name)
-    chunk_start = rank * chunk
-    chunk_mask = (jnp.arange(chunk) + chunk_start <
-                  real_size).astype(jnp.float32)
-    chunk_count = jnp.clip(real_size - chunk_start, 0, chunk).astype(
-        jnp.float32)
-    per_worker = jax.vmap(unpack_signs)(recv, scales)      # (world, chunk)
-    server_chunk = per_worker.mean(axis=0) * chunk_mask + server_error
-    server_packed, server_scale, _, new_server_error = masked_compress(
-        server_chunk, chunk_mask, chunk_count)
-
-    gathered = jax.lax.all_gather(server_packed, axis_name)  # (world, chunk/8)
-    gathered_scales = jax.lax.all_gather(server_scale, axis_name)
-    result = jax.vmap(unpack_signs)(gathered, gathered_scales).reshape(-1)
-    return result * mask, new_worker_error, new_server_error
+# One sign+scale implementation: the pack/scale primitives live with the
+# blockwise codec (quantize.py), the masked compressor and the exchange
+# bodies with the 1-bit collectives (onebit.py). Re-exported here for
+# the existing call sites (runtime.comm/__init__).
+from .onebit import compressed_allreduce_local, masked_compress  # noqa: F401
+from .quantize import pack_signs, sign_scale, unpack_signs  # noqa: F401
 
 
 class CompressedBackend:

@@ -1,9 +1,20 @@
 """``comm`` ds_config section: collective-communication behavior.
 
-Currently one sub-section, ``comm.collective_matmul`` — the gate for
-the ring-decomposed all-gather/reduce-scatter GEMMs
-(``parallel/collective_matmul.py``). Off by default: the unfused XLA
-path stays the reference oracle, and fusion is an explicit opt-in.
+Two sub-sections:
+
+``comm.collective_matmul`` — the gate for the ring-decomposed
+all-gather/reduce-scatter GEMMs (``parallel/collective_matmul.py``).
+Off by default: the unfused XLA path stays the reference oracle, and
+fusion is an explicit opt-in.
+
+``comm.quantized_collectives`` — in-collective quantization of the
+data-parallel gradient allreduce (EQuARX, arXiv:2506.17615): the micro
+step computes per-device LOCAL gradients inside ``shard_map`` and
+averages them through ``runtime/comm/quantize.py``'s in-collective ring
+(int8 blocks + scales on every hop, fp32 accumulation on-device), with
+a two-level hierarchical decomposition over ``topology.factor_data_axis``
+sub-axes (arXiv:2504.18658). Also the warmup-phase transport of the
+1-bit Adam optimizer (docs/onebit_adam.md).
 
 Shape::
 
@@ -21,11 +32,28 @@ Shape::
                                    // async remote copies + semaphore waits,
                                    // ops/pallas/ring_gemm; docs/pallas_kernels.md)
         "strict": false            // unknown/unhonorable keys raise instead of warn
+      },
+      "quantized_collectives": {
+        "enabled": false,          // master switch
+        "dtype": "int8",           // wire dtype of every hop (the only codec;
+                                   // other values rejected loudly)
+        "block_size": 256,         // lanes per quantization block
+        "hierarchical": 0,         // 0 = flat ring (the engine's only factored-mesh
+                                   // source, hpZ, is stage-3-only and stage 3 is a
+                                   // rejected combination — the mesh-following mode
+                                   // serves the QuantizedCollectives library facade);
+                                   // N>1 = factor the data axis into (dp/N, N)
+                                   // sub-axes for the two-level decomposition
+        "strict": false            // unknown/unhonorable keys raise instead of warn
       }
     }
 
+``comm.quantized_collectives.cuda_aware`` (a reference NCCL-backend key)
+is REJECTED loudly — there is no CUDA here and silently accepting it
+would misrepresent the transport.
+
 Validated with the PR 4/5 no-silent-no-ops policy: unknown keys warn,
-and raise when ``comm.collective_matmul.strict`` is set.
+and raise when the sub-section's ``strict`` is set.
 """
 from ...telemetry.config import warn_or_raise_noop
 
@@ -48,10 +76,26 @@ CM_BACKEND_DEFAULT = "ppermute"
 CM_BACKENDS = ("ppermute", "pallas")
 CM_STRICT = "strict"
 
-KNOWN_COMM_KEYS = {COLLECTIVE_MATMUL}
+QUANTIZED_COLLECTIVES = "quantized_collectives"
+
+QC_ENABLED = "enabled"
+QC_ENABLED_DEFAULT = False
+QC_DTYPE = "dtype"
+QC_DTYPE_DEFAULT = "int8"
+QC_DTYPES = ("int8",)
+QC_BLOCK_SIZE = "block_size"
+QC_HIERARCHICAL = "hierarchical"
+QC_HIERARCHICAL_DEFAULT = 0
+QC_CUDA_AWARE = "cuda_aware"
+QC_STRICT = "strict"
+
+KNOWN_COMM_KEYS = {COLLECTIVE_MATMUL, QUANTIZED_COLLECTIVES}
 KNOWN_COLLECTIVE_MATMUL_KEYS = {
     CM_ENABLED, CM_TENSOR_PARALLEL, CM_ZERO_GATHER, CM_CHUNKS, CM_DTYPE,
     CM_BACKEND, CM_STRICT,
+}
+KNOWN_QUANTIZED_COLLECTIVES_KEYS = {
+    QC_ENABLED, QC_DTYPE, QC_BLOCK_SIZE, QC_HIERARCHICAL, QC_STRICT,
 }
 
 
@@ -120,6 +164,58 @@ class CollectiveMatmulConfig(object):
                 self.strict, flag="comm.collective_matmul.strict")
 
 
+class QuantizedCollectivesConfig(object):
+    """Typed view of ``comm.quantized_collectives``."""
+
+    def __init__(self, d):
+        d = d or {}
+        if not isinstance(d, dict):
+            raise ValueError(
+                "comm.quantized_collectives must be a dict, got {}".format(
+                    type(d).__name__))
+        self.strict = bool(d.get(QC_STRICT, False))
+        if QC_CUDA_AWARE in d:
+            # the reference NcclBackend key: there is no CUDA transport
+            # here and accepting it (even as a warning) would claim one
+            raise ValueError(
+                "comm.quantized_collectives.cuda_aware is a CUDA/NCCL "
+                "transport key the TPU runtime cannot honor — the "
+                "exchange rides ICI through shard_map collectives; "
+                "remove the key (docs/onebit_adam.md)")
+        unknown = sorted(k for k in d
+                         if k not in KNOWN_QUANTIZED_COLLECTIVES_KEYS)
+        if unknown:
+            warn_or_raise_noop(
+                "comm.quantized_collectives.{} has NO effect: unknown "
+                "key(s) (accepted: {})".format(
+                    ", ".join(unknown),
+                    sorted(KNOWN_QUANTIZED_COLLECTIVES_KEYS)),
+                self.strict, flag="comm.quantized_collectives.strict")
+        self.enabled = bool(d.get(QC_ENABLED, QC_ENABLED_DEFAULT))
+        dtype = str(d.get(QC_DTYPE, QC_DTYPE_DEFAULT)).lower()
+        if dtype not in QC_DTYPES:
+            raise ValueError(
+                "comm.quantized_collectives.{} must be one of {}, got "
+                "{!r}".format(QC_DTYPE, QC_DTYPES, dtype))
+        self.dtype = dtype
+        from .quantize import DEFAULT_BLOCK_SIZE
+        block = d.get(QC_BLOCK_SIZE, DEFAULT_BLOCK_SIZE)
+        if isinstance(block, bool) or not isinstance(block, int) or \
+                block < 8:
+            raise ValueError(
+                "comm.quantized_collectives.{} must be an int >= 8, got "
+                "{!r}".format(QC_BLOCK_SIZE, block))
+        self.block_size = block
+        hier = d.get(QC_HIERARCHICAL, QC_HIERARCHICAL_DEFAULT)
+        if isinstance(hier, bool) or not isinstance(hier, int) or \
+                hier < 0 or hier == 1:
+            raise ValueError(
+                "comm.quantized_collectives.{} must be 0 (follow the "
+                "mesh) or an int >= 2 (factor the data axis that many "
+                "ways), got {!r}".format(QC_HIERARCHICAL, hier))
+        self.hierarchical = hier
+
+
 class DeepSpeedCommConfig(object):
     """Typed view of the ``comm`` section of a ds_config dict."""
 
@@ -131,3 +227,5 @@ class DeepSpeedCommConfig(object):
                     type(d).__name__))
         self.collective_matmul = CollectiveMatmulConfig(
             d.get(COLLECTIVE_MATMUL))
+        self.quantized_collectives = QuantizedCollectivesConfig(
+            d.get(QUANTIZED_COLLECTIVES))

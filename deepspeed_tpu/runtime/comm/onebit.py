@@ -1,0 +1,144 @@
+"""1-bit sign+scale compressed collectives — the single codec home.
+
+Reference parity: deepspeed/runtime/comm/nccl.py:43-178
+(NcclBackend.compressed_allreduce) and its MPI twin. The reference's
+2-phase algorithm is decomposed into its two collective stages so the
+1-bit Adam optimizer (runtime/fp16/onebit_adam.py) can ride them as a
+real reduce-scatter / all-gather pair inside ``shard_map``:
+
+  * :func:`onebit_reduce_scatter_local` — the WORKER phase: add worker
+    error feedback, take one scale ``||x||/sqrt(n)``, pack sign bits,
+    ``all_to_all`` the sign chunks (+ ``all_gather`` the scalar scales),
+    decompress and average my chunk across workers. The wire moves
+    ``n/8`` uint8 bytes instead of ``4n`` fp32 — the reduce-scatter of
+    the compressed allreduce.
+  * :func:`onebit_all_gather_local` — the SERVER phase: add server error
+    feedback to my averaged chunk, re-compress with a fresh scale,
+    ``all_gather`` the sign bytes back to everyone — the broadcast half,
+    again at ``n/8`` bytes on the wire.
+  * :func:`compressed_allreduce_local` — the composition, preserved
+    verbatim for ``CompressedBackend`` (runtime/comm/compressed.py).
+
+All axis arguments accept a single mesh-axis name or a TUPLE of sub-axis
+names (the hpZ-factored ``(data_replica, data_shard)`` mesh): jax's
+collectives and ``axis_index`` treat the tuple as one flattened axis, so
+the exchange composes with hierarchically partitioned meshes unchanged.
+
+Everything stays in the input's dtype (a bf16 buffer gets a bf16 scale —
+no mid-pipeline upcast), and pad lanes carry zero value AND zero error
+feedback (see :func:`masked_compress`). Constants are explicitly typed
+(``jnp.float32``) so the shard-lint weak-scalar rule stays silent on the
+exchange bodies.
+
+The bit-pack primitives (``pack_signs``/``unpack_signs``/``sign_scale``)
+live with the blockwise codec in quantize.py and are shared here.
+"""
+import jax
+import jax.numpy as jnp
+
+from .quantize import pack_signs, sign_scale, unpack_signs
+
+
+def onebit_padded_size(n, world_size):
+    """Lanes the 1-bit exchange needs: a multiple of ``8 * world`` so
+    every per-rank chunk packs to whole sign bytes."""
+    mult = 8 * int(world_size)
+    return ((int(n) + mult - 1) // mult) * mult
+
+
+def masked_compress(x, mask, count):
+    """Sign+scale quantize the lanes selected by ``mask`` (1.0/0.0 floats,
+    ``count`` = number of real lanes). Pad lanes must carry zero value AND
+    zero error feedback — quantizing a 0 lane to +scale would make its
+    error oscillate at ±scale and pollute ``||x||/sqrt(n)`` (torch's
+    sign(0)=0 gives the reference this for free). Returns (packed signs,
+    scale, decompressed, error residual). Everything stays in ``x``'s
+    dtype — a bf16 buffer gets a bf16 scale, no mid-pipeline upcast."""
+    mask = mask.astype(x.dtype)
+    masked = x * mask
+    scale = sign_scale(masked, count)
+    packed = pack_signs(x)
+    signs = jnp.where(x >= 0, jnp.float32(1.0),
+                      jnp.float32(-1.0)).astype(x.dtype)
+    decompressed = scale * signs * mask
+    return packed, scale, decompressed, (x - decompressed) * mask
+
+
+def onebit_reduce_scatter_local(x, worker_error, axis_name, world_size,
+                                real_size=None):
+    """Worker phase per-device body (call inside shard_map over
+    ``axis_name``): compress the error-corrected buffer, exchange sign
+    chunks, decompress + average my chunk across workers.
+
+    ``x``: this device's local buffer (flat fp32, size divisible by
+    ``8 * world_size``; lanes >= ``real_size`` are padding).
+    Returns ``(chunk_mean, chunk_mask, chunk_count, new_worker_error)``:
+    ``chunk_mean`` is my rank's chunk of the worker-average (masked to
+    real lanes, WITHOUT server error — the server phase owns that),
+    ``chunk_mask``/``chunk_count`` describe my chunk's real lanes for the
+    server compressor, ``new_worker_error`` is this device's residual.
+    """
+    n = x.size
+    chunk = n // world_size
+    if real_size is None:
+        real_size = n
+    mask = (jnp.arange(n) < real_size).astype(jnp.float32)
+
+    corrected = x + worker_error
+    packed, scale, _, new_worker_error = masked_compress(
+        corrected, mask, jnp.float32(real_size))
+    # rows: chunk destined to each server rank
+    packed_rows = packed.reshape(world_size, chunk // 8)
+    recv = jax.lax.all_to_all(packed_rows, axis_name, split_axis=0,
+                              concat_axis=0, tiled=False)
+    scales = jax.lax.all_gather(scale, axis_name)
+
+    # recv[w] = my chunk's sign bytes from worker w; my chunk's lane mask
+    # and real-lane count depend on my position in the gather order
+    rank = jax.lax.axis_index(axis_name)
+    chunk_start = rank * chunk
+    chunk_mask = (jnp.arange(chunk) + chunk_start <
+                  real_size).astype(jnp.float32)
+    chunk_count = jnp.clip(jnp.int32(real_size) - chunk_start, 0,
+                           chunk).astype(jnp.float32)
+    per_worker = jax.vmap(unpack_signs)(recv, scales)      # (world, chunk)
+    chunk_mean = per_worker.mean(axis=0) * chunk_mask
+    return chunk_mean, chunk_mask, chunk_count, new_worker_error
+
+
+def onebit_all_gather_local(server_chunk, server_error, axis_name,
+                            chunk_mask, chunk_count):
+    """Server phase per-device body: error-compensate + re-compress my
+    averaged chunk, all-gather the sign bytes, decompress the full
+    buffer. Returns ``(full, new_server_error)`` — ``full`` is the
+    world-concatenated result in rank order (pad lanes of OTHER chunks
+    are NOT masked here; the caller applies its full-length mask)."""
+    server_in = server_chunk + server_error
+    server_packed, server_scale, _, new_server_error = masked_compress(
+        server_in, chunk_mask, chunk_count)
+    gathered = jax.lax.all_gather(server_packed, axis_name)
+    gathered_scales = jax.lax.all_gather(server_scale, axis_name)
+    full = jax.vmap(unpack_signs)(gathered, gathered_scales).reshape(-1)
+    return full, new_server_error
+
+
+def compressed_allreduce_local(x, worker_error, server_error, axis_name,
+                               world_size, real_size=None):
+    """The composed per-device body: worker reduce-scatter then server
+    all-gather (reference nccl.py compressed_allreduce, both phases).
+
+    ``x``: this device's local buffer (flat fp32, size divisible by
+    8*world_size; lanes >= ``real_size`` are padding). Returns (averaged
+    buffer, new worker_error, new server_error) — errors have the same
+    shapes as the inputs (server_error is 1/world_size of the buffer).
+    """
+    n = x.size
+    if real_size is None:
+        real_size = n
+    mask = (jnp.arange(n) < real_size).astype(jnp.float32)
+    chunk_mean, chunk_mask, chunk_count, new_worker_error = \
+        onebit_reduce_scatter_local(x, worker_error, axis_name, world_size,
+                                    real_size)
+    result, new_server_error = onebit_all_gather_local(
+        chunk_mean, server_error, axis_name, chunk_mask, chunk_count)
+    return result * mask, new_worker_error, new_server_error

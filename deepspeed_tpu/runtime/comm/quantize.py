@@ -26,8 +26,20 @@ Scales follow the INPUT dtype (a bf16 buffer quantizes to bf16 scales):
 the encode side casts the scale to the storage dtype BEFORE dividing, so
 encode/decode agree bit-exactly and nothing upcasts mid-pipeline.
 
-``compressed.py``'s 1-bit path shares the sign-pack helpers below
-(``pack_signs``/``unpack_signs``/``sign_scale``).
+The 1-bit path (``onebit.py``/``compressed.py``) shares the sign-pack
+helpers below (``pack_signs``/``unpack_signs``/``sign_scale``).
+
+IN-COLLECTIVE mode (EQuARX, arXiv:2506.17615): instead of quantizing a
+buffer once and letting the collective move it, quantization is pushed
+INSIDE the ring — :func:`ring_reduce_scatter_inline` dequantizes each
+arriving int8 hop to fp32, accumulates its local contribution in fp32,
+and requantizes for the next hop, so every wire hop is int8 blocks +
+scales while the reduction itself never leaves fp32.
+:func:`hierarchical_all_reduce_local` is its two-level decomposition
+("The Big Send-off", arXiv:2504.18658) over ``topology.factor_data_axis``
+sub-axes: intra-``data_shard`` ring RS → cross-``data_replica`` ring
+RS + int8 all-gather → intra-``data_shard`` int8 all-gather, keeping
+most hops on the ICI-adjacent shard group.
 """
 import functools
 
@@ -275,21 +287,163 @@ def quantized_reduce_scatter_local(x, axis_name, world_size,
     return deq.sum(axis=0).astype(x.dtype), new_error
 
 
+# -------------------------------------------------- fused flat layout
+class FusedFlatLayout:
+    """Static layout of ONE fused flat fp32 buffer over a param tree —
+    the contract both compressed exchanges ride (the engine's quantized
+    gradient exchange and OnebitAdam's momentum buffer): leaves in jax
+    tree-flatten order, row-major concatenated, padded to
+    ``padded_size_fn(numel)`` (``qc_padded_size`` for the int8 ring,
+    ``onebit_padded_size`` for the sign-pack exchange). One
+    implementation so the two can never desynchronize."""
+
+    def __init__(self, tree, padded_size_fn):
+        flat, self.treedef = jax.tree_util.tree_flatten(tree)
+        self.leaf_meta = []
+        off = 0
+        for p in flat:
+            n = int(np.prod(np.shape(p))) if np.shape(p) else 1
+            self.leaf_meta.append((off, n, tuple(np.shape(p))))
+            off += n
+        self.numel = off
+        self.padded = int(padded_size_fn(off))
+
+    def flatten(self, tree):
+        """Tree -> (padded,) fp32 fused buffer."""
+        rows = [jnp.asarray(x, jnp.float32).reshape(-1)
+                for x in self.treedef.flatten_up_to(tree)]
+        flat = jnp.concatenate(rows)
+        pad = self.padded - self.numel
+        return jnp.pad(flat, (0, pad)) if pad else flat
+
+    def flatten_rows(self, stacked):
+        """Stacked tree (leaves (w, *shape)) -> (w, padded) fp32."""
+        rows = [g.reshape(g.shape[0], -1).astype(jnp.float32)
+                for g in self.treedef.flatten_up_to(stacked)]
+        flat = jnp.concatenate(rows, axis=1)
+        pad = self.padded - self.numel
+        return jnp.pad(flat, ((0, 0), (0, pad))) if pad else flat
+
+    def slices(self, flat):
+        """(padded,) buffer -> per-leaf tree of reshaped views."""
+        leaves = [flat[off:off + n].reshape(shape)
+                  for off, n, shape in self.leaf_meta]
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+    def unflatten_like(self, flat, like):
+        """(padded,) buffer -> tree in the dtypes of ``like``."""
+        return jax.tree_util.tree_map(
+            lambda x, l: x.astype(l.dtype), self.slices(flat), like)
+
+
+# ---------------------------------------------------- in-collective mode
+def qc_padded_size(n, world_size, block_size=DEFAULT_BLOCK_SIZE):
+    """Lanes the in-collective exchange needs: a multiple of
+    ``world * block_size`` so every per-rank chunk (and, hierarchically,
+    every sub-chunk) is whole blocks. ``world`` is the PRODUCT of the
+    group sizes across levels."""
+    mult = int(world_size) * int(block_size)
+    return ((int(n) + mult - 1) // mult) * mult
+
+
+def ring_reduce_scatter_inline(x, axis_name, world_size,
+                               block_size=DEFAULT_BLOCK_SIZE):
+    """EQuARX in-collective ring reduce-scatter per-device body (call
+    inside shard_map over ``axis_name``).
+
+    ``x``: this device's full-length partial-sum buffer of size
+    ``world_size * chunk`` with ``chunk`` divisible by ``block_size``;
+    chunk w is destined to rank w. Each of the ``world-1`` ring hops
+    moves ONE quantized chunk (int8 blocks + per-block scales); the
+    receiver dequantizes to fp32, accumulates its own fp32 contribution,
+    and requantizes for the next hop — NOT quantize-once-then-sum, so
+    the reduction itself never leaves fp32 and the final addition (my
+    own chunk) is exact. Returns my rank's fp32-accumulated chunk.
+    """
+    chunk = x.size // world_size
+    local = x.astype(jnp.float32).reshape(world_size, chunk)
+    if world_size == 1:
+        return local[0]
+    rank = jax.lax.axis_index(axis_name)
+    w = jnp.int32(world_size)
+
+    def take(idx):
+        return jnp.take(local, jnp.mod(idx, w), axis=0)
+
+    perm = [(i, (i + 1) % world_size) for i in range(world_size)]
+    # the partial for chunk c starts at device (c+1) mod w and terminates
+    # (fully accumulated) at device c after world-1 hops
+    acc = take(rank - 1)
+    for s in range(world_size - 1):
+        q, scales = quantize_blockwise(acc, block_size)
+        q = jax.lax.ppermute(q, axis_name, perm)
+        scales = jax.lax.ppermute(scales, axis_name, perm)
+        incoming = dequantize_blockwise(q, scales, chunk, jnp.float32)
+        acc = incoming + take(rank - 2 - s)
+    return acc
+
+
+def quantized_all_reduce_local(x, axis_name, world_size,
+                               block_size=DEFAULT_BLOCK_SIZE):
+    """Flat in-collective all-reduce SUM per-device body: EQuARX ring
+    reduce-scatter then int8 all-gather. ``x``: (n,) local partials with
+    n divisible by ``world * block``. Returns the (n,) fp32 global sum
+    (the caller divides by world for a mean)."""
+    chunk = ring_reduce_scatter_inline(x, axis_name, world_size,
+                                       block_size)
+    if world_size == 1:
+        return chunk
+    return quantized_all_gather_local(chunk, axis_name, block_size)
+
+
+def hierarchical_all_reduce_local(x, shard_axis, replica_axis, shard_size,
+                                  replica_size,
+                                  block_size=DEFAULT_BLOCK_SIZE):
+    """Two-level in-collective all-reduce SUM (The Big Send-off,
+    arXiv:2504.18658), composing with the hpZ-factored mesh: intra-shard
+    ring RS → cross-replica ring RS + int8 AG on the 1/shard chunk →
+    intra-shard int8 AG. ``x``: (n,) with n divisible by
+    ``shard * replica * block``. Most wire hops cross only the
+    ICI-adjacent ``data_shard`` group; the ``data_replica`` hop moves
+    ``1/shard`` of the payload. Returns the (n,) fp32 global sum."""
+    chunk_s = ring_reduce_scatter_inline(x, shard_axis, shard_size,
+                                         block_size)
+    if replica_size > 1:
+        chunk_r = ring_reduce_scatter_inline(chunk_s, replica_axis,
+                                             replica_size, block_size)
+        chunk_s = quantized_all_gather_local(chunk_r, replica_axis,
+                                             block_size)
+    if shard_size > 1:
+        return quantized_all_gather_local(chunk_s, shard_axis, block_size)
+    return chunk_s
+
+
 # ------------------------------------------------------------ mesh transports
 class QuantizedCollectives:
-    """CompressedBackend-style façade: blockwise-int8 all-gather /
-    reduce-scatter over one mesh axis, jitted through shard_map.
+    """CompressedBackend-style façade: blockwise-int8 collectives over
+    the mesh's data axis (or its hpZ-factored sub-axes), jitted through
+    shard_map.
 
     ``all_gather(values)``: (world, n) stacked shards -> (world, world*n)
     gathered rows. ``reduce_scatter(values)``: (world, world*chunk)
-    per-rank partials -> (world, chunk) summed chunks.
+    per-rank partials -> (world, chunk) summed chunks. ``all_reduce
+    (values)``: (world, n) per-rank partials -> (world, n) summed rows
+    through the IN-COLLECTIVE ring (EQuARX per-hop requantization), with
+    the two-level hierarchical decomposition on a factored mesh.
     """
 
     def __init__(self, mesh, axis=None, block_size=DEFAULT_BLOCK_SIZE):
-        from ...parallel.topology import DATA_AXIS
+        from ...parallel.topology import (DATA_AXIS, DATA_REPLICA_AXIS,
+                                          DATA_SHARD_AXIS)
         self.mesh = mesh
-        self.axis = DATA_AXIS if axis is None else axis
-        self.world_size = int(mesh.shape[self.axis])
+        if axis is None:
+            axis = DATA_AXIS if DATA_AXIS in mesh.shape else \
+                (DATA_REPLICA_AXIS, DATA_SHARD_AXIS)
+        self.axis = axis
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        self.world_size = int(np.prod([mesh.shape[a] for a in axes],
+                                      dtype=np.int64))
+        self.hierarchical = isinstance(axis, tuple) and len(axes) > 1
         self.block_size = block_size
         self._jit_cache = {}
 
@@ -300,10 +454,25 @@ class QuantizedCollectives:
         if key in self._jit_cache:
             return self._jit_cache[key]
         axis, world, block = self.axis, self.world_size, self.block_size
+        mesh = self.mesh
 
         if kind == "all_gather":
             def per_device(v):
                 return quantized_all_gather_local(v[0], axis, block)[None]
+        elif kind == "all_reduce":
+            if self.hierarchical:
+                replica_axis, shard_axis = axis
+                wr = int(mesh.shape[replica_axis])
+                ws = int(mesh.shape[shard_axis])
+
+                def per_device(v):
+                    return hierarchical_all_reduce_local(
+                        v[0], shard_axis, replica_axis, ws, wr,
+                        block)[None]
+            else:
+                def per_device(v):
+                    return quantized_all_reduce_local(v[0], axis, world,
+                                                      block)[None]
         else:
             def per_device(v):
                 out, _ = quantized_reduce_scatter_local(v[0], axis, world,
@@ -322,3 +491,11 @@ class QuantizedCollectives:
     def reduce_scatter(self, values):
         assert values.shape[-1] % self.world_size == 0, values.shape
         return self._build("reduce_scatter", values.shape[-1])(values)
+
+    def all_reduce(self, values):
+        """In-collective quantized SUM of the stacked (world, n) rows;
+        n must be ``qc_padded_size``-aligned for the mesh's group
+        sizes."""
+        assert values.shape[-1] % (self.world_size * self.block_size) \
+            == 0, (values.shape, self.world_size, self.block_size)
+        return self._build("all_reduce", values.shape[-1])(values)

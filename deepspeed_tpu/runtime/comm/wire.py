@@ -106,6 +106,17 @@ def overlap_report(wire_est, step_time_s, fused_classes, device):
         "allgather": float(wire_est.get("allgather_bytes_per_step", 0) or 0),
         "reduce": float(wire_est.get("reduce_bytes_per_step", 0) or 0),
     }
+    # the 1-bit momentum exchange is its own class when live (the
+    # compressed-comm tier, docs/onebit_adam.md)
+    opt_bytes = float(wire_est.get("optimizer_bytes_per_step", 0) or 0)
+    if opt_bytes:
+        classes["optimizer"] = opt_bytes
+    # per-class fp32-baseline reduction ratios from the estimator
+    # (wire_est["reduction_x"]: weight/gradient/optimizer vocabulary)
+    red = wire_est.get("reduction_x") or {}
+    red_by_class = {"allgather": red.get("weight"),
+                    "reduce": red.get("gradient"),
+                    "optimizer": red.get("optimizer")}
     est = {k: v / bw for k, v in classes.items()}
     exposed = {k: (0.0 if fused_classes.get(k) else est[k])
                for k in classes}
@@ -119,8 +130,75 @@ def overlap_report(wire_est, step_time_s, fused_classes, device):
             "exposed_s": round(exposed[k], 9),
             "overlap_efficiency": round(compute / (compute + exposed[k]),
                                         6),
+            "reduction_x": red_by_class.get(k),
         }
     return out
+
+
+def quantized_allreduce_bytes(numel, world, block_size=DEFAULT_BLOCK_SIZE,
+                              levels=None, scale_itemsize=_FP32_BYTES,
+                              min_component=0):
+    """Per-device wire bytes of ONE in-collective quantized all-reduce
+    (``quantized_all_reduce_local`` /
+    ``hierarchical_all_reduce_local``): a ring reduce-scatter whose
+    every hop moves one int8 chunk + its fp32 block scales (two
+    collective-permute instructions per hop), then an int8 all-gather
+    (+ scales gather). ``levels=(shard, replica)`` prices the two-level
+    decomposition (2504.18658): the full payload over the shard group,
+    the 1/shard chunk over the replica group. ``min_component`` drops
+    per-INSTRUCTION components below the HLO census threshold so the
+    estimate reconciles instruction-for-instruction
+    (analysis/hlo.py)."""
+    from .quantize import qc_padded_size
+    padded = qc_padded_size(numel, world, block_size)
+
+    def keep(b):
+        return int(b) if b >= min_component else 0
+
+    def level(n, g):
+        if g <= 1:
+            return 0
+        chunk = n // g
+        nblocks = chunk // block_size
+        total = 0
+        # ring RS: g-1 hops, each one q-chunk ppermute + one scales
+        # ppermute (census prices a collective-permute at its payload)
+        total += (g - 1) * (keep(chunk) +
+                            keep(nblocks * scale_itemsize))
+        # int8 AG back: result g*chunk -> (g-1)*chunk on the wire
+        total += keep((g - 1) * chunk)
+        total += keep((g - 1) * nblocks * scale_itemsize)
+        return total
+
+    if levels:
+        shard, replica = levels
+        assert shard * replica == world, (levels, world)
+        return level(padded, shard) + level(padded // shard, replica)
+    return level(padded, world)
+
+
+def onebit_exchange_bytes(numel, world, scale_itemsize=_FP32_BYTES,
+                          min_component=0, itemsize_bits=1):
+    """Per-device wire bytes of ONE compressed momentum allreduce
+    (runtime/comm/onebit.py): the worker ``all_to_all`` of packed sign
+    chunks + scalar-scale all-gather, then the server sign all-gather +
+    its scales — the reference 2-phase pipeline. ``itemsize_bits=32``
+    prices the SAME exchange uncompressed (the fp32-equivalent
+    denominator of the optimizer-class reduction ratio)."""
+    from .onebit import onebit_padded_size
+    padded = onebit_padded_size(numel, world)
+    ring = _ring_factor(world)
+    payload = padded * itemsize_bits // 8
+
+    def keep(b):
+        return int(b) if b >= min_component else 0
+
+    total = 0
+    total += keep(int(round(payload * ring)))              # worker a2a
+    total += keep(int(round(world * scale_itemsize * ring)))
+    total += keep(int(round(payload * ring)))              # server AG
+    total += keep(int(round(world * scale_itemsize * ring)))
+    return total
 
 
 def _payload(numel, itemsize, quantized, scale_itemsize, block_size):
@@ -133,7 +211,8 @@ def _payload(numel, itemsize, quantized, scale_itemsize, block_size):
 def _price_tree(params, eligible_fn, stage, dp, gather_group, gas,
                 compute_itemsize, grad_itemsize, quantized_weights,
                 quantized_gradients, block_size, gathers_per_micro=2,
-                explicit_gather_grad_itemsize=None, tp_ways_fn=None):
+                explicit_gather_grad_itemsize=None, tp_ways_fn=None,
+                replicate_itemsize=None, min_component=0):
     """The one pricing body both entry points share.
 
     ``eligible_fn(path, shape, numel) -> bool``: is this leaf a stage-3
@@ -151,6 +230,8 @@ def _price_tree(params, eligible_fn, stage, dp, gather_group, gas,
     """
     from .quantize import _lastdim_block
     from ..zero.partition import _path_str
+    if replicate_itemsize is None:
+        replicate_itemsize = compute_itemsize
     totals = {"allgather_bytes": 0.0, "reduce_bytes": 0.0}
 
     def leaf(path, p):
@@ -171,9 +252,16 @@ def _price_tree(params, eligible_fn, stage, dp, gather_group, gas,
                 any(d % dp == 0 for d in shape):
             # updated-partition re-replication, once per step (the plan
             # only shards — and thus re-gathers — leaves with a
-            # dp-divisible dim; others stay replicated)
-            totals["allgather_bytes"] += wire_numel * compute_itemsize * \
-                _ring_factor(dp)
+            # dp-divisible dim; others stay replicated). Census ground
+            # truth (PR 12, mirroring PR 10's reduce-dtype finding): the
+            # partitioner gathers the MASTER-dtype value and the convert
+            # to the compute dtype lands after, so the wire moves
+            # ``replicate_itemsize`` (fp32 under mixed precision).
+            # ``min_component`` drops per-leaf instructions below the
+            # census threshold when reconciling.
+            leaf_wire = wire_numel * replicate_itemsize * _ring_factor(dp)
+            if leaf_wire >= min_component:
+                totals["allgather_bytes"] += leaf_wire
         if dp > 1:
             gi = grad_itemsize
             if eligible and explicit_gather_grad_itemsize is not None:
@@ -197,6 +285,7 @@ def estimate_step_comm_bytes(plan, params, gas=1, compute_itemsize=4,
                              block_size=DEFAULT_BLOCK_SIZE,
                              gathers_per_micro=2,
                              explicit_gather_grad_itemsize=None,
+                             replicate_itemsize=None, min_component=0,
                              _force_flat_fp32=False):
     """Per-device collective bytes for ONE optimizer step under ``plan``.
 
@@ -214,6 +303,7 @@ def estimate_step_comm_bytes(plan, params, gas=1, compute_itemsize=4,
         compute_itemsize = grad_itemsize = _FP32_BYTES
         quantized_weights = quantized_gradients = False
         explicit_gather_grad_itemsize = None
+        replicate_itemsize = _FP32_BYTES
     return _price_tree(
         params,
         lambda path, shape, numel: plan.param_is_data_sharded(
@@ -227,7 +317,8 @@ def estimate_step_comm_bytes(plan, params, gas=1, compute_itemsize=4,
         quantized_gradients=quantized_gradients, block_size=block_size,
         gathers_per_micro=gathers_per_micro,
         explicit_gather_grad_itemsize=explicit_gather_grad_itemsize,
-        tp_ways_fn=plan.tp_ways)
+        tp_ways_fn=plan.tp_ways, replicate_itemsize=replicate_itemsize,
+        min_component=min_component)
 
 
 def project_comm_bytes(params, stage, dp, gas=1, compute_itemsize=4,
@@ -253,11 +344,68 @@ def project_comm_bytes(params, stage, dp, gas=1, compute_itemsize=4,
         quantized_gradients=quantized_gradients, block_size=block_size)
 
 
-def estimate_engine_comm_bytes(engine):
+def _compressed_comm_classes(engine, min_component=0):
+    """The compressed-comm tier's per-step byte classes, when live:
+    returns (reduce_bytes, optimizer_bytes, fp32_equiv_optimizer_bytes,
+    regime) or None on the GSPMD oracle path.
+
+    OneBitAdam warmup / quantized-collectives: the gradient (reduce)
+    class is the in-collective int8 exchange — per STEP under OneBitAdam
+    (the engine averages the accumulated stacked grads once in the
+    apply), per MICRO-step in pure exchange mode — or the fp32 stacked
+    mean for onebit-without-qc warmup. OneBitAdam frozen: gradients
+    never cross the wire (reduce = 0); the 1-bit momentum exchange is
+    its own ``optimizer`` class."""
+    mode_fn = getattr(engine, "_local_grad_mode", None)
+    mode = mode_fn() if mode_fn is not None else None
+    if mode is None:
+        return None
+    import jax
+    params = engine.state["params"] if engine.state is not None and \
+        engine.state.get("params") is not None else engine.model.params
+    numel = sum(int(np.prod(np.shape(p))) if np.shape(p) else 1
+                for p in jax.tree_util.tree_leaves(params))
+    dp = engine.zero_plan.dp_size
+    gas = engine.gradient_accumulation_steps()
+    qc = getattr(engine, "_qc", None)
+    levels = None
+    if isinstance(engine._batch_axis, tuple):
+        replica_axis, shard_axis = engine._batch_axis
+        levels = (int(engine.mesh.shape[shard_axis]),
+                  int(engine.mesh.shape[replica_axis]))
+
+    def qc_bytes():
+        return quantized_allreduce_bytes(
+            numel, dp, qc.block_size, levels=levels,
+            min_component=min_component)
+
+    if mode == "exchange":
+        return gas * qc_bytes(), 0, 0, None
+    frozen = engine._onebit_frozen()
+    if frozen:
+        opt = onebit_exchange_bytes(numel, dp,
+                                    min_component=min_component)
+        equiv = onebit_exchange_bytes(numel, dp, itemsize_bits=32,
+                                      min_component=min_component)
+        return 0, opt, equiv, "frozen"
+    if getattr(engine, "_qc_enabled", False):
+        # one exchange per step: the engine averages the ACCUMULATED
+        # stacked grads through the quantized ring in the apply step
+        return qc_bytes(), 0, 0, "warmup"
+    # uncompressed warmup: the per-leaf stacked mean lowers to fp32
+    # all-reduces over the data axis
+    return int(round(2 * _ring_factor(dp) * _FP32_BYTES * numel)), 0, 0, \
+        "warmup"
+
+
+def estimate_engine_comm_bytes(engine, min_component=0):
     """The engine's live config priced against the flat-fp32 baseline.
 
     JSON-ready dict: current-config and fp32-flat per-step bytes plus
     reduction ratios (>= 1 means the config moves fewer bytes).
+    ``min_component`` drops per-instruction components below the HLO
+    census threshold — pass the census ``min_bytes`` when reconciling
+    (analysis/hlo.reconcile_wire); the default 0 reports full bytes.
     """
     import jax.numpy as jnp
     plan = engine.zero_plan
@@ -280,12 +428,30 @@ def estimate_engine_comm_bytes(engine):
         quantized_weights=engine.zero_quantized_weights(),
         quantized_gradients=engine.zero_quantized_gradients(),
         explicit_gather_grad_itemsize=compute_itemsize
-        if explicit_gather else None)
+        if explicit_gather else None,
+        # stage 1-2 re-replication moves the MASTER dtype (census ground
+        # truth: the partitioner gathers before the compute-dtype
+        # convert lands)
+        replicate_itemsize=_FP32_BYTES if engine.mixed_precision
+        else compute_itemsize,
+        min_component=min_component)
     base = estimate_step_comm_bytes(plan, params, gas=gas,
                                     _force_flat_fp32=True)
 
     def ratio(b, c):
         return round(b / c, 2) if c else None
+
+    # compressed-comm tier (OneBitAdam / quantized_collectives): the
+    # gradient class is replaced by the live exchange's bytes, and the
+    # frozen-regime 1-bit momentum exchange is its own class
+    comp = _compressed_comm_classes(engine, min_component=min_component)
+    opt_bytes = equiv_opt = 0
+    onebit_regime = None
+    if comp is not None:
+        cur = dict(cur)
+        cur["reduce_bytes"], opt_bytes, equiv_opt, onebit_regime = comp
+        cur["total_bytes"] = cur["allgather_bytes"] + \
+            cur["reduce_bytes"] + opt_bytes
 
     out = {
         "zero_stage": plan.stage,
@@ -294,15 +460,39 @@ def estimate_engine_comm_bytes(engine):
         "quantized_gradients": engine.zero_quantized_gradients(),
         "allgather_bytes_per_step": cur["allgather_bytes"],
         "reduce_bytes_per_step": cur["reduce_bytes"],
+        "optimizer_bytes_per_step": opt_bytes,
         "total_bytes_per_step": cur["total_bytes"],
         "fp32_flat_allgather_bytes_per_step": base["allgather_bytes"],
         "fp32_flat_reduce_bytes_per_step": base["reduce_bytes"],
+        "fp32_equiv_optimizer_bytes_per_step": equiv_opt,
         "fp32_flat_total_bytes_per_step": base["total_bytes"],
         "allgather_reduction_x": ratio(base["allgather_bytes"],
                                        cur["allgather_bytes"]),
         "total_reduction_x": ratio(base["total_bytes"],
                                    cur["total_bytes"]),
+        # per-class fp32-baseline ratios (the bench extra.comm block):
+        # weight = the param all-gathers; gradient = every byte carrying
+        # gradient information (the grad reduce + the frozen-regime
+        # momentum exchange that replaces it); optimizer = the momentum
+        # exchange vs the SAME exchange uncompressed
+        "reduction_x": {
+            "weight": ratio(base["allgather_bytes"],
+                            cur["allgather_bytes"]),
+            "gradient": ratio(base["reduce_bytes"],
+                              cur["reduce_bytes"] + opt_bytes),
+            "optimizer": ratio(equiv_opt, opt_bytes),
+        },
     }
+    if onebit_regime is not None:
+        out["onebit_regime"] = onebit_regime
+    if getattr(engine, "_qc_enabled", False):
+        qc = engine._qc
+        out["quantized_collectives"] = {
+            "enabled": True,
+            "dtype": qc.dtype,
+            "block_size": int(qc.block_size),
+            "hierarchical": isinstance(engine._batch_axis, tuple),
+        }
     cm = getattr(engine, "_cm", None)
     if cm is not None and cm.enabled:
         # marker only: a ring-decomposed collective moves the bytes of

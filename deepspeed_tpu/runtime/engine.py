@@ -334,6 +334,44 @@ class DeepSpeedEngine:
             else:
                 self.mesh = factor_data_axis(self.mesh, hpz)
                 self._batch_axis = (DATA_REPLICA_AXIS, DATA_SHARD_AXIS)
+        # comm.quantized_collectives.hierarchical=N: factor the data axis
+        # for the two-level in-collective decomposition (2504.18658) even
+        # below stage 3 (where hpZ itself is inert). Placement of
+        # master/grad state is identical to the flat plan (it shards over
+        # BOTH sub-axes); only the collective decomposition changes.
+        qc = self._config.comm_config.quantized_collectives
+        if qc.enabled and qc.hierarchical >= 2:
+            from ..parallel.topology import (factor_data_axis as _factor,
+                                             DATA_REPLICA_AXIS as _DR,
+                                             DATA_SHARD_AXIS as _DS,
+                                             DATA_AXIS as _DA)
+            if _DS in self.mesh.shape:
+                if int(self.mesh.shape[_DS]) != qc.hierarchical:
+                    raise ValueError(
+                        "comm.quantized_collectives.hierarchical={} "
+                        "conflicts with the hpZ-factored mesh (data_shard"
+                        "={}); use hierarchical=0 to follow the mesh"
+                        .format(qc.hierarchical,
+                                int(self.mesh.shape[_DS])))
+            elif self._batch_axis != _DA:
+                raise ValueError(
+                    "comm.quantized_collectives.hierarchical needs a "
+                    "'data' mesh axis to factor; mesh has {}".format(
+                        dict(self.mesh.shape)))
+            elif int(self.mesh.shape[_DA]) <= 1:
+                # leave the mesh flat: _configure_quantized_collectives
+                # warns the documented dp<=1 no-op (raises under strict)
+                pass
+            elif int(self.mesh.shape[_DA]) % qc.hierarchical != 0:
+                # name OUR key — factor_data_axis's own error names
+                # zero_hierarchical_partition, which the user never set
+                raise ValueError(
+                    "comm.quantized_collectives.hierarchical={} must "
+                    "divide the data-parallel degree {}".format(
+                        qc.hierarchical, int(self.mesh.shape[_DA])))
+            else:
+                self.mesh = _factor(self.mesh, qc.hierarchical)
+                self._batch_axis = (_DR, _DS)
         self.zero_plan = ZeroShardingPlan(
             self.mesh, stage=stage,
             param_persistence_threshold=zc.param_persistence_threshold,
@@ -420,6 +458,7 @@ class DeepSpeedEngine:
 
         Off (the default) leaves every path exactly as before; the
         unfused XLA program stays the numerics oracle."""
+        self._configure_quantized_collectives()
         cm = self._config.comm_config.collective_matmul
         self._cm = cm
         self._cm_zero3 = False
@@ -485,6 +524,48 @@ class DeepSpeedEngine:
                     self._cm_zero3, self._cm_tp, cm.chunks, cm.dtype,
                     cm.backend),
                 ranks=[0])
+
+    def _configure_quantized_collectives(self):
+        """comm.quantized_collectives: replace the data-parallel gradient
+        allreduce with the in-collective int8 exchange
+        (runtime/comm/quantize.py, EQuARX 2506.17615). The micro step
+        computes per-device LOCAL gradients inside shard_map and averages
+        them through the quantized ring, so the compiled program's
+        data-axis wire is int8 blocks + scales instead of fp32 — the PR
+        10 HLO census verifies the bytes. Certified combinations only:
+        the local-grad body runs the model fully manual over the data
+        axis, so tensor/sequence/pipeline parallelism are rejected, and
+        ZeRO-3 (data-sharded compute params) cannot feed it."""
+        from ..telemetry.config import warn_or_raise_noop
+        qc = self._config.comm_config.quantized_collectives
+        self._qc = qc
+        self._qc_enabled = False
+        if not qc.enabled:
+            return
+        self._certify_local_grad_comm("comm.quantized_collectives")
+        if bool(self._config.zero_config.cpu_offload_params):
+            raise ValueError(
+                "comm.quantized_collectives is not a certified "
+                "combination with cpu_offload_params (the streamed "
+                "runner owns its own gradient path)")
+        dp = int(np.prod([self.mesh.shape[a] for a in
+                          (self._batch_axis if isinstance(
+                              self._batch_axis, tuple)
+                           else (self._batch_axis,))], dtype=np.int64))
+        if dp <= 1:
+            warn_or_raise_noop(
+                "comm.quantized_collectives has NO effect: the mesh has "
+                "no data-parallel degree to exchange over", qc.strict,
+                flag="comm.quantized_collectives.strict")
+            return
+        self._qc_enabled = True
+        log_dist(
+            "quantized_collectives ON: dtype={} block_size={} "
+            "hierarchical={} mesh={}".format(
+                qc.dtype, qc.block_size,
+                "({})".format(dict(self.mesh.shape))
+                if isinstance(self._batch_axis, tuple) else "flat",
+                dict(self.mesh.shape)), ranks=[0])
 
     def _apply_transformer_overrides(self):
         """``transformer.flash_attention``: flip the model config's
@@ -571,6 +652,7 @@ class DeepSpeedEngine:
             self.optimizer = client_optimizer
             log_dist("Using client optimizer {}".format(
                 type(client_optimizer).__name__), ranks=[0])
+            self._resolve_onebit_mode()
             return
 
         name = (self._config.optimizer_name or "adam").lower()
@@ -608,7 +690,66 @@ class DeepSpeedEngine:
             raise ValueError(
                 "zero_optimization.cpu_offload requires the Adam/AdamW "
                 "optimizer, got '{}'".format(name))
+        self._resolve_onebit_mode()
         log_dist("Using DeepSpeed optimizer: {}".format(name), ranks=[0])
+
+    def _certify_local_grad_comm(self, feature):
+        """The ONE certified-combination gate every local-grad comm
+        feature (quantized_collectives, OneBitAdam) passes: the body
+        runs the model fully manual over the data axis, so non-data mesh
+        axes are rejected; ZeRO-3's data-sharded compute params cannot
+        feed it; qgZ would double-quantize the same reduction."""
+        from ..parallel.topology import (MODEL_AXIS, PIPE_AXIS,
+                                         SEQUENCE_AXIS)
+        for axis in (PIPE_AXIS, MODEL_AXIS, SEQUENCE_AXIS):
+            if axis in self.mesh.shape and self.mesh.shape[axis] > 1:
+                raise ValueError(
+                    "{} is not a certified combination with the '{}' "
+                    "mesh axis (the local-grad exchange runs the model "
+                    "fully manual over the data axis only)".format(
+                        feature, axis))
+        if self._config.zero_optimization_stage >= 3:
+            raise ValueError(
+                "{} is not compatible with ZeRO stage 3 (data-sharded "
+                "compute params cannot feed the local-grad shard_map "
+                "body; stages 0-2 are supported — use "
+                "zero_quantized_weights/zero_quantized_gradients at "
+                "stage 3, docs/onebit_adam.md)".format(feature))
+        if self._config.zero_config.quantized_gradients:
+            raise ValueError(
+                "{} with zero_quantized_gradients (qgZ) double-"
+                "quantizes the gradient reduction — enable one (the "
+                "local-grad exchange moves real compressed wire; qgZ "
+                "models the codec on the GSPMD path)".format(feature))
+
+    def _resolve_onebit_mode(self):
+        """OneBitAdam: the micro step computes per-worker LOCAL grads
+        (stacked, shard_map over the data axis) and the apply step runs
+        the compressed momentum exchange — certified combinations only
+        (docs/onebit_adam.md)."""
+        from .fp16.onebit_adam import OnebitAdam
+        self._onebit_mode = isinstance(self.optimizer, OnebitAdam)
+        if not self._onebit_mode:
+            return
+        self._certify_local_grad_comm("OneBitAdam")
+        stage = self._config.zero_optimization_stage
+        if self.zero_cpu_offload():
+            raise ValueError(
+                "OneBitAdam is not compatible with cpu_offload (the "
+                "compressed exchange runs on device; the host step is "
+                "plain Adam)")
+        if self.gradient_clipping():
+            raise ValueError(
+                "OneBitAdam does not support gradient_clipping: the "
+                "global grad norm is never materialized in the "
+                "compressed regime (grads stay per-worker local)")
+        if float(getattr(self.optimizer, "weight_decay", 0.0) or 0.0) \
+                and stage >= 1:
+            raise ValueError(
+                "OneBitAdam weight_decay needs replicated params (the "
+                "L2 term feeds the fused flat momentum on every "
+                "worker); use ZeRO stage 0 or weight_decay=0")
+        self.optimizer.configure_comm(self.mesh)
 
     def _configure_lr_scheduler(self, client_lr_scheduler):
         if client_lr_scheduler is not None:
@@ -772,11 +913,14 @@ class DeepSpeedEngine:
         opt_state = self.optimizer.init_state(opt_target)
         # all per-param moments/buffers live with the master shards; state
         # shapes may differ from param shapes (e.g. OnebitAdam's flat error
-        # buffers), so shardings come from each subtree's own leaves
+        # buffers), so shardings come from each subtree's own leaves —
+        # unless the optimizer declares a placement (state_placements():
+        # OnebitAdam keeps the fused momentum replicated and the error
+        # tensors per-worker)
         opt_state = {
             key: val if key == "step" else jax.tree_util.tree_map(
                 lambda m, s: jax.device_put(m, s), val,
-                plan.tree_shardings(val, "master"))
+                self._opt_state_shardings(key, val))
             for key, val in opt_state.items()
         }
         acc_dtype = jnp.float32
@@ -794,10 +938,26 @@ class DeepSpeedEngine:
                     "storage is lossless only when the compute dtype "
                     "is bf16 too", jnp.dtype(self.compute_dtype).name)
             acc_dtype = jnp.bfloat16
-        acc_grads = jax.tree_util.tree_map(
-            lambda p, s: jax.device_put(
-                jnp.zeros(p.shape, dtype=acc_dtype), s),
-            params_f32, grad_sh)
+        if self._onebit_mode:
+            # per-worker LOCAL gradient accumulators: a leading (world,)
+            # dim sharded one row per device — the local-grad micro step
+            # writes its own row, the 1-bit exchange consumes them. The
+            # accumulation dtype stays fp32 (the exchange math is fp32).
+            if acc_dtype != jnp.float32:
+                logger.warning(
+                    "grad_accum_dtype=bf16 ignored under OneBitAdam: the "
+                    "compressed exchange consumes fp32 local grads")
+            w = self.dp_world_size
+            stacked_sh = self._stacked_grad_sharding()
+            acc_grads = jax.tree_util.tree_map(
+                lambda p: jax.device_put(
+                    jnp.zeros((w,) + p.shape, dtype=jnp.float32),
+                    stacked_sh), params_f32)
+        else:
+            acc_grads = jax.tree_util.tree_map(
+                lambda p, s: jax.device_put(
+                    jnp.zeros(p.shape, dtype=acc_dtype), s),
+                params_f32, grad_sh)
 
         self.state = {
             "params": compute_params,
@@ -916,7 +1076,100 @@ class DeepSpeedEngine:
                 block_size=DEFAULT_BLOCK_SIZE)
         return self._qwz_gather_tree_fn()
 
+    def _opt_state_shardings(self, key, val):
+        """Sharding tree for one optimizer-state subtree, honoring the
+        optimizer's placement hints (state_placements()): "replicated"
+        (OnebitAdam's fused momentum — every worker compresses the full
+        buffer), "stacked" (per-worker rows over the data axis), default
+        = the master-shard plan."""
+        hints = getattr(self.optimizer, "state_placements", None)
+        kind = (hints() if hints is not None else {}).get(key, "master")
+        if kind == "replicated":
+            rep = self.zero_plan.replicated()
+            return jax.tree_util.tree_map(lambda _: rep, val)
+        if kind == "stacked":
+            sh = self._stacked_grad_sharding()
+            return jax.tree_util.tree_map(lambda _: sh, val)
+        return self.zero_plan.tree_shardings(val, "master")
+
+    def _opt_constrain(self, key, val):
+        """with_sharding_constraint one optimizer-state subtree to its
+        resolved placement (the in-jit twin of _opt_state_shardings)."""
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.lax.with_sharding_constraint(x, s), val,
+            self._opt_state_shardings(key, val))
+
+    def _stacked_grad_sharding(self):
+        """One row per device over the data axis (or its factored
+        sub-axes): the layout of per-worker local grads / error state."""
+        return NamedSharding(self.mesh, P(self._batch_axis))
+
+    def _constrain_grads(self, tree):
+        """Sharding constraint for the accumulated-gradient tree: the
+        stacked per-worker layout under OneBitAdam, the ZeRO grad plan
+        otherwise."""
+        if getattr(self, "_onebit_mode", False):
+            sh = self._stacked_grad_sharding()
+            return jax.tree_util.tree_map(
+                lambda x: jax.lax.with_sharding_constraint(x, sh), tree)
+        return self.zero_plan.constrain(tree, "grad")
+
+    def _local_grad_mode(self):
+        """Which local-gradient micro-step variant is live: "stacked"
+        (OneBitAdam — grads stay per-worker for the momentum exchange),
+        "exchange" (quantized_collectives with a plain optimizer — grads
+        average through the in-collective int8 ring inside the micro
+        step), or None (the GSPMD oracle path)."""
+        if getattr(self, "_onebit_mode", False):
+            return "stacked"
+        if getattr(self, "_qc_enabled", False):
+            return "exchange"
+        return None
+
+    def _flat_grad_meta(self):
+        """The fused flat-gradient-buffer layout the quantized exchange
+        rides (comm.quantize.FusedFlatLayout — the SAME layout helper
+        OnebitAdam's momentum buffer uses), padded to whole blocks per
+        rank chunk (qc_padded_size)."""
+        if getattr(self, "_flat_meta_cache", None) is not None:
+            return self._flat_meta_cache
+        from .comm.quantize import FusedFlatLayout, qc_padded_size
+        params = self.state["params"] if self.state is not None and \
+            self.state.get("params") is not None else self.model.params
+        self._flat_meta_cache = FusedFlatLayout(
+            params, lambda n: qc_padded_size(n, self.dp_world_size,
+                                             self._qc.block_size))
+        return self._flat_meta_cache
+
+    def _qc_exchange_fn(self):
+        """The in-collective quantized all-reduce over a fused flat fp32
+        buffer, resolved for this mesh: the two-level hierarchical
+        decomposition on a factored data axis, the flat EQuARX ring
+        otherwise. Returns a per-device body: (padded,) local partials ->
+        (padded,) fp32 global SUM (call inside shard_map)."""
+        from .comm.quantize import (hierarchical_all_reduce_local,
+                                    quantized_all_reduce_local)
+        block = self._qc.block_size
+        if isinstance(self._batch_axis, tuple):
+            replica_axis, shard_axis = self._batch_axis
+            wr = int(self.mesh.shape[replica_axis])
+            ws = int(self.mesh.shape[shard_axis])
+
+            def exchange(flat):
+                return hierarchical_all_reduce_local(
+                    flat, shard_axis, replica_axis, ws, wr, block)
+        else:
+            axis = self._batch_axis
+            world = self.dp_world_size
+
+            def exchange(flat):
+                return quantized_all_reduce_local(flat, axis, world,
+                                                  block)
+        return exchange
+
     def _micro_step_fn(self):
+        if self._local_grad_mode() is not None:
+            return self._local_grad_micro_fn()
         apply_fn = self.model.apply_fn
         gas = self.gradient_accumulation_steps()
         plan = self.zero_plan
@@ -978,12 +1231,157 @@ class DeepSpeedEngine:
 
         return micro
 
-    def _apply_step_fn(self):
+    def _local_grad_micro_fn(self):
+        """The local-gradient micro step (OneBitAdam / quantized
+        collectives): forward + backward run FULLY MANUAL over the data
+        axis inside shard_map, so each device's gradients are its OWN
+        micro-batch shard's — no GSPMD fp32 gradient psum is ever
+        emitted. "stacked" mode (OneBitAdam) accumulates the per-worker
+        grads as (world, ...) rows for the 1-bit momentum exchange;
+        "exchange" mode averages them through the in-collective int8
+        ring (EQuARX) right here, so downstream the step is byte-for-
+        byte the GSPMD program minus the fp32 reduce. The scalar loss is
+        pmean'd for reporting (a handful of wire bytes)."""
+        apply_fn = self.model.apply_fn
+        gas = self.gradient_accumulation_steps()
+        model = self.model
+        mode = self._local_grad_mode()
+        mesh = self.mesh
+        axes = self._batch_axis
+        world = self.dp_world_size
+        meta = self._flat_grad_meta() if mode == "exchange" else None
+        exchange = self._qc_exchange_fn() if mode == "exchange" else None
+        pld_live = self.progressive_layer_drop is not None
+        from ..parallel.topology import shard_map_compat
+
+        def micro(state, batch, rng, pld_theta=None):
+            leaves, batch_def = jax.tree_util.tree_flatten(batch)
+            specs = tuple(
+                P(axes) if getattr(leaf, "ndim", 0) >= 1 and
+                leaf.shape[0] % world == 0 else P()
+                for leaf in leaves)
+            scale = state["scaler"].cur_scale
+
+            def per_dev(compute_params, *local_leaves):
+                local_batch = jax.tree_util.tree_unflatten(
+                    batch_def, list(local_leaves))
+                lrng = rng
+                if lrng is not None and world > 1:
+                    # honest per-device dropout masks: fold the device's
+                    # position into the key (the GSPMD path draws one
+                    # global mask; statistically equivalent)
+                    lrng = jax.random.fold_in(
+                        lrng, jax.lax.axis_index(axes))
+                kwargs = {**model.rng_kwargs(lrng),
+                          **model.mode_kwargs(True)}
+                if pld_live:
+                    if model.accepts_kwarg("progressive_layer_drop"):
+                        kwargs["progressive_layer_drop"] = True
+                    if model.accepts_kwarg("pld_theta"):
+                        kwargs["pld_theta"] = pld_theta
+
+                def loss_fn(p):
+                    out = apply_fn(p, *local_batch, **kwargs)
+                    loss = self._loss_of(out)
+                    scaled = loss.astype(jnp.float32) * (scale / gas)
+                    return scaled, loss
+
+                (_, loss), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(compute_params)
+                loss = jax.lax.pmean(loss, axes)
+                if mode == "exchange":
+                    flat = meta.flatten(grads)
+                    summed = exchange(flat)
+                    mean = summed * jnp.float32(1.0 / world)
+                    return loss, meta.unflatten_like(mean, grads)
+                return loss, jax.tree_util.tree_map(
+                    lambda g: g[None].astype(jnp.float32), grads)
+
+            out_spec = P() if mode == "exchange" else P(axes)
+            sharded = shard_map_compat(
+                per_dev, mesh=mesh, in_specs=(P(),) + specs,
+                out_specs=(P(), out_spec))
+            loss, grads = sharded(state["params"], *leaves)
+            new_state = dict(state)
+            new_acc = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(a.dtype), state["acc_grads"],
+                grads)
+            new_state["acc_grads"] = self._constrain_grads(new_acc)
+            return new_state, loss
+
+        return micro
+
+    def _onebit_frozen(self):
+        """Whether the NEXT optimizer step runs OneBitAdam's compressed
+        regime — host-side, so the engine compiles one program per
+        regime (global_steps counts attempted steps; under overflow
+        skips it can run ahead of the device step counter by
+        skipped_steps, documented in docs/onebit_adam.md)."""
+        return getattr(self, "_onebit_mode", False) and \
+            self.optimizer.frozen_at(self.global_steps)
+
+    def _regime_jit_key(self, base):
+        """Jit-cache key for a step program that differs by OneBitAdam
+        regime; invalidates the cached wire estimate when the regime
+        flips (the compressed wire differs from warmup's)."""
+        if not getattr(self, "_onebit_mode", False):
+            return base
+        frozen = self._onebit_frozen()
+        if frozen != getattr(self, "_onebit_last_regime", None):
+            self._onebit_last_regime = frozen
+            self._tele_wire = "unset"
+        return base + ("@ob_frozen" if frozen else "@ob_warmup")
+
+    def _apply_step_fn(self, frozen=None):
         plan = self.zero_plan
         optimizer = self.optimizer
         clip = self.gradient_clipping()
         mixed = self.mixed_precision
         compute_dtype = self.compute_dtype
+        onebit = getattr(self, "_onebit_mode", False)
+        if frozen is None:
+            frozen = self._onebit_frozen()
+        qc_meta = qc_exchange = None
+        if onebit and not frozen and getattr(self, "_qc_enabled", False):
+            qc_meta = self._flat_grad_meta()
+            qc_exchange = self._qc_exchange_fn()
+        world = self.dp_world_size
+
+        def _onebit_grads(grads):
+            """Per-worker stacked grads -> (update grads, grad_norm).
+            Warmup: average the workers (through the in-collective int8
+            ring when quantized_collectives is on, the plain fp32
+            allreduce otherwise) — exact Adam follows. Frozen: grads
+            STAY per-worker (the 1-bit momentum exchange consumes them);
+            grad_norm is the RMS-over-workers estimate
+            sqrt(sum_w ||g_w||^2 / w) — equal to the true norm when
+            workers agree, an upper bound otherwise (the averaged
+            gradient is never materialized in this regime)."""
+            if frozen:
+                norm = get_grad_norm(grads) / \
+                    jnp.sqrt(jnp.float32(world))
+                return grads, True, norm
+            if qc_exchange is not None:
+                from jax.sharding import PartitionSpec as SMP
+                from ..parallel.topology import shard_map_compat
+
+                def per_dev(stacked_leaves):
+                    flat = qc_meta.flatten(
+                        jax.tree_util.tree_map(lambda g: g[0],
+                                               stacked_leaves))
+                    summed = qc_exchange(flat)
+                    return summed * jnp.float32(1.0 / world)
+
+                sharded = shard_map_compat(
+                    per_dev, mesh=self.mesh,
+                    in_specs=(SMP(self._batch_axis),), out_specs=SMP())
+                mean_flat = sharded(grads)
+                like = jax.tree_util.tree_map(lambda g: g[0], grads)
+                avg = qc_meta.unflatten_like(mean_flat, like)
+            else:
+                avg = jax.tree_util.tree_map(
+                    lambda g: g.mean(axis=0), grads)
+            return avg, False, get_grad_norm(avg)
 
         def apply_step(state, hyper):
             scaler = state["scaler"]
@@ -994,16 +1392,26 @@ class DeepSpeedEngine:
             # unscale/clip/update math always runs fp32
             grads = jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.float32) * inv_scale, grads)
-            if clip > 0:
-                grads, grad_norm = clip_grad_norm_(grads, clip)
-            else:
-                grad_norm = get_grad_norm(grads)
-
             target = state["master"] if mixed else state["params"]
-            new_target, new_opt = optimizer.update(
-                grads, state["opt"], target, lr=hyper["lr"],
-                beta1=hyper["beta1"], beta2=hyper["beta2"], eps=hyper["eps"],
-                weight_decay=hyper["weight_decay"])
+            if onebit:
+                # clip is rejected at config time for OneBitAdam
+                grads, stacked, grad_norm = _onebit_grads(grads)
+                new_target, new_opt = optimizer.update(
+                    grads, state["opt"], target, lr=hyper["lr"],
+                    beta1=hyper["beta1"], beta2=hyper["beta2"],
+                    eps=hyper["eps"],
+                    weight_decay=hyper["weight_decay"],
+                    frozen=frozen, averaged=not stacked)
+            else:
+                if clip > 0:
+                    grads, grad_norm = clip_grad_norm_(grads, clip)
+                else:
+                    grad_norm = get_grad_norm(grads)
+                new_target, new_opt = optimizer.update(
+                    grads, state["opt"], target, lr=hyper["lr"],
+                    beta1=hyper["beta1"], beta2=hyper["beta2"],
+                    eps=hyper["eps"],
+                    weight_decay=hyper["weight_decay"])
 
             # Branchless overflow-skip (reference engine.py:1073-1083 +
             # stage2.py overflow path): select old state when overflowed.
@@ -1023,13 +1431,24 @@ class DeepSpeedEngine:
                 new_state["params"] = plan.constrain(new_params, "param")
             else:
                 new_state["params"] = plan.constrain(new_target, "param")
-            new_state["acc_grads"] = plan.constrain(
-                jax.tree_util.tree_map(jnp.zeros_like, state["acc_grads"]),
-                "grad")
+            new_state["acc_grads"] = self._constrain_grads(
+                jax.tree_util.tree_map(jnp.zeros_like,
+                                       state["acc_grads"]))
             new_state["opt"] = {
-                key: val if key == "step" else plan.constrain(val, "master")
+                key: val if key == "step" else self._opt_constrain(key,
+                                                                   val)
                 for key, val in new_opt.items()
             }
+            # an overflowed window compressed inf/nan through the 1-bit
+            # codec — the worker/server residuals are poisoned; zero them
+            # with the skip, like qg_error below (the optimizer declares
+            # which subtrees are error feedback)
+            for err_key in getattr(optimizer, "error_state_keys", ()):
+                if err_key in new_state["opt"]:
+                    new_state["opt"][err_key] = jax.tree_util.tree_map(
+                        lambda e: jnp.where(overflow, jnp.zeros_like(e),
+                                            e),
+                        new_state["opt"][err_key])
             new_state["scaler"] = ls.update_scale(scaler, overflow)
             if "qg_error" in state:
                 # an overflowed micro window quantized inf/nan grads, so
@@ -1164,6 +1583,9 @@ class DeepSpeedEngine:
         fused = {
             "allgather": bool(getattr(self, "_cm_zero3", False)),
             "reduce": False,
+            # the 1-bit momentum exchange (its class appears when live)
+            # is never ring-fused into compute
+            "optimizer": False,
         }
         return overlap_report(self._telemetry_wire(), step_time_s, fused,
                               self.telemetry._device)
@@ -2016,7 +2438,8 @@ class DeepSpeedEngine:
         elif self.host_state is not None:
             metrics = self._host_apply_step()
         else:
-            apply_fn = self._jit_priced("apply", self._apply_step_fn,
+            apply_fn = self._jit_priced(self._regime_jit_key("apply"),
+                                        self._apply_step_fn,
                                         self.state, self._hyper())
             self.state, metrics = apply_fn(self.state, self._hyper())
         self._step_metrics = {k: v for k, v in metrics.items()}
@@ -2088,7 +2511,8 @@ class DeepSpeedEngine:
             batch = self._to_device_stacked(batch)
             self._telemetry_add_tokens(batch)
             self._rng, step_rng = jax.random.split(self._rng)
-            fused = self._jit_priced("fused_train", self._fused_train_fn,
+            fused = self._jit_priced(self._regime_jit_key("fused_train"),
+                                     self._fused_train_fn,
                                      self.state, batch, step_rng,
                                      self._hyper(), self._pld_theta())
             self.state, (mean_loss, metrics) = fused(
@@ -2955,7 +3379,7 @@ class DeepSpeedEngine:
                 key: jnp.asarray(val) if key == "step" else
                 jax.tree_util.tree_map(
                     lambda x, s: jax.device_put(jnp.asarray(x, jnp.float32), s),
-                    val, plan.tree_shardings(val, "master"))
+                    val, self._opt_state_shardings(key, val))
                 for key, val in opt.items()
             }
 

@@ -1,21 +1,37 @@
-"""1-bit Adam: communication-compressed Adam.
+"""1-bit Adam: communication-compressed Adam with error feedback.
 
 Reference parity: deepspeed/runtime/fp16/onebit/adam.py. Two phases:
-  * warmup (< freeze_step): exact Adam — full-precision gradient averaging;
-  * compression (>= freeze_step): the variance (exp_avg_sq) is frozen and
-    the *momentum* is what crosses the wire, sign-compressed with error
-    feedback (reference :201-219 via NcclBackend.compressed_allreduce).
 
-The sign-pack + all_to_all + all_gather transport lives in
-runtime/comm/compressed.py. Under the engine's GSPMD path gradients arrive
-globally averaged, so every rank's momentum is identical and the reference's
-compressed allreduce degenerates to its two quantization stages (worker
-compress -> server average of equal values -> server compress), each with
-its own error-feedback accumulator. That exact degenerate pipeline is what
-``update`` applies in the frozen phase — numerics match the reference's
-convergence behavior, and the same ``_compress``/``unpack_signs`` kernels
-carry the real multi-worker exchange when driven through
-``CompressedBackend`` under shard_map.
+  * warmup (< ``freeze_step``): exact Adam — the per-worker local
+    gradients are averaged at full precision (or through the
+    in-collective int8 ring when ``comm.quantized_collectives`` is on);
+  * compression (>= ``freeze_step``): the variance (``exp_avg_sq``) is
+    FROZEN and the *momentum* is what crosses the wire: each worker
+    updates its local momentum from its LOCAL gradient, sign-compresses
+    it with persistent fp32 worker error feedback, and the exchange runs
+    as a real ``shard_map`` reduce-scatter / all-gather pair
+    (runtime/comm/onebit.py) — ``all_to_all`` of sign-bit chunks, server
+    averaging, server-error-compensated re-compression, ``all_gather``
+    back — so GSPMD sees 1-bit collectives and the wire moves ``n/8``
+    bytes where fp32 moved ``4n``.
+
+The momentum lives as ONE flat fused buffer (``exp_avg["_flat"]``, the
+reference fuses its buckets the same way) replicated across the data
+axis; worker/server error state is per-worker (leading ``world`` dim,
+sharded one row per device) and rides checkpoints inside the optimizer
+state like any other moment — save/resume is bit-exact (the engine
+resets both error tensors on overflow, like qg_error). The engine feeds
+this optimizer STACKED local gradients (leaves ``(world, *shape)``) from
+its local-grad ``shard_map`` micro step; ``frozen`` is compiled in
+host-side by the engine (one program per regime — a warmup run never
+executes compression code, and the transition is a plain re-jit over
+identical state).
+
+Reference-key surface (mirrored, docs/onebit_adam.md): ``freeze_step``
+is honored; ``cuda_aware=True`` is REJECTED loudly (there is no CUDA
+transport — the exchange rides ICI through shard_map); NCCL/MPI
+``comm_backend_name`` values are reinterpreted to the XLA transport with
+a loud warning.
 """
 import numpy as np
 
@@ -23,34 +39,23 @@ import jax
 import jax.numpy as jnp
 
 from ...ops.adam.fused_adam import FusedAdam
-from ..comm.compressed import masked_compress
-
-
-def _padded_flat_size(shape):
-    n = int(np.prod(shape)) if shape else 1
-    return ((n + 7) // 8) * 8
-
-
-def _quantize_with_feedback(x, worker_error, server_error):
-    """Worker-compress then server-compress one buffer, updating both error
-    accumulators (the all-equal-workers form of compressed_allreduce_local).
-    Pad-lane masking lives in comm.compressed.masked_compress."""
-    n = x.size
-    padded = worker_error.size
-    flat = jnp.pad(x.reshape(-1), (0, padded - n))
-    mask = (jnp.arange(padded) < n).astype(jnp.float32)
-    corrected = flat + worker_error
-    _, _, worker_q, new_worker_error = masked_compress(corrected, mask,
-                                                       float(n))
-    server_in = worker_q + server_error
-    _, _, server_q, new_server_error = masked_compress(server_in, mask,
-                                                       float(n))
-    return server_q[:n].reshape(x.shape), new_worker_error, new_server_error
+from ...utils.logging import logger
+from ..comm.onebit import (onebit_all_gather_local, onebit_padded_size,
+                           onebit_reduce_scatter_local)
+from ..comm.quantize import FusedFlatLayout
 
 
 class OnebitAdam(FusedAdam):
     name = "onebitadam"
-    supports_zero = False  # reference restricts to stage < 2
+    # ZeRO stages 1-2 are supported (the engine keeps exp_avg replicated
+    # and the error state per-worker; master/exp_avg_sq shard normally);
+    # stage 3 is rejected by the engine — data-sharded compute params
+    # cannot feed the local-grad shard_map body.
+    supports_zero = True
+    # the engine zeroes these opt-state subtrees on an overflowed step
+    # (an overflow window compressed inf/nan — the residuals are
+    # poisoned), mirroring the qgZ error reset
+    error_state_keys = ("worker_error", "server_error")
 
     def __init__(self, lr=1e-3, freeze_step=100000, bias_correction=True,
                  betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
@@ -61,70 +66,189 @@ class OnebitAdam(FusedAdam):
         super().__init__(lr=lr, bias_correction=bias_correction, betas=betas,
                          eps=eps, adam_w_mode=False, weight_decay=weight_decay,
                          amsgrad=amsgrad, use_pallas=False)
+        if cuda_aware:
+            raise ValueError(
+                "OneBitAdam cuda_aware=true is a CUDA/NCCL transport key "
+                "the TPU runtime cannot honor — the compressed exchange "
+                "rides ICI through shard_map collectives; remove the key "
+                "(docs/onebit_adam.md)")
+        if comm_backend_name not in (None, "xla", "shard_map"):
+            logger.warning(
+                "OneBitAdam comm_backend_name=%r reinterpreted: the "
+                "compressed allreduce runs as shard_map collectives over "
+                "the mesh's data axis (there is no %s backend here)",
+                comm_backend_name, comm_backend_name)
+        if max_coeff is not None or min_coeff is not None:
+            logger.warning(
+                "OneBitAdam max_coeff/min_coeff are 1-bit LAMB "
+                "coefficient bounds; OneBitAdam ignores them (reference "
+                "parity)")
         self.freeze_step = int(freeze_step)
-        self.mesh = mesh
         self.comm_backend_name = comm_backend_name
+        self.mesh = None
+        self.axes = None
+        self.world_size = 1
+        if mesh is not None:
+            self.configure_comm(mesh)
+        # fused flat-buffer layout (comm.quantize.FusedFlatLayout — the
+        # same helper the engine's quantized exchange uses), filled by
+        # init_state
+        self._layout = None
 
+    # ------------------------------------------------------------ comm setup
+    def configure_comm(self, mesh):
+        """Bind the exchange to a mesh's data axis (or its hpZ-factored
+        sub-axes). Called by the engine after the mesh is final."""
+        from ...parallel.topology import (DATA_AXIS, DATA_REPLICA_AXIS,
+                                          DATA_SHARD_AXIS)
+        self.mesh = mesh
+        if DATA_AXIS in mesh.shape:
+            self.axes = DATA_AXIS
+        elif DATA_SHARD_AXIS in mesh.shape:
+            self.axes = tuple(a for a in (DATA_REPLICA_AXIS,
+                                          DATA_SHARD_AXIS)
+                              if a in mesh.shape)
+        else:
+            raise ValueError(
+                "OneBitAdam needs a data axis to exchange over; mesh has "
+                "{}".format(dict(mesh.shape)))
+        names = self.axes if isinstance(self.axes, tuple) else (self.axes,)
+        self.world_size = int(np.prod([mesh.shape[a] for a in names],
+                                      dtype=np.int64))
+
+    def frozen_at(self, step):
+        """Whether optimizer step ``step`` (0-based attempted steps — the
+        engine's global_steps counter) runs the compressed regime."""
+        return int(step) >= self.freeze_step
+
+    # ---------------------------------------------------------------- state
     def init_state(self, params):
-        state = super().init_state(params)
-        # error-feedback accumulators for the compression phase, padded to
-        # the sign-pack lane width
-        state["worker_error"] = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(_padded_flat_size(p.shape),
-                                dtype=jnp.float32), params)
-        state["server_error"] = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(_padded_flat_size(p.shape),
-                                dtype=jnp.float32), params)
-        return state
+        w = self.world_size
+        self._layout = FusedFlatLayout(
+            params, lambda n: onebit_padded_size(n, w))
+        padded = self._layout.padded
+        return {
+            "step": jnp.zeros((), dtype=jnp.int32),
+            "exp_avg": {"_flat": jnp.zeros(padded, jnp.float32)},
+            "exp_avg_sq": jax.tree_util.tree_map(
+                lambda p: jnp.zeros(np.shape(p), jnp.float32), params),
+            "worker_error": {"_flat": jnp.zeros((w, padded),
+                                                jnp.float32)},
+            "server_error": {"_flat": jnp.zeros((w, padded // w),
+                                                jnp.float32)},
+        }
 
-    def update(self, grads, state, params, lr, beta1, beta2, eps, weight_decay):
+    def state_placements(self):
+        """Engine placement hints: the fused momentum is replicated
+        (every worker compresses the full buffer); the error tensors are
+        per-worker — one row per device on the data axis."""
+        return {"exp_avg": "replicated", "worker_error": "stacked",
+                "server_error": "stacked"}
+
+    def state_dict_names(self):
+        return ["exp_avg", "exp_avg_sq", "worker_error", "server_error",
+                "step"]
+
+    # ------------------------------------------------------------- update
+    def _exchange(self, gflat, m, we, se, beta1, wd_flat):
+        """The frozen-phase compressed momentum exchange: per-worker
+        momentum update + the shard_map reduce-scatter/all-gather pair.
+        Returns (m_new (padded,) replicated, new worker/server error)."""
+        from jax.sharding import PartitionSpec as P
+        from ...parallel.topology import shard_map_compat
+        axes, w = self.axes, self.world_size
+        numel, padded = self._layout.numel, self._layout.padded
+        with_wd = wd_flat is not None
+
+        def body(g_row, m_in, we_row, se_row, *wd_term):
+            g = g_row[0]
+            if with_wd:
+                g = g + wd_term[0]
+            m_w = beta1 * m_in + (jnp.float32(1.0) - beta1) * g
+            chunk_mean, cmask, ccount, nwe = onebit_reduce_scatter_local(
+                m_w, we_row[0], axes, w, real_size=numel)
+            full, nse = onebit_all_gather_local(
+                chunk_mean, se_row[0], axes, cmask, ccount)
+            mask = (jnp.arange(padded) < numel).astype(jnp.float32)
+            return full * mask, nwe[None], nse[None]
+
+        in_specs = (P(axes), P(), P(axes), P(axes)) + \
+            ((P(),) if with_wd else ())
+        operands = (gflat, m, we, se) + ((wd_flat,) if with_wd else ())
+        sharded = shard_map_compat(
+            body, mesh=self.mesh, in_specs=in_specs,
+            out_specs=(P(), P(axes), P(axes)))
+        return sharded(*operands)
+
+    def update(self, grads, state, params, lr, beta1, beta2, eps,
+               weight_decay, frozen=False, averaged=False):
+        """One 1-bit Adam step.
+
+        ``grads``: STACKED local grads (leaves ``(world, *shape)``) —
+        the engine's local-grad micro step — or, with ``averaged=True``
+        (warmup only), a plain tree of already-averaged gradients (the
+        engine pre-averaged them through quantized collectives).
+        ``frozen`` is compiled in host-side by the engine, one program
+        per regime; a direct (engine-less) caller gets warmup semantics
+        with plain averaging."""
+        if self._layout is None:
+            raise RuntimeError(
+                "OnebitAdam.update before init_state (the flat-buffer "
+                "layout is derived from the param tree)")
+        if averaged and frozen:
+            raise ValueError("averaged grads only apply to the warmup "
+                             "regime (frozen exchanges locals)")
         step = state["step"] + 1
-        frozen = step > self.freeze_step
+        beta1 = jnp.asarray(beta1, jnp.float32)
+        beta2 = jnp.asarray(beta2, jnp.float32)
+        m = state["exp_avg"]["_flat"]
+        we = state["worker_error"]["_flat"]
+        se = state["server_error"]["_flat"]
+        # weight decay needs the full flat params on every worker for
+        # the fused momentum buffer; the engine restricts wd>0 to
+        # replicated-param configs (ZeRO stage 0, docs/onebit_adam.md)
+        wd = float(self.weight_decay or 0.0)
 
-        def leaf(p, g, m, v, werr, serr):
-            g = g.astype(jnp.float32)
-            p32 = p.astype(jnp.float32)
-            g = g + weight_decay * p32
-            m_exact = beta1 * m + (1.0 - beta1) * g
+        if frozen:
+            gflat = self._layout.flatten_rows(grads)      # (w, padded)
+            wd_flat = jnp.asarray(wd, jnp.float32) * \
+                self._layout.flatten(params) if wd else None
+            m_new, we_new, se_new = self._exchange(gflat, m, we, se,
+                                                   beta1, wd_flat)
+            v_new = state["exp_avg_sq"]            # frozen variance
+        else:
+            # warmup: exact Adam on the worker-averaged gradient — the
+            # mean over the stacked rows IS the uncompressed allreduce
+            # (GSPMD lowers it on the data axis) unless the engine
+            # already averaged through quantized collectives.
+            g_mean = self._layout.flatten(grads) if averaged \
+                else self._layout.flatten_rows(grads).mean(axis=0)
+            if wd:
+                g_mean = g_mean + jnp.asarray(wd, jnp.float32) * \
+                    self._layout.flatten(params)
+            m_new = beta1 * m + (jnp.float32(1.0) - beta1) * g_mean
+            g_tree = self._layout.slices(g_mean)
+            v_new = jax.tree_util.tree_map(
+                lambda v, g: beta2 * v + (jnp.float32(1.0) - beta2) *
+                (g * g), state["exp_avg_sq"], g_tree)
+            we_new, se_new = we, se
 
-            # lax.cond so the warmup phase (typically thousands of steps)
-            # never executes the compression pipeline.
-            def frozen_branch(args):
-                m_ex, v_old, we, se, _ = args
-                m_comp, nwe, nse = _quantize_with_feedback(m_ex, we, se)
-                return m_comp, v_old, nwe, nse
+        if self.bias_correction:
+            bc1 = 1.0 - jnp.power(beta1, step.astype(jnp.float32))
+            bc2 = 1.0 - jnp.power(beta2, step.astype(jnp.float32))
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
 
-            def warmup_branch(args):
-                m_ex, v_old, we, se, g_ = args
-                return (m_ex, beta2 * v_old + (1.0 - beta2) * (g_ * g_),
-                        we, se)
-
-            m_new, v_new, new_werr, new_serr = jax.lax.cond(
-                frozen, frozen_branch, warmup_branch,
-                (m_exact, v, werr, serr, g))
-            if self.bias_correction:
-                bc1 = 1.0 - jnp.power(beta1, step.astype(jnp.float32))
-                bc2 = 1.0 - jnp.power(beta2, step.astype(jnp.float32))
-            else:
-                bc1 = bc2 = 1.0
-            update = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
-            return ((p32 - lr * update).astype(p.dtype), m_new, v_new,
-                    new_werr, new_serr)
-
-        flat_p, treedef = jax.tree_util.tree_flatten(params)
-        flat_g = treedef.flatten_up_to(grads)
-        flat_m = treedef.flatten_up_to(state["exp_avg"])
-        flat_v = treedef.flatten_up_to(state["exp_avg_sq"])
-        flat_we = treedef.flatten_up_to(state["worker_error"])
-        flat_se = treedef.flatten_up_to(state["server_error"])
-        out = [leaf(*xs) for xs in zip(flat_p, flat_g, flat_m, flat_v,
-                                       flat_we, flat_se)]
-        unflatten = lambda i: jax.tree_util.tree_unflatten(
-            treedef, [o[i] for o in out])
-        return unflatten(0), {
+        m_tree = self._layout.slices(m_new)
+        new_params = jax.tree_util.tree_map(
+            lambda p, mm, vv: (p.astype(jnp.float32) - lr *
+                               ((mm / bc1) / (jnp.sqrt(vv / bc2) + eps))
+                               ).astype(p.dtype),
+            params, m_tree, v_new)
+        return new_params, {
             "step": step,
-            "exp_avg": unflatten(1),
-            "exp_avg_sq": unflatten(2),
-            "worker_error": unflatten(3),
-            "server_error": unflatten(4),
+            "exp_avg": {"_flat": m_new},
+            "exp_avg_sq": v_new,
+            "worker_error": {"_flat": we_new},
+            "server_error": {"_flat": se_new},
         }

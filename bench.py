@@ -193,6 +193,11 @@ def main():
             "rung": {"micro_batch": micro_batch, "remat": remat,
                      "bf16_state": bf16_state},
             "comm": comm,
+            # segment-executor accounting (docs/executor.md): plan
+            # size and per-kind walls of the step plans this run
+            # executed (the fused path is a one-segment plan; the
+            # offload microbench reports the multi-segment plans)
+            "executor": engine.executor_snapshot(),
             # omitted (not {}) on non-writer processes: the schema
             # checker rejects an empty snapshot (bin/check_bench_schema)
             **({"telemetry": engine.telemetry_snapshot()}

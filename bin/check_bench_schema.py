@@ -44,6 +44,64 @@ SERVING_SUBDICT_KEYS = {
     "speculative": ("proposed", "accepted", "acceptance_rate"),
 }
 
+# Local copy of telemetry/record.py SEGMENT_KEYS /
+# SEGMENT_KIND_KEYS / SEGMENT_OPTIONAL_KEYS (same stdlib-only
+# constraint; pinned equal by tests/unit/test_executor.py): the
+# unified per-segment stats schema of the executor-lowered offload
+# paths' ``offload`` record sub-dict and the benches'
+# ``extra.executor`` payload.
+SEGMENT_KEYS = (
+    "plan_segments", "per_kind", "overlap_efficiency",
+    "upload_batches", "upload_elems", "upload_bytes",
+    "bucket_elems", "bucket_occupancy",
+)
+SEGMENT_KIND_KEYS = ("segments", "run_s", "wait_s")
+SEGMENT_OPTIONAL_KEYS = (
+    "segment_upload_bytes_peak", "groups", "collective_matmul",
+    "work_chunks", "mode", "plans_executed", "segments_executed",
+    "last_plan_segments",
+)
+
+
+def check_segment_stats(stats, where):
+    """-> list of problems with one SEGMENT_KEYS stats dict (a stdlib
+    re-statement of telemetry/record.py validate_segment_stats —
+    executor dicts carry the lifetime counter extras; record dicts the
+    path extras)."""
+    problems = []
+    if not isinstance(stats, dict):
+        return ["{} is not a dict".format(where)]
+    # dispatch marker: dicts without plan_segments are pre-executor
+    # artifacts (older BENCH records) — validated only for shape above
+    if "plan_segments" not in stats:
+        return []
+    for key in SEGMENT_KEYS:
+        if key not in stats and not (
+                where.endswith("executor") and key.startswith(
+                    ("upload_", "bucket_"))):
+            problems.append("{} missing key {!r}".format(where, key))
+    extra = sorted(set(stats) - set(SEGMENT_KEYS)
+                   - set(SEGMENT_OPTIONAL_KEYS))
+    if extra:
+        problems.append("{} has unexpected key(s) {}".format(
+            where, extra))
+    per_kind = stats.get("per_kind")
+    if not isinstance(per_kind, dict):
+        problems.append("{}.per_kind is not a dict".format(where))
+    else:
+        for kind, slot in per_kind.items():
+            if not isinstance(slot, dict):
+                problems.append(
+                    "{}.per_kind.{} is not a dict".format(where, kind))
+                continue
+            for key in SEGMENT_KIND_KEYS:
+                if not _is_num(slot.get(key)):
+                    problems.append(
+                        "{}.per_kind.{}.{} is not a number".format(
+                            where, kind, key))
+    return problems
+
+
 # Local copy of telemetry/recorder.py CRASH_BUNDLE_KEYS (same stdlib-
 # only constraint; pinned equal by tests/unit/test_diagnostics.py).
 CRASH_BUNDLE_KEYS = (
@@ -90,6 +148,9 @@ def check_telemetry_snapshot(snap):
             _check_dist(snap.get(name), name, problems)
         if not isinstance(snap.get("phases_mean_s"), dict):
             problems.append("telemetry.phases_mean_s is not a dict")
+        if isinstance(snap.get("offload_last"), dict):
+            problems.extend(check_segment_stats(
+                snap["offload_last"], "telemetry.offload_last"))
     if serving > 0:
         srv = snap.get("serving")
         if not isinstance(srv, dict):
@@ -215,6 +276,9 @@ def check_bench_payload(payload):
                     check_telemetry_snapshot(extra["telemetry"]))
             if "serving_trace" in extra:
                 problems.extend(check_serving_trace(extra["serving_trace"]))
+            if "executor" in extra:
+                problems.extend(check_segment_stats(
+                    extra["executor"], "extra.executor"))
     return problems
 
 

@@ -25,6 +25,17 @@ AFTER the cost is paid:
     place (ops/pallas and the op packages; docs/pallas_kernels.md is
     the inventory), so dispatch layers import kernels rather than
     inlining them.
+  * **DSL006 step-scheduling-outside-executor** — hand-written step
+    scheduling outside ``deepspeed_tpu/runtime/executor/``: an async
+    transfer issue (``copy_to_host_async``), a worker pool
+    (``ThreadPoolExecutor`` / ``make_upload_pool``), or a donation
+    declaration (a ``donate_argnums=`` call keyword). Since ISSUE 13
+    the segment executor owns overlap construction, phase timing and
+    donation for every step path; the surviving legacy sites (pipe
+    engine, jit caches, the transfer batcher internals, the audit
+    layer reading declarations) are baselined — NEW occurrences fail
+    CI so new paths lower onto the executor instead of growing a
+    seventh bespoke scheduler (docs/executor.md).
 
 Violations key as ``DSL###:<relpath>::<qualname>`` and count per key —
 the committed baseline file maps keys to accepted counts, so existing
@@ -42,10 +53,13 @@ LINT_RULES = {
     "DSL003": "telemetry-gate-missing",
     "DSL004": "jit-in-loop",
     "DSL005": "pallas-call-outside-ops",
+    "DSL006": "step-scheduling-outside-executor",
 }
 
 # DSL005: the one directory kernels may live in
 _OPS_PREFIX = "deepspeed_tpu/ops/"
+# DSL006: the one directory step-scheduling machinery may live in
+_EXECUTOR_PREFIX = "deepspeed_tpu/runtime/executor/"
 
 _TIME_FNS = {"time", "monotonic", "perf_counter"}
 
@@ -161,6 +175,29 @@ class _FunctionLint(ast.NodeVisitor):
                                "pl.pallas_call outside deepspeed_tpu/"
                                "ops/ — kernels live in one place "
                                "(ops/pallas; docs/pallas_kernels.md)")
+        if not self.linter.in_executor:
+            name_id = fn.id if isinstance(fn, ast.Name) else ""
+            sched = None
+            # split-tail match: a subscripted receiver
+            # (bufs[0].copy_to_host_async()) truncates the chain to the
+            # bare attribute name
+            if chain.split(".")[-1] == "copy_to_host_async":
+                sched = "async transfer issue (copy_to_host_async)"
+            elif chain.endswith("ThreadPoolExecutor") or \
+                    name_id == "ThreadPoolExecutor":
+                sched = "worker pool (ThreadPoolExecutor)"
+            elif chain.endswith("make_upload_pool") or \
+                    name_id == "make_upload_pool":
+                sched = "upload worker (make_upload_pool)"
+            elif any(kw.arg == "donate_argnums"
+                     for kw in node.keywords):
+                sched = "donation declaration (donate_argnums=)"
+            if sched:
+                self.linter.report(
+                    "DSL006", self.qualname, node.lineno,
+                    "{} outside deepspeed_tpu/runtime/executor/ — "
+                    "step scheduling lowers onto the segment executor "
+                    "(docs/executor.md)".format(sched))
         self.generic_visit(node)
 
     def finish(self):
@@ -175,7 +212,9 @@ class _FunctionLint(ast.NodeVisitor):
 class FileLinter:
     def __init__(self, relpath):
         self.relpath = relpath
-        self.in_ops = relpath.replace(os.sep, "/").startswith(_OPS_PREFIX)
+        norm = relpath.replace(os.sep, "/")
+        self.in_ops = norm.startswith(_OPS_PREFIX)
+        self.in_executor = norm.startswith(_EXECUTOR_PREFIX)
         self.violations = []       # [(rule, qualname, lineno, message)]
 
     def report(self, rule, qualname, lineno, message):

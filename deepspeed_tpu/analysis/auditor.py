@@ -218,6 +218,41 @@ def dispose(report, config, raise_on_findings=None):
     return report
 
 
+def audit_plan(engine, report):
+    """Lowered-plan verification (ISSUE 13): build the abstract segment
+    plan of the engine's step path through the SAME entry point the
+    executor uses (``ir.plan_of``) and run the plan-level rules —
+    unique names, IR-vocabulary kinds, resolvable topologically-ordered
+    deps. Plan problems are unsuppressable findings (a malformed plan
+    is a bug in the lowering, never an accepted quirk); the plan's
+    shape lands in the report's program table as ``plan/<name>``."""
+    if getattr(engine, "stream_runner", None) is None and \
+            getattr(engine, "host_state", None) is None:
+        return                      # micro/fused: one-segment plans
+    from .ir import plan_of
+    try:
+        plan = plan_of(engine)
+    except Exception as err:  # noqa: BLE001 - report, don't die
+        report.add(Finding(
+            rule="executor_plan", check="plan_build_error",
+            program="plan", severity="error",
+            message="segment plan could not be built for the audit: "
+                    "{}".format(err),
+            key="plan_build_error"))
+        return
+    for i, problem in enumerate(plan.validate()):
+        report.add(Finding(
+            rule="executor_plan", check="plan_invalid",
+            program="plan/" + plan.name, severity="error",
+            message="segment plan {!r} is invalid: {}".format(
+                plan.name, problem),
+            key="plan_invalid:{}:{}".format(plan.name, i)))
+    summary = plan.summary()
+    report.add_program("plan/" + plan.name, family="plan",
+                       plan_segments=summary["segments"],
+                       per_kind=summary["per_kind"])
+
+
 def audit_engine(engine, batch=None, hlo=None, report_path=None,
                  strict=None):
     """Ahead-of-time shard-lint over one engine's resolved step
@@ -264,6 +299,12 @@ def audit_engine(engine, batch=None, hlo=None, report_path=None,
     report = audit_programs(
         specs, config, job=job,
         suppressions=config.suppressions, sequence=sequence,
-        hlo=use_hlo, wire_est=wire_est, mesh=mesh,
-        report_path=report_path or config.report_path)
+        hlo=use_hlo, wire_est=wire_est, mesh=mesh)
+    if job == "train":
+        # lowered-plan verification rides the same report (and lands in
+        # the same artifact) as the program rules
+        audit_plan(engine, report)
+    out_path = report_path or config.report_path
+    if out_path:
+        report.write(out_path)
     return dispose(report, config, raise_on_findings=strict)

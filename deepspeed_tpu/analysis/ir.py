@@ -378,6 +378,22 @@ def make_walk(fn, args, taint_in=None):
     return closed, walk(closed, taint_in=taint_in)
 
 
+def plan_of(engine, family=None):
+    """The SEGMENT PLAN of an engine's step path (ISSUE 13): the same
+    graph construction the executor runs
+    (``runtime/executor/plan_for_engine``), abstract — topology,
+    kinds, deps and declared prices with no payloads attached. Plan
+    construction and audit share one graph: the auditor validates
+    exactly the plan the engine executes (``audit_engine`` calls this
+    for the offload/streamed families), and the concrete step builders
+    attach payloads to the same topology, pinned by
+    tests/unit/test_executor.py (executed segment records == plan
+    nodes). ``family``: ``"offload_apply"`` / ``"streamed_micro"``, or
+    None to resolve from the engine's live path."""
+    from ..runtime.executor import plan_for_engine
+    return plan_for_engine(engine, family)
+
+
 def segment_summary(walk_result):
     """Aggregate the walked eqns into the segment vocabulary — the
     embryonic schedulable-segment view (ROADMAP item 5): per-kind op

@@ -436,6 +436,35 @@ def get_checkpoint_keep_last_n(checkpoint_params):
 TRANSFORMER = "transformer"
 TRANSFORMER_FLASH_ATTENTION = "flash_attention"
 
+#############################################
+# Runtime executor (docs/executor.md)
+#############################################
+RUNTIME = "runtime"
+RUNTIME_EXECUTOR = "executor"
+RUNTIME_EXECUTOR_DEFAULT = "auto"
+RUNTIME_EXECUTOR_MODES = ("auto", "on", "off")
+
+
+def get_runtime_executor(param_dict):
+    """``runtime.executor``: tri-state gate for the segment-plan
+    executor's constructed overlap (``runtime/executor/``). ``auto``
+    (default) and ``on`` run plans with async transfer/compute overlap;
+    ``off`` runs every plan serially in plan order — the bit-exact
+    oracle mode for A/B debugging. Strict-validated: any other value
+    raises (an enum typo silently falling back would un-A/B the
+    comparison it exists for)."""
+    sub = param_dict.get(RUNTIME) or {}
+    if not isinstance(sub, dict):
+        raise DeepSpeedConfigError(
+            "runtime must be a dict, got {}".format(type(sub).__name__))
+    val = sub.get(RUNTIME_EXECUTOR, RUNTIME_EXECUTOR_DEFAULT)
+    if not isinstance(val, str) or \
+            val.lower() not in RUNTIME_EXECUTOR_MODES:
+        raise DeepSpeedConfigError(
+            "runtime.{} must be one of {}, got {!r}".format(
+                RUNTIME_EXECUTOR, "|".join(RUNTIME_EXECUTOR_MODES), val))
+    return val.lower()
+
 
 def get_transformer_flash_attention(param_dict):
     """``transformer.flash_attention``: tri-state gate for the Pallas
@@ -590,6 +619,7 @@ class DeepSpeedConfig(object):
         self.comm_config = DeepSpeedCommConfig(param_dict)
         self.transformer_flash_attention = \
             get_transformer_flash_attention(param_dict)
+        self.runtime_executor = get_runtime_executor(param_dict)
 
         self.gradient_clipping = get_gradient_clipping(param_dict)
         self.grad_accum_dtype = get_grad_accum_dtype(param_dict)
@@ -708,7 +738,7 @@ class DeepSpeedConfig(object):
         "sparse_gradients", "prescale_gradients",
         "gradient_predivide_factor", "disable_allgather", "fp32_allreduce",
         "vocabulary_size", "config_validation", "data_types",
-        INFERENCE, TELEMETRY, COMM, TRANSFORMER, ANALYSIS,
+        INFERENCE, TELEMETRY, COMM, TRANSFORMER, ANALYSIS, RUNTIME,
         # deprecated boolean form + its companion (read_zero_config_deprecated)
         "allgather_size",
     }
@@ -752,6 +782,7 @@ class DeepSpeedConfig(object):
         # CollectiveMatmulConfig itself (runtime/comm/config.py)
         COMM: KNOWN_COMM_KEYS,
         TRANSFORMER: {TRANSFORMER_FLASH_ATTENTION},
+        RUNTIME: {RUNTIME_EXECUTOR},
         "elasticity": {"enabled", "max_train_batch_size",
                        "micro_batch_sizes", "min_gpus", "max_gpus",
                        "min_time", "prefer_larger_batch",

@@ -160,6 +160,13 @@ class DeepSpeedEngine:
         self._window_flops = 0.0
         self._step_hbm = None
         self._step_path = "micro"
+        # segment-plan executor (runtime/executor/, docs/executor.md):
+        # every step path runs as a SegmentPlan through one scheduler;
+        # runtime.executor "off" = serial oracle, "on"/"auto" = the
+        # overlap-constructing schedule (built lazily on first use)
+        self._executor_mode = "serial" \
+            if self._config.runtime_executor == "off" else "overlap"
+        self._plan_executor = None
         if self.telemetry is not None and \
                 self.telemetry.recorder is not None:
             # flight recorder context (docs/diagnostics.md): resolved at
@@ -1650,18 +1657,38 @@ class DeepSpeedEngine:
             phases[key] = phases.get(key, 0.0) + float(val)
         return phases
 
-    def _telemetry_offload_stats(self):
+    def _telemetry_offload_stats(self, exec_stats=None):
+        """The StepRecord's ``offload`` sub-dict in the unified
+        SEGMENT_KEYS schema (telemetry/record.py): per-kind executed-
+        segment walls from the PlanExecutor joined with the path's
+        upload counters — one shape for the streamed and classic
+        offload paths (validated by bin/check_bench_schema.py)."""
         if self.stream_runner is not None:
-            snap = self.stream_runner.transfer_snapshot()
+            snap = self.stream_runner.transfer_snapshot(
+                exec_stats=exec_stats)
             self.stream_runner.reset_step_counters()
             return snap
         if self.host_state is not None:
+            exec_stats = exec_stats or {}
             occ = getattr(self, "h2d_bucket_occupancy", None)
+            elems = int(getattr(self, "h2d_elems", 0) or 0)
+            itemsize = np.dtype(self.compute_dtype).itemsize
             return {
-                "h2d_batches": int(getattr(self, "h2d_batches", 0) or 0),
-                "work_chunks": int(getattr(self, "offload_work_chunks", 0)
-                                   or 0),
+                "plan_segments": int(exec_stats.get("plan_segments", 0)),
+                "per_kind": exec_stats.get("per_kind", {}),
+                # constructed transfer/compute overlap: host-Adam wall
+                # the D2H stream hid vs the residual it could not (the
+                # bespoke pre-executor path reported NO efficiency here)
+                "overlap_efficiency": exec_stats.get(
+                    "overlap_efficiency"),
+                "upload_batches": int(getattr(self, "h2d_batches", 0)
+                                      or 0),
+                "upload_elems": elems,
+                "upload_bytes": elems * itemsize,
+                "bucket_elems": self._h2d_bucket_elems,
                 "bucket_occupancy": round(occ, 4) if occ else None,
+                "work_chunks": int(getattr(self, "offload_work_chunks",
+                                           0) or 0),
             }
         return None
 
@@ -1670,6 +1697,13 @@ class DeepSpeedEngine:
         reading grad_norm/overflow forces one device value fetch per
         step on paths that otherwise defer it — part of telemetry's
         documented <5% overhead budget (docs/telemetry.md)."""
+        # executor per-step accounting: snapshot the per-kind stats,
+        # then drain the segment records (the drain also opens the next
+        # step's window, so it runs even when telemetry is off)
+        ex = self._plan_executor
+        exec_stats = ex.step_snapshot() if ex is not None else None
+        exec_segments = ex.drain_step_records() if ex is not None \
+            else None
         tel = self.telemetry
         if tel is None or self._window_t0 is None:
             return
@@ -1712,8 +1746,15 @@ class DeepSpeedEngine:
             phases=self._telemetry_phases(),
             wire=self._telemetry_wire(),
             comm_overlap=self._telemetry_comm_overlap(dt),
-            offload=self._telemetry_offload_stats(),
-            pipe=pipe)
+            offload=self._telemetry_offload_stats(exec_stats),
+            pipe=pipe,
+            # segment-derived span trees on the multi-segment lowered
+            # paths (span tree == executed plan); micro/fused keep the
+            # phase-derived tree (their plan is one segment — the phase
+            # clocks say more)
+            segments=exec_segments if exec_segments and (
+                self.stream_runner is not None or
+                self.host_state is not None) else None)
 
     # ----------------------------------------------------------- diagnostics
     def _resolved_step_path(self):
@@ -1987,265 +2028,39 @@ class DeepSpeedEngine:
 
     def _host_apply_step(self):
         """ZeRO-Offload optimizer step, shard-wise and OVERLAPPED
-        (reference stage2.py:283-286, 780-908 + csrc/adam/cpu_adam.cpp):
-        each process D2Hs only its ADDRESSABLE acc_grad shards, runs the
-        host Adam on its host master/moment shards, H2Ds the updated
-        shards and reshards to the param layout on device (the all-gather
-        of updated partitions rides ICI, not PCIe).
+        (reference stage2.py:283-286, 780-908 + csrc/adam/cpu_adam.cpp),
+        lowered onto the segment executor (runtime/executor/offload.py,
+        docs/executor.md): each process D2Hs only its ADDRESSABLE
+        acc_grad shards, runs the host Adam on its host master/moment
+        shards, H2Ds the updated shards and reshards to the param
+        layout on device. The transfer/compute overlap the bespoke
+        shard pipeline hand-threaded here is now CONSTRUCTED by the
+        PlanExecutor from the declared segment deps (async D2H fetches
+        in a bounded window ahead of the host Adam, leaf uploads riding
+        the coalescing batcher behind the remaining chunks)."""
+        from .executor.offload import run_offload_apply
+        return run_offload_apply(self)
 
-        Transfer/compute overlap — the reference's dedicated
-        streams + pinned buffers become a three-stage shard pipeline:
+    def plan_executor(self):
+        """The engine's PlanExecutor (runtime/executor/scheduler.py),
+        built lazily: mode resolves from the strict-validated
+        ``runtime.executor`` tri-state (off = serial oracle, on/auto =
+        constructed overlap)."""
+        if self._plan_executor is None:
+            from .executor import PlanExecutor
+            self._plan_executor = PlanExecutor(
+                mode=self._executor_mode,
+                windows={"d2h": self._D2H_WINDOW})
+        return self._plan_executor
 
-          1. every grad shard's D2H is issued ASYNC up front
-             (``copy_to_host_async``), so transfers stream while the
-             overflow check round-trips and while earlier shards step;
-          2. while the host Adam crunches shard j, a background thread
-             blocks on shard j+1's fetch (both sides drop the GIL —
-             native Adam in OpenMP, fetch in the runtime);
-          3. each leaf's updated shards H2D as soon as that leaf
-             finishes (``device_put`` dispatches async), so uploads ride
-             behind the remaining leaves' Adam; one jitted reshard at the
-             end turns the grad-layout shards into param layout.
-
-        Overflow/grad-norm are global jitted reductions so every process
-        agrees without owning every gradient."""
-        import time as _time
-        hyper = self._hyper()
-        scaler = self.state["scaler"]
-        cur_scale = float(scaler.cur_scale)
-        inv_scale = 1.0 / cur_scale
-        clip = self.gradient_clipping()
-
-        # per-phase wall clocks (cheap; read via offload_phase_times).
-        # "micros_and_check" includes waiting for the jitted micro steps
-        # to finish — the check's value fetch is the first sync point.
-        # OVERLAP ACCOUNTING: the shard pipeline overlaps the host Adam
-        # with the next shard's D2H by construction (the pool fetches
-        # shard j+1 while Adam steps shard j), so "d2h_wait_s" is the
-        # RESIDUAL blocking wait after that overlap, not raw transfer
-        # time — host_adam_s is real wall the device transfers could
-        # not hide, and the phases are disjoint and sum to the step
-        # (any residual vs sec_per_step is loop overhead, reported by
-        # bench_gpt2_xl.py as unattributed_s).
-        phases = {"micros_and_check_s": 0.0, "d2h_wait_s": 0.0,
-                  "host_adam_s": 0.0, "h2d_dispatch_s": 0.0,
-                  "h2d_reshard_s": 0.0}
-        self.offload_phase_times = phases
-        t_phase = _time.time()
-        check = self._get_jit("offload_check", self._offload_check_fn)
-        finite, sumsq = check(self.state["acc_grads"],
-                              np.float32(inv_scale))
-        hs = self.host_state
-        flat_acc = hs["treedef"].flatten_up_to(self.state["acc_grads"])
-        # flat work list over (leaf, shard, row-chunk) for the fetch
-        # pipeline — built from the HOST shard registry so replicated
-        # leaves dedupe to one entry (the same order the Adam loop
-        # consumes). ``sub_group_size`` chunks each shard's D2H + host
-        # Adam into <= that many elements per work item (the reference's
-        # sub-group-partitioned stage-3 optimizer step, stage3.py:1003):
-        # smaller chunks pipeline transfer/compute at finer grain; the
-        # huge default keeps one chunk per shard.
-        from .zero.transfer import chunk_rows
-        work = []
-        shard_bufs = []     # unique device grad buffers, in work order
-        for i, (g_arr, shards) in enumerate(zip(flat_acc,
-                                                hs["shard_leaves"])):
-            local = {_shard_key(sh.index): sh.data
-                     for sh in g_arr.addressable_shards}
-            for tup in shards:
-                buf = local[_shard_key(tup[0])]
-                buf_idx = len(shard_bufs)
-                shard_bufs.append(buf)
-                chunks = chunk_rows(np.shape(tup[1]),
-                                    self._sub_group_size)
-                whole = len(chunks) == 1
-                for r0, r1 in chunks:
-                    work.append((i, tup, buf,
-                                 None if whole else (r0, r1), buf_idx))
-        self.offload_work_chunks = len(work)
-        # stage 1: kick off a BOUNDED window of shard D2Hs (in work-list
-        # order) so transfers stream behind the (round-trip) overflow
-        # fetch below; the work loop tops the window up one shard ahead
-        # of the host Adam. An unbounded warm-up (every shard at once)
-        # pins a device staging buffer per shard — at 1.5B that is ~an
-        # extra full gradient copy of HBM and OOMs the chip that the
-        # serial round-2 step fit on. A plugin without async copy
-        # disables the prefetch permanently (not one raise per leaf per
-        # step).
-        if getattr(self, "_async_d2h", True):
-            try:
-                for buf in shard_bufs[:self._D2H_WINDOW]:
-                    buf.copy_to_host_async()
-            except Exception:  # noqa: BLE001
-                self._async_d2h = False
-        # a sumsq that overflowed despite finite elements is an overflow
-        # too: clipping against an inf norm would silently zero the update
-        overflow = (not bool(finite)) or not np.isfinite(float(sumsq))
-        phases["micros_and_check_s"] = _time.time() - t_phase
-
-        grad_norm = 0.0
-        if not overflow:
-            grad_norm = float(np.sqrt(float(sumsq)))
-            coef = inv_scale
-            if clip > 0 and grad_norm > clip:
-                coef *= clip / (grad_norm + 1e-6)
-
-            hs["step"] += 1
-            step = hs["step"]
-            beta1, beta2 = hyper["beta1"], hyper["beta2"]
-            bias_correction = getattr(self.optimizer, "bias_correction", True)
-            bc1 = 1.0 - beta1 ** step if bias_correction else 1.0
-            bc2 = 1.0 - beta2 ** step if bias_correction else 1.0
-            adam_w = 1 if getattr(self.optimizer, "adam_w_mode", True) else 0
-            lib = self._offload_lib()
-
-            left_in_leaf = [0] * len(flat_acc)
-            for i, *_ in work:
-                left_in_leaf[i] += 1
-            flat_params = [None] * len(flat_acc)
-
-            # Release the engine's references so device memory frees as
-            # the loop consumes it — at 1.5B the resting fp32 acc_grads
-            # (6.2 GB) + bf16 params (3.1 GB) plus the step's uploads
-            # and reshard output exceed one v5e's HBM if everything is
-            # held to the end. The params' updated values come from the
-            # host master (params are dead the moment the micros ran);
-            # each acc leaf is dead once its last shard's fetch landed.
-            acc_specs = [(a.shape, a.dtype) for a in flat_acc]
-            acc_shardings = [a.sharding for a in flat_acc]
-            self.state["params"] = None
-            self.state["acc_grads"] = None
-
-            def fetch(item):
-                # writable fp32 copy for the in-place host Adam; a
-                # sub_group row-chunk fetches only its slice
-                rows = item[3]
-                if rows is None:
-                    return np.array(item[2], dtype=np.float32)
-                return np.array(item[2][rows[0]:rows[1]],
-                                dtype=np.float32)
-
-            # step-wide upload batcher: finished leaves' master shards
-            # coalesce into few large pinned transfers on a background
-            # worker, overlapping the remaining chunks' host Adam
-            # (stage3_prefetch_bucket_size elements per device_put)
-            from .zero.transfer import H2DBatcher
-            batcher = H2DBatcher(
-                self._h2d_bucket_elems, self.compute_dtype,
-                pool=self._upload_pool(),
-                jit_cache=self._h2d_split_cache())
-            try:
-                self._offload_update_loop(
-                    work, flat_acc, shard_bufs, batcher, left_in_leaf,
-                    fetch, coef, hyper, bc1, bc2, adam_w, lib, acc_specs,
-                    acc_shardings, hs)
-                t0 = _time.time()
-                uploaded = batcher.finish()
-                self.h2d_batches = batcher.batches
-                self.h2d_bucket_occupancy = batcher.occupancy()
-                for i, sharding in enumerate(acc_shardings):
-                    flat_params[i] = self._assemble_uploaded_leaf(
-                        uploaded, i, acc_specs[i][0], sharding)
-                phases["h2d_dispatch_s"] += _time.time() - t0
-            except BaseException:
-                # a mid-step failure (e.g. OOM in a leaf H2D) must not
-                # strand the engine with None pytrees: the host masters
-                # hold the authoritative values, so rebuild params from
-                # them (best effort — skip if even that allocation
-                # fails) so the run can still checkpoint or retry.
-                # The masters are now PARTIALLY stepped (some leaves ran
-                # Adam, some did not) and hs["step"] stayed incremented:
-                # record the torn step so a checkpoint taken after the
-                # re-raise carries the fact instead of silently looking
-                # whole (a resumed run should re-run the step's data).
-                hs["torn_step"] = hs["step"]
-                try:
-                    self._restore_params_from_host(acc_specs,
-                                                   acc_shardings, hs)
-                except Exception:  # noqa: BLE001
-                    pass
-                raise
-            hs.pop("torn_step", None)
-            t_phase = _time.time()
-            self._finish_offload_step(flat_params, acc_specs,
-                                      acc_shardings, hs)
-            if os.environ.get("DS_OFFLOAD_PROFILE"):
-                # force the uploads/reshard to COMPLETE so the phase
-                # clock captures the H2D wait (serializes the tail —
-                # profiling only; block_until_ready is a no-op through
-                # the axon tunnel, only a value fetch syncs)
-                leaf = jax.tree_util.tree_leaves(self.state["params"])[0]
-                float(jnp.asarray(leaf).ravel()[0])
-            phases["h2d_reshard_s"] = _time.time() - t_phase
-        else:
-            self.state["acc_grads"] = jax.tree_util.tree_map(
-                jnp.zeros_like, self.state["acc_grads"])
-            if "qg_error" in self.state:
-                # poisoned by the inf/nan grads this window quantized —
-                # reset with the skip (mirrors _apply_step_fn)
-                self.state["qg_error"] = jax.tree_util.tree_map(
-                    jnp.zeros_like, self.state["qg_error"])
-        self.state["scaler"] = ls.update_scale(scaler, overflow)
-        return {"overflow": overflow, "grad_norm": grad_norm,
-                "loss_scale": cur_scale}
-
-    def _offload_update_loop(self, work, flat_acc, shard_bufs, batcher,
-                             left_in_leaf, fetch, coef, hyper, bc1, bc2,
-                             adam_w, lib, acc_specs, acc_shardings, hs):
-        """The shard-pipelined host Adam (see _host_apply_step)."""
-        import time as _time
-        from .zero.transfer import host_adam_chunk
-        phases = getattr(self, "offload_phase_times", {})
-        pool = self._offload_fetch_pool()
-        nxt = pool.submit(fetch, work[0]) if work else None
-        d2h_issued = self._D2H_WINDOW  # buffers already async-copied
-        for j, item in enumerate(work):
-                t0 = _time.time()
-                g = nxt.result()
-                phases["d2h_wait_s"] = phases.get("d2h_wait_s", 0.0) \
-                    + (_time.time() - t0)
-                nxt = pool.submit(fetch, work[j + 1]) \
-                    if j + 1 < len(work) else None
-                # top the bounded D2H window up one BUFFER ahead of the
-                # chunk the Adam loop is consuming
-                want = item[4] + self._D2H_WINDOW
-                while getattr(self, "_async_d2h", True) \
-                        and d2h_issued <= want \
-                        and d2h_issued < len(shard_bufs):
-                    try:
-                        shard_bufs[d2h_issued].copy_to_host_async()
-                    except Exception:  # noqa: BLE001
-                        self._async_d2h = False
-                    d2h_issued += 1
-                t0 = _time.time()
-                g *= coef  # unscale (+clip) in place on the host copy
-                i, (idx, p, m, v), _, rows, _ = item
-                if rows is not None:
-                    # sub_group chunk: in-place Adam on contiguous
-                    # row-range views of the host shard
-                    p = p[rows[0]:rows[1]]
-                    m = m[rows[0]:rows[1]]
-                    v = v[rows[0]:rows[1]]
-                host_adam_chunk(lib, p, g, m, v, hyper, bc1, bc2, adam_w)
-                phases["host_adam_s"] = phases.get("host_adam_s", 0.0) \
-                    + (_time.time() - t0)
-                # the moment a leaf's last chunk steps, queue its master
-                # shards on the upload batcher: packing + device_put run
-                # on the background upload worker in few large coalesced
-                # transfers (stage3_prefetch_bucket_size), riding behind
-                # the remaining chunks' Adam; drop the consumed grad
-                # references so their buffers free.
-                work[j] = None
-                left_in_leaf[i] -= 1
-                if left_in_leaf[i] == 0:
-                    t0 = _time.time()
-                    self._enqueue_leaf_upload(
-                        batcher, i, acc_specs[i][0], acc_shardings[i],
-                        hs["shard_leaves"][i])
-                    flat_acc[i] = None
-                    phases["h2d_dispatch_s"] = \
-                        phases.get("h2d_dispatch_s", 0.0) \
-                        + (_time.time() - t0)
+    def executor_snapshot(self):
+        """Engine-lifetime executor counters (mode, plans/segments
+        executed, per-kind walls, constructed overlap) — the payload of
+        the benches' ``extra.executor``."""
+        if self._plan_executor is None:
+            return {"mode": self._executor_mode, "plans_executed": 0,
+                    "segments_executed": 0, "last_plan_segments": 0}
+        return self._plan_executor.lifetime_snapshot()
 
     def _finish_offload_step(self, flat_params, acc_specs, acc_shardings,
                              hs):
@@ -2281,13 +2096,6 @@ class DeepSpeedEngine:
                                         hs["shard_leaves"])]
         self._finish_offload_step(flat_params, acc_specs, acc_shardings,
                                   hs)
-
-    def _offload_fetch_pool(self):
-        from concurrent.futures import ThreadPoolExecutor
-        if getattr(self, "_offload_pool", None) is None:
-            self._offload_pool = ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="offload-fetch")
-        return self._offload_pool
 
     def _upload_pool(self):
         from .zero.transfer import make_upload_pool
@@ -2441,7 +2249,11 @@ class DeepSpeedEngine:
             apply_fn = self._jit_priced(self._regime_jit_key("apply"),
                                         self._apply_step_fn,
                                         self.state, self._hyper())
-            self.state, metrics = apply_fn(self.state, self._hyper())
+            # one-segment plan: the apply program rides the same
+            # executor (and per-step accounting) as the offload plans
+            self.state, metrics = self.plan_executor().run_program(
+                "apply", "compute",
+                lambda: apply_fn(self.state, self._hyper()))
         self._step_metrics = {k: v for k, v in metrics.items()}
         overflow = self._read_overflow(metrics)
         if overflow:
@@ -2504,8 +2316,10 @@ class DeepSpeedEngine:
             fused = self._jit_priced("fused_micros", self._fused_micros_fn,
                                      self.state, batch, step_rng,
                                      self._pld_theta())
-            self.state, mean_loss = fused(self.state, batch, step_rng,
-                                          self._pld_theta())
+            self.state, mean_loss = self.plan_executor().run_program(
+                "fused_micros", "compute",
+                lambda: fused(self.state, batch, step_rng,
+                              self._pld_theta()))
             metrics = self._host_apply_step()
         else:
             batch = self._to_device_stacked(batch)
@@ -2515,9 +2329,13 @@ class DeepSpeedEngine:
                                      self._fused_train_fn,
                                      self.state, batch, step_rng,
                                      self._hyper(), self._pld_theta())
-            self.state, (mean_loss, metrics) = fused(
-                self.state, batch, step_rng, self._hyper(),
-                self._pld_theta())
+            # one-segment plan: the fused train program rides the same
+            # executor (and per-step accounting) as the offload plans
+            self.state, (mean_loss, metrics) = \
+                self.plan_executor().run_program(
+                    "fused_train", "compute",
+                    lambda: fused(self.state, batch, step_rng,
+                                  self._hyper(), self._pld_theta()))
         overflow = self._read_overflow(metrics)
         if overflow:
             self.skipped_steps += 1

@@ -1,0 +1,55 @@
+"""Segment-graph executor: one overlap-constructing scheduler under
+every step path (docs/executor.md).
+
+``plan.py`` defines the :class:`SegmentPlan` / :class:`Segment`
+vocabulary (the shard-lint IR's segment kinds), ``scheduler.py`` the
+:class:`PlanExecutor` that runs plans serially (the bit-exact oracle,
+``runtime.executor: "off"``) or with constructed transfer/compute
+overlap (``on``/``auto``), and ``offload.py`` / ``stream.py`` the
+lowerings of the classic ZeRO-Offload and streamed beyond-HBM step
+paths onto it.
+
+``plan_for_engine`` is the abstract entry point the auditor uses via
+``analysis.ir.plan_of``: the same plan topology that executes, with no
+payloads attached.
+"""
+from .plan import PlanError, Segment, SegmentPlan, SEGMENT_KINDS
+from .scheduler import PlanExecutor, SegmentRecord
+
+
+def plan_for_engine(engine, family=None):
+    """The abstract segment plan of ``engine``'s step path (topology
+    only — run payloads are None). ``family``: ``"offload_apply"`` /
+    ``"streamed_micro"`` / ``"streamed_apply"``; default resolves from
+    the engine's live path. Raises ValueError for paths that have no
+    multi-segment lowering (micro/fused run as one-segment plans built
+    inline at step time)."""
+    if family is None:
+        if getattr(engine, "stream_runner", None) is not None:
+            family = "streamed_micro"
+        elif getattr(engine, "host_state", None) is not None:
+            family = "offload_apply"
+        else:
+            raise ValueError(
+                "plan_for_engine: engine runs the {} path, which lowers "
+                "to one-segment plans built at step time — only the "
+                "offload/streamed paths expose a multi-segment plan "
+                "ahead of time".format(
+                    getattr(engine, "_step_path", "micro")))
+    if family == "offload_apply":
+        from .offload import build_update_plan
+        return build_update_plan(engine)
+    if family == "streamed_micro":
+        from .stream import build_micro_plan
+        runner = engine.stream_runner
+        runner._bind()
+        return build_micro_plan(runner)
+    if family == "streamed_apply":
+        raise ValueError(
+            "streamed_apply's plan shape depends on which slots carry "
+            "grads this step — audit the streamed_micro plan instead")
+    raise ValueError("unknown plan family {!r}".format(family))
+
+
+__all__ = ["Segment", "SegmentPlan", "SegmentRecord", "PlanExecutor",
+           "PlanError", "SEGMENT_KINDS", "plan_for_engine"]

@@ -1,0 +1,158 @@
+"""Segment plans: the one step-scheduling representation every engine
+path lowers onto.
+
+A :class:`SegmentPlan` is an ordered DAG of :class:`Segment` nodes in
+the shard-lint IR's segment vocabulary (``analysis/ir.py``
+``SEGMENT_KINDS``: compute / collective / host / transfer / sharding).
+Each node declares
+
+  * ``deps`` — the segment names whose results it consumes (the plan's
+    insertion order must be a valid topological order: a dep always
+    precedes its consumer, so the serial "oracle" execution is simply
+    insertion order);
+  * ``run(env)`` — the payload: reads its inputs from the value
+    environment (keyed by producer segment name), returns the value
+    stored under its own name. ``None`` in ABSTRACT plans (built by
+    ``analysis.ir.plan_of`` for the auditor — topology only, nothing
+    executable);
+  * ``start(env)`` — optional nonblocking launch hook for async-eligible
+    segments (issue a ``copy_to_host_async``, enqueue an upload on the
+    coalescing batcher); the scheduler calls it on the main thread the
+    moment the segment is dispatched, then runs ``run`` on the segment
+    class's worker — this is where transfer/compute overlap is
+    CONSTRUCTED rather than hoped for (T3, 2401.16677);
+  * ``async_ok`` / ``pool`` — whether the segment may run off the main
+    thread, and on which serial worker class (``"d2h"`` / ``"h2d"``);
+  * ``phase`` / ``wait_phase`` — the engine phase-clock names its run
+    wall and its dep-wait wall bill to (the SAME disjoint keys the
+    StepRecord ``phases`` dict always carried, so telemetry consumers
+    see no schema change);
+  * ``donate`` — the donation declaration of the jitted program the
+    segment invokes (informational mirror of the one declaration the
+    jit path reads, e.g. ``stream.STREAM_DONATE``), plus ``flops`` /
+    ``nbytes`` prices when the lowering knows them.
+
+``validate()`` is the plan-level contract the auditor enforces on
+lowered plans (``analysis/auditor.py`` via ``ir.plan_of``): unique
+names, declared kinds in the IR vocabulary, every dep resolvable, and
+deps-precede-consumers (acyclic by construction).
+"""
+import dataclasses
+
+# The schedulable-segment vocabulary. Canonically defined by the
+# shard-lint IR (analysis/ir.py SEGMENT_KINDS); duplicated here so the
+# runtime executor never imports the analysis package at module scope
+# (tests/unit/test_executor.py pins the two tuples equal).
+SEGMENT_KINDS = ("compute", "collective", "host", "transfer", "sharding")
+
+# serial worker classes async segments may run on
+POOL_KEYS = ("d2h", "h2d", "host")
+
+
+@dataclasses.dataclass
+class Segment:
+    name: str
+    kind: str
+    deps: tuple = ()
+    run: object = None            # callable(env) -> value, or None (abstract)
+    start: object = None          # optional nonblocking launch hook(env)
+    async_ok: bool = False
+    pool: str = "d2h"             # worker class when async_ok
+    phase: str = None             # phase clock the run wall bills to
+    wait_phase: str = None        # phase clock dep-wait walls bill to
+    donate: tuple = ()            # declared donation of the jitted payload
+    flops: float = 0.0            # price, when the lowering knows it
+    nbytes: int = 0               # payload bytes (transfers), when known
+    keep_result: bool = False     # exempt from refcount release
+
+    def __post_init__(self):
+        self.deps = tuple(self.deps)
+
+
+class PlanError(ValueError):
+    """A malformed segment plan (duplicate name, unknown kind, dangling
+    or out-of-order dep)."""
+
+
+class SegmentPlan:
+    """Ordered segment DAG. Insertion order IS the serial schedule."""
+
+    def __init__(self, name, segments=None):
+        self.name = str(name)
+        self.segments = []
+        self._by_name = {}
+        # per-plan overrides of the executor's in-flight windows (e.g.
+        # the streamed micro plan's grad fetches all ride behind compute
+        # like the bespoke path's deferred resolve — unbounded window)
+        self.windows = {}
+        for seg in segments or ():
+            self.add(seg)
+
+    def add(self, segment):
+        if segment.name in self._by_name:
+            raise PlanError("plan {!r}: duplicate segment {!r}".format(
+                self.name, segment.name))
+        self.segments.append(segment)
+        self._by_name[segment.name] = segment
+        return segment
+
+    def __len__(self):
+        return len(self.segments)
+
+    def __getitem__(self, name):
+        return self._by_name[name]
+
+    def __contains__(self, name):
+        return name in self._by_name
+
+    def validate(self):
+        """-> list of problem strings; empty = valid. The executor
+        refuses to run an invalid plan; the auditor turns each problem
+        into a finding."""
+        problems = []
+        seen = set()
+        for seg in self.segments:
+            if seg.kind not in SEGMENT_KINDS:
+                problems.append(
+                    "segment {!r} has unknown kind {!r} (vocabulary: "
+                    "{})".format(seg.name, seg.kind,
+                                 "/".join(SEGMENT_KINDS)))
+            if seg.async_ok and seg.pool not in POOL_KEYS:
+                problems.append(
+                    "segment {!r} names unknown worker pool {!r}".format(
+                        seg.name, seg.pool))
+            for dep in seg.deps:
+                if dep not in self._by_name:
+                    problems.append(
+                        "segment {!r} depends on unknown segment "
+                        "{!r}".format(seg.name, dep))
+                elif dep not in seen:
+                    problems.append(
+                        "segment {!r} depends on {!r} which is inserted "
+                        "AFTER it — insertion order must be a "
+                        "topological order".format(seg.name, dep))
+            seen.add(seg.name)
+        return problems
+
+    def consumer_counts(self):
+        """{segment name: number of dependents} — the refcount table the
+        scheduler uses to release a segment's result (free its device
+        buffers) the moment the last consumer finished."""
+        counts = {seg.name: 0 for seg in self.segments}
+        for seg in self.segments:
+            for dep in seg.deps:
+                if dep in counts:
+                    counts[dep] += 1
+        return counts
+
+    def summary(self):
+        """Per-kind node counts + declared prices — the plan-shape view
+        telemetry and ``extra.executor`` report."""
+        per_kind = {}
+        for seg in self.segments:
+            slot = per_kind.setdefault(seg.kind,
+                                       {"segments": 0, "nbytes": 0})
+            slot["segments"] += 1
+            slot["nbytes"] += int(seg.nbytes or 0)
+        return {"name": self.name, "segments": len(self.segments),
+                "per_kind": per_kind}

@@ -1,0 +1,376 @@
+"""PlanExecutor: runs a :class:`~.plan.SegmentPlan` as an ordered,
+overlappable schedule.
+
+One scheduler under every step path (ISSUE 13 / ROADMAP item 1): the
+engines describe WHAT a step does (segments + deps + prices); this
+module owns WHEN — phase timing, async dispatch, bounded transfer
+windows, result lifetime — implemented exactly once instead of once per
+engine path.
+
+Two modes, selected by the strict-validated ``runtime.executor``
+ds_config key (``auto|on|off``; docs/executor.md):
+
+  * ``serial`` (``off``) — every segment runs inline on the calling
+    thread in plan (insertion) order. This is the bit-exact ORACLE: the
+    same payloads in the same order with zero constructed overlap.
+  * ``overlap`` (``on``/``auto``) — async-eligible segments (host and
+    transfer work marked ``async_ok``) are launched the moment their
+    deps resolve, bounded by a per-pool in-flight window (each pool is
+    ONE serial worker, so launch order is execution order and values
+    never reorder): their ``start`` hook fires on the main thread
+    (issue the DMA / enqueue the coalesced upload) and ``run`` rides
+    the worker while the main thread streams the next compute segment.
+    Overlap is CONSTRUCTED from the dependency graph, not recovered by
+    a lucky scheduler (T3 2401.16677, 2305.06942).
+
+Numerics contract: both modes invoke identical payloads with identical
+inputs in an identical consumption order — mode changes WALL CLOCK
+placement only, never values (pinned bit-exactly by
+tests/unit/test_executor.py and the dryrun executor leg).
+
+Accounting: per-segment wall/wait records (the flight-recorder span
+tree of an executed step is derived 1:1 from them — spans.py), phase
+clocks billed to the SAME disjoint keys the bespoke paths used
+(``host_adam_s`` / ``d2h_wait_s`` / ...), and a per-step
+``step_snapshot()`` in the ``SEGMENT_KEYS`` schema
+(telemetry/record.py) with per-kind run/wait walls and the constructed
+``overlap_efficiency`` = main-thread-busy / (busy + exposed waits).
+"""
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from ...utils.lifecycle import AtexitCloseMixin
+from .plan import PlanError, Segment, SegmentPlan
+
+# bounded in-flight launches per worker class: each launched-but-not-
+# yet-consumed async segment may pin buffers (a D2H staging copy, an
+# uploaded layer group), so the window bounds the extra memory overlap
+# may use — the executor twin of engine._D2H_WINDOW and the streamed
+# runner's "2 live groups" budget.
+DEFAULT_WINDOWS = {"d2h": 4, "h2d": 2, "host": 4}
+
+# launch-ahead scan horizon: async segments sit within a few plan
+# positions of their consumers in every lowering, and the windows are
+# single digits — bounding the per-iteration scan keeps the scheduler
+# O(n·H) instead of O(n²) on thousand-segment offload plans
+LOOKAHEAD_SEGMENTS = 64
+
+
+class SegmentRecord:
+    """One executed segment's measured walls (consumed by telemetry
+    spans and the per-step snapshot)."""
+
+    __slots__ = ("name", "kind", "phase", "start_s", "end_s", "run_s",
+                 "wait_s", "async_run", "nbytes")
+
+    def __init__(self, name, kind, phase=None, nbytes=0):
+        self.name = name
+        self.kind = kind
+        self.phase = phase
+        self.start_s = None
+        self.end_s = None
+        self.run_s = 0.0
+        self.wait_s = 0.0
+        self.async_run = False
+        self.nbytes = int(nbytes or 0)
+
+    def to_dict(self):
+        return {"name": self.name, "kind": self.kind,
+                "start_s": self.start_s, "end_s": self.end_s,
+                "run_s": self.run_s, "wait_s": self.wait_s,
+                "async": self.async_run, "nbytes": self.nbytes}
+
+
+def _timed_run(fn, snap):
+    t0 = time.time()
+    value = fn(snap) if fn is not None else None
+    return value, t0, time.time()
+
+
+class PlanExecutor(AtexitCloseMixin):
+    """Executes segment plans; owns the worker pools and the per-step
+    accounting. One instance per engine (``engine.plan_executor()``)."""
+
+    def __init__(self, mode="overlap", windows=None):
+        if mode not in ("overlap", "serial"):
+            raise ValueError(
+                "executor mode must be 'overlap' or 'serial', got "
+                "{!r}".format(mode))
+        self.mode = mode
+        self.windows = dict(DEFAULT_WINDOWS)
+        if windows:
+            self.windows.update({k: int(v) for k, v in windows.items()})
+        self._pools = {}
+        # per-step accounting (drained by the telemetry emit path)
+        self._step_records = []
+        # engine-lifetime counters (bench extra.executor); per-kind
+        # walls accumulate at drain time so the lifetime view survives
+        # the per-step record drains
+        self.plans_total = 0
+        self.segments_total = 0
+        self.last_plan_segments = 0
+        self._life_per_kind = {}
+        self._life_busy = 0.0
+        self._life_waits = 0.0
+
+    # ------------------------------------------------------------- pools
+    def _pool(self, key):
+        pool = self._pools.get(key)
+        if pool is None:
+            if not self._pools:
+                self._register_atexit_close()
+            pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="executor-" + key)
+            self._pools[key] = pool
+        return pool
+
+    def close(self):
+        """Shut down the worker pools. Registered at interpreter exit
+        when the first pool spins up (long multi-engine processes never
+        accumulate idle workers past close); idempotent, and a later
+        execute() lazily rebuilds what it needs."""
+        if self._finish_close():
+            return
+        for pool in self._pools.values():
+            pool.shutdown(wait=False)
+        self._pools = {}
+
+    # ----------------------------------------------------------- execute
+    def execute(self, plan, env=None, phases=None):
+        """Run ``plan``; returns the value environment (results of
+        segments nobody consumed stay available to the caller). Phase
+        walls accumulate into ``phases`` when given (the engine's
+        ``offload_phase_times`` dict)."""
+        problems = plan.validate()
+        if problems:
+            raise PlanError("plan {!r} invalid: {}".format(
+                plan.name, "; ".join(problems)))
+        env = {} if env is None else env
+        phases = {} if phases is None else phases
+        overlap = self.mode == "overlap"
+        windows = dict(self.windows)
+        windows.update(plan.windows)
+        segs = plan.segments
+        remaining = plan.consumer_counts()
+        launched = {}               # name -> (future, record)
+        completed = set()
+        inflight = {}               # pool -> launched-not-yet-consumed
+        records = []
+
+        def bill(phase, dt):
+            if phase and dt > 0:
+                phases[phase] = phases.get(phase, 0.0) + dt
+
+        def dep_done(name):
+            if name in completed:
+                return True
+            ent = launched.get(name)
+            return ent is not None and ent[0].done()
+
+        def materialize(name, waiter=None, wait_phase=None):
+            """Ensure ``env[name]`` holds an async segment's result;
+            bills the blocking residual (the EXPOSED wait overlap could
+            not hide) to the waiter."""
+            ent = launched.get(name)
+            if ent is None or name in completed:
+                return
+            fut, rec = ent
+            t0 = time.time()
+            value, r0, r1 = fut.result()
+            wait = time.time() - t0
+            rec.start_s, rec.end_s, rec.run_s = r0, r1, r1 - r0
+            env[name] = value
+            completed.add(name)
+            if wait > 0:
+                bill(wait_phase, wait)
+                if waiter is not None:
+                    waiter.wait_s += wait
+
+        def consume(seg):
+            """Decrement the refcount of each dep; release exhausted
+            results (frees device buffers at the same point the bespoke
+            paths dropped their references)."""
+            for dep in seg.deps:
+                left = remaining.get(dep)
+                if left is None:
+                    continue
+                left -= 1
+                remaining[dep] = left
+                if left == 0:
+                    dep_seg = plan[dep]
+                    if not dep_seg.keep_result:
+                        env.pop(dep, None)
+                    if dep in launched:
+                        inflight[dep_seg.pool] = max(
+                            inflight.get(dep_seg.pool, 0) - 1, 0)
+
+        def launch_ahead(idx):
+            """Launch every async-eligible segment from ``idx`` on whose
+            deps resolved, within its pool window — in plan order per
+            pool (one blocked segment blocks the segments behind it on
+            the same pool, so a serial worker never reorders)."""
+            if not overlap:
+                return
+            blocked = set()
+            for seg in segs[idx:idx + LOOKAHEAD_SEGMENTS]:
+                if not seg.async_ok or seg.name in launched or \
+                        seg.name in completed:
+                    continue
+                if seg.pool in blocked:
+                    continue
+                if inflight.get(seg.pool, 0) >= \
+                        windows.get(seg.pool, 1) or \
+                        not all(dep_done(d) for d in seg.deps):
+                    blocked.add(seg.pool)
+                    continue
+                for dep in seg.deps:
+                    materialize(dep)        # futures done: no wait
+                snap = {d: env[d] for d in set(seg.deps)}
+                rec = SegmentRecord(seg.name, seg.kind, phase=seg.phase,
+                                    nbytes=seg.nbytes)
+                rec.async_run = True
+                if seg.start is not None:
+                    seg.start(snap)
+                fut = self._pool(seg.pool).submit(_timed_run, seg.run,
+                                                  snap)
+                launched[seg.name] = (fut, rec)
+                records.append(rec)
+                inflight[seg.pool] = inflight.get(seg.pool, 0) + 1
+                consume(seg)    # snapshot holds the dep refs now
+
+        try:
+            for idx, seg in enumerate(segs):
+                launch_ahead(idx)
+                if seg.name in launched:
+                    continue                # riding a worker
+                rec = SegmentRecord(seg.name, seg.kind, phase=seg.phase,
+                                    nbytes=seg.nbytes)
+                for dep in seg.deps:
+                    materialize(dep, waiter=rec,
+                                wait_phase=seg.wait_phase)
+                snap = {d: env[d] for d in set(seg.deps)}
+                t0 = time.time()
+                if seg.start is not None:
+                    seg.start(snap)
+                value = seg.run(snap) if seg.run is not None else None
+                t1 = time.time()
+                rec.start_s, rec.end_s, rec.run_s = t0, t1, t1 - t0
+                bill(seg.phase, rec.run_s)
+                env[seg.name] = value
+                completed.add(seg.name)
+                records.append(rec)
+                consume(seg)
+        finally:
+            # drain stragglers (none on the happy path: every async
+            # segment has a consumer) so a raised step never leaves a
+            # worker mutating freed state
+            for name, (fut, _rec) in list(launched.items()):
+                if name not in completed:
+                    try:
+                        value, r0, r1 = fut.result()
+                        _rec.start_s, _rec.end_s = r0, r1
+                        _rec.run_s = r1 - r0
+                        env[name] = value
+                        completed.add(name)
+                    except Exception:  # noqa: BLE001 - secondary failure
+                        pass
+            self._step_records.extend(records)
+            self.plans_total += 1
+            self.segments_total += len(segs)
+            self.last_plan_segments = len(segs)
+        return env
+
+    def run_program(self, name, kind, fn, phase=None):
+        """One-segment convenience plan: the micro/fused/apply jit
+        programs ride the same executor (and the same accounting) as
+        the multi-segment offload lowerings."""
+        plan = SegmentPlan(name)
+        plan.add(Segment(name=name, kind=kind, phase=phase,
+                         run=lambda env: fn()))
+        return self.execute(plan)[name]
+
+    # -------------------------------------------------------- accounting
+    def drain_step_records(self):
+        """This step's executed-segment records (for the span tree);
+        clears the per-step buffer, folding the walls into the
+        lifetime per-kind totals."""
+        per_kind, busy, waits = self._aggregate(self._step_records)
+        for kind, slot in per_kind.items():
+            life = self._life_per_kind.setdefault(
+                kind, {"segments": 0, "run_s": 0.0, "wait_s": 0.0})
+            for key in ("segments", "run_s", "wait_s"):
+                life[key] += slot[key]
+        self._life_busy += busy
+        self._life_waits += waits
+        records = self._step_records
+        self._step_records = []
+        return records
+
+    @staticmethod
+    def _aggregate(records):
+        per_kind = {}
+        busy = waits = 0.0
+        for rec in records:
+            slot = per_kind.setdefault(
+                rec.kind, {"segments": 0, "run_s": 0.0, "wait_s": 0.0})
+            slot["segments"] += 1
+            slot["run_s"] += rec.run_s
+            slot["wait_s"] += rec.wait_s
+            waits += rec.wait_s
+            if rec.async_run:
+                continue            # hidden behind main-thread work
+            if rec.kind == "transfer":
+                waits += rec.run_s  # serial mode: exposed transfer wall
+            else:
+                busy += rec.run_s
+        return per_kind, busy, waits
+
+    @staticmethod
+    def _rounded(per_kind):
+        return {kind: {"segments": slot["segments"],
+                       "run_s": round(slot["run_s"], 6),
+                       "wait_s": round(slot["wait_s"], 6)}
+                for kind, slot in per_kind.items()}
+
+    def step_snapshot(self):
+        """Per-kind walls + constructed overlap for the live step window
+        (SEGMENT_KEYS core; the caller merges path-specific upload
+        counters). ``plan_segments`` counts every segment executed in
+        the window — ALL the step's plans (gas micro-plans + the apply
+        on the streamed path); one plan's own size lives in the audit
+        report's ``plan/<name>`` entry. Does NOT clear —
+        ``drain_step_records`` does."""
+        per_kind, busy, waits = self._aggregate(self._step_records)
+        eff = None
+        if busy + waits > 0:
+            eff = round(busy / (busy + waits), 4)
+        return {
+            "plan_segments": len(self._step_records),
+            "per_kind": self._rounded(per_kind),
+            "overlap_efficiency": eff,
+        }
+
+    def lifetime_snapshot(self):
+        """Engine-lifetime counters (bench ``extra.executor``):
+        cumulative per-kind walls over every executed plan (drained
+        steps included) + the live window."""
+        per_kind, busy, waits = self._aggregate(self._step_records)
+        for kind, life in self._life_per_kind.items():
+            slot = per_kind.setdefault(
+                kind, {"segments": 0, "run_s": 0.0, "wait_s": 0.0})
+            for key in ("segments", "run_s", "wait_s"):
+                slot[key] += life[key]
+        busy += self._life_busy
+        waits += self._life_waits
+        eff = None
+        if busy + waits > 0:
+            eff = round(busy / (busy + waits), 4)
+        return {
+            "plan_segments": len(self._step_records),
+            "per_kind": self._rounded(per_kind),
+            "overlap_efficiency": eff,
+            "mode": self.mode,
+            "plans_executed": self.plans_total,
+            "segments_executed": self.segments_total,
+            "last_plan_segments": self.last_plan_segments,
+        }

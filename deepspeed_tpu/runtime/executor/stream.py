@@ -1,0 +1,307 @@
+"""Streamed (beyond-HBM) parameter offload, lowered onto the segment
+executor.
+
+Replaces the bespoke hand-interleaved upload/compute/fetch loop that
+lived in ``StreamedOffloadRunner.micro_step``: one micro step is now a
+:class:`~.plan.SegmentPlan` —
+
+  ``up/e_f -> e_fwd -> [up/g_f<g> -> g_fwd/<g>]* -> up/h_f -> h_grad
+  -> [up/g_b<g> -> g_bwd/<g>]* (reverse) -> up/e_b -> e_bwd``
+
+with an async ``d2h/*`` grad-fetch segment per packed gradient vector
+and one ``resolve`` segment accumulating them into the host buffers in
+plan order (bit-for-bit the bespoke fetch order). The double-buffered
+"current + prefetched layer group" discipline is the ``h2d`` pool's
+in-flight window of 2 — constructed by the scheduler from the declared
+deps, not by hand-threaded ``pending`` variables.
+
+The optimizer apply (host Adam over the accumulated grads) lowers to a
+plan of per-slot host segments (``run_streamed_apply``).
+
+``build_micro_plan(runner)`` with no payloads is the ABSTRACT twin for
+``analysis.ir.plan_of`` / the auditor: same topology, nothing
+executable.
+"""
+import numpy as np
+
+import jax
+
+from ..zero.transfer import chunk_rows, host_adam_chunk
+from .offload import resolve_adam_step
+from .plan import Segment, SegmentPlan
+
+
+def _micro_topology(G):
+    """Ordered (name, kind, deps, pool, phase) descriptors of one
+    streamed micro step over ``G`` layer groups — the ONE place the
+    plan shape is written down (concrete and abstract builders share
+    it, so ``plan_of`` can never drift from what executes)."""
+    nodes = []
+
+    def add(name, kind, deps=(), pool=None, phase=None):
+        nodes.append((name, kind, tuple(deps), pool, phase))
+
+    add("up/e_f", "transfer", (), "h2d", "h2d_wait_s")
+    add("e_fwd", "compute", ("up/e_f",), None, "compute_fwd_s")
+    prev = "e_fwd"
+    for g in range(G):
+        add("up/g_f%d" % g, "transfer", (), "h2d", "h2d_wait_s")
+        add("g_fwd/%d" % g, "compute", ("up/g_f%d" % g, prev), None,
+            "compute_fwd_s")
+        prev = "g_fwd/%d" % g
+    add("up/h_f", "transfer", (), "h2d", "h2d_wait_s")
+    add("h_grad", "compute", ("up/h_f", prev), None, "compute_bwd_s")
+    add("loss", "host", ("h_grad",), None, None)
+    add("d2h/h", "transfer", ("h_grad",), "d2h", "d2h_grads_s")
+    prev_dx = "h_grad"
+    for g in reversed(range(G)):
+        if g == G - 1:
+            dev = "up/g_f%d" % g        # the last fwd group's upload is
+            # KEPT for the first backward group (no re-stream)
+        else:
+            dev = "up/g_b%d" % g
+            add(dev, "transfer", (), "h2d", "h2d_wait_s")
+        x_in = "e_fwd" if g == 0 else "g_fwd/%d" % (g - 1)
+        add("g_bwd/%d" % g, "compute", (dev, x_in, prev_dx), None,
+            "compute_bwd_s")
+        add("d2h/g%d" % g, "transfer", ("g_bwd/%d" % g,), "d2h",
+            "d2h_grads_s")
+        prev_dx = "g_bwd/%d" % g
+    add("up/e_b", "transfer", (), "h2d", "h2d_wait_s")
+    add("e_bwd", "compute", ("up/e_b", prev_dx), None, "compute_bwd_s")
+    add("d2h/e", "transfer", ("e_bwd",), "d2h", "d2h_grads_s")
+    fetches = ["d2h/h"] + ["d2h/g%d" % g for g in reversed(range(G))] \
+        + ["d2h/e"]
+    add("resolve", "host", tuple(fetches), None, "d2h_grads_s")
+    return nodes, fetches
+
+
+def build_micro_plan(runner, payloads=None):
+    """Segment plan of one streamed micro step. ``payloads`` maps
+    names to (run, start); absent -> abstract plan (``ir.plan_of``)."""
+    G = len(runner.groups)
+    nodes, fetches = _micro_topology(G)
+    payloads = payloads or {}
+    plan = SegmentPlan("streamed_micro")
+    # grad fetches all ride behind compute and resolve at the end (the
+    # bespoke deferred-resolve semantics): unbounded d2h window; the
+    # h2d window of 2 IS the "current + prefetched group" HBM budget
+    plan.windows = {"d2h": len(fetches), "h2d": 2}
+    from ..zero.stream import STREAM_DONATE
+    for name, kind, deps, pool, phase in nodes:
+        run, start = payloads.get(name, (None, None))
+        plan.add(Segment(
+            name=name, kind=kind, deps=deps, run=run, start=start,
+            async_ok=pool is not None, pool=pool or "d2h", phase=phase,
+            wait_phase="h2d_wait_s" if kind == "compute"
+            else ("d2h_grads_s" if name == "resolve" else None),
+            keep_result=(name == "loss"),
+            # the plan mirrors the ONE donation declaration the jit
+            # path and the shard-lint auditor read (stream.py)
+            donate=STREAM_DONATE.get(name.split("/")[0], ())))
+    return plan
+
+
+def run_streamed_micro(runner, batch, rng):
+    """One streamed micro step on the executor: forward + backward with
+    grads accumulated into the host buffers. Returns the (unscaled)
+    loss as a device scalar — bit-exact with the bespoke loop (same
+    programs, same values, same accumulation order)."""
+    eng = runner.engine
+    runner._bind()
+    gas = eng.gradient_accumulation_steps()
+    scaler = eng.state["scaler"]
+    scale = np.float32(float(scaler.cur_scale) / gas)
+    inv_scale = np.float32(1.0 / float(scaler.cur_scale))
+    has_rng = eng.model.accepts_rng and rng is not None
+    keys_all = (jax.random.split(rng, runner.n_layers)
+                if has_rng else None)
+    G = len(runner.groups)
+    e_def, b_defs, h_def = runner._e_def, runner._b_defs, runner._h_def
+    key0 = keys_all[0] if has_rng else None
+
+    payloads = {}
+
+    def upload(name, leaves):
+        pending = {}
+
+        def start(env):
+            pending["p"] = runner._start_upload(leaves)
+
+        def run(env):
+            return runner._finish_upload(pending["p"], bill_wait=False)
+
+        payloads[name] = (run, start)
+
+    def compute(name, key, builder, make_args):
+        def run(env):
+            return runner._run(key, builder, *make_args(env))
+
+        payloads[name] = (run, None)
+
+    def d2h(name, producer, pick):
+        def start(env):
+            try:
+                pick(env[producer]).copy_to_host_async()
+            except Exception:  # noqa: BLE001 - plugin without async copy
+                pass
+
+        def run(env):
+            return np.asarray(pick(env[producer]))
+
+        payloads[name] = (run, start)
+
+    upload("up/e_f", runner._e_leaves)
+    compute("e_fwd", ("e_fwd", has_rng),
+            lambda: runner._embed_fwd_fn(e_def, has_rng),
+            lambda env: (env["up/e_f"], batch, key0))
+    for g in range(G):
+        start_i, stop_i = runner.groups[g]
+        defs = tuple(b_defs[start_i:stop_i])
+        gkeys = keys_all[start_i:stop_i] if has_rng else None
+        upload("up/g_f%d" % g, runner._group_leaves(g))
+        x_src = "e_fwd" if g == 0 else "g_fwd/%d" % (g - 1)
+        compute("g_fwd/%d" % g, ("g_fwd", defs, has_rng),
+                lambda d=defs: runner._group_fwd_fn(d, has_rng),
+                lambda env, g=g, x=x_src, k=gkeys:
+                (runner._split_group(env["up/g_f%d" % g], g),
+                 env[x], k))
+    upload("up/h_f", runner._h_leaves)
+    x_last = "g_fwd/%d" % (G - 1) if G else "e_fwd"
+    compute("h_grad", ("h_grad", has_rng),
+            lambda: runner._head_grad_fn(h_def, has_rng),
+            lambda env: (env["up/h_f"], env[x_last], batch, key0, scale,
+                         inv_scale))
+    payloads["loss"] = (lambda env: env["h_grad"][0], None)
+    d2h("d2h/h", "h_grad", lambda out: out[2])
+    for g in reversed(range(G)):
+        start_i, stop_i = runner.groups[g]
+        defs = tuple(b_defs[start_i:stop_i])
+        gkeys = keys_all[start_i:stop_i] if has_rng else None
+        dev = "up/g_f%d" % g if g == G - 1 else "up/g_b%d" % g
+        if g != G - 1:
+            upload(dev, runner._group_leaves(g))
+        x_in = "e_fwd" if g == 0 else "g_fwd/%d" % (g - 1)
+        dx_src = "h_grad" if g == G - 1 else "g_bwd/%d" % (g + 1)
+        dx_pos = 1 if g == G - 1 else 0
+        compute("g_bwd/%d" % g, ("g_bwd", defs, has_rng),
+                lambda d=defs: runner._group_bwd_fn(d, has_rng),
+                lambda env, g=g, dev=dev, x=x_in, dxs=dx_src, dxp=dx_pos,
+                k=gkeys:
+                (runner._split_group(env[dev], g), env[x],
+                 env[dxs][dxp], k, inv_scale))
+        d2h("d2h/g%d" % g, "g_bwd/%d" % g, lambda out: out[1])
+    upload("up/e_b", runner._e_leaves)
+    dx_src = "g_bwd/0" if G else "h_grad"
+    dx_pos = 0 if G else 1
+    compute("e_bwd", ("e_bwd", has_rng),
+            lambda: runner._embed_bwd_fn(e_def, has_rng),
+            lambda env: (env["up/e_b"], batch, env[dx_src][dx_pos], key0,
+                         inv_scale))
+    d2h("d2h/e", "e_bwd", lambda out: out)
+
+    _, fetches = _micro_topology(G)
+    fetch_slots = {
+        "d2h/h": (runner._h_slots,
+                  [np.shape(p) for p in runner._h_leaves]),
+        "d2h/e": (runner._e_slots,
+                  [np.shape(p) for p in runner._e_leaves]),
+    }
+    for g in range(G):
+        start_i, stop_i = runner.groups[g]
+        fetch_slots["d2h/g%d" % g] = (
+            [s for i in range(start_i, stop_i)
+             for s in runner._b_slots[i]],
+            [np.shape(p) for p in runner._group_leaves(g)])
+
+    def resolve(env):
+        finite_all, sumsq_all = True, 0.0
+        for name in fetches:
+            slot_idxs, shapes = fetch_slots[name]
+            finite, sumsq = runner._accumulate_fetched(
+                env[name], slot_idxs, shapes)
+            finite_all = finite_all and finite
+            sumsq_all += sumsq
+        runner._micro_finites.append(finite_all)
+        runner._micro_sumsqs.append(sumsq_all)
+        runner._micros_in_step += 1
+
+    payloads["resolve"] = (resolve, None)
+
+    plan = build_micro_plan(runner, payloads=payloads)
+    env = eng.plan_executor().execute(plan, phases=runner.phase_times)
+    return env["loss"]
+
+
+def run_streamed_apply(runner):
+    """Host Adam over the accumulated grads, as a plan of per-slot host
+    segments (chunked by ``sub_group_size``), with classic offload's
+    overflow-skip semantics. Returns the metrics dict; the caller
+    updates the scaler — bit-exact with the bespoke loop."""
+    eng = runner.engine
+    scaler = eng.state["scaler"]
+    cur_scale = float(scaler.cur_scale)
+    inv_scale = 1.0 / cur_scale
+    clip = eng.gradient_clipping()
+
+    finite = all(runner._micro_finites) if runner._micro_finites \
+        else False
+    if runner._micros_in_step == 1 and \
+            not getattr(runner, "_has_shared_slots", True):
+        # single micro, no tied leaves: the per-segment device
+        # reductions sum to the true norm
+        sumsq = sum(runner._micro_sumsqs)
+    else:
+        # multi-micro windows price PARTIAL per-micro grads, and tied
+        # leaves (wte in embed+head) need the square of the SUM, not
+        # the sum of squares — recompute over the accumulated host
+        # buffers (one bandwidth pass)
+        sumsq = 0.0
+        if finite:
+            for buf in runner._grad_bufs:
+                if buf is None:
+                    continue
+                flat = buf.ravel()
+                if not np.all(np.isfinite(flat)):
+                    finite = False
+                    break
+                scaled = flat.astype(np.float64) * inv_scale
+                sumsq += float(np.dot(scaled, scaled))
+    overflow = (not finite) or not np.isfinite(sumsq)
+
+    grad_norm = 0.0
+    if not overflow:
+        grad_norm, coef, hyper, bc1, bc2, adam_w, lib = \
+            resolve_adam_step(eng, sumsq, inv_scale, clip)
+
+        plan = SegmentPlan("streamed_apply")
+        for slot, (p, m, v) in enumerate(runner._slots):
+            if runner._grad_bufs[slot] is None:
+                continue
+            plan.add(Segment(
+                name="adam/%d" % slot, kind="host",
+                phase="host_adam_s",
+                run=_slot_adam(runner, slot, p, m, v, eng, coef, hyper,
+                               bc1, bc2, adam_w, lib)))
+        eng.plan_executor().execute(plan, phases=runner.phase_times)
+    runner.zero_grads()
+    return {"overflow": overflow, "grad_norm": grad_norm,
+            "loss_scale": cur_scale}
+
+
+def _slot_adam(runner, slot, p, m, v, eng, coef, hyper, bc1, bc2,
+               adam_w, lib):
+    def run(env):
+        g = runner._grad_bufs[slot]
+        for r0, r1 in chunk_rows(np.shape(p), eng._sub_group_size):
+            if np.shape(p):
+                pc, gc = p[r0:r1], g[r0:r1]
+                mc, vc = m[r0:r1], v[r0:r1]
+            else:
+                pc, gc, mc, vc = p, g, m, v
+            # fresh scratch: host_adam_chunk consumes g in place
+            gc = gc * np.float32(coef)
+            host_adam_chunk(lib, pc, gc, mc, vc, hyper, bc1, bc2,
+                            adam_w)
+
+    return run

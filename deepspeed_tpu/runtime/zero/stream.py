@@ -40,7 +40,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...utils.logging import logger, log_dist
-from .transfer import H2DBatcher, chunk_rows, host_adam_chunk
+from .transfer import H2DBatcher
 
 
 def _full_index(shape):
@@ -187,8 +187,12 @@ class StreamedOffloadRunner:
         batcher.flush()
         return batcher, [np.shape(a) for a in leaves]
 
-    def _finish_upload(self, pending):
-        """Block on a queued upload; return replicated global arrays."""
+    def _finish_upload(self, pending, bill_wait=True):
+        """Block on a queued upload; return replicated global arrays.
+        ``bill_wait=False`` when the executor runs this on its h2d
+        worker — there the EXPOSED wait is billed by the scheduler at
+        the consuming compute segment, so billing the worker's own wall
+        here would double-count it."""
         t0 = time.time()
         batcher, shapes = pending
         res = batcher.finish()
@@ -197,8 +201,10 @@ class StreamedOffloadRunner:
             singles = list(res[li].values())
             out.append(jax.make_array_from_single_device_arrays(
                 shape, self._replicated, singles))
-        self.phase_times["h2d_wait_s"] = \
-            self.phase_times.get("h2d_wait_s", 0.0) + (time.time() - t0)
+        if bill_wait:
+            self.phase_times["h2d_wait_s"] = \
+                self.phase_times.get("h2d_wait_s", 0.0) + \
+                (time.time() - t0)
         # upload accounting (per device replica; telemetry snapshot)
         elems = sum(int(np.prod(s)) if s else 1 for s in shapes)
         self._step_upload_batches += batcher.batches
@@ -229,13 +235,16 @@ class StreamedOffloadRunner:
         self.engine._tele_add_flops(("stream",) + tuple(key), fn, *args)
         return fn(*args)
 
-    def transfer_snapshot(self):
-        """Per-step upload/overlap stats for the telemetry record
-        (T3-style: how much of the step's wall the host<->HBM transfers
-        could not hide behind compute) + bucket occupancy of the
-        coalesced H2D batcher. Read-only — safe as a debugging probe;
-        the telemetry emit path resets the per-step counters afterwards
-        via reset_step_counters()."""
+    def transfer_snapshot(self, exec_stats=None):
+        """Per-step upload/overlap stats for the telemetry record in
+        the unified ``SEGMENT_KEYS`` schema (telemetry/record.py — the
+        same shape the classic-offload executor stats use, validated by
+        bin/check_bench_schema.py): T3-style overlap efficiency, bucket
+        occupancy of the coalesced H2D batcher, and the executed plan's
+        per-kind walls when the engine's PlanExecutor ran this step.
+        Read-only — safe as a debugging probe; the telemetry emit path
+        resets the per-step counters afterwards via
+        reset_step_counters()."""
         eng = self.engine
         phases = getattr(eng, "offload_phase_times", None) or {}
         compute = sum(phases.get(k, 0.0) for k in
@@ -244,7 +253,10 @@ class StreamedOffloadRunner:
                     ("h2d_wait_s", "d2h_grads_s"))
         bucket_elems = eng._h2d_bucket_elems
         batches = self._step_upload_batches
+        exec_stats = exec_stats or {}
         snap = {
+            "plan_segments": int(exec_stats.get("plan_segments", 0)),
+            "per_kind": exec_stats.get("per_kind", {}),
             "upload_batches": batches,
             "upload_elems": self._step_upload_elems,
             "upload_bytes": self._step_upload_elems *
@@ -430,233 +442,45 @@ class StreamedOffloadRunner:
                 for leaf in self._b_leaves[i]]
 
     # ------------------------------------------------------------- fetch
-    def _queue_grad_fetch(self, packed, slot_idxs, shapes, fetches):
-        """Async D2H of a segment's packed grad vector; resolution
-        splits it into host views and accumulates per slot."""
-        try:
-            packed.copy_to_host_async()
-        except Exception:  # noqa: BLE001 - plugin without async copy
-            pass
-        fetches.append((packed, slot_idxs, shapes))
-
-    def _resolve_fetches(self, fetches):
-        t0 = time.time()
-        finite_all, sumsq_all = True, 0.0
-        for packed, slot_idxs, shapes in fetches:
-            host = np.asarray(packed)
-            off = 0
-            for slot, shape in zip(slot_idxs, shapes):
-                n = int(np.prod(shape)) if shape else 1
-                view = host[off:off + n].reshape(shape)
-                off += n
-                if self._grad_bufs[slot] is None:
-                    # adopt the fetched view without copying — jax host
-                    # buffers are read-only, so a later accumulation
-                    # into this slot (tied leaf / gas>1) copies lazily
-                    self._grad_bufs[slot] = view
-                elif self._grad_bufs[slot].flags.writeable:
-                    self._grad_bufs[slot] += view
-                else:
-                    self._grad_bufs[slot] = self._grad_bufs[slot] + view
-            finite_all = finite_all and bool(host[off] > 0.5)
-            sumsq_all += float(host[off + 1])
-        self.phase_times["d2h_grads_s"] = \
-            self.phase_times.get("d2h_grads_s", 0.0) + (time.time() - t0)
-        return finite_all, sumsq_all
+    def _accumulate_fetched(self, host, slot_idxs, shapes):
+        """Split one fetched packed grad vector into per-leaf host views
+        and accumulate per slot; returns the packed (finite, sumsq)
+        tail. Called by the executor's ``resolve`` segment in the
+        bespoke fetch order (runtime/executor/stream.py)."""
+        off = 0
+        for slot, shape in zip(slot_idxs, shapes):
+            n = int(np.prod(shape)) if shape else 1
+            view = host[off:off + n].reshape(shape)
+            off += n
+            if self._grad_bufs[slot] is None:
+                # adopt the fetched view without copying — jax host
+                # buffers are read-only, so a later accumulation
+                # into this slot (tied leaf / gas>1) copies lazily
+                self._grad_bufs[slot] = view
+            elif self._grad_bufs[slot].flags.writeable:
+                self._grad_bufs[slot] += view
+            else:
+                self._grad_bufs[slot] = self._grad_bufs[slot] + view
+        return bool(host[off] > 0.5), float(host[off + 1])
 
     # ------------------------------------------------------------- steps
     def micro_step(self, batch, rng):
         """One streamed micro-step: forward + backward with grads
         accumulated into the host buffers. Returns the (unscaled) loss
-        as a device scalar."""
-        eng = self.engine
-        self._bind()
-        gas = eng.gradient_accumulation_steps()
-        scaler = eng.state["scaler"]
-        scale = np.float32(float(scaler.cur_scale) / gas)
-        inv_scale = np.float32(1.0 / float(scaler.cur_scale))
-        has_rng = eng.model.accepts_rng and rng is not None
-        keys_all = (jax.random.split(rng, self.n_layers)
-                    if has_rng else None)
-        G = len(self.groups)
-        e_def, b_defs, h_def = self._e_def, self._b_defs, self._h_def
-        fetches = []
-
-        # ---- forward: embed -> groups (double-buffered uploads) -> head
-        # section clocks exclude the h2d waits accumulated inside them
-        # (phases stay disjoint: h2d_wait + compute_fwd + compute_bwd +
-        # d2h_grads + host_adam ~ step wall)
-        w0 = self.phase_times.get("h2d_wait_s", 0.0)
-        t_fwd = time.time()
-        pending = self._start_upload(self._e_leaves)
-        e_dev = self._finish_upload(pending)
-        pending = self._start_upload(self._group_leaves(0)) if G else None
-        key0 = keys_all[0] if has_rng else None
-        x = self._run(("e_fwd", has_rng),
-                      lambda: self._embed_fwd_fn(e_def, has_rng),
-                      tuple(e_dev), batch, key0)
-        del e_dev
-        acts = [x]
-        group_devs = [None] * G
-        for g in range(G):
-            dev_g = self._split_group(self._finish_upload(pending), g)
-            if g + 1 < G:
-                pending = self._start_upload(self._group_leaves(g + 1))
-            else:
-                pending = self._start_upload(self._h_leaves)
-            start, stop = self.groups[g]
-            gkeys = keys_all[start:stop] if has_rng else None
-            x = self._run(
-                ("g_fwd", tuple(b_defs[start:stop]), has_rng),
-                lambda: self._group_fwd_fn(tuple(b_defs[start:stop]),
-                                           has_rng),
-                dev_g, x, gkeys)
-            acts.append(x)
-            if g == G - 1:
-                group_devs[g] = dev_g  # reuse for the first backward
-            del dev_g
-        fwd_waits = self.phase_times.get("h2d_wait_s", 0.0) - w0
-        self.phase_times["compute_fwd_s"] = \
-            self.phase_times.get("compute_fwd_s", 0.0) + \
-            (time.time() - t_fwd) - fwd_waits
-
-        # ---- head loss + backward
-        w0 = self.phase_times.get("h2d_wait_s", 0.0)
-        t_bwd = time.time()
-        h_dev = self._finish_upload(pending)
-        loss, dx, h_packed = self._run(
-            ("h_grad", has_rng),
-            lambda: self._head_grad_fn(h_def, has_rng),
-            tuple(h_dev), acts[-1], batch, key0, scale, inv_scale)
-        del h_dev
-        self._queue_grad_fetch(
-            h_packed, self._h_slots,
-            [np.shape(p) for p in self._h_leaves], fetches)
-        pending = (self._start_upload(self._group_leaves(G - 2))
-                   if G >= 2 else None)
-        for g in reversed(range(G)):
-            if group_devs[g] is None:
-                bl = self._split_group(self._finish_upload(pending), g)
-                pending = (self._start_upload(self._group_leaves(g - 1))
-                           if g - 1 >= 0 else None)
-            else:
-                bl = group_devs[g]
-                group_devs[g] = None
-                pending = (self._start_upload(self._group_leaves(g - 1))
-                           if g - 1 >= 0 else None) \
-                    if pending is None else pending
-            start, stop = self.groups[g]
-            gkeys = keys_all[start:stop] if has_rng else None
-            dx, g_packed = self._run(
-                ("g_bwd", tuple(b_defs[start:stop]), has_rng),
-                lambda: self._group_bwd_fn(tuple(b_defs[start:stop]),
-                                           has_rng),
-                bl, acts[g], dx, gkeys, inv_scale)
-            del bl
-            acts[g + 1] = None
-            slot_idxs = [s for i in range(start, stop)
-                         for s in self._b_slots[i]]
-            shapes = [np.shape(p) for p in self._group_leaves(g)]
-            self._queue_grad_fetch(g_packed, slot_idxs, shapes, fetches)
-            if g == 0:
-                pending = self._start_upload(self._e_leaves)
-        e_dev = self._finish_upload(pending) if pending is not None \
-            else self._finish_upload(self._start_upload(self._e_leaves))
-        e_packed = self._run(
-            ("e_bwd", has_rng),
-            lambda: self._embed_bwd_fn(e_def, has_rng),
-            tuple(e_dev), batch, dx, key0, inv_scale)
-        del e_dev, dx
-        self._queue_grad_fetch(
-            e_packed, self._e_slots,
-            [np.shape(p) for p in self._e_leaves], fetches)
-        bwd_waits = self.phase_times.get("h2d_wait_s", 0.0) - w0
-        self.phase_times["compute_bwd_s"] = \
-            self.phase_times.get("compute_bwd_s", 0.0) + \
-            (time.time() - t_bwd) - bwd_waits
-
-        finite, sumsq = self._resolve_fetches(fetches)
-        self._micro_finites.append(finite)
-        self._micro_sumsqs.append(sumsq)
-        self._micros_in_step += 1
-        return loss
+        as a device scalar. Lowered onto the segment executor
+        (runtime/executor/stream.py): the double-buffered upload /
+        compute / grad-fetch interleaving that used to be hand-threaded
+        here is now a SegmentPlan the scheduler overlaps."""
+        from ..executor.stream import run_streamed_micro
+        return run_streamed_micro(self, batch, rng)
 
     def apply_step(self):
         """Host Adam over the accumulated grads (chunked by
         sub_group_size), with classic offload's overflow-skip
-        semantics. Returns the metrics dict; the caller updates the
-        scaler."""
-        eng = self.engine
-        hs = eng.host_state
-        hyper = eng._hyper()
-        scaler = eng.state["scaler"]
-        cur_scale = float(scaler.cur_scale)
-        inv_scale = 1.0 / cur_scale
-        clip = eng.gradient_clipping()
-        phases = self.phase_times
-
-        finite = all(self._micro_finites) if self._micro_finites \
-            else False
-        if self._micros_in_step == 1 and \
-                not getattr(self, "_has_shared_slots", True):
-            # single micro, no tied leaves: the per-segment device
-            # reductions sum to the true norm
-            sumsq = sum(self._micro_sumsqs)
-        else:
-            # multi-micro windows price PARTIAL per-micro grads, and
-            # tied leaves (wte in embed+head) need the square of the
-            # SUM, not the sum of squares — recompute over the
-            # accumulated host buffers (one bandwidth pass)
-            sumsq = 0.0
-            if finite:
-                for buf in self._grad_bufs:
-                    if buf is None:
-                        continue
-                    flat = buf.ravel()
-                    if not np.all(np.isfinite(flat)):
-                        finite = False
-                        break
-                    scaled = flat.astype(np.float64) * inv_scale
-                    sumsq += float(np.dot(scaled, scaled))
-        overflow = (not finite) or not np.isfinite(sumsq)
-
-        grad_norm = 0.0
-        if not overflow:
-            grad_norm = float(np.sqrt(sumsq))
-            coef = inv_scale
-            if clip > 0 and grad_norm > clip:
-                coef *= clip / (grad_norm + 1e-6)
-            hs["step"] += 1
-            step = hs["step"]
-            beta1, beta2 = hyper["beta1"], hyper["beta2"]
-            bias_correction = getattr(eng.optimizer, "bias_correction",
-                                      True)
-            bc1 = 1.0 - beta1 ** step if bias_correction else 1.0
-            bc2 = 1.0 - beta2 ** step if bias_correction else 1.0
-            adam_w = 1 if getattr(eng.optimizer, "adam_w_mode", True) \
-                else 0
-            lib = eng._offload_lib()
-            t0 = time.time()
-            for slot, (p, m, v) in enumerate(self._slots):
-                g = self._grad_bufs[slot]
-                if g is None:
-                    continue
-                for r0, r1 in chunk_rows(np.shape(p),
-                                         eng._sub_group_size):
-                    if np.shape(p):
-                        pc, gc = p[r0:r1], g[r0:r1]
-                        mc, vc = m[r0:r1], v[r0:r1]
-                    else:
-                        pc, gc, mc, vc = p, g, m, v
-                    # fresh scratch: host_adam_chunk consumes g in place
-                    gc = gc * np.float32(coef)
-                    host_adam_chunk(lib, pc, gc, mc, vc, hyper, bc1,
-                                    bc2, adam_w)
-            phases["host_adam_s"] = phases.get("host_adam_s", 0.0) + \
-                (time.time() - t0)
-        self.zero_grads()
-        return {"overflow": overflow, "grad_norm": grad_norm,
-                "loss_scale": cur_scale}
+        semantics, lowered onto the segment executor. Returns the
+        metrics dict; the caller updates the scaler."""
+        from ..executor.stream import run_streamed_apply
+        return run_streamed_apply(self)
 
     def zero_grads(self):
         self._grad_bufs = [None] * len(self._grad_bufs or [])

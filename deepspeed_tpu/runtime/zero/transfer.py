@@ -152,10 +152,11 @@ def make_upload_pool(name="offload-upload"):
 
 def host_adam_chunk(lib, p, g, m, v, hyper, bc1, bc2, adam_w):
     """One in-place host Adam chunk on fp32 numpy arrays (native SIMD
-    kernel when built, numpy fallback otherwise) — shared by the classic
-    offload shard pipeline (engine._offload_update_loop) and the
-    streamed-offload runner (stream.py). ``g`` is consumed (the
-    classic-L2 mode folds decay into it in place)."""
+    kernel when built, numpy fallback otherwise) — shared by the
+    executor-lowered classic offload plan (runtime/executor/offload.py)
+    and the streamed-offload apply plan (runtime/executor/stream.py).
+    ``g`` is consumed (the classic-L2 mode folds decay into it in
+    place)."""
     beta1, beta2 = hyper["beta1"], hyper["beta2"]
     if lib is not None:
         lib.ds_cpu_adam_step(

@@ -245,7 +245,7 @@ class TelemetryCollector(AtexitCloseMixin):
                         loss_scale, overflow, skipped_steps, micro_steps,
                         tokens_per_step, model_flops_per_step, phases,
                         wire=None, comm_overlap=None, offload=None,
-                        pipe=None, hbm=None, path=None):
+                        pipe=None, hbm=None, path=None, segments=None):
         n = max(self._n_devices, 1)
         dt = max(float(step_time_s), 1e-12)
         rec = rec_mod.make_train_record(
@@ -272,7 +272,8 @@ class TelemetryCollector(AtexitCloseMixin):
                 attrs["path"] = str(path)
             self.spans.emit_step_tree(
                 "train_step", step=step, t0=rec["wall"] - dt,
-                t1=rec["wall"], phases=rec["phases"], attrs=attrs)
+                t1=rec["wall"], phases=rec["phases"], attrs=attrs,
+                segments=segments)
         if self.watchdog is not None:
             self.watchdog.step_end()
             self.watchdog.observe_train(rec)

@@ -42,6 +42,83 @@ SERVING_STEP_KEYS = (
     "ttft", "tpot", "page_pool", "prefix", "speculative",
 )
 
+# Unified per-segment/offload stats schema (ISSUE 13): the ONE shape
+# both offload paths' StepRecord ``offload`` sub-dict uses — the
+# streamed runner's transfer_snapshot() and the classic-offload
+# executor stats emit exactly these keys (plus optional path extras),
+# so telemetry consumers join on one schema. ``plan_segments``/
+# ``per_kind`` come from the PlanExecutor (runtime/executor/) and
+# cover the whole step window — every segment of every plan the step
+# executed (gas micro-plans + apply on the streamed path), NOT one
+# plan's size (that lives in the audit report's plan/<name> entry);
+# ``upload_*``/``bucket_*`` from the coalescing H2D batcher;
+# ``overlap_efficiency`` is the constructed transfer/compute overlap
+# (T3-style compute/(compute+exposed waits)). Validated by
+# ``validate_segment_stats`` here and by bin/check_bench_schema.py's
+# stdlib copy (pinned equal by tests/unit/test_executor.py).
+SEGMENT_KEYS = (
+    "plan_segments", "per_kind", "overlap_efficiency",
+    "upload_batches", "upload_elems", "upload_bytes",
+    "bucket_elems", "bucket_occupancy",
+)
+# per-kind sub-dict numeric keys (kinds = the shard-lint IR vocabulary)
+SEGMENT_KIND_KEYS = ("segments", "run_s", "wait_s")
+# path-specific extras a SEGMENT_KEYS dict may additionally carry
+SEGMENT_OPTIONAL_KEYS = (
+    "segment_upload_bytes_peak", "groups", "collective_matmul",
+    "work_chunks", "mode", "plans_executed", "segments_executed",
+    "last_plan_segments",
+)
+
+
+def validate_segment_stats(stats):
+    """Schema check for one SEGMENT_KEYS stats dict (a StepRecord's
+    ``offload`` sub-dict on the lowered paths, or a bench's
+    ``extra.executor``). Returns a list of problem strings."""
+    problems = []
+    if not isinstance(stats, dict):
+        return ["segment stats is not a dict: {!r}".format(
+            type(stats).__name__)]
+    for key in SEGMENT_KEYS:
+        if key not in stats:
+            problems.append("missing key {!r}".format(key))
+    extra = sorted(set(stats) - set(SEGMENT_KEYS)
+                   - set(SEGMENT_OPTIONAL_KEYS))
+    if extra:
+        problems.append("unexpected key(s) {}".format(extra))
+    if problems:
+        return problems
+    for key in ("plan_segments", "upload_batches", "upload_elems",
+                "upload_bytes", "bucket_elems"):
+        val = stats[key]
+        if isinstance(val, bool) or not isinstance(val, _NUMERIC) or \
+                val < 0:
+            problems.append(
+                "{} is not a nonnegative number: {!r}".format(key, val))
+    for key in ("overlap_efficiency", "bucket_occupancy"):
+        val = stats[key]
+        if val is not None and (isinstance(val, bool) or
+                                not isinstance(val, _NUMERIC)):
+            problems.append(
+                "{} is neither null nor a number: {!r}".format(key, val))
+    per_kind = stats["per_kind"]
+    if not isinstance(per_kind, dict):
+        problems.append("per_kind is not a dict")
+        return problems
+    for kind, slot in per_kind.items():
+        if not isinstance(slot, dict):
+            problems.append("per_kind.{} is not a dict".format(kind))
+            continue
+        for key in SEGMENT_KIND_KEYS:
+            val = slot.get(key)
+            if isinstance(val, bool) or not isinstance(val, _NUMERIC) \
+                    or val < 0:
+                problems.append(
+                    "per_kind.{}.{} is not a nonnegative number: "
+                    "{!r}".format(kind, key, val))
+    return problems
+
+
 # nullable serving sub-dicts and the numeric keys each must carry
 SERVING_SUBDICT_KEYS = {
     "ttft": ("count", "mean_s", "p50_s", "p95_s"),

@@ -209,20 +209,38 @@ class SpanTracer:
         return root
 
     def emit_step_tree(self, name, *, step, t0, t1, phases=None,
-                       attrs=None):
+                       attrs=None, segments=None):
         """Derive and export one step's span tree from its measured
         window [t0, t1] and the StepRecord's disjoint phase clocks: the
         root spans the window; each phase becomes a child, laid out
         sequentially from t0 (the clocks are disjoint by construction —
         see engine._telemetry_phases — so the sequential layout
-        preserves every duration)."""
+        preserves every duration).
+
+        ``segments``: the PlanExecutor's executed-segment records for
+        steps that ran as segment plans (runtime/executor/). When
+        given, the children ARE the executed plan — one span per
+        segment at its measured wall, named by its plan node, so the
+        trace tree and the segment plan cannot drift (a phase-derived
+        tree is the fallback for unlowered paths)."""
         root = self.begin(name, start_s=t0, **(dict(attrs or {},
                                                     step=int(step))))
-        at = t0
-        for phase, dur in (phases or {}).items():
-            dur = float(dur)
-            root.timed_child(str(phase), at, at + dur)
-            at += dur
+        if segments:
+            for rec in segments:
+                start = rec.start_s if rec.start_s is not None else t0
+                end = rec.end_s if rec.end_s is not None else start
+                child = root.timed_child(rec.name, start, end,
+                                         kind=rec.kind)
+                if rec.async_run:
+                    child.attrs["async"] = True
+                if rec.wait_s:
+                    child.attrs["wait_s"] = round(rec.wait_s, 6)
+        else:
+            at = t0
+            for phase, dur in (phases or {}).items():
+                dur = float(dur)
+                root.timed_child(str(phase), at, at + dur)
+                at += dur
         root.end(end_s=t1)
         return root
 

@@ -1,0 +1,122 @@
+"""Paired serial-vs-overlap microbench of the executor-lowered classic
+ZeRO-Offload step (ISSUE 13).
+
+Two engines over identical data — ``runtime.executor: "off"`` (the
+serial oracle: every segment inline in plan order, zero constructed
+overlap) vs ``"on"`` (async D2H fetches windowed ahead of the host
+Adam, uploads riding the coalescing batcher) — interleaved per round so
+machine drift cancels. Asserts the two streams are BIT-IDENTICAL
+(the executor's numerics contract), then reports the median step-wall
+ratio and the constructed per-segment overlap the bespoke pre-executor
+path never reported.
+
+Writes tests/perf/BENCH_EXECUTOR_OVERLAP.json (bench.py-shaped;
+bin/check_bench_schema.py validates, including the SEGMENT_KEYS
+``extra.executor`` block).
+"""
+import json
+import os
+import statistics
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+ROUNDS = 3
+STEPS_PER_ROUND = 5
+
+
+def _engine(mode, tele_dir):
+    import deepspeed_tpu as deepspeed
+    from deepspeed_tpu.models import gpt2
+    cfg = gpt2.GPT2Config(vocab_size=256, max_seq_len=128, n_layers=4,
+                          n_heads=4, d_model=128,
+                          use_flash_attention=False, remat=False,
+                          loss_chunk=0)
+    engine, _, _, _ = deepspeed.initialize(
+        model=gpt2.make_gpt2_model(config=cfg),
+        config_params={
+            "train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": 1,
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 2, "cpu_offload": True,
+                                  "sub_group_size": 65536},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+            "runtime": {"executor": mode},
+            "steps_per_print": 10 ** 9,
+            "telemetry": {"enabled": True, "output_path": tele_dir},
+        })
+    return engine, cfg
+
+
+def main():
+    from bench import scratch_telemetry_dir
+    engines = {}
+    for mode in ("off", "on"):
+        engines[mode] = _engine(
+            mode, scratch_telemetry_dir("bench_exec_%s_" % mode))
+    cfg = engines["on"][1]
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size,
+                      size=(4, cfg.max_seq_len)).astype(np.int32)
+
+    def step(engine):
+        loss = engine(ids, ids.copy())
+        engine.backward(loss)
+        engine.step()
+        return float(loss)
+
+    # warmup/compile both
+    losses = {m: [step(e)] for m, (e, _) in engines.items()}
+    walls = {"off": [], "on": []}
+    for _ in range(ROUNDS):
+        for mode in ("off", "on"):
+            engine = engines[mode][0]
+            t0 = time.time()
+            for _ in range(STEPS_PER_ROUND):
+                losses[mode].append(step(engine))
+            walls[mode].append((time.time() - t0) / STEPS_PER_ROUND)
+    assert losses["off"] == losses["on"], \
+        "executor modes diverged: {} vs {}".format(
+            losses["off"][-1], losses["on"][-1])
+
+    med = {m: statistics.median(w) for m, w in walls.items()}
+    snaps = {m: engines[m][0].telemetry_snapshot()["offload_last"]
+             for m in ("off", "on")}
+    payload = {
+        "metric": "offload_executor_overlap_step_ratio",
+        # >1.0 = the constructed overlap beat the serial oracle
+        "value": round(med["off"] / med["on"], 4),
+        "unit": "x (serial wall / overlap wall)",
+        "vs_baseline": None,
+        "extra": {
+            "serial_sec_per_step_median": round(med["off"], 4),
+            "overlap_sec_per_step_median": round(med["on"], 4),
+            "rounds": ROUNDS, "steps_per_round": STEPS_PER_ROUND,
+            "loss_last": losses["on"][-1],
+            "bit_identical": True,
+            "offload_last": {"serial": snaps["off"],
+                             "overlap": snaps["on"]},
+            "executor": engines["on"][0].executor_snapshot(),
+            "telemetry": engines["on"][0].telemetry_snapshot(),
+        },
+    }
+    out = os.path.join(os.path.dirname(__file__),
+                       "BENCH_EXECUTOR_OVERLAP.json")
+    with open(out, "w") as fh:
+        json.dump(payload, fh, indent=2)
+        fh.write("\n")
+    print(json.dumps({k: payload[k] for k in
+                      ("metric", "value", "unit")}))
+    print("serial {:.4f}s/step overlap {:.4f}s/step -> {}x; "
+          "overlap_efficiency serial={} overlap={}".format(
+              med["off"], med["on"], payload["value"],
+              snaps["off"].get("overlap_efficiency"),
+              snaps["on"].get("overlap_efficiency")))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

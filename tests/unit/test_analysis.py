@@ -115,10 +115,16 @@ def test_clean_offload_family():
     engine = _make_engine(zero={"stage": 2, "cpu_offload": True})
     report = engine.audit(batch=_batch())
     assert report.findings == [], [f.key for f in report.findings]
+    # ISSUE 13: the audit also validates the lowered executor plan and
+    # records its shape as plan/<name> alongside the program families
     assert set(report.programs) == {"micro", "fused_micros",
-                                    "offload_check"}
+                                    "offload_check",
+                                    "plan/offload_apply"}
     assert all(m["family"] == "offload"
-               for m in report.programs.values())
+               for name, m in report.programs.items()
+               if not name.startswith("plan/"))
+    assert report.programs["plan/offload_apply"]["family"] == "plan"
+    assert report.programs["plan/offload_apply"]["plan_segments"] > 2
 
 
 def test_clean_streamed_family():
@@ -129,7 +135,7 @@ def test_clean_streamed_family():
     assert report.findings == [], [f.key for f in report.findings]
     assert set(report.programs) == {
         "stream/e_fwd", "stream/g_fwd", "stream/h_grad", "stream/g_bwd",
-        "stream/e_bwd"}
+        "stream/e_bwd", "plan/streamed_micro"}
     # the audited donation sets ARE the executed ones (one declaration)
     from deepspeed_tpu.runtime.zero.stream import STREAM_DONATE
     assert report.programs["stream/g_bwd"]["donate_argnums"] == \

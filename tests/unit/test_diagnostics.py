@@ -200,6 +200,37 @@ def test_train_step_span_tree_matches_phases(tmp_path):
         assert validate_span(s) == []
 
 
+def test_offload_span_tree_is_the_executed_segment_plan(tmp_path):
+    """ISSUE 13: on the executor-lowered paths the step's span tree IS
+    the executed segment plan — one child per segment, named by its
+    plan node with its kind attr — so trace durations and plan nodes
+    cannot drift (phase-derived trees remain the micro/fused
+    fallback)."""
+    from deepspeed_tpu.runtime.executor import plan_for_engine
+    engine = _engine(tmp_path, telemetry=_diag_telemetry(tmp_path),
+                     extra={"zero_optimization": {
+                         "stage": 2, "cpu_offload": True},
+                         "bf16": {"enabled": True}})
+    plan_names = [s.name for s in plan_for_engine(engine).segments]
+    plan_kinds = {s.name: s.kind
+                  for s in plan_for_engine(engine).segments}
+    _train_steps(engine, 2)
+    spans = _spans_of(engine)
+    roots = [s for s in spans if s["parent_id"] is None]
+    assert len(roots) == 2
+    for root in roots:
+        assert root["attrs"]["path"] == "offload"
+        kids = [s for s in spans if s["parent_id"] == root["span_id"]]
+        # tree == plan: same node names (the async launch order may
+        # permute the record order, never the node set)
+        assert sorted(k["name"] for k in kids) == sorted(plan_names)
+        for kid in kids:
+            assert kid["attrs"]["kind"] == plan_kinds[kid["name"]]
+            assert kid["dur_s"] is not None and kid["dur_s"] >= 0
+    for s in spans:
+        assert validate_span(s) == []
+
+
 def test_fused_path_span_labeled(tmp_path):
     engine = _engine(tmp_path, telemetry=_diag_telemetry(tmp_path),
                      extra={"train_batch_size": 8})

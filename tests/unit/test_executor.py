@@ -1,0 +1,547 @@
+"""Segment-graph executor (ISSUE 13): plan/scheduler semantics, the
+classic-offload + streamed lowerings bit-exact against the serial
+oracle, the unified SEGMENT_KEYS telemetry schema, and plan_of/audit.
+
+The load-bearing contract: ``runtime.executor`` changes WALL-CLOCK
+placement only, never values — serial and overlap runs produce
+bit-identical losses, master/optimizer state, and checkpoint bytes on
+both lowered paths.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import deepspeed_tpu as deepspeed
+from deepspeed_tpu.models import gpt2
+from deepspeed_tpu.runtime.config import DeepSpeedConfigError
+from deepspeed_tpu.runtime.executor import (PlanError, PlanExecutor,
+                                            Segment, SegmentPlan,
+                                            SEGMENT_KINDS,
+                                            plan_for_engine)
+from deepspeed_tpu.runtime.model import Model
+from deepspeed_tpu.telemetry import record as rec_mod
+
+pytestmark = pytest.mark.executor
+
+GPT_CFG = gpt2.GPT2Config(vocab_size=64, max_seq_len=32, n_layers=2,
+                          n_heads=2, d_model=32,
+                          use_flash_attention=False, remat=False,
+                          loss_chunk=0)
+
+
+def _linear_engine(mode="auto", offload=True, telemetry=None, lr=5e-2):
+    config = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": lr}},
+        "bf16": {"enabled": True},
+        "runtime": {"executor": mode},
+        "steps_per_print": 10 ** 9,
+    }
+    if offload:
+        config["zero_optimization"] = {"stage": 2, "cpu_offload": True,
+                                       "sub_group_size": 16}
+    if telemetry is not None:
+        config["telemetry"] = telemetry
+    engine, _, _, _ = deepspeed.initialize(
+        model=Model(lambda p, x, y: jnp.mean((x @ p["w"] - y) ** 2),
+                    {"w": jnp.zeros((8, 4))}),
+        config_params=config)
+    return engine
+
+
+def _gpt_engine(mode="auto", streamed=False, extra_zero=None):
+    zero = {"stage": 3 if streamed else 2, "cpu_offload": True}
+    if streamed:
+        zero.update({"cpu_offload_params": True,
+                     "stage3_max_live_parameters": 1})
+    zero.update(extra_zero or {})
+    engine, _, _, _ = deepspeed.initialize(
+        model=gpt2.make_gpt2_model(config=GPT_CFG),
+        config_params={
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 1,
+            "bf16": {"enabled": True},
+            "zero_optimization": zero,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "runtime": {"executor": mode},
+            "steps_per_print": 10 ** 9,
+        })
+    return engine
+
+
+def _gpt_ids(seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, GPT_CFG.vocab_size,
+                       size=(2, GPT_CFG.max_seq_len)).astype(np.int32)
+
+
+def _linear_batch(seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(8, 8).astype(np.float32)
+    return jnp.asarray(x), jnp.asarray(x @ rs.randn(8, 4)
+                                       .astype(np.float32))
+
+
+def _host_masters(engine):
+    return [np.asarray(tup[1])
+            for shards in engine.host_state["shard_leaves"]
+            for tup in shards]
+
+
+def _host_moments(engine):
+    return [np.asarray(arr)
+            for shards in engine.host_state["shard_leaves"]
+            for tup in shards for arr in (tup[2], tup[3])]
+
+
+# ------------------------------------------------------------ plan layer
+def test_plan_validate_catches_malformed_plans():
+    plan = SegmentPlan("p")
+    plan.add(Segment(name="a", kind="compute"))
+    with pytest.raises(PlanError):
+        plan.add(Segment(name="a", kind="compute"))     # duplicate
+    plan.add(Segment(name="b", kind="warp", deps=("a",)))
+    plan.add(Segment(name="c", kind="host", deps=("ghost",)))
+    plan.add(Segment(name="d", kind="host", deps=("e",)))
+    plan.add(Segment(name="e", kind="host"))
+    problems = plan.validate()
+    assert any("unknown kind 'warp'" in p for p in problems)
+    assert any("unknown segment 'ghost'" in p for p in problems)
+    assert any("inserted AFTER" in p for p in problems)
+    good = SegmentPlan("g", [Segment(name="a", kind="compute"),
+                             Segment(name="b", kind="host",
+                                     deps=("a",))])
+    assert good.validate() == []
+    assert good.consumer_counts() == {"a": 1, "b": 0}
+    assert good.summary()["segments"] == 2
+
+
+def test_executor_refuses_invalid_plan():
+    plan = SegmentPlan("bad", [Segment(name="x", kind="host",
+                                       deps=("nope",))])
+    with pytest.raises(PlanError):
+        PlanExecutor(mode="serial").execute(plan)
+
+
+def test_segment_kinds_pinned_to_ir_vocabulary():
+    from deepspeed_tpu.analysis.ir import SEGMENT_KINDS as IR_KINDS
+    assert tuple(SEGMENT_KINDS) == tuple(IR_KINDS)
+
+
+# ------------------------------------------------------- scheduler layer
+def _toy_plan(log):
+    plan = SegmentPlan("toy")
+    plan.add(Segment(name="src", kind="compute",
+                     run=lambda env: 2, phase="compute_s"))
+    plan.add(Segment(name="fetch", kind="transfer", deps=("src",),
+                     async_ok=True, pool="d2h", phase="t_s",
+                     run=lambda env: env["src"] * 10))
+    plan.add(Segment(name="consume", kind="host", deps=("fetch",),
+                     wait_phase="wait_s", phase="host_s",
+                     run=lambda env: log.append(env["fetch"]) or
+                     env["fetch"] + 1))
+    return plan
+
+
+@pytest.mark.parametrize("mode", ["serial", "overlap"])
+def test_scheduler_dataflow_and_release(mode):
+    log = []
+    ex = PlanExecutor(mode=mode)
+    env = ex.execute(_toy_plan(log))
+    assert log == [20]
+    # exhausted intermediates are released; terminal results retained
+    assert "src" not in env and "fetch" not in env
+    assert env["consume"] == 21
+    records = ex.drain_step_records()
+    assert [r.name for r in records] == ["src", "fetch", "consume"]
+    by_name = {r.name: r for r in records}
+    assert by_name["fetch"].async_run == (mode == "overlap")
+
+
+def test_scheduler_window_blocked_async_runs_inline():
+    """More async segments than the pool window: the blocked ones
+    execute synchronously at their own plan position — values and
+    completion never depend on the window."""
+    ex = PlanExecutor(mode="overlap", windows={"d2h": 1})
+    plan = SegmentPlan("windowed")
+    for i in range(4):
+        plan.add(Segment(name="t%d" % i, kind="transfer",
+                         async_ok=True, pool="d2h",
+                         run=lambda env, i=i: i))
+    plan.add(Segment(name="sum", kind="host",
+                     deps=tuple("t%d" % i for i in range(4)),
+                     run=lambda env: sum(env["t%d" % i]
+                                         for i in range(4))))
+    assert ex.execute(plan)["sum"] == 6
+
+
+def test_scheduler_phase_billing_keys():
+    log = []
+    phases = {}
+    PlanExecutor(mode="serial").execute(_toy_plan(log), phases=phases)
+    # serial: transfer run wall bills to ITS phase; host+compute to theirs
+    assert set(phases) >= {"compute_s", "t_s", "host_s"}
+
+
+def test_run_program_counts_one_segment():
+    ex = PlanExecutor(mode="overlap")
+    assert ex.run_program("apply", "compute", lambda: 7) == 7
+    snap = ex.lifetime_snapshot()
+    assert snap["plans_executed"] == 1
+    assert snap["last_plan_segments"] == 1
+    assert snap["per_kind"]["compute"]["segments"] == 1
+
+
+def test_overlap_constructs_real_concurrency():
+    """The overlap mode genuinely runs async segments concurrently with
+    main-thread segments (sleeps release the GIL, so this pins the
+    schedule, not numpy luck): serial pays both walls, overlap hides
+    the transfer behind the compute."""
+    import time as _time
+
+    def plan():
+        p = SegmentPlan("sleepy")
+        p.add(Segment(name="t", kind="transfer", async_ok=True,
+                      pool="d2h",
+                      run=lambda env: _time.sleep(0.15) or 1))
+        p.add(Segment(name="c", kind="compute",
+                      run=lambda env: _time.sleep(0.15) or 2))
+        p.add(Segment(name="join", kind="host", deps=("t", "c"),
+                      run=lambda env: env["t"] + env["c"]))
+        return p
+
+    t0 = _time.time()
+    assert PlanExecutor(mode="serial").execute(plan())["join"] == 3
+    serial = _time.time() - t0
+    t0 = _time.time()
+    assert PlanExecutor(mode="overlap").execute(plan())["join"] == 3
+    overlap = _time.time() - t0
+    assert serial > 0.28, serial
+    assert overlap < 0.25, overlap
+
+
+def test_worker_exception_propagates():
+    plan = SegmentPlan("boom")
+    plan.add(Segment(name="t", kind="transfer", async_ok=True,
+                     pool="d2h",
+                     run=lambda env: (_ for _ in ()).throw(
+                         RuntimeError("boom"))))
+    plan.add(Segment(name="use", kind="host", deps=("t",),
+                     run=lambda env: env["t"]))
+    with pytest.raises(RuntimeError, match="boom"):
+        PlanExecutor(mode="overlap").execute(plan)
+
+
+# ----------------------------------------------------- schema pins
+def test_segment_keys_pinned_to_checker_copy():
+    """bin/check_bench_schema.py must stay a bare stdlib script; its
+    local SEGMENT_* tables are pinned equal here so they cannot
+    drift."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(__file__), "..", "..", "bin",
+                        "check_bench_schema.py")
+    spec = importlib.util.spec_from_file_location("_cbs", path)
+    checker = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(checker)
+    assert tuple(checker.SEGMENT_KEYS) == tuple(rec_mod.SEGMENT_KEYS)
+    assert tuple(checker.SEGMENT_KIND_KEYS) == \
+        tuple(rec_mod.SEGMENT_KIND_KEYS)
+    assert tuple(checker.SEGMENT_OPTIONAL_KEYS) == \
+        tuple(rec_mod.SEGMENT_OPTIONAL_KEYS)
+
+
+def test_validate_segment_stats():
+    good = {"plan_segments": 3,
+            "per_kind": {"transfer": {"segments": 2, "run_s": 0.1,
+                                      "wait_s": 0.0}},
+            "overlap_efficiency": 0.8, "upload_batches": 1,
+            "upload_elems": 10, "upload_bytes": 40, "bucket_elems": 8,
+            "bucket_occupancy": None, "work_chunks": 4}
+    assert rec_mod.validate_segment_stats(good) == []
+    bad = dict(good)
+    bad.pop("per_kind")
+    assert rec_mod.validate_segment_stats(bad)
+    assert rec_mod.validate_segment_stats(
+        dict(good, mystery=1))          # unexpected key flags
+    assert rec_mod.validate_segment_stats(
+        dict(good, per_kind={"transfer": {"segments": -1, "run_s": 0,
+                                          "wait_s": 0}}))
+
+
+# ------------------------------------------- classic offload, bit-exact
+def test_classic_offload_serial_vs_overlap_bitexact():
+    engines = {m: _linear_engine(mode=m) for m in ("off", "on")}
+    x, y = _linear_batch()
+    for step in range(4):
+        losses = {}
+        for mode, eng in engines.items():
+            loss = eng(x, y)
+            eng.backward(loss)
+            eng.step()
+            losses[mode] = float(loss)
+        assert losses["off"] == losses["on"], (step, losses)
+    for a, b in zip(_host_masters(engines["off"]),
+                    _host_masters(engines["on"])):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(_host_moments(engines["off"]),
+                    _host_moments(engines["on"])):
+        np.testing.assert_array_equal(a, b)
+    # the overlap engine executed multi-segment plans (chunked d2h/adam
+    # + upload/reshard), and both modes saw identical plan shapes
+    snaps = {m: e.executor_snapshot() for m, e in engines.items()}
+    assert snaps["on"]["last_plan_segments"] > 4
+    assert snaps["on"]["last_plan_segments"] == \
+        snaps["off"]["last_plan_segments"]
+    assert snaps["on"]["mode"] == "overlap"
+    assert snaps["off"]["mode"] == "serial"
+
+
+def test_classic_offload_checkpoints_byte_identical(tmp_path):
+    dirs = {}
+    for mode in ("off", "on"):
+        eng = _linear_engine(mode=mode)
+        x, y = _linear_batch()
+        for _ in range(2):
+            loss = eng(x, y)
+            eng.backward(loss)
+            eng.step()
+        d = tmp_path / mode
+        eng.save_checkpoint(str(d), tag="t")
+        dirs[mode] = d
+    manifests = {}
+    for mode, d in dirs.items():
+        payload = json.load(open(os.path.join(str(d), "t",
+                                              "manifest.json")))
+        manifests[mode] = {name: rec["crc32"]
+                           for name, rec in payload["files"].items()}
+    assert manifests["off"] == manifests["on"]
+
+
+def test_classic_offload_overlap_efficiency_reported(tmp_path):
+    """The bespoke pre-executor classic path reported NO overlap
+    efficiency; the lowered plan reports the constructed overlap in
+    the unified SEGMENT_KEYS offload record."""
+    eng = _linear_engine(mode="on", telemetry={
+        "enabled": True, "output_path": str(tmp_path)})
+    x, y = _linear_batch()
+    for _ in range(2):
+        loss = eng(x, y)
+        eng.backward(loss)
+        eng.step()
+    snap = eng.telemetry_snapshot()["offload_last"]
+    assert rec_mod.validate_segment_stats(snap) == [], snap
+    assert snap["plan_segments"] > 4
+    assert snap["overlap_efficiency"] is not None
+    assert snap["overlap_efficiency"] > 0
+    assert snap["per_kind"]["host"]["segments"] > 0
+    assert snap["per_kind"]["transfer"]["segments"] > 0
+
+
+def test_offload_overflow_skip_still_resets(tmp_path):
+    """An overflowing step skips the plan entirely and resets the
+    accumulators (the bespoke overflow semantics)."""
+    eng = _linear_engine(mode="on", lr=5e-2)
+    x, y = _linear_batch()
+    loss = eng(x * np.float32(1e38), y * np.float32(1e38))
+    eng.backward(loss)
+    eng.step()
+    assert eng.skipped_steps == 1
+    assert eng.host_state["step"] == 0
+    # and a sane step afterwards still works
+    loss = eng(x, y)
+    eng.backward(loss)
+    eng.step()
+    assert eng.host_state["step"] == 1
+
+
+# ------------------------------------------------ streamed, bit-exact
+def test_streamed_serial_vs_overlap_bitexact():
+    engines = {m: _gpt_engine(mode=m, streamed=True)
+               for m in ("off", "on")}
+    assert len(engines["on"].stream_runner.groups) == GPT_CFG.n_layers
+    ids = _gpt_ids()
+    for step in range(3):
+        losses = {}
+        for mode, eng in engines.items():
+            loss = eng(ids, ids.copy())
+            eng.backward(loss)
+            eng.step()
+            losses[mode] = float(loss)
+        assert losses["off"] == losses["on"], (step, losses)
+    for a, b in zip(_host_masters(engines["off"]),
+                    _host_masters(engines["on"])):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(_host_moments(engines["off"]),
+                    _host_moments(engines["on"])):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_streamed_gas2_bitexact_across_modes():
+    def run(mode):
+        zero = {"stage": 3, "cpu_offload": True,
+                "cpu_offload_params": True,
+                "stage3_max_live_parameters": 1}
+        eng, _, _, _ = deepspeed.initialize(
+            model=gpt2.make_gpt2_model(config=GPT_CFG),
+            config_params={
+                "train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 2,
+                "bf16": {"enabled": True},
+                "zero_optimization": zero,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "runtime": {"executor": mode},
+                "steps_per_print": 10 ** 9,
+            })
+        ids = np.stack([_gpt_ids(0), _gpt_ids(1)])
+        out = [float(eng.train_batch(batch=(ids, ids.copy())))
+               for _ in range(2)]
+        return out, _host_masters(eng)
+
+    (loss_a, masters_a) = run("off")
+    (loss_b, masters_b) = run("on")
+    assert loss_a == loss_b
+    for a, b in zip(masters_a, masters_b):
+        np.testing.assert_array_equal(a, b)
+
+
+# --------------------------------------------------- plan_of + audit
+def test_plan_of_offload_topology_matches_execution():
+    eng = _linear_engine(mode="on")
+    plan = plan_for_engine(eng)
+    assert plan.validate() == []
+    assert plan.name == "offload_apply"
+    names = {s.name for s in plan.segments}
+    assert "upload_finish" in names and "reshard" in names
+    # run one real step; the executed update-plan records must carry
+    # exactly the abstract plan's nodes (plan construction and
+    # execution share one topology builder)
+    x, y = _linear_batch()
+    loss = eng(x, y)
+    eng.backward(loss)
+    eng.step()
+    # records were drained by the step boundary; run the apply again
+    # via another step and intercept before the drain
+    loss = eng(x, y)
+    eng.backward(loss)
+    eng._take_model_step()
+    executed = {r.name for r in eng.plan_executor().drain_step_records()}
+    assert executed == names
+
+
+def test_plan_of_streamed_topology_matches_execution():
+    eng = _gpt_engine(mode="on", streamed=True)
+    ids = _gpt_ids()
+    plan = plan_for_engine(eng)
+    assert plan.validate() == []
+    assert plan.name == "streamed_micro"
+    names = {s.name for s in plan.segments}
+    assert {"e_fwd", "h_grad", "e_bwd", "resolve", "loss"} <= names
+    loss = eng(ids, ids.copy())     # one micro step, no boundary drain
+    executed = {r.name for r in eng.plan_executor().drain_step_records()}
+    assert executed == names
+    assert np.isfinite(float(loss))
+    eng.backward(loss)
+    eng.step()
+
+
+def test_ir_plan_of_is_the_executor_entry_point():
+    from deepspeed_tpu.analysis.ir import plan_of
+    eng = _linear_engine(mode="auto")
+    plan = plan_of(eng)
+    assert plan.name == "offload_apply" and plan.validate() == []
+    with pytest.raises(ValueError):
+        plan_of(_linear_engine(mode="auto", offload=False))
+
+
+def test_audit_plan_reports_shape_and_catches_breakage(monkeypatch):
+    from deepspeed_tpu.analysis import AnalysisReport
+    from deepspeed_tpu.analysis.auditor import audit_plan
+    eng = _linear_engine(mode="auto")
+    report = AnalysisReport(job="t")
+    audit_plan(eng, report)
+    assert not report.findings
+    assert any(name.startswith("plan/offload_apply")
+               for name in report.programs)
+    # a lowering bug (malformed plan) becomes an unsuppressable finding
+    import deepspeed_tpu.runtime.executor as ex_mod
+    broken = SegmentPlan("offload_apply",
+                         [Segment(name="a", kind="host",
+                                  deps=("missing",))])
+    monkeypatch.setattr(ex_mod, "plan_for_engine",
+                        lambda engine, family=None: broken)
+    report2 = AnalysisReport(job="t2")
+    audit_plan(eng, report2)
+    assert report2.findings
+    assert report2.findings[0].check == "plan_invalid"
+
+
+def test_engine_audit_green_on_lowered_paths():
+    eng = _gpt_engine(mode="on")
+    ids = _gpt_ids()
+    report = eng.audit(batch=(ids, ids.copy()))
+    assert report.findings == [], [f.message for f in report.findings]
+    assert any(name.startswith("plan/") for name in report.programs)
+
+
+# ------------------------------------------------------- config gate
+def test_runtime_executor_config_gate():
+    assert _linear_engine(mode="off")._executor_mode == "serial"
+    assert _linear_engine(mode="on")._executor_mode == "overlap"
+    assert _linear_engine(mode="auto")._executor_mode == "overlap"
+    with pytest.raises(DeepSpeedConfigError):
+        _linear_engine(mode="sideways")
+
+
+def test_runtime_section_unknown_key_validated(tmp_path):
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig(None, param_dict={
+            "train_batch_size": 8,
+            "config_validation": "strict",
+            "runtime": {"executor": "auto", "warp_drive": True}})
+
+
+# ---------------------------------------------------------- DSL006
+def test_dsl006_flags_scheduling_outside_executor(tmp_path):
+    from deepspeed_tpu.analysis import astlint
+    dirty = tmp_path / "deepspeed_tpu" / "runtime" / "zero"
+    dirty.mkdir(parents=True)
+    (dirty / "sneaky.py").write_text(
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "import jax\n"
+        "def go(bufs, fn):\n"
+        "    pool = ThreadPoolExecutor(max_workers=1)\n"
+        "    bufs[0].copy_to_host_async()\n"
+        "    jitted = jax.jit(fn, donate_argnums=(0,))\n"
+        "    return pool, jitted\n")
+    exec_dir = tmp_path / "deepspeed_tpu" / "runtime" / "executor"
+    exec_dir.mkdir(parents=True)
+    (exec_dir / "sched.py").write_text(
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "def pool():\n"
+        "    return ThreadPoolExecutor(max_workers=1)\n")
+    findings = astlint.lint_paths([str(tmp_path / "deepspeed_tpu")],
+                                  base=str(tmp_path))
+    dsl6 = sorted(k for k in findings if k.startswith("DSL006"))
+    assert dsl6 == [
+        "DSL006:deepspeed_tpu/runtime/zero/sneaky.py::go"], dsl6
+    assert len(findings[dsl6[0]]) == 3      # pool + async copy + donate
+
+
+def test_repo_lint_green_with_dsl006_baseline():
+    from deepspeed_tpu.analysis import astlint
+    repo = os.path.join(os.path.dirname(__file__), "..", "..")
+    findings = astlint.lint_paths(
+        [os.path.join(repo, "deepspeed_tpu")], base=repo)
+    baseline = astlint.load_baseline(
+        os.path.join(repo, "bin", "ds_lint_baseline.json"))
+    new, _stale = astlint.diff_baseline(findings, baseline)
+    assert new == [], [f.message for f in new]
+    # the executor package itself must be DSL006-clean (it is the one
+    # place scheduling is allowed — nothing there needs baselining)
+    assert not any("runtime/executor" in k for k in findings
+                   if k.startswith("DSL006"))

@@ -119,7 +119,12 @@ def main():
             # trajectories from now on
             "telemetry": {"enabled": True,
                           "output_path": scratch_telemetry_dir(
-                              "bench_telemetry_")},
+                              "bench_telemetry_"),
+                          # fleet export plane (docs/fleet.md): the
+                          # final /metrics scrape is embedded under
+                          # extra.metrics so every rung carries its
+                          # exported series (port 0 = ephemeral)
+                          "metrics": {"enabled": True, "port": 0}},
         }
         if bf16_state:
             ds_config["optimizer"]["params"]["moments_dtype"] = "bf16"
@@ -202,6 +207,12 @@ def main():
             # checker rejects an empty snapshot (bin/check_bench_schema)
             **({"telemetry": engine.telemetry_snapshot()}
                if engine.telemetry is not None else {}),
+            # final Prometheus scrape of the fleet metrics plane
+            # (series count + exposition text; None-safe when the
+            # metrics section is off or this is a non-writer process)
+            **({"metrics": engine.telemetry.metrics_scrape()}
+               if engine.telemetry is not None and
+               engine.telemetry.metrics is not None else {}),
         },
     }))
 

@@ -17,10 +17,17 @@ Three artifact families, dispatched by shape:
   auditor / ``bin/ds_lint.py --json`` — docs/analysis.md): programs
   map, findings/suppressed lists with rule/check/key/severity, summary
   counters.
+* **bench scoreboards** (``kind: "bench_scoreboard"``,
+  ``bin/ds_scoreboard.py --json`` — docs/fleet.md): non-empty
+  trajectory rows with rung/mfu/regression fields.
 * **Chrome trace-event files** (a JSON array, telemetry.spans'
-  trace_events.json): parsed leniently (a crashed run may leave the
-  Perfetto-tolerated trailing-comma/unclosed-array form) and each event
-  checked for name/ph/ts/pid/tid.
+  trace_events.json and ``bin/ds_fleet.py --trace``'s merged form):
+  parsed leniently (a crashed run may leave the Perfetto-tolerated
+  trailing-comma/unclosed-array form) and each event checked for
+  name/ph/ts/pid/tid.
+
+BENCH ``extra.metrics`` (the embedded final /metrics scrape of the
+fleet export plane) is validated for series count + exposition text.
 
 Usage: check_bench_schema.py [FILE...]; with no args, validates every
 BENCH_*.json in the repo root and tests/perf/. Exit 1 on any failure.
@@ -175,6 +182,63 @@ def check_telemetry_snapshot(snap):
     return problems
 
 
+def check_metrics_payload(payload):
+    """-> list of problems with one ``extra.metrics`` payload (the
+    bench-embedded final /metrics scrape; docs/fleet.md)."""
+    problems = []
+    if not isinstance(payload, dict):
+        return ["extra.metrics is not a dict"]
+    series = payload.get("series")
+    if not isinstance(series, int) or isinstance(series, bool) or \
+            series < 1:
+        problems.append("metrics.series is not an int >= 1: "
+                        "{!r}".format(series))
+    scrape = payload.get("scrape")
+    if not isinstance(scrape, str) or "# TYPE " not in scrape:
+        problems.append("metrics.scrape is not Prometheus exposition "
+                        "text (no '# TYPE ' line)")
+    return problems
+
+
+# Local copy of bin/ds_scoreboard.py SCOREBOARD_ROW_KEYS (same stdlib-
+# only constraint; pinned equal by tests/unit/test_fleet.py).
+SCOREBOARD_ROW_KEYS = (
+    "rung", "file", "rc", "metric", "value", "unit", "mfu",
+    "tokens_per_sec_per_chip", "goodput_tokens_per_sec", "reduction_x",
+    "device", "error",
+)
+
+
+def check_scoreboard(payload):
+    """-> list of problems with one bench_scoreboard artifact
+    (bin/ds_scoreboard.py --json)."""
+    problems = []
+    rows = payload.get("rows")
+    if not isinstance(rows, list) or not rows:
+        return ["scoreboard rows is not a non-empty list"]
+    for i, row in enumerate(rows):
+        if not isinstance(row, dict):
+            problems.append("rows[{}] is not an object".format(i))
+            break
+        for key in SCOREBOARD_ROW_KEYS:
+            if key not in row:
+                problems.append("rows[{}] missing {!r}".format(i, key))
+        if not isinstance(row.get("rung"), int):
+            problems.append("rows[{}].rung is not an int".format(i))
+        if row.get("mfu") is not None and not _is_num(row["mfu"]):
+            problems.append("rows[{}].mfu is neither null nor a "
+                            "number".format(i))
+        if problems:
+            break
+    if not isinstance(payload.get("regression"), bool):
+        problems.append("regression is not a bool")
+    for key in ("latest_mfu", "best_prior_mfu"):
+        val = payload.get(key)
+        if val is not None and not _is_num(val):
+            problems.append("{} is neither null nor a number".format(key))
+    return problems
+
+
 # per-config metrics every serving-trace artifact row must report
 SERVING_TRACE_CONFIG_KEYS = (
     "goodput_tokens_per_sec", "completed_requests", "completed_tokens",
@@ -279,6 +343,8 @@ def check_bench_payload(payload):
             if "executor" in extra:
                 problems.extend(check_segment_stats(
                     extra["executor"], "extra.executor"))
+            if "metrics" in extra:
+                problems.extend(check_metrics_payload(extra["metrics"]))
     return problems
 
 
@@ -454,6 +520,9 @@ def check_file(path):
     if isinstance(payload, dict) and \
             payload.get("kind") == "analysis_report":
         return check_analysis_report(payload)
+    if isinstance(payload, dict) and \
+            payload.get("kind") == "bench_scoreboard":
+        return check_scoreboard(payload)
     if isinstance(payload, dict) and "traceEvents" in payload:
         return check_trace_events(text)
     return check_bench_payload(payload)

@@ -1,0 +1,240 @@
+#!/usr/bin/env python3
+"""Bench-trajectory scoreboard: assemble every ``BENCH_r*.json`` rung
+into one table (MFU, tokens/s/chip, goodput, wire reduction ratios per
+rung) and gate regressions.
+
+    python bin/ds_scoreboard.py                      # markdown to stdout
+    python bin/ds_scoreboard.py --json scoreboard.json
+    python bin/ds_scoreboard.py --md SCOREBOARD.md
+    python bin/ds_scoreboard.py --regression-pct 10  # the gate (default)
+
+Exit codes: 0 = trajectory healthy (or nothing to compare), **1** =
+the newest measured rung's MFU sits more than ``--regression-pct``
+below the best prior rung — the scoreboard is the CI tripwire that
+keeps the MFU trajectory from silently decaying. Failed rungs (rc != 0
+/ ``value: null``) stay in the table with their error, excluded from
+the regression math.
+
+Repo-root ``BENCH_r*.json`` files are driver run records
+(``{"n", "cmd", "rc", "tail"}``) whose bench JSON line is embedded in
+the tail — the same unwrap ``bin/check_bench_schema.py`` applies.
+Stdlib-only. The JSON artifact (``kind: "bench_scoreboard"``) is
+validated by check_bench_schema.py.
+"""
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+KIND_SCOREBOARD = "bench_scoreboard"
+
+# every trajectory row carries exactly these keys
+SCOREBOARD_ROW_KEYS = (
+    "rung", "file", "rc", "metric", "value", "unit", "mfu",
+    "tokens_per_sec_per_chip", "goodput_tokens_per_sec", "reduction_x",
+    "device", "error",
+)
+
+
+def unwrap_driver_record(payload):
+    """Driver run record -> the embedded bench JSON line (or None for
+    an honestly failed rung)."""
+    inner = None
+    for line in payload.get("tail", "").splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                cand = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(cand, dict) and "metric" in cand:
+                inner = cand
+    return inner
+
+
+def _rung_index(path, payload):
+    if isinstance(payload.get("n"), int):
+        return payload["n"]
+    m = re.search(r"BENCH_r(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else -1
+
+
+def load_rung(path):
+    """-> one scoreboard row for a BENCH_r*.json file."""
+    with open(path) as fh:
+        payload = json.load(fh)
+    rung = _rung_index(path, payload)
+    rc = payload.get("rc") if "rc" in payload else 0
+    inner = unwrap_driver_record(payload) if "tail" in payload \
+        else payload
+    row = {
+        "rung": rung,
+        "file": os.path.basename(path),
+        "rc": rc,
+        "metric": None, "value": None, "unit": None, "mfu": None,
+        "tokens_per_sec_per_chip": None, "goodput_tokens_per_sec": None,
+        "reduction_x": None, "device": None, "error": None,
+    }
+    if inner is None:
+        row["error"] = "no bench JSON line in the run record " \
+            "(rc={})".format(rc)
+        return row
+    extra = inner.get("extra") or {}
+    row.update({
+        "metric": inner.get("metric"),
+        "value": inner.get("value"),
+        "unit": inner.get("unit"),
+        "mfu": extra.get("mfu"),
+        "device": extra.get("device"),
+        "error": inner.get("error"),
+    })
+    if inner.get("unit") == "tokens/s/chip":
+        row["tokens_per_sec_per_chip"] = inner.get("value")
+    trace = extra.get("serving_trace") or {}
+    best_goodput = None
+    for cfg in (trace.get("configs") or {}).values():
+        val = cfg.get("goodput_tokens_per_sec")
+        if val is not None:
+            best_goodput = val if best_goodput is None \
+                else max(best_goodput, val)
+    row["goodput_tokens_per_sec"] = best_goodput
+    comm = extra.get("comm") or {}
+    red = comm.get("reduction_x")
+    row["reduction_x"] = red if isinstance(red, dict) else (
+        {"total": comm.get("total_reduction_x")}
+        if comm.get("total_reduction_x") is not None else None)
+    return row
+
+
+def build_scoreboard(paths, regression_pct=10.0, gate_cpu=False):
+    """MFU regression gate: the newest measured rung against the best
+    PRIOR rung **of the same device kind** — MFU is a fraction of that
+    chip's peak, so a TPU rung never gates against a CPU one. CPU
+    (backend-fallback) rungs are correctness vehicles whose MFU swings
+    with box co-tenancy; they are exempt from the gate unless
+    ``gate_cpu`` (the trajectory still shows them)."""
+    rows = sorted((load_rung(p) for p in paths),
+                  key=lambda r: (r["rung"], r["file"]))
+    measured = [r for r in rows if r["mfu"] is not None and r["rc"] == 0]
+    best_prior = latest = None
+    regression = False
+    gate = None
+    if measured:
+        latest = measured[-1]
+        same_device = [r for r in measured[:-1]
+                       if r["device"] == latest["device"]]
+        if latest["device"] == "cpu" and not gate_cpu:
+            gate = "skipped: latest rung is a cpu-fallback rung " \
+                   "(pass --gate-cpu to include)"
+        elif not same_device:
+            gate = "skipped: no prior rung on device " \
+                   "{!r}".format(latest["device"])
+        else:
+            best_prior = max(same_device, key=lambda r: r["mfu"])
+            regression = latest["mfu"] < \
+                best_prior["mfu"] * (1.0 - regression_pct / 100.0)
+            gate = "tripped" if regression else "passed"
+    return {
+        "kind": KIND_SCOREBOARD,
+        "rows": rows,
+        "measured_rungs": len(measured),
+        "best_prior_mfu": best_prior["mfu"] if best_prior else None,
+        "best_prior_rung": best_prior["rung"] if best_prior else None,
+        "latest_mfu": latest["mfu"] if latest else None,
+        "latest_rung": latest["rung"] if latest else None,
+        "regression_pct": regression_pct,
+        "regression": regression,
+        "gate": gate,
+    }
+
+
+def _fmt(val, spec="{:.4f}"):
+    if val is None:
+        return "-"
+    if isinstance(val, dict):
+        return ",".join("{}={}".format(k, "-" if v is None else
+                                       "{:.1f}".format(v))
+                        for k, v in sorted(val.items()))
+    return spec.format(val)
+
+
+def render_markdown(board):
+    lines = [
+        "# Bench trajectory",
+        "",
+        "| rung | file | rc | MFU | tokens/s/chip | goodput tok/s | "
+        "wire reduction_x | device | error |",
+        "|---:|---|---:|---:|---:|---:|---|---|---|",
+    ]
+    for row in board["rows"]:
+        lines.append(
+            "| {rung} | {file} | {rc} | {mfu} | {tps} | {goodput} | "
+            "{red} | {device} | {error} |".format(
+                rung=row["rung"], file=row["file"], rc=row["rc"],
+                mfu=_fmt(row["mfu"]),
+                tps=_fmt(row["tokens_per_sec_per_chip"], "{:.1f}"),
+                goodput=_fmt(row["goodput_tokens_per_sec"], "{:.1f}"),
+                red=_fmt(row["reduction_x"]),
+                device=row["device"] or "-",
+                error=(row["error"] or "-").replace("|", "/")[:60]))
+    lines.append("")
+    if board["regression"]:
+        lines.append(
+            "**REGRESSION**: rung {} MFU {} is more than {}% below the "
+            "best prior rung {} ({}).".format(
+                board["latest_rung"], _fmt(board["latest_mfu"]),
+                board["regression_pct"], board["best_prior_rung"],
+                _fmt(board["best_prior_mfu"])))
+    else:
+        lines.append("Trajectory healthy: latest measured MFU {} "
+                     "(best same-device prior {}; gate {}).".format(
+                         _fmt(board["latest_mfu"]),
+                         _fmt(board["best_prior_mfu"]),
+                         board["gate"] or "n/a"))
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="assemble BENCH_r*.json rungs into the MFU "
+                    "trajectory scoreboard")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="BENCH files (default: repo-root "
+                             "BENCH_r*.json)")
+    parser.add_argument("--json", dest="json_out", default=None)
+    parser.add_argument("--md", dest="md_out", default=None)
+    parser.add_argument("--regression-pct", type=float, default=10.0)
+    parser.add_argument("--gate-cpu", action="store_true",
+                        help="apply the regression gate to cpu-fallback "
+                             "rungs too (off: cpu MFU swings with box "
+                             "co-tenancy)")
+    args = parser.parse_args(argv)
+    paths = args.paths or sorted(glob.glob(
+        os.path.join(_REPO, "BENCH_r*.json")))
+    if not paths:
+        print("ds_scoreboard: no BENCH_r*.json rungs found",
+              file=sys.stderr)
+        return 1
+    board = build_scoreboard(paths, regression_pct=args.regression_pct,
+                             gate_cpu=args.gate_cpu)
+    md = render_markdown(board)
+    if args.json_out:
+        with open(args.json_out, "w") as fh:
+            json.dump(board, fh, indent=2, sort_keys=True)
+    if args.md_out:
+        with open(args.md_out, "w") as fh:
+            fh.write(md)
+    print(md, end="")
+    if board["regression"]:
+        print("ds_scoreboard: REGRESSION gate tripped (>{}% MFU drop)"
+              .format(args.regression_pct), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

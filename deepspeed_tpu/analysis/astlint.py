@@ -25,6 +25,12 @@ AFTER the cost is paid:
     place (ops/pallas and the op packages; docs/pallas_kernels.md is
     the inventory), so dispatch layers import kernels rather than
     inlining them.
+  * **DSL007 metric-name-outside-catalog** — a string-literal metric
+    name passed to a ``.counter()``/``.gauge()``/``.histogram()``
+    registry call that does not appear in docs/fleet.md's metric
+    catalog: every exported series must be documented (name + labels)
+    before it ships, or scrapers chase undocumented gauges
+    (docs/fleet.md; the rule is inert when the catalog file is absent).
   * **DSL006 step-scheduling-outside-executor** — hand-written step
     scheduling outside ``deepspeed_tpu/runtime/executor/``: an async
     transfer issue (``copy_to_host_async``), a worker pool
@@ -54,7 +60,30 @@ LINT_RULES = {
     "DSL004": "jit-in-loop",
     "DSL005": "pallas-call-outside-ops",
     "DSL006": "step-scheduling-outside-executor",
+    "DSL007": "metric-name-outside-catalog",
 }
+
+# DSL007: registry-call method names + the metric-name literal shape
+_METRIC_METHODS = {"counter", "gauge", "histogram"}
+_METRIC_NAME_RE = None          # compiled lazily (module stays light)
+
+
+def _looks_like_metric_name(text):
+    global _METRIC_NAME_RE
+    if _METRIC_NAME_RE is None:
+        import re
+        _METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+    return bool(_METRIC_NAME_RE.match(text))
+
+
+def load_metric_catalog(base):
+    """docs/fleet.md's text, the DSL007 catalog — None (rule inert)
+    when the file is absent so partial checkouts never false-fail."""
+    path = os.path.join(base or ".", "docs", "fleet.md")
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        return fh.read()
 
 # DSL005: the one directory kernels may live in
 _OPS_PREFIX = "deepspeed_tpu/ops/"
@@ -170,6 +199,19 @@ class _FunctionLint(ast.NodeVisitor):
                                "key)")
         is_pallas_call = chain.endswith(".pallas_call") or (
             isinstance(fn, ast.Name) and fn.id == "pallas_call")
+        catalog = self.linter.metric_catalog
+        if catalog is not None and isinstance(fn, ast.Attribute) and \
+                fn.attr in _METRIC_METHODS and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and \
+                    isinstance(arg.value, str) and \
+                    _looks_like_metric_name(arg.value) and \
+                    arg.value not in catalog:
+                self.linter.report(
+                    "DSL007", self.qualname, node.lineno,
+                    "metric {!r} is not in docs/fleet.md's catalog — "
+                    "document every exported series (name + labels) "
+                    "before shipping it".format(arg.value))
         if is_pallas_call and not self.linter.in_ops:
             self.linter.report("DSL005", self.qualname, node.lineno,
                                "pl.pallas_call outside deepspeed_tpu/"
@@ -210,11 +252,12 @@ class _FunctionLint(ast.NodeVisitor):
 
 
 class FileLinter:
-    def __init__(self, relpath):
+    def __init__(self, relpath, metric_catalog=None):
         self.relpath = relpath
         norm = relpath.replace(os.sep, "/")
         self.in_ops = norm.startswith(_OPS_PREFIX)
         self.in_executor = norm.startswith(_EXECUTOR_PREFIX)
+        self.metric_catalog = metric_catalog
         self.violations = []       # [(rule, qualname, lineno, message)]
 
     def report(self, rule, qualname, lineno, message):
@@ -243,7 +286,7 @@ class FileLinter:
         return self.violations
 
 
-def lint_file(path, relpath=None):
+def lint_file(path, relpath=None, metric_catalog=None):
     relpath = relpath or path
     with open(path) as fh:
         source = fh.read()
@@ -252,13 +295,15 @@ def lint_file(path, relpath=None):
     except SyntaxError as err:
         return [("DSL000", "<module>", getattr(err, "lineno", 0),
                  "unparseable: {}".format(err))]
-    return FileLinter(relpath).run(tree)
+    return FileLinter(relpath, metric_catalog=metric_catalog).run(tree)
 
 
-def lint_paths(paths, base=None):
+def lint_paths(paths, base=None, metric_catalog=None):
     """-> {key: [Finding, ...]} over every .py file under ``paths``
     (key = 'RULE:relpath::qualname'; ``base`` anchors the relpaths —
-    pass the repo root so baseline keys are stable under any cwd)."""
+    pass the repo root so baseline keys are stable under any cwd).
+    ``metric_catalog``: DSL007's documented-name text; defaults to
+    ``base``/docs/fleet.md when present."""
     findings = {}
     files = []
     for root in paths:
@@ -269,9 +314,12 @@ def lint_paths(paths, base=None):
             files += [os.path.join(dirpath, n) for n in sorted(names)
                       if n.endswith(".py")]
     base = base or os.getcwd()
+    if metric_catalog is None:
+        metric_catalog = load_metric_catalog(base)
     for path in sorted(files):
         rel = os.path.relpath(path, base)
-        for rule, qual, lineno, message in lint_file(path, rel):
+        for rule, qual, lineno, message in lint_file(
+                path, rel, metric_catalog=metric_catalog):
             key = "{}:{}::{}".format(rule, rel.replace(os.sep, "/"), qual)
             findings.setdefault(key, []).append(Finding(
                 rule=rule, check=LINT_RULES.get(rule, rule),

@@ -17,3 +17,6 @@ from .sinks import (ChromeTraceSink, JsonlSink, TelemetrySinks,
 from .spans import SPAN_KEYS, Span, SpanTracer, validate_span
 from .trace import TraceWindow
 from .watchdog import Watchdog, WatchdogError
+from .fleet import (FLEET_STEP_KEYS, MetricsExporter, MetricsRegistry,
+                    MetricsSink, StragglerDetector, merge_run,
+                    parse_prometheus_text, validate_fleet_record)

@@ -10,6 +10,7 @@ JSON line, and (once per compiled program) an XLA ``cost_analysis``
 lowering — documented with measured numbers in docs/telemetry.md and
 tests/perf/bench_telemetry_overhead.py."""
 import os
+import time
 
 from ..utils.lifecycle import AtexitCloseMixin
 from ..utils.logging import logger
@@ -189,6 +190,47 @@ class TelemetryCollector(AtexitCloseMixin):
             if self.recorder is not None:
                 self.recorder.watchdog_state = self.watchdog.snapshot
 
+        # ------------------------------------------------ fleet observatory
+        # (docs/fleet.md): metrics plane + /metrics + /healthz export —
+        # OFF = structurally absent (no registry, no sink, no HTTP
+        # thread), like the other PR 8 subsystems. The MetricsSink rides
+        # the existing record stream: zero new hot-path instrumentation.
+        self.fleet = None
+        self.metrics = None
+        self.exporter = None
+        # healthz() reads _wall_start and the exporter thread serves it
+        # the moment it starts — every state it touches must exist first
+        self._wall_start = time.time()
+        if tconfig.metrics_enabled:
+            import socket
+            from .fleet import (FleetLocalState, MetricsExporter,
+                                MetricsRegistry, MetricsSink)
+            self.fleet = FleetLocalState()
+            registry = MetricsRegistry(
+                namespace=tconfig.metrics_namespace,
+                const_labels={"job": self.job_name,
+                              "host": socket.gethostname()})
+            self.metrics = MetricsSink(registry, watchdog=self.watchdog,
+                                       fleet=self.fleet,
+                                       host=socket.gethostname())
+            sinks.append(self.metrics)
+            try:
+                self.exporter = MetricsExporter(registry,
+                                                port=tconfig.metrics_port,
+                                                healthz=self.healthz)
+            except OSError as err:
+                # a bound port (two engines/processes sharing the
+                # documented fixed port) must not kill engine
+                # construction: the sink keeps folding records (the
+                # bench metrics_scrape() path stays live), only the
+                # HTTP plane is absent — and loudly so
+                logger.warning(
+                    "telemetry.metrics: could not bind the export "
+                    "port %s (%s) — /metrics + /healthz disabled for "
+                    "this collector; records still feed the registry "
+                    "(use port 0 for an ephemeral port)",
+                    tconfig.metrics_port, err)
+
         self.sinks = TelemetrySinks(sinks)
         self.trace = None
         if tconfig.trace_enabled:
@@ -206,6 +248,22 @@ class TelemetryCollector(AtexitCloseMixin):
             self._device = "cpu"
             self._n_devices = 1
         self.peak_flops_per_chip = peak_flops_for(self._device)
+        # per-host manifest: the structural discovery seam the fleet
+        # merger joins on (fleet/aggregate.py) — written for EVERY live
+        # collector, metrics on or off, so any telemetry run is
+        # mergeable post-mortem
+        try:
+            import jax
+            process_index = jax.process_index()
+            process_count = jax.process_count()
+        except Exception:  # noqa: BLE001
+            process_index = process_count = None
+        from .fleet.aggregate import write_host_manifest
+        write_host_manifest(
+            self.output_dir, job_name=self.job_name,
+            metrics_port=self.exporter.port
+            if self.exporter is not None else None,
+            process_index=process_index, process_count=process_count)
         # same lifecycle contract as SummaryMonitor (utils/lifecycle.py):
         # the exit handler closes an active trace window and the JSONL
         # handle at process end, deregistered by close()
@@ -320,7 +378,83 @@ class TelemetryCollector(AtexitCloseMixin):
             out["watchdog_trips"] = len(self.watchdog.trips)
         if self.programs.flags:
             out["program_flags"] = [f["key"] for f in self.programs.flags]
+        if self.fleet is not None or self.exporter is not None:
+            # the fleet observatory's one snapshot seam (docs/fleet.md):
+            # straggler flags + last ici_health + export liveness ride
+            # the EXISTING telemetry_snapshot() instead of a second API
+            out["fleet"] = self.fleet_snapshot()
         return out
+
+    # ---------------------------------------------------------------- fleet
+    def fleet_snapshot(self):
+        """``telemetry_snapshot()["fleet"]``: straggler flags and
+        ici_health last values (FleetLocalState) + metrics-export
+        liveness."""
+        out = self.fleet.snapshot() if self.fleet is not None else \
+            {"straggler_flags": [], "ici_health": {}, "ingests": 0}
+        out["metrics_export"] = self.exporter.snapshot() \
+            if self.exporter is not None else None
+        return out
+
+    def ingest_fleet(self, report):
+        """Feed a merged fleet view (fleet/aggregate.merge_run) into
+        this process: stores the straggler flags / ici_health for the
+        snapshot + /healthz, and trips the ``straggler`` watchdog (the
+        PR 8 machinery) on each newly flagged host. The live seam
+        ``bin/ds_fleet.py`` and the ROADMAP item 3/4 controllers use."""
+        if self.fleet is None:
+            from .fleet import FleetLocalState
+            self.fleet = FleetLocalState()
+        if not isinstance(report, dict):
+            report = {"straggler": {"flags": list(report)}}
+        straggler = report.get("straggler") or {}
+        self.fleet.straggler_flags = list(straggler.get("flags", []))
+        for host, classes in (report.get("ici_health") or {}).items():
+            for cls, val in classes.items():
+                self.fleet.ici_health["{}:{}".format(host, cls)] = val
+        self.fleet.ingests += 1
+        if self.watchdog is not None:
+            self.watchdog.observe_fleet(report)
+
+    def healthz(self):
+        """The ``/healthz`` JSON payload: watchdog trips, rolling-window
+        MFU, TTFT-SLO burn rate, overflow/skip counters, and the fleet
+        flags. ``status`` degrades on any watchdog trip or ingested
+        straggler flag (the exporter answers 503 then)."""
+        agg = self.aggregator.snapshot()
+        trips = list(self.watchdog.trips) if self.watchdog is not None \
+            else []
+        fleet = self.fleet_snapshot()
+        degraded = bool(trips) or bool(fleet["straggler_flags"])
+        out = {
+            "status": "degraded" if degraded else "ok",
+            "job_name": self.job_name,
+            "wall": time.time(),
+            "uptime_s": round(time.time() - self._wall_start, 3),
+            "steps": agg.get("steps", 0),
+            "serving_steps": agg.get("serving_steps", 0),
+            "mfu": agg.get("mfu"),
+            "overflow_last": agg.get("overflow_last"),
+            "skipped_steps": agg.get("skipped_steps", 0),
+            "watchdog": {"trips": len(trips),
+                         "last": trips[-1] if trips else None},
+            "ttft_slo_burn_rate": self.watchdog.ttft_burn_rate()
+            if self.watchdog is not None else None,
+            "fleet": fleet,
+        }
+        return out
+
+    def metrics_scrape(self):
+        """The live registry rendered as exposition text (what a
+        ``/metrics`` GET serves) plus series count — benches embed this
+        under ``extra.metrics``. ``None`` when the metrics plane is
+        off."""
+        if self.metrics is None:
+            return None
+        return {"series": self.metrics.registry.series_count,
+                "port": self.exporter.port
+                if self.exporter is not None else None,
+                "scrape": self.metrics.registry.render_text()}
 
     def close(self):
         """Idempotent: the first call stops any active trace window and
@@ -336,5 +470,7 @@ class TelemetryCollector(AtexitCloseMixin):
             self.recorder.close()
         if self.spans is not None:
             self.spans.close()
+        if self.exporter is not None:
+            self.exporter.close()
         self.sinks.close()
         _claimed_dirs.discard(self._claim_key)

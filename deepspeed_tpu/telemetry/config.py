@@ -48,6 +48,11 @@ Shape::
       "programs": {                      // compile-observatory thresholds
         "recompile_storm_threshold": 32,
         "replicated_leaf_bytes": 1073741824
+      },
+      "metrics": {                       // fleet export plane (docs/fleet.md)
+        "enabled": true,
+        "port": 9400,                    // 0 = ephemeral (tests read it back)
+        "namespace": "ds"                // series-name prefix
       }
     }
 
@@ -65,7 +70,8 @@ from .recorder import (RECORDER_CAPACITY_DEFAULT,
 from .spans import SPANS_MAX_EVENTS_DEFAULT
 from .watchdog import (LOSS_SPIKE_DEFAULTS, NAN_STREAK_DEFAULTS,
                        POOL_EXHAUSTION_DEFAULTS, STEP_DEADLINE_DEFAULTS,
-                       TTFT_SLO_DEFAULTS, WATCHDOG_ACTIONS)
+                       STRAGGLER_DEFAULTS, TTFT_SLO_DEFAULTS,
+                       WATCHDOG_ACTIONS)
 
 
 def warn_or_raise_noop(msg, strict, flag="telemetry.strict"):
@@ -93,6 +99,9 @@ TELEMETRY_SPANS = "spans"
 TELEMETRY_FLIGHT_RECORDER = "flight_recorder"
 TELEMETRY_WATCHDOG = "watchdog"
 TELEMETRY_PROGRAMS = "programs"
+TELEMETRY_METRICS = "metrics"
+
+METRICS_NAMESPACE_DEFAULT = "ds"
 
 TRACE_START_STEP = "start_step"
 TRACE_NUM_STEPS = "num_steps"
@@ -105,6 +114,7 @@ KNOWN_TELEMETRY_KEYS = {
     TELEMETRY_WINDOW, TELEMETRY_STRICT, TELEMETRY_TRACE,
     TELEMETRY_JSONL_MAX_BYTES, TELEMETRY_SPANS,
     TELEMETRY_FLIGHT_RECORDER, TELEMETRY_WATCHDOG, TELEMETRY_PROGRAMS,
+    TELEMETRY_METRICS,
 }
 KNOWN_TRACE_KEYS = {
     TRACE_START_STEP, TRACE_NUM_STEPS, TRACE_TRIGGER_FILE,
@@ -114,9 +124,11 @@ KNOWN_SPANS_KEYS = {"enabled", "chrome_trace", "max_events_per_span"}
 KNOWN_FLIGHT_RECORDER_KEYS = {"enabled", "capacity", "max_bundles",
                               "output_path", "on_sigterm"}
 KNOWN_WATCHDOG_KEYS = {"enabled", "step_deadline", "nan_streak",
-                       "loss_spike", "ttft_slo", "pool_exhaustion"}
+                       "loss_spike", "ttft_slo", "pool_exhaustion",
+                       "straggler"}
 KNOWN_PROGRAMS_KEYS = {"recompile_storm_threshold",
                        "replicated_leaf_bytes"}
+KNOWN_METRICS_KEYS = {"enabled", "port", "namespace"}
 
 
 class DeepSpeedTelemetryConfig(object):
@@ -201,6 +213,7 @@ class DeepSpeedTelemetryConfig(object):
         self._parse_flight_recorder(d.get(TELEMETRY_FLIGHT_RECORDER))
         self._parse_watchdog(d.get(TELEMETRY_WATCHDOG))
         self._parse_programs(d.get(TELEMETRY_PROGRAMS))
+        self._parse_metrics(d.get(TELEMETRY_METRICS))
 
     # ----------------------------------------------- diagnostics sections
     def _section_dict(self, section, name):
@@ -272,6 +285,7 @@ class DeepSpeedTelemetryConfig(object):
             "loss_spike": LOSS_SPIKE_DEFAULTS,
             "ttft_slo": TTFT_SLO_DEFAULTS,
             "pool_exhaustion": POOL_EXHAUSTION_DEFAULTS,
+            "straggler": STRAGGLER_DEFAULTS,
         }
         parsed = {}
         for name, base in defaults.items():
@@ -327,6 +341,33 @@ class DeepSpeedTelemetryConfig(object):
         self.programs_replicated_leaf_bytes = self._pos_int(
             section, TELEMETRY_PROGRAMS, "replicated_leaf_bytes",
             REPLICATED_LEAF_BYTES_DEFAULT)
+
+    def _parse_metrics(self, section):
+        """Fleet metrics export plane (telemetry/fleet/, docs/fleet.md).
+        Absent/disabled = structurally off: no registry, no sink, no
+        HTTP thread (the PR 8 subsystem contract)."""
+        self.metrics_enabled = False
+        self.metrics_port = 0
+        self.metrics_namespace = METRICS_NAMESPACE_DEFAULT
+        if section is None:
+            return
+        section = self._section_dict(section, TELEMETRY_METRICS)
+        self._reject_unknown(section, KNOWN_METRICS_KEYS,
+                             "telemetry.metrics")
+        self.metrics_enabled = bool(section.get("enabled", True))
+        port = section.get("port", 0)
+        if isinstance(port, bool) or not isinstance(port, int) or \
+                not 0 <= port <= 65535:
+            raise ValueError(
+                "telemetry.metrics.port must be an int in [0, 65535] "
+                "(0 = ephemeral), got {!r}".format(port))
+        self.metrics_port = port
+        namespace = section.get("namespace", METRICS_NAMESPACE_DEFAULT)
+        if not isinstance(namespace, str) or not namespace:
+            raise ValueError(
+                "telemetry.metrics.namespace must be a non-empty "
+                "string, got {!r}".format(namespace))
+        self.metrics_namespace = namespace
 
     def _reject_unknown(self, d, known, section):
         unknown = sorted(k for k in d if k not in known)

@@ -1,0 +1,32 @@
+"""Fleet observatory (ISSUE 14; docs/fleet.md): the multi-host
+observability layer over the per-process telemetry of PRs 5/8/13.
+
+Four parts: a **metrics plane** (metrics.py — counter/gauge/histogram
+families fed from the existing StepRecord sinks) with a **live export
+plane** (export.py — ``/metrics`` Prometheus text + ``/healthz`` JSON
+over a stdlib http.server daemon thread); **multi-host aggregation**
+(aggregate.py — per-host manifests, a step-joined merger with
+clock-offset estimation from step-completion skew); and **straggler /
+ICI-health attribution** (straggler.py — fleet-median deviation streaks
++ achieved-vs-nominal ICI bandwidth per collective class), surfaced
+through the ``straggler`` watchdog and ``bin/ds_fleet.py``.
+
+Every module here is STDLIB-ONLY with sibling-relative imports, so
+``bin/ds_fleet.py`` can mount the package under a synthetic name (the
+``bin/ds_lint.py`` trick) and doctor a run directory on a box without
+jax.
+"""
+from .aggregate import (CHROME_TRACE_NAME, FLEET_HOST_KEYS,
+                        FLEET_STEP_KEYS, HOST_MANIFEST_KEYS,
+                        HostView, KIND_FLEET_REPORT, KIND_FLEET_STEP,
+                        KIND_MANIFEST, MANIFEST_NAME, discover_hosts,
+                        estimate_offsets, load_host, merge_chrome_traces,
+                        merge_records, merge_run, read_jsonl_tolerant,
+                        validate_fleet_record, validate_host_manifest,
+                        write_host_manifest)
+from .export import MetricsExporter
+from .metrics import (FleetLocalState, Metric, MetricsRegistry,
+                      MetricsSink, parse_prometheus_text)
+from .straggler import (STRAGGLER_DEFAULTS, StragglerDetector,
+                        detect_stragglers, ici_health_from_record,
+                        nominal_ici_bytes_per_s)

@@ -1,0 +1,110 @@
+"""Live export plane: ``/metrics`` (Prometheus text format) and
+``/healthz`` (JSON) over a stdlib ``http.server`` daemon thread.
+
+Owned by the telemetry collector when the strict-validated
+``telemetry.metrics`` config section is enabled; OFF = this module is
+never imported, zero threads, structurally absent (the PR 8 subsystem
+contract). ``port: 0`` binds an ephemeral port (tests/benches read it
+back from ``exporter.port``).
+
+``/healthz`` returns HTTP 200 with ``status: "ok"`` while the run is
+healthy and HTTP 503 with ``status: "degraded"`` once a watchdog has
+tripped or a merged fleet view flagged a straggler/degraded link — the
+shape load balancers and the ROADMAP item 3/4 controllers expect.
+
+Stdlib-only (the fleet-package contract; see metrics.py).
+"""
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+logger = logging.getLogger("DeepSpeedTPU")
+
+CONTENT_TYPE_METRICS = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsExporter:
+    """Serves ``registry.render_text()`` at ``/metrics`` and the
+    ``healthz`` callable's payload at ``/healthz``. The server thread
+    is a daemon: a hung scrape can never hold the process open."""
+
+    def __init__(self, registry, port=0, healthz=None, host=""):
+        self.registry = registry
+        self.healthz = healthz
+        self.scrapes = 0
+        self._scrapes_total = registry.counter(
+            "metrics_scrapes_total", "scrapes served by this exporter")
+        exporter = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):    # no per-request stderr spam
+                pass
+
+            def _send(self, code, content_type, body):
+                if isinstance(body, str):
+                    body = body.encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    path = self.path.split("?", 1)[0]
+                    if path == "/metrics":
+                        exporter.scrapes += 1
+                        exporter._scrapes_total.inc()
+                        self._send(200, CONTENT_TYPE_METRICS,
+                                   exporter.registry.render_text())
+                    elif path == "/healthz":
+                        payload = exporter._healthz_payload()
+                        code = 200 if payload.get("status") == "ok" \
+                            else 503
+                        self._send(code, "application/json",
+                                   json.dumps(payload))
+                    else:
+                        self._send(404, "text/plain",
+                                   "not found (try /metrics or "
+                                   "/healthz)\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    pass                    # scraper went away mid-write
+
+        self._server = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="ds-metrics-exporter", daemon=True)
+        self._thread.start()
+        self._closed = False
+        logger.info("telemetry.metrics: /metrics + /healthz live on "
+                    "port %d", self.port)
+
+    def _healthz_payload(self):
+        """Resolve the healthz provider; a provider failure degrades to
+        an error payload instead of a 500 (observe, never crash)."""
+        if self.healthz is None:
+            return {"status": "ok", "detail": "no healthz provider"}
+        try:
+            return self.healthz()
+        except Exception as err:  # noqa: BLE001
+            return {"status": "degraded",
+                    "error": "{}: {}".format(type(err).__name__, err)}
+
+    def snapshot(self):
+        """Liveness gauge for ``telemetry_snapshot()["fleet"]``."""
+        return {"live": not self._closed, "port": self.port,
+                "scrapes": self.scrapes}
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:  # noqa: BLE001 - teardown must never raise
+            pass
+        self._thread.join(timeout=2.0)

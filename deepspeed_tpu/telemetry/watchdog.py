@@ -15,7 +15,14 @@ Five alarms, each with a configurable action (``telemetry.watchdog``):
 * **ttft_slo** — a serving request's time-to-first-token exceeded
   ``slo_s`` (off unless configured: there is no universal SLO);
 * **pool_exhaustion** — paged-KV admission blocked or a decoder was
-  preempted for pages (the serving engine is out of KV memory).
+  preempted for pages (the serving engine is out of KV memory);
+* **straggler** — a merged fleet view (telemetry/fleet/) flagged this
+  run's host set: a host ``factor``x over the fleet-median step or
+  segment wall for ``k`` consecutive steps, or a collective class whose
+  measured ICI bandwidth fell below ``1/factor`` of nominal. Fed via
+  :meth:`Watchdog.observe_fleet` by ``TelemetryCollector.ingest_fleet``
+  (the ``bin/ds_fleet.py`` live seam); the detection itself lives in
+  fleet/straggler.py.
 
 Actions: ``warn`` logs; ``dump`` logs + writes a flight-recorder crash
 bundle; ``raise`` logs + dumps + raises :class:`WatchdogError` (from the
@@ -28,6 +35,10 @@ import time
 from collections import deque
 
 from ..utils.logging import logger
+# the straggler thresholds live with the detector (fleet/straggler.py);
+# re-exported here so telemetry/config.py reads one defaults table per
+# watchdog like the five local ones below
+from .fleet.straggler import STRAGGLER_DEFAULTS, describe_flag_ratio
 
 WATCHDOG_ACTIONS = ("warn", "dump", "raise")
 
@@ -64,7 +75,9 @@ class Watchdog:
         spike = self.cfg.get("loss_spike")
         self._losses = deque(maxlen=int(spike["window"])) if spike else None
         self._ttft_violations = 0
+        self._ttft_samples = 0
         self._pool_events = 0
+        self._fleet_tripped = set()     # (host, metric) already tripped
         # step-deadline thread state
         self._dl_cfg = self.cfg.get("step_deadline")
         self._durations = deque(maxlen=64)
@@ -224,6 +237,7 @@ class Watchdog:
         cfg = self.cfg.get("ttft_slo")
         if cfg is None or cfg.get("slo_s") is None:
             return
+        self._ttft_samples += 1
         if seconds <= float(cfg["slo_s"]):
             return
         self._ttft_violations += 1
@@ -233,6 +247,43 @@ class Watchdog:
                 "TTFT {:.3f}s exceeded the {:.3f}s SLO ({} violation(s) "
                 "so far)".format(seconds, float(cfg["slo_s"]),
                                  self._ttft_violations),
+                cfg["action"])
+
+    def ttft_burn_rate(self):
+        """TTFT-SLO burn: violations / samples since arm (None without
+        a configured SLO or before the first sample) — the /healthz and
+        ``ds_ttft_slo_burn_rate`` gauge payload."""
+        cfg = self.cfg.get("ttft_slo")
+        if cfg is None or cfg.get("slo_s") is None or \
+                self._ttft_samples == 0:
+            return None
+        return self._ttft_violations / self._ttft_samples
+
+    # -------------------------------------------------------------- fleet
+    def observe_fleet(self, report):
+        """Feed a merged fleet report (fleet/aggregate.merge_run shape
+        or a bare flags list): each NEW (host, metric) straggler/ICI
+        flag trips the ``straggler`` alarm once."""
+        cfg = self.cfg.get("straggler")
+        if cfg is None:
+            return
+        flags = report.get("straggler", {}).get("flags", []) \
+            if isinstance(report, dict) else list(report)
+        for flag in flags:
+            key = (flag.get("host"), flag.get("metric"))
+            if key in self._fleet_tripped:
+                continue
+            self._fleet_tripped.add(key)
+            # ici:<class> ratios are inverted achieved/nominal
+            # bandwidth, not fleet-median deviations — word them so
+            self._trip(
+                "straggler",
+                "host {} {} for {} consecutive steps "
+                "(first step {})".format(
+                    flag.get("host"),
+                    describe_flag_ratio(flag.get("metric"),
+                                        flag.get("worst_ratio", 0.0)),
+                    flag.get("steps"), flag.get("first_step")),
                 cfg["action"])
 
     def observe_pool_event(self, kind):
@@ -256,6 +307,7 @@ class Watchdog:
             "trips": list(self.trips),
             "nan_streak": self._nan_streak,
             "ttft_violations": self._ttft_violations,
+            "ttft_samples": self._ttft_samples,
             "pool_events": self._pool_events,
             "step_durations_tracked": len(self._durations),
         }

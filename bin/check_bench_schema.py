@@ -20,6 +20,13 @@ Three artifact families, dispatched by shape:
 * **bench scoreboards** (``kind: "bench_scoreboard"``,
   ``bin/ds_scoreboard.py --json`` — docs/fleet.md): non-empty
   trajectory rows with rung/mfu/regression fields.
+* **fleet reports** (``kind: "fleet_report"``, ``bin/ds_fleet.py
+  --json`` — docs/fleet.md): hosts/offsets/records/straggler plus the
+  ISSUE 15 ``divergence`` section (published/digests/mismatch/
+  divergent_hosts — docs/concurrency.md).
+* **host manifests** (``kind: "host_manifest"``, the collector's
+  discovery seam): required keys plus the optional
+  ``program_fingerprint`` extension (version/digest/families).
 * **Chrome trace-event files** (a JSON array, telemetry.spans'
   trace_events.json and ``bin/ds_fleet.py --trace``'s merged form):
   parsed leniently (a crashed run may leave the Perfetto-tolerated
@@ -449,6 +456,99 @@ def check_analysis_report(payload):
     return problems
 
 
+# Local copies of telemetry/fleet/aggregate.py FLEET_REPORT_KEYS /
+# HOST_MANIFEST_KEYS / FINGERPRINT_KEYS (same stdlib-only constraint;
+# pinned equal by tests/unit/test_concurrency.py).
+FLEET_REPORT_KEYS = (
+    "kind", "run_dir", "n_hosts", "hosts", "offsets", "records", "gaps",
+    "straggler", "ici_health", "trace", "divergence",
+)
+HOST_MANIFEST_KEYS = (
+    "kind", "job_name", "host", "pid", "process_index", "wall_start",
+    "files", "metrics_port",
+)
+FINGERPRINT_KEYS = ("version", "digest", "families")
+
+
+def _check_fingerprint(fp, where, problems):
+    if not isinstance(fp, dict):
+        problems.append("{} is not a dict".format(where))
+        return
+    for key in FINGERPRINT_KEYS:
+        if key not in fp:
+            problems.append("{} missing {!r}".format(where, key))
+    if not isinstance(fp.get("digest", ""), str):
+        problems.append("{}.digest is not a string".format(where))
+    fams = fp.get("families")
+    if fams is not None and not isinstance(fams, dict):
+        problems.append("{}.families is not a dict".format(where))
+
+
+def check_host_manifest(payload):
+    """-> list of problems with one host_manifest.json (the fleet
+    merger's discovery seam; the optional ``program_fingerprint``
+    extension is ISSUE 15's divergence-auditor seam)."""
+    problems = []
+    for key in HOST_MANIFEST_KEYS:
+        if key not in payload:
+            problems.append("missing key {!r}".format(key))
+    if not problems and not isinstance(payload.get("files"), dict):
+        problems.append("files is not a dict")
+    fp = payload.get("program_fingerprint")
+    if fp is not None:
+        _check_fingerprint(fp, "program_fingerprint", problems)
+    return problems
+
+
+def check_fleet_report(payload):
+    """-> list of problems with one fleet_report artifact
+    (``bin/ds_fleet.py --json``), including the ISSUE 15 ``divergence``
+    section."""
+    problems = []
+    for key in FLEET_REPORT_KEYS:
+        if key not in payload:
+            problems.append("missing key {!r}".format(key))
+    if problems:
+        return problems
+    if not isinstance(payload.get("n_hosts"), int) or \
+            isinstance(payload.get("n_hosts"), bool):
+        problems.append("n_hosts is not an int")
+    for key in ("hosts", "records", "gaps"):
+        if not isinstance(payload.get(key), list):
+            problems.append("{} is not a list".format(key))
+    for key in ("offsets", "straggler", "ici_health"):
+        if not isinstance(payload.get(key), dict):
+            problems.append("{} is not a dict".format(key))
+    for i, rec in enumerate(payload.get("records") or []):
+        if not isinstance(rec, dict) or rec.get("kind") != "fleet_step":
+            problems.append(
+                "records[{}] is not a fleet_step record".format(i))
+            break
+    straggler = payload.get("straggler")
+    if isinstance(straggler, dict) and \
+            not isinstance(straggler.get("flags"), list):
+        problems.append("straggler.flags is not a list")
+    div = payload.get("divergence")
+    if not isinstance(div, dict):
+        problems.append("divergence is not a dict")
+    else:
+        if not isinstance(div.get("mismatch"), bool):
+            problems.append("divergence.mismatch is not a bool")
+        if not isinstance(div.get("published"), int) or \
+                isinstance(div.get("published"), bool):
+            problems.append("divergence.published is not an int")
+        for key in ("digests", "families"):
+            if not isinstance(div.get(key), dict):
+                problems.append(
+                    "divergence.{} is not a dict".format(key))
+        if not isinstance(div.get("divergent_hosts"), list):
+            problems.append("divergence.divergent_hosts is not a list")
+        if div.get("mismatch") and not div.get("divergent_hosts"):
+            problems.append(
+                "divergence.mismatch set with no divergent_hosts")
+    return problems
+
+
 # every Chrome trace event must carry these fields
 TRACE_EVENT_KEYS = ("name", "ph", "ts", "pid", "tid")
 
@@ -523,6 +623,12 @@ def check_file(path):
     if isinstance(payload, dict) and \
             payload.get("kind") == "bench_scoreboard":
         return check_scoreboard(payload)
+    if isinstance(payload, dict) and \
+            payload.get("kind") == "fleet_report":
+        return check_fleet_report(payload)
+    if isinstance(payload, dict) and \
+            payload.get("kind") == "host_manifest":
+        return check_host_manifest(payload)
     if isinstance(payload, dict) and "traceEvents" in payload:
         return check_trace_events(text)
     return check_bench_payload(payload)

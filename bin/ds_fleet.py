@@ -8,6 +8,7 @@ report, and emit a merged multi-process Perfetto trace.
     python bin/ds_fleet.py RUN_DIR --trace merged.json # merged Chrome trace
     python bin/ds_fleet.py RUN_DIR --factor 2 --k 5    # detector thresholds
     python bin/ds_fleet.py RUN_DIR --strict            # exit 2 on flags
+                                                       #   or divergence
 
 ``RUN_DIR`` is a ``telemetry.output_path`` whose per-job subdirectories
 each hold one host's ``host_manifest.json`` + ``telemetry.jsonl`` (the
@@ -93,6 +94,28 @@ def print_report(report):
         print("ici_health: no measured exposed-wait walls in this run "
               "(micro/fused paths hide collectives inside one program; "
               "see docs/fleet.md)")
+    divergence = report.get("divergence") or {}
+    print()
+    if divergence.get("mismatch"):
+        print("PROGRAM DIVERGENCE: host(s) {} lowered a DIFFERENT "
+              "collective sequence than reference host {} — the mesh "
+              "hangs at the first divergent collective "
+              "(docs/concurrency.md)".format(
+                  ", ".join(divergence["divergent_hosts"]),
+                  divergence["reference"]))
+        for host, digest in sorted(divergence["digests"].items()):
+            marker = " <-- DIVERGENT" \
+                if host in divergence["divergent_hosts"] else ""
+            print("  {:<24} fingerprint {}{}".format(host, digest,
+                                                     marker))
+    elif divergence.get("published"):
+        print("program fingerprints: {} host(s) published, all agree "
+              "({})".format(
+                  divergence["published"],
+                  next(iter(divergence["digests"].values()))))
+    else:
+        print("program fingerprints: none published (hosts ran without "
+              "an audit/fingerprint pass; see docs/concurrency.md)")
 
 
 def main(argv=None):
@@ -114,7 +137,8 @@ def main(argv=None):
                         help="minimum hosts for median attribution "
                              "(default 2)")
     parser.add_argument("--strict", action="store_true",
-                        help="exit 2 when any straggler/ICI flag fired")
+                        help="exit 2 when any straggler/ICI flag fired "
+                             "or the host program fingerprints diverge")
     args = parser.parse_args(argv)
     aggregate, _straggler = _load_fleet_modules()
     if not os.path.isdir(args.run_dir):
@@ -138,7 +162,8 @@ def main(argv=None):
         print("merged trace -> {} ({} events from {} host(s); load at "
               "ui.perfetto.dev)".format(trace["path"], trace["events"],
                                         trace["hosts_merged"]))
-    if args.strict and report["straggler"]["flags"]:
+    if args.strict and (report["straggler"]["flags"] or
+                        (report.get("divergence") or {}).get("mismatch")):
         return 2
     return 0
 

@@ -43,6 +43,19 @@ AFTER the cost is paid:
     CI so new paths lower onto the executor instead of growing a
     seventh bespoke scheduler (docs/executor.md).
 
+  * **DSL008 guarded-mutation-outside-lock** — a mutating call /
+    subscript assign on a ``self.<attr>`` the class declares in its
+    ``_GUARDED_BY`` map, with no enclosing ``with self.<lock>:`` for
+    the declared lock. The static twin of the dynamic guarded-state
+    checker (analysis/concurrency/locksan.py): the AST rule catches
+    sites a run never exercised, the runtime proxy catches the threads
+    the AST cannot see (``__init__`` is exempt — construction
+    happens-before publication).
+  * **DSL009 thread-without-daemon-story** — ``threading.Thread(...)``
+    constructed without a ``daemon=`` keyword: the thread's lifetime is
+    undeclared, and a non-daemon thread with no join/close path holds
+    the interpreter open on every crash (docs/concurrency.md).
+
 Violations key as ``DSL###:<relpath>::<qualname>`` and count per key —
 the committed baseline file maps keys to accepted counts, so existing
 (reviewed) occurrences stay green while any NEW occurrence fails.
@@ -61,7 +74,21 @@ LINT_RULES = {
     "DSL005": "pallas-call-outside-ops",
     "DSL006": "step-scheduling-outside-executor",
     "DSL007": "metric-name-outside-catalog",
+    "DSL008": "guarded-mutation-outside-lock",
+    "DSL009": "thread-without-daemon-story",
 }
+
+# DSL008: mutating container methods (the static twin of the dynamic
+# checker in concurrency/locksan.py — the AST rule catches the sites a
+# run never exercised, the proxy catches the threads the AST cannot
+# see)
+_DSL008_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "pop",
+    "popleft", "popitem", "remove", "discard", "clear", "add", "update",
+    "setdefault", "sort", "reverse", "rotate",
+})
+# the class-level declaration both checkers read
+_GUARDED_BY_NAME = "_GUARDED_BY"
 
 # DSL007: registry-call method names + the metric-name literal shape
 _METRIC_METHODS = {"counter", "gauge", "histogram"}
@@ -105,9 +132,10 @@ def _attr_chain(node):
 
 
 class _FunctionLint(ast.NodeVisitor):
-    """Per-function-body state: loop depth, telemetry guards/uses."""
+    """Per-function-body state: loop depth, telemetry guards/uses,
+    enclosing ``with <lock>`` scopes (DSL008)."""
 
-    def __init__(self, linter, qualname, in_builder):
+    def __init__(self, linter, qualname, in_builder, guarded=None):
         self.linter = linter
         self.qualname = qualname
         self.in_builder = in_builder       # nested under a *_fn builder
@@ -115,14 +143,70 @@ class _FunctionLint(ast.NodeVisitor):
         self.telemetry_guarded = False
         self.telemetry_aliases = set()
         self.telemetry_uses = []           # [lineno]
+        # DSL008 state: the owning class's _GUARDED_BY map and the
+        # stack of lock attr names entered via `with self.<lock>:`
+        self.guarded = guarded or {}
+        self.with_locks = []
 
     # ---- nested functions delegate back to the linter (fresh state)
     def visit_FunctionDef(self, node):
         self.linter.visit_function(
             node, self.qualname,
-            self.in_builder or self.qualname.endswith("_fn"))
+            self.in_builder or self.qualname.endswith("_fn"),
+            guarded=self.guarded)
 
     visit_AsyncFunctionDef = visit_FunctionDef
+
+    # ------------------------------------------------------------ DSL008
+    def visit_With(self, node):
+        entered = set()
+        for item in node.items:
+            expr = item.context_expr
+            chain = _attr_chain(expr) if isinstance(expr, ast.Attribute) \
+                else ""
+            if chain.startswith("self."):
+                entered.add(chain.split(".")[-1])
+        self.with_locks.append(entered)
+        self.generic_visit(node)
+        self.with_locks.pop()
+
+    visit_AsyncWith = visit_With
+
+    def _held_locks(self):
+        held = set()
+        for scope in self.with_locks:
+            held |= scope
+        return held
+
+    def _guarded_attr_of(self, node):
+        """'attr' when ``node`` is ``self.<attr>`` and the class
+        declares it _GUARDED_BY; None otherwise."""
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self" and node.attr in self.guarded:
+            return node.attr
+        return None
+
+    def _check_guarded_mutation(self, attr, lineno, how):
+        # __init__ builds the structure before any thread can see it
+        if attr is None or self.qualname.endswith("__init__"):
+            return
+        lock = self.guarded[attr]
+        if lock in self._held_locks():
+            return
+        self.linter.report(
+            "DSL008", self.qualname, lineno,
+            "self.{} mutated ({}) outside `with self.{}` — the class "
+            "declares it _GUARDED_BY that lock "
+            "(docs/concurrency.md)".format(attr, how, lock))
+
+    def visit_AugAssign(self, node):
+        tgt = node.target
+        if isinstance(tgt, ast.Subscript):
+            self._check_guarded_mutation(
+                self._guarded_attr_of(tgt.value), node.lineno,
+                "augmented subscript assign")
+        self.generic_visit(node)
 
     def visit_For(self, node):
         self.loop_depth += 1
@@ -138,6 +222,12 @@ class _FunctionLint(ast.NodeVisitor):
             for tgt in node.targets:
                 if isinstance(tgt, ast.Name):
                     self.telemetry_aliases.add(tgt.id)
+        # DSL008: self.<guarded>[k] = v outside the declared lock
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Subscript):
+                self._check_guarded_mutation(
+                    self._guarded_attr_of(tgt.value), node.lineno,
+                    "subscript assign")
         self.generic_visit(node)
 
     def _guards_telemetry(self, expr):
@@ -217,6 +307,23 @@ class _FunctionLint(ast.NodeVisitor):
                                "pl.pallas_call outside deepspeed_tpu/"
                                "ops/ — kernels live in one place "
                                "(ops/pallas; docs/pallas_kernels.md)")
+        # DSL008: mutating-method call on a declared-guarded attribute
+        if isinstance(fn, ast.Attribute) and fn.attr in _DSL008_MUTATORS:
+            self._check_guarded_mutation(
+                self._guarded_attr_of(fn.value), node.lineno,
+                ".{}()".format(fn.attr))
+        # DSL009: a thread constructed with no daemon story — a
+        # non-daemon thread with no declared join/close path holds the
+        # interpreter open on every crash (the repo's threads are
+        # daemon + joined-with-timeout in close(); a reviewed baseline
+        # entry is how a deliberate non-daemon thread ships)
+        if chain == "threading.Thread" and \
+                not any(kw.arg == "daemon" for kw in node.keywords):
+            self.linter.report(
+                "DSL009", self.qualname, node.lineno,
+                "threading.Thread(...) without daemon= — declare the "
+                "thread's lifetime (daemon=True, or daemon=False with "
+                "a reviewed join/close story; docs/concurrency.md)")
         if not self.linter.in_executor:
             name_id = fn.id if isinstance(fn, ast.Name) else ""
             sched = None
@@ -263,26 +370,51 @@ class FileLinter:
     def report(self, rule, qualname, lineno, message):
         self.violations.append((rule, qualname, lineno, message))
 
-    def visit_function(self, node, parent_qual, in_builder):
+    def visit_function(self, node, parent_qual, in_builder,
+                       guarded=None):
         qual = "{}.{}".format(parent_qual, node.name) if parent_qual \
             else node.name
-        state = _FunctionLint(self, qual, in_builder)
+        state = _FunctionLint(self, qual, in_builder, guarded=guarded)
         for stmt in node.body:
             state.visit(stmt)
         state.finish()
 
+    @staticmethod
+    def _guarded_decl(class_node):
+        """The class's ``_GUARDED_BY`` literal ({attr: lock_attr}), or
+        {} — the DSL008 declaration (shared with the dynamic checker,
+        concurrency/locksan.py)."""
+        for stmt in class_node.body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            if not any(isinstance(t, ast.Name) and
+                       t.id == _GUARDED_BY_NAME for t in stmt.targets):
+                continue
+            if not isinstance(stmt.value, ast.Dict):
+                return {}
+            decl = {}
+            for k, v in zip(stmt.value.keys, stmt.value.values):
+                if isinstance(k, ast.Constant) and \
+                        isinstance(k.value, str) and \
+                        isinstance(v, ast.Constant) and \
+                        isinstance(v.value, str):
+                    decl[k.value] = v.value
+            return decl
+        return {}
+
     def run(self, tree):
         # walk module/class levels; functions get per-body state
-        def top(node, prefix):
+        def top(node, prefix, guarded):
             for child in ast.iter_child_nodes(node):
                 if isinstance(child, (ast.FunctionDef,
                                       ast.AsyncFunctionDef)):
-                    self.visit_function(child, prefix, False)
+                    self.visit_function(child, prefix, False,
+                                        guarded=guarded)
                 elif isinstance(child, ast.ClassDef):
                     name = "{}.{}".format(prefix, child.name) if prefix \
                         else child.name
-                    top(child, name)
-        top(tree, "")
+                    top(child, name, self._guarded_decl(child))
+        top(tree, "", {})
         return self.violations
 
 

@@ -122,14 +122,20 @@ def _output_drift_findings(spec, fn, compiled):
 
 def audit_programs(specs, config, job="audit", suppressions=None,
                    sequence=(), hlo=False, wire_est=None, mesh=None,
-                   report_path=None):
+                   report_path=None, extra_findings=()):
     """Run the full rule set over ``specs`` and assemble the report.
 
     ``hlo=True`` additionally compiles each spec whose meta carries a
     ``wire_multiplier`` or ``out_expect`` and runs the collective
     census / output-drift checks; the summed census reconciles against
-    ``wire_est`` when given.
+    ``wire_est`` when given. ``extra_findings``: pre-built findings
+    (the lock sanitizer's) routed through the same suppression file.
+    The walked collective sequences land in
+    ``report.collective_families`` — the program-fingerprint source
+    (ISSUE 15; analysis/concurrency/divergence.py).
     """
+    from .concurrency.divergence import (collective_tokens,
+                                         control_flow_findings)
     report = AnalysisReport(job=job)
     if isinstance(suppressions, str):
         suppressions = Suppressions.load(suppressions)
@@ -143,6 +149,10 @@ def audit_programs(specs, config, job="audit", suppressions=None,
                 "donate_argnums": list(spec.donate_argnums)}
         if walk_result is not None:
             meta["segments"] = segment_summary(walk_result)
+            report.collective_families[spec.name] = \
+                collective_tokens(walk_result)
+            report.extend(control_flow_findings(spec.name, walk_result),
+                          suppressions)
         if hlo and closed is not None and (
                 spec.meta.get("wire_multiplier") or
                 spec.meta.get("out_expect")):
@@ -180,6 +190,8 @@ def audit_programs(specs, config, job="audit", suppressions=None,
         report.add_program(spec.name, **meta)
     if sequence:
         report.extend(sequence_findings(sequence), suppressions)
+    if extra_findings:
+        report.extend(extra_findings, suppressions)
     if hlo and census_list and wire_est is not None:
         sharded_grads = any(
             getattr(s.plan, "stage", 0) >= 2 for s in specs
@@ -228,7 +240,7 @@ def audit_plan(engine, report):
     shape lands in the report's program table as ``plan/<name>``."""
     if getattr(engine, "stream_runner", None) is None and \
             getattr(engine, "host_state", None) is None:
-        return                      # micro/fused: one-segment plans
+        return None                 # micro/fused: one-segment plans
     from .ir import plan_of
     try:
         plan = plan_of(engine)
@@ -239,7 +251,7 @@ def audit_plan(engine, report):
             message="segment plan could not be built for the audit: "
                     "{}".format(err),
             key="plan_build_error"))
-        return
+        return None
     for i, problem in enumerate(plan.validate()):
         report.add(Finding(
             rule="executor_plan", check="plan_invalid",
@@ -251,6 +263,7 @@ def audit_plan(engine, report):
     report.add_program("plan/" + plan.name, family="plan",
                        plan_segments=summary["segments"],
                        per_kind=summary["per_kind"])
+    return plan
 
 
 def audit_engine(engine, batch=None, hlo=None, report_path=None,
@@ -296,14 +309,35 @@ def audit_engine(engine, batch=None, hlo=None, report_path=None,
             logger.info("shard-lint: wire estimate unavailable (%s)", err)
         job = "train"
     use_hlo = bool(config.hlo if hlo is None else hlo)
+    # lock-sanitizer findings (docs/concurrency.md) ride the same
+    # report — and the same suppression file — as the program rules
+    from .concurrency import locksan
+    san = locksan.current()
     report = audit_programs(
         specs, config, job=job,
         suppressions=config.suppressions, sequence=sequence,
-        hlo=use_hlo, wire_est=wire_est, mesh=mesh)
+        hlo=use_hlo, wire_est=wire_est, mesh=mesh,
+        extra_findings=san.report() if san is not None else ())
+    plan = None
     if job == "train":
         # lowered-plan verification rides the same report (and lands in
         # the same artifact) as the program rules
-        audit_plan(engine, report)
+        plan = audit_plan(engine, report)
+    # canonical program fingerprint (ISSUE 15): the collective order of
+    # every walked program + the lowered plan topology, published into
+    # this host's manifest so bin/ds_fleet.py can verify the whole
+    # fleet lowered the SAME program
+    if report.collective_families and \
+            getattr(config, "concurrency_fingerprint", True):
+        from .concurrency.divergence import (canonical_fingerprint,
+                                             plan_tokens)
+        fams = dict(report.collective_families)
+        if plan is not None:
+            fams["plan/" + plan.name] = plan_tokens(plan)
+        report.fingerprint = canonical_fingerprint(fams)
+        tel = getattr(engine, "telemetry", None)
+        if tel is not None:
+            tel.publish_fingerprint(report.fingerprint)
     out_path = report_path or config.report_path
     if out_path:
         report.write(out_path)

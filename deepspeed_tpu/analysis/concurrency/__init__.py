@@ -1,0 +1,36 @@
+"""Concurrency sanitizer + SPMD divergence auditor (ISSUE 15;
+docs/concurrency.md).
+
+Two halves, both reporting through the PR 10 ``Finding``/suppression
+machinery:
+
+* :mod:`locksan` — an opt-in instrumented shim over the ``threading``
+  locks the runtime already creates: lock-order cycles, locks held
+  across blocking calls, signal-handler acquisition of non-reentrant
+  locks, and declared-guarded state accessed without its lock
+  (``_GUARDED_BY`` — the one declaration per class the DSL008 AST rule
+  also reads). Off = structurally absent.
+* :mod:`divergence` — per-host program fingerprints over the fleet's
+  collective order, derived from the shard-lint IR walk + lowered
+  segment plans, published in the host manifest, verified across hosts
+  by ``telemetry/fleet/aggregate.py`` + ``bin/ds_fleet.py`` (which
+  stay stdlib-only; this package supplies derivation + findings).
+"""
+from .divergence import (FINGERPRINT_KEYS, FINGERPRINT_VERSION,
+                         audit_fleet, canonical_fingerprint,
+                         collective_tokens, divergence_findings,
+                         fingerprint_engine, plan_tokens,
+                         publish_fingerprint, validate_fingerprint)
+from .locksan import (GUARDED_BY_ATTR, LockSanitizer, SanLock, current,
+                      guarded, install, instrument_collector, new_lock,
+                      new_rlock, note_blocking, signal_scope, uninstall)
+
+__all__ = [
+    "LockSanitizer", "SanLock", "GUARDED_BY_ATTR", "current", "install",
+    "uninstall", "new_lock", "new_rlock", "guarded", "note_blocking",
+    "signal_scope", "instrument_collector",
+    "FINGERPRINT_KEYS", "FINGERPRINT_VERSION", "canonical_fingerprint",
+    "collective_tokens", "plan_tokens", "fingerprint_engine",
+    "publish_fingerprint", "divergence_findings", "audit_fleet",
+    "validate_fingerprint",
+]

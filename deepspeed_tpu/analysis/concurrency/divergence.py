@@ -1,0 +1,289 @@
+"""SPMD divergence auditor: per-host program fingerprints over the
+fleet's collective order (docs/concurrency.md "Program fingerprints").
+
+On a real pod a single host that lowers a DIFFERENT program — an extra
+collective from host-dependent control flow, a reordered gather from a
+config drift, a segment plan built against a stale topology — hangs
+the whole mesh with no diagnosis: every other host sits in a
+collective the divergent host never enters. GSPMD-style whole-program
+partitioning (2105.04663) makes the collective SEQUENCE a per-program
+invariant, so we can canonicalize it ahead of time and compare across
+hosts BEFORE the first step:
+
+* each program family's collective sequence is derived from the
+  existing shard-lint IR walk (``analysis/ir.py`` classification — the
+  same records every other rule reads) and, for lowered step paths,
+  from the executed :class:`SegmentPlan` topology;
+* the sequences canonicalize into one JSON payload + sha256 digest
+  (:func:`canonical_fingerprint`) published in the PR 14 host manifest
+  (``program_fingerprint``; ``telemetry/fleet/aggregate.py`` owns the
+  cross-host comparison so ``bin/ds_fleet.py`` stays jax-less);
+* :func:`divergence_findings` turns a mismatched comparison into
+  ``fleet_divergence`` findings through the PR 10 machinery (warn,
+  raise under ``analysis.strict``) — "the pod hung" becomes "host 3
+  lowered a different plan at step 0".
+
+The derivation half (this module's jax-touching functions) runs only
+in-process on an engine; the comparison half is stdlib and lives with
+the fleet merger.
+"""
+import hashlib
+import json
+
+from ..findings import AnalysisReport, Finding
+
+FINGERPRINT_VERSION = 1
+
+# every published fingerprint carries exactly these keys (the manifest
+# extension bin/check_bench_schema.py validates)
+FINGERPRINT_KEYS = ("version", "digest", "families")
+
+
+# ------------------------------------------------------- canonical form
+def collective_tokens(walk_result, structure=True):
+    """The ordered collective sequence of one walked program: one token
+    per collective-classified op record — primitive name, the mesh axes
+    it moves over, and its static trip count (``xN`` for scan bodies;
+    ``x?`` under a dynamic-trip ``while``). Two hosts executing the
+    same program produce the same token list BY CONSTRUCTION; any
+    divergence in collective order/kind/axis shows as a token diff.
+
+    ``structure=True`` appends one ``#ops:...`` summary token (op /
+    GEMM / host-call counts): GSPMD programs carry NO explicit
+    collective primitives — the partitioner inserts them post-lowering,
+    deterministically from the program structure — so the structural
+    census is what diverges when two hosts lower different GSPMD
+    programs (2105.04663)."""
+    tokens = []
+    n_ops = n_gemm = n_host = 0
+    for info in walk_result.eqns:
+        n_ops += 1
+        if info.prim in ("dot_general", "conv_general_dilated"):
+            n_gemm += 1
+        if info.kind == "host":
+            n_host += 1
+        if info.kind != "collective":
+            continue
+        axes = info.eqn.params.get("axes",
+                                   info.eqn.params.get("axis_name"))
+        if isinstance(axes, (list, tuple)):
+            axes = ",".join(str(a) for a in axes)
+        token = info.prim
+        if axes is not None:
+            token += "@{}".format(axes)
+        trips = info.trips
+        if trips is None:
+            token += "x?"
+        elif trips != 1:
+            token += "x{}".format(int(trips))
+        tokens.append(token)
+    if structure:
+        tokens.append("#ops:{}/gemm:{}/host:{}".format(
+            n_ops, n_gemm, n_host))
+    return tokens
+
+
+def plan_tokens(plan):
+    """The ordered byte-moving segment sequence of one lowered
+    :class:`SegmentPlan`: collective/transfer segments in plan
+    (insertion = serial-oracle) order. Segment names are deterministic
+    functions of the config/topology, so equal configs fingerprint
+    equal and a host that lowered a different plan diffs at the first
+    divergent segment."""
+    return ["{}:{}".format(seg.kind, seg.name)
+            for seg in plan.segments
+            if seg.kind in ("collective", "transfer")]
+
+
+def canonical_fingerprint(families):
+    """``{family: [token, ...]}`` -> the fingerprint payload published
+    in the host manifest: a version, the canonical-JSON sha256 digest
+    (16 hex chars — collision is a non-goal, diffability is), and the
+    family map itself (kept so a mismatch can name the first divergent
+    family/token instead of just "digests differ")."""
+    fams = {str(k): [str(t) for t in v]
+            for k, v in sorted(families.items())}
+    payload = json.dumps({"version": FINGERPRINT_VERSION,
+                          "families": fams},
+                         sort_keys=True, separators=(",", ":"))
+    return {
+        "version": FINGERPRINT_VERSION,
+        "digest": hashlib.sha256(payload.encode("utf-8")).hexdigest()[:16],
+        "families": fams,
+    }
+
+
+def validate_fingerprint(payload):
+    """-> list of problems with one program_fingerprint payload."""
+    problems = []
+    if not isinstance(payload, dict):
+        return ["fingerprint is not a dict"]
+    for key in FINGERPRINT_KEYS:
+        if key not in payload:
+            problems.append("missing key {!r}".format(key))
+    if problems:
+        return problems
+    if not isinstance(payload["digest"], str) or not payload["digest"]:
+        problems.append("digest is not a non-empty string")
+    fams = payload["families"]
+    if not isinstance(fams, dict):
+        problems.append("families is not a dict")
+    else:
+        for name, tokens in fams.items():
+            if not isinstance(tokens, list) or \
+                    not all(isinstance(t, str) for t in tokens):
+                problems.append(
+                    "families[{!r}] is not a list of tokens".format(name))
+                break
+    return problems
+
+
+# ---------------------------------------------------- control-flow rule
+def control_flow_findings(spec_name, walk_result):
+    """``collective_in_branch``: a collective primitive nested inside a
+    ``cond``/``switch`` branch — the collective executes only on one
+    data-dependent path, so value divergence across hosts (a
+    host-dependent predicate feeding the branch) reorders the
+    collective sequence and hangs the mesh (the GSPMD uniformity
+    contract, 2105.04663). Loop bodies (``scan``/``while``) are exempt:
+    they execute structurally identically on every device — only
+    BRANCHES make a collective conditional."""
+    findings = []
+    seen = set()
+    for info in walk_result.eqns:
+        if info.kind != "collective":
+            continue
+        parts = info.path.split("/")
+        if not any(p in ("cond", "switch") for p in parts[:-1]):
+            continue
+        key = "collective_in_branch:{}:{}".format(spec_name, info.prim)
+        if key in seen:
+            continue
+        seen.add(key)
+        findings.append(Finding(
+            rule="concurrency", check="collective_in_branch",
+            program=spec_name, severity="warn",
+            message="program {!r} runs collective {!r} inside a "
+                    "conditional branch ({}) — a host-dependent "
+                    "predicate diverges the fleet's collective order "
+                    "and hangs the mesh (hoist the collective out of "
+                    "the branch, or make the predicate provably "
+                    "uniform)".format(spec_name, info.prim, info.path),
+            key=key,
+            details={"prim": info.prim, "path": info.path}))
+    return findings
+
+
+# ------------------------------------------------------- engine derive
+def fingerprint_engine(engine, batch=None):
+    """Derive this engine's program fingerprint: walk every resolved
+    step program (the same collectors the auditor uses) for its
+    collective sequence, plus the lowered segment-plan topology on the
+    offload/streamed paths. Heavier than a manifest read (one
+    ``make_jaxpr`` per family) — ``engine.audit()`` computes the same
+    payload as a side effect of the walk it already does, so prefer
+    auditing when both are wanted."""
+    import jax
+
+    from .. import programs as collectors
+    from ..ir import plan_of, walk
+    if hasattr(engine, "prefill_buckets"):
+        specs = collectors.collect_inference_programs(engine)
+    else:
+        specs = collectors.collect_train_programs(engine, batch=batch)
+    families = {}
+    for spec in specs:
+        closed = jax.make_jaxpr(spec.build())(*spec.args)
+        families[spec.name] = collective_tokens(walk(closed))
+    if getattr(engine, "stream_runner", None) is not None or \
+            getattr(engine, "host_state", None) is not None:
+        plan = plan_of(engine)
+        families["plan/" + plan.name] = plan_tokens(plan)
+    return canonical_fingerprint(families)
+
+
+def publish_fingerprint(engine, fingerprint):
+    """Publish a fingerprint into this host's manifest through the
+    engine's live telemetry collector (no-op without one — there is no
+    manifest to extend then)."""
+    tel = getattr(engine, "telemetry", None)
+    if tel is None:
+        return None
+    return tel.publish_fingerprint(fingerprint)
+
+
+# ----------------------------------------------------------- findings
+def _first_divergence(ref_fams, fams):
+    """(family, index, ref_token, token) of the first diff between two
+    family maps, or a family present on one side only."""
+    for name in sorted(set(ref_fams) | set(fams)):
+        a, b = ref_fams.get(name), fams.get(name)
+        if a is None or b is None:
+            return name, None, None if a is None else "present", \
+                None if b is None else "present"
+        for i in range(max(len(a), len(b))):
+            ta = a[i] if i < len(a) else None
+            tb = b[i] if i < len(b) else None
+            if ta != tb:
+                return name, i, ta, tb
+    return None, None, None, None
+
+
+def divergence_findings(comparison):
+    """``fleet_divergence`` findings from one comparison payload (the
+    ``divergence`` section ``telemetry/fleet/aggregate.py``'s
+    ``compare_fingerprints`` builds / ``merge_run`` embeds): one
+    finding per divergent host, naming the first differing
+    family/token against the reference host."""
+    if not isinstance(comparison, dict) or \
+            not comparison.get("mismatch"):
+        return []
+    ref_host = comparison.get("reference")
+    fams_by_host = comparison.get("families") or {}
+    ref_fams = fams_by_host.get(ref_host) or {}
+    findings = []
+    for host in comparison.get("divergent_hosts") or []:
+        fams = fams_by_host.get(host) or {}
+        family, idx, ref_tok, tok = _first_divergence(ref_fams, fams)
+        if family is None:
+            where = "digests differ (token detail not published)"
+        elif idx is None:
+            where = "family {!r} exists on only one side".format(family)
+        else:
+            where = ("family {!r} token {}: reference {!r} vs "
+                     "{!r}".format(family, idx, ref_tok, tok))
+        findings.append(Finding(
+            rule="concurrency", check="fleet_divergence",
+            program="fleet", severity="error",
+            message="host {!r} lowered a DIFFERENT program than "
+                    "reference host {!r}: {} — on a real pod every "
+                    "other host hangs in a collective this host never "
+                    "enters".format(host, ref_host, where),
+            key="fleet_divergence:{}".format(host),
+            details={"host": host, "reference": ref_host,
+                     "digest": (comparison.get("digests") or {})
+                     .get(host),
+                     "reference_digest": (comparison.get("digests")
+                                          or {}).get(ref_host),
+                     "family": family, "index": idx,
+                     "reference_token": ref_tok, "token": tok}))
+    return findings
+
+
+def audit_fleet(report_or_comparison, config=None, strict=None):
+    """Dispose fleet-divergence findings the PR 10 way: warn each, and
+    raise :class:`~..auditor.AuditFindingsError` under
+    ``analysis.strict`` (``strict`` argument overrides). Accepts a full
+    merged fleet report (``merge_run`` shape) or a bare comparison
+    payload; returns the :class:`AnalysisReport`."""
+    from ..auditor import dispose
+    payload = report_or_comparison or {}
+    if "divergence" in payload:
+        payload = payload.get("divergence") or {}
+    report = AnalysisReport(job="fleet-divergence")
+    suppressions = None
+    if config is not None and getattr(config, "suppressions", None):
+        from ..findings import Suppressions
+        suppressions = Suppressions.load(config.suppressions)
+    report.extend(divergence_findings(payload), suppressions)
+    return dispose(report, config, raise_on_findings=strict)

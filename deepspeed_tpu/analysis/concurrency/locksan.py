@@ -1,0 +1,571 @@
+"""Lock sanitizer: an opt-in instrumented shim over the ``threading``
+locks the runtime already creates (docs/concurrency.md is the lock
+inventory and rule catalog).
+
+The runtime is genuinely concurrent — the PlanExecutor worker pools,
+the H2D upload worker, the watchdog deadline thread, the recorder's
+signal-handler dumps, the metrics exporter's HTTP handler threads —
+and every concurrency bug so far (the SIGTERM RLock self-deadlock, the
+deque-mutated-during-dump race, the histogram aliased mid-scrape) was
+caught by human review after the fact. This module makes three whole
+bug classes observable AHEAD of the hang:
+
+* **lock_order_cycle** — the global lock-order graph (edge A->B when B
+  is acquired while A is held, acquisition stack kept for the first
+  observation of each edge) contains a cycle: two threads taking the
+  same pair of locks in opposite orders WILL deadlock under the right
+  interleaving, whether or not this run hit it.
+* **held_blocking** — a sanitized lock was held across a declared
+  blocking call (a worker-future wait, a device sync, an H2D drain, a
+  crash-bundle file write): every other thread touching that lock
+  stalls behind IO it has no part in, and a blocked dump can wedge the
+  dying process.
+* **signal_unsafe** — a signal handler acquired a NON-reentrant
+  sanitized lock: the signal can land while the interrupted main-thread
+  frame already holds it, and the handler self-deadlocks the process
+  (the exact bug that forced the recorder ring onto an RLock).
+* **guarded_race** — a structure declared ``_GUARDED_BY`` its class was
+  mutated (or iterated) without its guarding lock held by the current
+  thread (the deque-mutated-during-dump class, caught at the racy
+  access instead of the crashed iteration).
+
+OFF = structurally absent: :func:`new_lock`/:func:`new_rlock` return
+plain ``threading`` locks, :func:`guarded` returns the container
+unchanged, and :func:`note_blocking` is one module-global ``is None``
+check — the PR 8 subsystem contract. ON (``analysis.concurrency`` in
+the ds_config, or an explicit :func:`install`), every acquisition costs
+a thread-local list append; stacks are captured only on the FIRST
+observation of an edge or finding, so the steady state stays inside
+the telemetry <5% budget (measured by the dryrun concurrency leg).
+
+Findings ride the PR 10 machinery: :meth:`LockSanitizer.report`
+returns :class:`~..findings.Finding` objects that route through the
+usual suppression file and raise under ``analysis.strict``
+(docs/concurrency.md documents the suppression policy).
+
+Stdlib-only by construction (``from ..findings import Finding`` is the
+only sibling import), so the sanitizer itself can never drag jax into
+a thread it instruments.
+"""
+import threading
+import time
+import traceback
+
+from ..findings import Finding
+
+RULE = "concurrency"
+
+# findings the sanitizer can produce (docs/concurrency.md rule catalog)
+CHECKS = ("lock_order_cycle", "held_blocking", "signal_unsafe",
+          "guarded_race")
+
+STACK_DEPTH_DEFAULT = 12
+
+# class-level declaration read by the dynamic checker AND the DSL008
+# AST rule: {attr_name: lock_attr_name}
+GUARDED_BY_ATTR = "_GUARDED_BY"
+
+# the process-global active sanitizer; None = off = every seam below is
+# a single is-None check (the zero-overhead-off contract)
+_ACTIVE = None
+
+
+# ------------------------------------------------------------- seams
+def current():
+    """The installed :class:`LockSanitizer`, or None (off)."""
+    return _ACTIVE
+
+
+def install(sanitizer):
+    """Install ``sanitizer`` process-globally (idempotent when the same
+    instance is already active). Locks created via :func:`new_lock` /
+    :func:`new_rlock` AFTER this point are instrumented."""
+    global _ACTIVE
+    if _ACTIVE is not None and _ACTIVE is not sanitizer:
+        raise RuntimeError(
+            "a lock sanitizer is already installed — uninstall() it "
+            "first (the lock-order graph is process-global by design)")
+    _ACTIVE = sanitizer
+    return sanitizer
+
+
+def uninstall():
+    """Remove the active sanitizer (tests; already-wrapped locks keep
+    working — they hold their own sanitizer reference — but new locks
+    come out plain)."""
+    global _ACTIVE
+    san = _ACTIVE
+    _ACTIVE = None
+    return san
+
+
+def new_lock(name):
+    """A ``threading.Lock`` — instrumented under ``name`` when the
+    sanitizer is active, plain otherwise."""
+    if _ACTIVE is None:
+        return threading.Lock()
+    return _ACTIVE.lock(name)
+
+
+def new_rlock(name):
+    """A ``threading.RLock`` — instrumented under ``name`` when the
+    sanitizer is active, plain otherwise."""
+    if _ACTIVE is None:
+        return threading.RLock()
+    return _ACTIVE.rlock(name)
+
+
+def guarded(owner, attr, container):
+    """Wrap ``container`` (deque/list/dict/set) in a guarded-access
+    checker when the sanitizer is active and ``type(owner)`` declares
+    ``attr`` in its ``_GUARDED_BY`` map; returns ``container`` itself
+    otherwise. Call at the CREATION site so every alias (e.g. the log
+    handler's ring reference) shares the checked object."""
+    if _ACTIVE is None:
+        return container
+    decl = getattr(type(owner), GUARDED_BY_ATTR, None)
+    if not decl or attr not in decl:
+        return container
+    return _ACTIVE.guard(container, owner, attr, decl[attr])
+
+
+def note_blocking(desc):
+    """Declare the calling frame is about to BLOCK (a future wait, a
+    device sync, a file write on a shared path). No-op when off; a
+    ``held_blocking`` finding when any sanitized lock is held."""
+    if _ACTIVE is not None:
+        _ACTIVE.note_blocking(desc)
+
+
+class signal_scope:
+    """Context manager marking the dynamic extent of a signal handler:
+    non-reentrant sanitized acquisitions inside it become
+    ``signal_unsafe`` findings. No-op (but still a valid context
+    manager) when the sanitizer is off."""
+
+    def __enter__(self):
+        if _ACTIVE is not None:
+            _ACTIVE._tls_state().in_signal += 1
+        return self
+
+    def __exit__(self, *exc):
+        if _ACTIVE is not None:
+            state = _ACTIVE._tls_state()
+            state.in_signal = max(state.in_signal - 1, 0)
+        return False
+
+
+# ----------------------------------------------------------- wrappers
+class _LockInfo:
+    __slots__ = ("name", "reentrant")
+
+    def __init__(self, name, reentrant):
+        self.name = name
+        self.reentrant = reentrant
+
+
+class SanLock:
+    """Instrumented lock: delegates to the wrapped ``threading`` lock,
+    reporting every acquisition/release to the owning sanitizer. Usable
+    anywhere the plain lock was (``with``, ``acquire``/``release``,
+    ``logging`` handler locks)."""
+
+    __slots__ = ("_san", "_info", "_inner")
+
+    def __init__(self, san, info, inner):
+        self._san = san
+        self._info = info
+        self._inner = inner
+
+    def acquire(self, blocking=True, timeout=-1):
+        self._san.before_acquire(self._info)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._san.after_acquire(self._info)
+        return got
+
+    def release(self):
+        self._inner.release()
+        self._san.after_release(self._info)
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+    def held_by_current_thread(self):
+        """Whether THIS thread holds the lock (from the sanitizer's
+        thread-local held list — a plain Lock cannot answer this)."""
+        return any(info is self._info
+                   for info, _ in self._san._tls_state().held)
+
+    @property
+    def name(self):
+        return self._info.name
+
+    @property
+    def reentrant(self):
+        return self._info.reentrant
+
+
+# mutating method names checked by the guarded proxies, per operation
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "pop",
+    "popleft", "popitem", "remove", "discard", "clear", "add", "update",
+    "setdefault", "sort", "reverse", "rotate",
+})
+# read operations that are UNSAFE concurrent with mutation (the
+# deque-mutated-during-dump / dict-changed-size-during-render class):
+# snapshotting must hold the lock too
+_CHECKED_READS = frozenset({"__iter__", "copy", "items", "keys",
+                            "values"})
+
+
+class GuardedProxy:
+    """Transparent wrapper over a declared-guarded container: mutating
+    calls (and iteration) verify the declared lock is held by the
+    current thread, else record ONE ``guarded_race`` finding per
+    (class.attr, method) site. Non-checked attributes delegate."""
+
+    __slots__ = ("_obj", "_san", "_owner_name", "_attr", "_lock_attr",
+                 "_owner_ref")
+
+    def __init__(self, obj, san, owner, attr, lock_attr):
+        object.__setattr__(self, "_obj", obj)
+        object.__setattr__(self, "_san", san)
+        object.__setattr__(self, "_owner_name", type(owner).__name__)
+        object.__setattr__(self, "_attr", attr)
+        object.__setattr__(self, "_lock_attr", lock_attr)
+        object.__setattr__(self, "_owner_ref", owner)
+
+    # ------------------------------------------------------------ checks
+    def _check(self, op):
+        san = self._san
+        lock = getattr(self._owner_ref, self._lock_attr, None)
+        held = isinstance(lock, SanLock) and lock.held_by_current_thread()
+        if not held:
+            san.record_guarded_race(self._owner_name, self._attr,
+                                    self._lock_attr, op)
+
+    def __getattr__(self, name):
+        val = getattr(self._obj, name)
+        if name in _MUTATORS or name in _CHECKED_READS:
+            def checked(*args, **kwargs):
+                self._check(name)
+                return val(*args, **kwargs)
+            return checked
+        return val
+
+    # dunder lookups bypass __getattr__ — spell the checked ones out
+    def __iter__(self):
+        self._check("__iter__")
+        return iter(self._obj)
+
+    def __setitem__(self, key, value):
+        self._check("__setitem__")
+        self._obj[key] = value
+
+    def __delitem__(self, key):
+        self._check("__delitem__")
+        del self._obj[key]
+
+    def __getitem__(self, key):
+        return self._obj[key]
+
+    def __len__(self):
+        return len(self._obj)
+
+    def __contains__(self, item):
+        return item in self._obj
+
+    def __bool__(self):
+        return bool(self._obj)
+
+    def __repr__(self):
+        return "GuardedProxy({!r})".format(self._obj)
+
+    @property
+    def maxlen(self):            # deque passthrough
+        return getattr(self._obj, "maxlen", None)
+
+
+class _TlsState(threading.local):
+    def __init__(self):
+        self.held = []              # [(info, count)] acquisition order
+        self.in_signal = 0
+
+
+class LockSanitizer:
+    """Owns the instrumentation state: the lock registry, the per-thread
+    held stacks, the lock-order edge graph, and the raw finding events.
+    One instance per process (``install()``); thread-safe — its own
+    internal lock is a PLAIN lock (never itself sanitized)."""
+
+    def __init__(self, stack_depth=STACK_DEPTH_DEFAULT):
+        self.stack_depth = int(stack_depth)
+        self._tls = _TlsState()
+        self._state_lock = threading.Lock()     # guards the tables below
+        self._locks = []                        # [_LockInfo]
+        self._edges = {}      # (held_name, acq_name) -> edge dict
+        self._events = {}     # finding key -> event dict (fire once)
+        self.acquisitions = 0
+
+    # ----------------------------------------------------------- factory
+    def lock(self, name):
+        return self._wrap_new(threading.Lock(), name, reentrant=False)
+
+    def rlock(self, name):
+        return self._wrap_new(threading.RLock(), name, reentrant=True)
+
+    def wrap(self, lock, name):
+        """Instrument an EXISTING lock object (the post-construction
+        seam for the stdlib-only fleet modules, which cannot import this
+        package themselves). Already-sanitized locks pass through."""
+        if isinstance(lock, SanLock):
+            return lock
+        reentrant = "RLock" in type(lock).__name__
+        return self._wrap_new(lock, name, reentrant=reentrant)
+
+    def _wrap_new(self, inner, name, reentrant):
+        name = str(name)
+        with self._state_lock:
+            # the order graph keys edges by NAME — two distinct locks
+            # sharing one name (a second engine's "recorder.ring")
+            # would conflate into self-edges reporting a deadlock that
+            # cannot exist, so a reused name gets a #n suffix and every
+            # _LockInfo stays a unique graph node
+            taken = sum(1 for i in self._locks
+                        if i.name == name or
+                        i.name.startswith(name + "#"))
+            if taken:
+                name = "{}#{}".format(name, taken + 1)
+            info = _LockInfo(name, bool(reentrant))
+            self._locks.append(info)
+        return SanLock(self, info, inner)
+
+    def guard(self, container, owner, attr, lock_attr):
+        if isinstance(container, GuardedProxy):
+            return container
+        return GuardedProxy(container, self, owner, attr, lock_attr)
+
+    # ------------------------------------------------------ acquire hooks
+    def _tls_state(self):
+        return self._tls
+
+    def _stack(self):
+        # drop the innermost frames (sanitizer internals) — the caller
+        # wants to see ITS acquisition site
+        return traceback.format_stack(limit=self.stack_depth + 2)[:-2]
+
+    def before_acquire(self, info):
+        state = self._tls
+        if state.in_signal and not info.reentrant:
+            held_here = any(i is info for i, _ in state.held)
+            self._record_event(
+                "signal_unsafe:{}".format(info.name),
+                check="signal_unsafe",
+                message="signal handler acquires NON-reentrant lock "
+                        "{!r}{} — a signal landing while the "
+                        "interrupted frame holds it self-deadlocks the "
+                        "dying process (use an RLock, or move the work "
+                        "off the handler)".format(
+                            info.name,
+                            " it already holds" if held_here else ""),
+                details={"lock": info.name,
+                         "held_by_this_thread": held_here})
+
+    def after_acquire(self, info):
+        state = self._tls
+        with self._state_lock:
+            # under the state lock: a bare += from every acquiring
+            # thread is the exact lost-increment race this tool exists
+            # to flag
+            self.acquisitions += 1
+        for held_info, _count in state.held:
+            if held_info is info:
+                # reentrant re-acquisition: bump the count, no edge
+                for i, (hi, c) in enumerate(state.held):
+                    if hi is info:
+                        state.held[i] = (hi, c + 1)
+                        return
+        # nesting edge from every currently-held lock (the order graph)
+        for held_info, _count in state.held:
+            key = (held_info.name, info.name)
+            with self._state_lock:
+                edge = self._edges.get(key)
+                if edge is None:
+                    self._edges[key] = {
+                        "count": 1,
+                        "stack": self._stack(),
+                        "thread": threading.current_thread().name,
+                    }
+                else:
+                    edge["count"] += 1
+        state.held.append((info, 1))
+
+    def after_release(self, info):
+        state = self._tls
+        for i in range(len(state.held) - 1, -1, -1):
+            held_info, count = state.held[i]
+            if held_info is info:
+                if count > 1:
+                    state.held[i] = (held_info, count - 1)
+                else:
+                    del state.held[i]
+                return
+
+    # ------------------------------------------------------ blocking note
+    def note_blocking(self, desc):
+        state = self._tls
+        if not state.held:
+            return
+        names = [info.name for info, _ in state.held]
+        self._record_event(
+            "held_blocking:{}:{}".format(names[-1], desc),
+            check="held_blocking",
+            message="lock(s) {} held across blocking call {!r} — every "
+                    "thread touching them stalls behind IO/waits they "
+                    "have no part in (move the blocking work outside "
+                    "the critical section)".format(names, desc),
+            details={"locks": names, "blocking": str(desc)})
+
+    # ------------------------------------------------------- guarded race
+    def record_guarded_race(self, owner, attr, lock_attr, op):
+        self._record_event(
+            "guarded_race:{}.{}:{}".format(owner, attr, op),
+            check="guarded_race",
+            message="{}.{} accessed via {!r} WITHOUT {} held by this "
+                    "thread — the structure is declared _GUARDED_BY "
+                    "that lock (racy mutation/iteration; the "
+                    "deque-mutated-during-dump class)".format(
+                        owner, attr, op, lock_attr),
+            details={"class": owner, "attr": attr,
+                     "lock": lock_attr, "op": op})
+
+    def _record_event(self, key, check, message, details):
+        with self._state_lock:
+            if key in self._events:
+                self._events[key]["count"] += 1
+                return
+            self._events[key] = {
+                "key": key, "check": check, "message": message,
+                "details": dict(details), "count": 1,
+                "stack": self._stack(),
+                "thread": threading.current_thread().name,
+                "wall": time.time(),
+            }
+
+    # ------------------------------------------------------------- cycles
+    def _cycles(self):
+        """Elementary cycles of the lock-order graph, canonicalized
+        (each reported once, rotation-invariant)."""
+        with self._state_lock:
+            edges = {k: dict(v, stack=list(v["stack"]))
+                     for k, v in self._edges.items()}
+        graph = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+        seen = set()
+        cycles = []
+
+        def dfs(start, node, path, on_path):
+            for nxt in sorted(graph.get(node, ())):
+                if nxt == start:
+                    cycle = tuple(path)
+                    # canonical rotation: start at the lexicographically
+                    # smallest lock so A->B->A and B->A->B are ONE cycle
+                    pivot = cycle.index(min(cycle))
+                    canon = cycle[pivot:] + cycle[:pivot]
+                    if canon not in seen:
+                        seen.add(canon)
+                        cycles.append((canon, edges))
+                elif nxt not in on_path and nxt > start:
+                    # only walk nodes > start: each cycle found exactly
+                    # once from its smallest node
+                    dfs(start, nxt, path + [nxt], on_path | {nxt})
+
+        for start in sorted(graph):
+            dfs(start, start, [start], {start})
+        return cycles
+
+    # ------------------------------------------------------------- report
+    def report(self):
+        """-> [Finding]: the lock-order cycles plus every recorded
+        event, in the PR 10 shape (route through a Suppressions file /
+        ``dispose`` for the strict behavior)."""
+        findings = []
+        for canon, edges in self._cycles():
+            chain = " -> ".join(canon + (canon[0],))
+            stacks = {}
+            for i, a in enumerate(canon):
+                b = canon[(i + 1) % len(canon)]
+                edge = edges.get((a, b))
+                if edge is not None:
+                    stacks["{}->{}".format(a, b)] = {
+                        "count": edge["count"],
+                        "thread": edge["thread"],
+                        "stack": edge["stack"],
+                    }
+            findings.append(Finding(
+                rule=RULE, check="lock_order_cycle", program="runtime",
+                severity="error",
+                message="lock-order cycle {} — two threads taking these "
+                        "locks in opposite orders WILL deadlock under "
+                        "the right interleaving (acquisition stacks in "
+                        "details)".format(chain),
+                key="lock_order_cycle:{}".format(":".join(canon)),
+                details={"cycle": list(canon), "edges": stacks}))
+        with self._state_lock:
+            events = list(self._events.values())
+        for ev in events:
+            findings.append(Finding(
+                rule=RULE, check=ev["check"], program="runtime",
+                severity="error" if ev["check"] == "signal_unsafe"
+                else "warn",
+                message=ev["message"],
+                key=ev["key"],
+                details=dict(ev["details"], count=ev["count"],
+                             thread=ev["thread"], stack=ev["stack"])))
+        return findings
+
+    def snapshot(self):
+        """Cheap counters for telemetry/dryrun printing."""
+        with self._state_lock:
+            return {
+                "locks": len(self._locks),
+                "acquisitions": self.acquisitions,
+                "edges": len(self._edges),
+                "events": len(self._events),
+            }
+
+
+# ------------------------------------------------- collector instrument
+def instrument_collector(collector):
+    """Post-construction instrumentation of a TelemetryCollector's
+    STDLIB-ONLY fleet objects (they cannot import this package under
+    the ``bin/ds_fleet.py`` synthetic mount, so their plain locks are
+    wrapped from outside): the metrics registry + every metric family
+    + the exporter's state lock. The recorder/watchdog locks are
+    already sanitized at creation (telemetry/recorder.py,
+    telemetry/watchdog.py use :func:`new_lock`/:func:`new_rlock`).
+    No-op when the sanitizer is off."""
+    san = current()
+    if san is None or collector is None:
+        return
+    metrics = getattr(collector, "metrics", None)
+    if metrics is not None:
+        reg = metrics.registry
+        reg._lock = san.wrap(reg._lock, "metrics.registry")
+        for name, metric in list(reg._metrics.items()):
+            metric._lock = san.wrap(metric._lock,
+                                    "metrics.family:{}".format(name))
+            metric._samples = san.guard(metric._samples, metric,
+                                        "_samples", "_lock")
+        reg._metrics = san.guard(reg._metrics, reg, "_metrics", "_lock")
+    exporter = getattr(collector, "exporter", None)
+    if exporter is not None and hasattr(exporter, "_lock"):
+        exporter._lock = san.wrap(exporter._lock, "metrics.exporter")

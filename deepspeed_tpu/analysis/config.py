@@ -10,7 +10,12 @@ keys warn, and raise under ``analysis.strict``. Shape::
       "hlo": false,               // audits also compile + census the HLO
       "donation_min_bytes": 1048576,   // donation findings below this stay quiet
       "census_min_bytes": 1024,        // collectives below this are noise
-      "fp32_allowlist": []        // GEMM prims allowed to run fp32 off bf16
+      "fp32_allowlist": [],       // GEMM prims allowed to run fp32 off bf16
+      "concurrency": {            // ISSUE 15 sanitizer (dict | true | false)
+        "enabled": false,         //   instrument the runtime's locks
+        "stack_depth": 12,        //   frames kept per first-seen edge/finding
+        "fingerprint": true       //   engine.audit() publishes the program
+      }                           //   fingerprint into the host manifest
     }
 
 The sharding/recompile thresholds are NOT duplicated here: the auditor
@@ -29,7 +34,12 @@ ANALYSIS = "analysis"
 KNOWN_ANALYSIS_KEYS = {
     "strict", "report_path", "suppressions", "hlo",
     "donation_min_bytes", "census_min_bytes", "fp32_allowlist",
+    "concurrency",
 }
+
+KNOWN_CONCURRENCY_KEYS = {"enabled", "stack_depth", "fingerprint"}
+
+CONCURRENCY_STACK_DEPTH_DEFAULT = 12
 
 
 class DeepSpeedAnalysisConfig(object):
@@ -70,6 +80,42 @@ class DeepSpeedAnalysisConfig(object):
                 "analysis.fp32_allowlist must be a list of primitive "
                 "names, got {!r}".format(allow))
         self.fp32_allowlist = tuple(allow)
+
+        # concurrency sanitizer (docs/concurrency.md): dict | true |
+        # false like the watchdog sub-keys — true enables with defaults
+        conc = d.get("concurrency", False)
+        if conc is True:
+            conc = {}
+        if conc is False or conc is None:
+            self.concurrency_enabled = False
+            self.concurrency_stack_depth = CONCURRENCY_STACK_DEPTH_DEFAULT
+            self.concurrency_fingerprint = True
+        elif isinstance(conc, dict):
+            unknown = sorted(k for k in conc
+                             if k not in KNOWN_CONCURRENCY_KEYS)
+            if unknown:
+                from ..telemetry.config import warn_or_raise_noop
+                warn_or_raise_noop(
+                    "analysis.concurrency.{} has NO effect: unknown "
+                    "key(s) (accepted: {})".format(
+                        ", ".join(unknown),
+                        sorted(KNOWN_CONCURRENCY_KEYS)),
+                    self.strict, flag="analysis.strict")
+            self.concurrency_enabled = bool(conc.get("enabled", True))
+            depth = conc.get("stack_depth",
+                             CONCURRENCY_STACK_DEPTH_DEFAULT)
+            if isinstance(depth, bool) or not isinstance(depth, int) \
+                    or depth < 1:
+                raise ValueError(
+                    "analysis.concurrency.stack_depth must be an int "
+                    ">= 1, got {!r}".format(depth))
+            self.concurrency_stack_depth = depth
+            self.concurrency_fingerprint = bool(
+                conc.get("fingerprint", True))
+        else:
+            raise ValueError(
+                "analysis.concurrency must be a dict or a bool, got "
+                "{!r}".format(conc))
 
         # shared observatory thresholds (one config — see module doc)
         self.storm_threshold = getattr(

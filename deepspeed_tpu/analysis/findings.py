@@ -157,6 +157,12 @@ class AnalysisReport:
         self.suppressed = []        # [(Finding, reason)]
         self.census = None          # optional wire-reconciliation payload
         self.stale_suppressions = []  # suppression keys that matched 0
+        # canonical program fingerprint (ISSUE 15): set by the auditor
+        # from the walked collective sequences + lowered plan topology,
+        # published into the host manifest for the fleet divergence
+        # check (analysis/concurrency/divergence.py)
+        self.fingerprint = None
+        self.collective_families = {}   # {program: [collective tokens]}
 
     def add_program(self, name, **meta):
         self.programs[name] = _jsonable(meta)
@@ -209,6 +215,8 @@ class AnalysisReport:
             out["census"] = _jsonable(self.census)
         if self.stale_suppressions:
             out["stale_suppressions"] = list(self.stale_suppressions)
+        if self.fingerprint is not None:
+            out["fingerprint"] = _jsonable(self.fingerprint)
         return out
 
     def write(self, path):

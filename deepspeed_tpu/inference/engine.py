@@ -89,6 +89,14 @@ class InferenceEngine:
             from ..analysis.config import DeepSpeedAnalysisConfig
             analysis_config = DeepSpeedAnalysisConfig({})
         self.analysis_config = analysis_config
+        # concurrency sanitizer (docs/concurrency.md): installed before
+        # the telemetry subsystems so their locks come out instrumented
+        # (process-global; a training engine may already own it)
+        if analysis_config.concurrency_enabled:
+            from ..analysis.concurrency import locksan
+            if locksan.current() is None:
+                locksan.install(locksan.LockSanitizer(
+                    stack_depth=analysis_config.concurrency_stack_depth))
         # dtype override is engine-local state: the config object may be
         # shared with other engines (or the training engine) and must not
         # be mutated

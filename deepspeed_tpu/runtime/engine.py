@@ -109,6 +109,17 @@ class DeepSpeedEngine:
         ckpt.set_retry_policy(
             retries=self._config.checkpoint_io_retries,
             backoff_seconds=self._config.checkpoint_io_backoff_seconds)
+        # concurrency sanitizer (analysis.concurrency, docs/
+        # concurrency.md): installed BEFORE the telemetry subsystems so
+        # the recorder/watchdog locks they create come out instrumented;
+        # process-global (the lock-order graph spans engines), so a
+        # second engine reuses the active instance
+        if self._config.analysis_config.concurrency_enabled:
+            from ..analysis.concurrency import locksan
+            if locksan.current() is None:
+                locksan.install(locksan.LockSanitizer(
+                    stack_depth=self._config.analysis_config
+                    .concurrency_stack_depth))
         self.model = as_model(model, model_parameters)
         self._configure_precision()
         self._configure_zero()

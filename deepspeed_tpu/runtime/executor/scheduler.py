@@ -39,6 +39,10 @@ clocks billed to the SAME disjoint keys the bespoke paths used
 import time
 from concurrent.futures import ThreadPoolExecutor
 
+# blocking-call tripwire (docs/concurrency.md): a worker-future wait
+# with any sanitized lock held stalls every thread behind that lock —
+# one is-None check when the sanitizer is off
+from ...analysis.concurrency.locksan import note_blocking
 from ...utils.lifecycle import AtexitCloseMixin
 from .plan import PlanError, Segment, SegmentPlan
 
@@ -175,6 +179,8 @@ class PlanExecutor(AtexitCloseMixin):
             if ent is None or name in completed:
                 return
             fut, rec = ent
+            if not fut.done():
+                note_blocking("executor.wait:{}".format(name))
             t0 = time.time()
             value, r0, r1 = fut.result()
             wait = time.time() - t0
@@ -267,6 +273,9 @@ class PlanExecutor(AtexitCloseMixin):
             for name, (fut, _rec) in list(launched.items()):
                 if name not in completed:
                     try:
+                        if not fut.done():
+                            note_blocking(
+                                "executor.drain:{}".format(name))
                         value, r0, r1 = fut.result()
                         _rec.start_s, _rec.end_s = r0, r1
                         _rec.run_s = r1 - r0

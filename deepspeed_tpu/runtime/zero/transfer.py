@@ -24,6 +24,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+# blocking-call tripwire (docs/concurrency.md): finish() waits on the
+# upload worker — a sanitized lock held across it stalls its owners
+from ...analysis.concurrency.locksan import note_blocking
+
 
 def _split_fn_for(layout):
     """Jitted flat-buffer -> tuple-of-reshaped-views program for one
@@ -139,6 +143,8 @@ class H2DBatcher:
         for device in list(self._pending):
             self._flush_device(device)
         for fut in self._futures:
+            if not fut.done():
+                note_blocking("h2d_batcher.finish")
             self._store(fut.result())
         self._futures = []
         return self._results

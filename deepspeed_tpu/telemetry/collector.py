@@ -259,11 +259,23 @@ class TelemetryCollector(AtexitCloseMixin):
         except Exception:  # noqa: BLE001
             process_index = process_count = None
         from .fleet.aggregate import write_host_manifest
-        write_host_manifest(
-            self.output_dir, job_name=self.job_name,
-            metrics_port=self.exporter.port
+        # kept so publish_fingerprint() can RE-write the identical
+        # manifest extended with the program fingerprint (ISSUE 15)
+        self._manifest_meta = {
+            "metrics_port": self.exporter.port
             if self.exporter is not None else None,
-            process_index=process_index, process_count=process_count)
+            "process_index": process_index,
+            "process_count": process_count,
+            "wall_start": self._wall_start,
+        }
+        write_host_manifest(self.output_dir, job_name=self.job_name,
+                            **self._manifest_meta)
+        # concurrency sanitizer (docs/concurrency.md): the fleet
+        # modules are stdlib-only and cannot import the sanitizer
+        # themselves — their locks are wrapped from here, post-
+        # construction (no-op when the sanitizer is off)
+        from ..analysis.concurrency import locksan
+        locksan.instrument_collector(self)
         # same lifecycle contract as SummaryMonitor (utils/lifecycle.py):
         # the exit handler closes an active trace window and the JSONL
         # handle at process end, deregistered by close()
@@ -396,6 +408,16 @@ class TelemetryCollector(AtexitCloseMixin):
             if self.exporter is not None else None
         return out
 
+    def publish_fingerprint(self, fingerprint):
+        """Extend this host's manifest with the canonical program
+        fingerprint (analysis/concurrency/divergence.py derives it;
+        ``engine.audit()`` calls this) — the seam the fleet doctor's
+        divergence check joins on."""
+        from .fleet.aggregate import write_host_manifest
+        return write_host_manifest(
+            self.output_dir, job_name=self.job_name,
+            fingerprint=fingerprint, **self._manifest_meta)
+
     def ingest_fleet(self, report):
         """Feed a merged fleet view (fleet/aggregate.merge_run) into
         this process: stores the straggler flags / ici_health for the
@@ -413,6 +435,14 @@ class TelemetryCollector(AtexitCloseMixin):
             for cls, val in classes.items():
                 self.fleet.ici_health["{}:{}".format(host, cls)] = val
         self.fleet.ingests += 1
+        divergence = report.get("divergence") or {}
+        if divergence.get("mismatch"):
+            logger.warning(
+                "fleet divergence ingested: host(s) %s lowered a "
+                "different program than %s — audit them before the "
+                "next step (docs/concurrency.md)",
+                ", ".join(divergence.get("divergent_hosts", [])),
+                divergence.get("reference"))
         if self.watchdog is not None:
             self.watchdog.observe_fleet(report)
 
@@ -422,8 +452,10 @@ class TelemetryCollector(AtexitCloseMixin):
         flags. ``status`` degrades on any watchdog trip or ingested
         straggler flag (the exporter answers 503 then)."""
         agg = self.aggregator.snapshot()
-        trips = list(self.watchdog.trips) if self.watchdog is not None \
-            else []
+        # trips_snapshot: healthz runs on the exporter's handler
+        # threads while the deadline/main threads append trips
+        trips = self.watchdog.trips_snapshot() \
+            if self.watchdog is not None else []
         fleet = self.fleet_snapshot()
         degraded = bool(trips) or bool(fleet["straggler_flags"])
         out = {

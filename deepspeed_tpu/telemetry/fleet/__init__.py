@@ -17,9 +17,11 @@ Every module here is STDLIB-ONLY with sibling-relative imports, so
 jax.
 """
 from .aggregate import (CHROME_TRACE_NAME, FLEET_HOST_KEYS,
-                        FLEET_STEP_KEYS, HOST_MANIFEST_KEYS,
-                        HostView, KIND_FLEET_REPORT, KIND_FLEET_STEP,
-                        KIND_MANIFEST, MANIFEST_NAME, discover_hosts,
+                        FLEET_REPORT_KEYS, FLEET_STEP_KEYS,
+                        HOST_MANIFEST_KEYS, HostView, KIND_FLEET_REPORT,
+                        KIND_FLEET_STEP, KIND_MANIFEST,
+                        MANIFEST_FINGERPRINT_KEY, MANIFEST_NAME,
+                        compare_fingerprints, discover_hosts,
                         estimate_offsets, load_host, merge_chrome_traces,
                         merge_records, merge_run, read_jsonl_tolerant,
                         validate_fleet_record, validate_host_manifest,

@@ -53,6 +53,22 @@ HOST_MANIFEST_KEYS = (
     "files", "metrics_port",
 )
 
+# optional manifest extension (ISSUE 15): the host's canonical program
+# fingerprint — analysis/concurrency/divergence.py derives/publishes
+# it; this module only compares. Keys duplicated from FINGERPRINT_KEYS
+# there (stdlib-import contract); pinned equal by
+# tests/unit/test_concurrency.py
+MANIFEST_FINGERPRINT_KEY = "program_fingerprint"
+FINGERPRINT_KEYS = ("version", "digest", "families")
+
+# every merged fleet report carries exactly these top-level keys
+# (bin/check_bench_schema.py holds the stdlib twin, pinned equal by
+# tests/unit/test_concurrency.py)
+FLEET_REPORT_KEYS = (
+    "kind", "run_dir", "n_hosts", "hosts", "offsets", "records", "gaps",
+    "straggler", "ici_health", "trace", "divergence",
+)
+
 # every merged fleet-step record carries exactly these keys
 FLEET_STEP_KEYS = (
     "kind", "step", "n_hosts", "wall", "hosts", "step_time",
@@ -69,23 +85,32 @@ _NUMERIC = (int, float)
 
 # --------------------------------------------------------------- manifest
 def write_host_manifest(output_dir, job_name, metrics_port=None,
-                        process_index=None, process_count=None):
+                        process_index=None, process_count=None,
+                        fingerprint=None, wall_start=None):
     """Write ``host_manifest.json`` atomically into this host's
     telemetry directory (collector init). Never raises — a manifest
-    failure must not kill engine construction."""
+    failure must not kill engine construction. ``fingerprint``: the
+    optional canonical program fingerprint (ISSUE 15) — published when
+    the engine audited/derived one, so the fleet doctor can verify
+    every host lowered the SAME collective sequence. ``wall_start``:
+    pass the collector's recorded start on RE-writes so a fingerprint
+    published hours into a run does not replace the process-start
+    timestamp with the audit time."""
     payload = {
         "kind": KIND_MANIFEST,
         "job_name": job_name,
         "host": socket.gethostname(),
         "pid": os.getpid(),
         "process_index": process_index,
-        "wall_start": time.time(),
+        "wall_start": time.time() if wall_start is None else wall_start,
         "files": {"telemetry": JSONL_NAME, "spans": SPANS_JSONL_NAME,
                   "chrome_trace": CHROME_TRACE_NAME},
         "metrics_port": metrics_port,
     }
     if process_count is not None:
         payload["process_count"] = process_count
+    if fingerprint is not None:
+        payload[MANIFEST_FINGERPRINT_KEY] = fingerprint
     try:
         os.makedirs(output_dir, exist_ok=True)
         path = os.path.join(output_dir, MANIFEST_NAME)
@@ -111,7 +136,66 @@ def validate_host_manifest(payload):
             problems.append("missing key {!r}".format(key))
     if not problems and not isinstance(payload.get("files"), dict):
         problems.append("files is not a dict")
+    fp = payload.get(MANIFEST_FINGERPRINT_KEY)
+    if fp is not None:
+        if not isinstance(fp, dict):
+            problems.append("program_fingerprint is not a dict")
+        else:
+            for key in FINGERPRINT_KEYS:
+                if key not in fp:
+                    problems.append(
+                        "program_fingerprint missing {!r}".format(key))
+            if not isinstance(fp.get("families", {}), dict):
+                problems.append(
+                    "program_fingerprint.families is not a dict")
     return problems
+
+
+# ------------------------------------------------------- divergence
+def compare_fingerprints(fingerprints):
+    """Cross-host SPMD divergence check over the published manifest
+    fingerprints (``{host: program_fingerprint dict}``; hosts that
+    published none are reported but never flagged — absence is a
+    coverage gap, not a divergence). The REFERENCE digest is the
+    majority one (ties break to the alphabetically-first publishing
+    host), so a single divergent host in an 8-host mesh is named as
+    THE divergent one rather than flagging the seven agreeing hosts.
+    Returns the ``divergence`` section of the fleet report;
+    ``analysis/concurrency/divergence.py`` turns a mismatch into
+    ``fleet_divergence`` findings."""
+    published = {h: fp for h, fp in sorted((fingerprints or {}).items())
+                 if isinstance(fp, dict) and fp.get("digest")}
+    out = {
+        "published": len(published),
+        "unpublished_hosts": sorted(set(fingerprints or {})
+                                    - set(published)),
+        "digests": {h: fp["digest"] for h, fp in published.items()},
+        "families": {h: fp.get("families") or {}
+                     for h, fp in published.items()},
+        "mismatch": False,
+        "reference": None,
+        "divergent_hosts": [],
+    }
+    if not published:
+        return out
+    votes = {}
+    for host, fp in published.items():
+        votes.setdefault(fp["digest"], []).append(host)
+    # majority digest; ties break to the alphabetically-first host
+    best = max(len(hosts) for hosts in votes.values())
+    tied = [d for d, hosts in votes.items() if len(hosts) == best]
+    ref_digest = min(tied, key=lambda d: votes[d][0])
+    out["reference"] = votes[ref_digest][0]
+    out["divergent_hosts"] = sorted(
+        h for h, fp in published.items() if fp["digest"] != ref_digest)
+    out["mismatch"] = bool(out["divergent_hosts"])
+    if out["mismatch"]:
+        logger.warning(
+            "fleet divergence: host(s) %s published a DIFFERENT "
+            "program fingerprint than reference host %s — the mesh "
+            "will hang at the first divergent collective",
+            ", ".join(out["divergent_hosts"]), out["reference"])
+    return out
 
 
 # ----------------------------------------------------------- JSONL reads
@@ -439,6 +523,12 @@ def merge_run(run_dir, factor=None, k=None, min_hosts=None,
     gaps = []
     for host in hosts:
         gaps.extend("{}: {}".format(host.name, g) for g in host.gaps)
+    # SPMD divergence (ISSUE 15): compare the program fingerprints the
+    # hosts' manifests published — a mismatch means one host lowered a
+    # different collective sequence and the mesh WILL hang on a pod
+    divergence = compare_fingerprints({
+        h.name: (h.manifest or {}).get(MANIFEST_FINGERPRINT_KEY)
+        for h in hosts})
     return {
         "kind": KIND_FLEET_REPORT,
         "run_dir": os.path.abspath(run_dir),
@@ -450,6 +540,7 @@ def merge_run(run_dir, factor=None, k=None, min_hosts=None,
         "straggler": detector.report(),
         "ici_health": ici_last,
         "trace": trace,
+        "divergence": divergence,
     }
 
 

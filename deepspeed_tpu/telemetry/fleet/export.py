@@ -33,6 +33,12 @@ class MetricsExporter:
         self.registry = registry
         self.healthz = healthz
         self.scrapes = 0
+        # ThreadingHTTPServer serves each request on its OWN thread:
+        # the scrape counter bump is a read-modify-write that loses
+        # increments under concurrent scrapes without this lock (the
+        # concurrency sanitizer wraps it when installed —
+        # locksan.instrument_collector)
+        self._lock = threading.Lock()
         self._scrapes_total = registry.counter(
             "metrics_scrapes_total", "scrapes served by this exporter")
         exporter = self
@@ -54,10 +60,13 @@ class MetricsExporter:
                 try:
                     path = self.path.split("?", 1)[0]
                     if path == "/metrics":
-                        exporter.scrapes += 1
+                        # render OUTSIDE the lock (it walks every
+                        # family); the lock covers only the counter
+                        body = exporter.registry.render_text()
+                        with exporter._lock:
+                            exporter.scrapes += 1
                         exporter._scrapes_total.inc()
-                        self._send(200, CONTENT_TYPE_METRICS,
-                                   exporter.registry.render_text())
+                        self._send(200, CONTENT_TYPE_METRICS, body)
                     elif path == "/healthz":
                         payload = exporter._healthz_payload()
                         code = 200 if payload.get("status") == "ok" \

@@ -73,6 +73,13 @@ class Metric:
 
     __slots__ = ("name", "kind", "help", "buckets", "_samples", "_lock")
 
+    # concurrency-sanitizer declaration (docs/concurrency.md): samples
+    # are mutated by the emitting thread and rendered by the exporter's
+    # handler threads — every access holds the family lock. (This
+    # module is stdlib-only; the sanitizer wraps the lock from the
+    # collector side — locksan.instrument_collector.)
+    _GUARDED_BY = {"_samples": "_lock"}
+
     def __init__(self, name, kind, help_text="", buckets=None, lock=None):
         if not _NAME_RE.match(name):
             raise ValueError("invalid metric name {!r}".format(name))
@@ -180,6 +187,10 @@ class MetricsRegistry:
     ``namespace`` prefixes every family name (``telemetry.metrics.
     namespace``, default ``ds``); ``const_labels`` (job/host) ride
     every sample so a fleet scrape can tell processes apart."""
+
+    # sanitizer declaration: the family table is registered from any
+    # engine thread and walked by render_text on handler threads
+    _GUARDED_BY = {"_metrics": "_lock"}
 
     def __init__(self, namespace="ds", const_labels=None):
         if namespace and not _NAME_RE.match(namespace):
@@ -474,7 +485,9 @@ class MetricsSink:
         if self.watchdog is None:
             return
         counts = {}
-        for trip in self.watchdog.trips:
+        # trips_snapshot, not .trips: the deadline thread appends trips
+        # concurrently with this emit-time iteration
+        for trip in self.watchdog.trips_snapshot():
             counts[trip["watchdog"]] = counts.get(trip["watchdog"], 0) + 1
         for name, count in counts.items():
             self._trips.set_to(count, watchdog=name)

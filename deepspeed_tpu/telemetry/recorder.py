@@ -29,11 +29,11 @@ import json
 import logging
 import os
 import signal
-import threading
 import time
 import traceback
 from collections import deque
 
+from ..analysis.concurrency import locksan
 from ..utils.logging import logger
 
 KIND_BUNDLE = "crash_bundle"
@@ -158,6 +158,14 @@ class FlightRecorder:
     it in the StepRecord sink list, so ``emit()`` receives every record
     the run produces."""
 
+    # concurrency-sanitizer declaration (docs/concurrency.md): the three
+    # rings are appended by the main thread (emit), the log handler
+    # (any thread), and the span sink, and snapshotted by watchdog-
+    # thread dumps — every access holds the ring lock. The dynamic
+    # checker and the DSL008 AST rule both read this map.
+    _GUARDED_BY = {"records": "_lock", "spans": "_lock",
+                   "log_events": "_lock"}
+
     def __init__(self, output_dir, job_name="train",
                  capacity=RECORDER_CAPACITY_DEFAULT,
                  max_bundles=RECORDER_MAX_BUNDLES_DEFAULT,
@@ -167,9 +175,18 @@ class FlightRecorder:
         self.job_name = job_name
         self.capacity = int(capacity)
         self.max_bundles = int(max_bundles)
-        self.records = deque(maxlen=self.capacity)
-        self.spans = deque(maxlen=self.capacity)
-        self.log_events = deque(maxlen=self.capacity)
+        # RLock, not Lock: the SIGTERM handler dumps ON the main thread,
+        # and the signal can land while that same thread already holds
+        # the lock inside an emit — a plain Lock would self-deadlock the
+        # dying process instead of dumping (the sanitizer's
+        # signal_unsafe rule now guards this invariant)
+        self._lock = locksan.new_rlock("recorder.ring")
+        self.records = locksan.guarded(
+            self, "records", deque(maxlen=self.capacity))
+        self.spans = locksan.guarded(
+            self, "spans", deque(maxlen=self.capacity))
+        self.log_events = locksan.guarded(
+            self, "log_events", deque(maxlen=self.capacity))
         self.programs = programs
         self.tracer = spans
         self.watchdog_state = watchdog_state    # callable or None
@@ -196,11 +213,6 @@ class FlightRecorder:
         # KeyboardInterrupt is a fresh exception object the step-path
         # hooks would otherwise dump AGAIN for an already-dumped trip
         self._interrupt_covered_until = 0.0
-        # RLock, not Lock: the SIGTERM handler dumps ON the main thread,
-        # and the signal can land while that same thread already holds
-        # the lock inside an emit — a plain Lock would self-deadlock the
-        # dying process instead of dumping
-        self._lock = threading.RLock()
         self._closed = False
         self._log_handler = _LogRingHandler(self.log_events, self._lock)
         logger.addHandler(self._log_handler)
@@ -299,24 +311,34 @@ class FlightRecorder:
             "state": {name: self._resolve(provider)
                       for name, provider in context.items()},
         }
+        # the file write happens OUTSIDE the ring lock: holding it
+        # across makedirs/json.dump/replace stalled every emit (and the
+        # log handler on any thread) behind bundle IO — the exact
+        # held_blocking hazard the concurrency sanitizer flags. The
+        # lock only reserves the bundle index and updates retention.
         with self._lock:
-            os.makedirs(self.output_dir, exist_ok=True)
-            slug = "".join(c if c.isalnum() or c in "-_" else "-"
-                           for c in str(reason))[:48]
-            path = os.path.join(self.output_dir, "bundle_{:03d}_{}.json"
-                                .format(self.bundles_written, slug))
-            tmp = path + ".tmp"
-            with open(tmp, "w") as fh:
-                json.dump(bundle, fh)
-            os.replace(tmp, path)       # a bundle is whole or absent
+            index = self.bundles_written
             self.bundles_written += 1
+        locksan.note_blocking("recorder.bundle_write")
+        os.makedirs(self.output_dir, exist_ok=True)
+        slug = "".join(c if c.isalnum() or c in "-_" else "-"
+                       for c in str(reason))[:48]
+        path = os.path.join(self.output_dir, "bundle_{:03d}_{}.json"
+                            .format(index, slug))
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(bundle, fh)
+        os.replace(tmp, path)           # a bundle is whole or absent
+        stale_paths = []
+        with self._lock:
             self._bundle_paths.append(path)
             while len(self._bundle_paths) > self.max_bundles:
-                stale = self._bundle_paths.pop(0)
-                try:
-                    os.remove(stale)
-                except OSError:
-                    pass
+                stale_paths.append(self._bundle_paths.pop(0))
+        for stale in stale_paths:       # unlink outside the lock too
+            try:
+                os.remove(stale)
+            except OSError:
+                pass
         logger.warning(
             "flight recorder: crash bundle (%s) -> %s  [%d records, "
             "%d spans, %d log events]", reason, path,
@@ -337,7 +359,12 @@ class FlightRecorder:
                 "(%s) — SIGTERM will not produce a crash bundle", err)
 
     def _on_sigterm(self, signum, frame):
-        self.dump("sigterm")
+        # signal_scope: under the sanitizer, any NON-reentrant lock the
+        # dump path acquires inside this handler becomes a
+        # signal_unsafe finding (the ring lock being an RLock is the
+        # invariant that keeps this dump deadlock-free)
+        with locksan.signal_scope():
+            self.dump("sigterm")
         prev = self._sigterm_prev
         if callable(prev):
             prev(signum, frame)

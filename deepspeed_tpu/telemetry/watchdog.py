@@ -34,6 +34,7 @@ import threading
 import time
 from collections import deque
 
+from ..analysis.concurrency import locksan
 from ..utils.logging import logger
 # the straggler thresholds live with the detector (fleet/straggler.py);
 # re-exported here so telemetry/config.py reads one defaults table per
@@ -62,6 +63,13 @@ class Watchdog:
     (records, step begin/end) and the serving scheduler (TTFT samples,
     pool-pressure events)."""
 
+    # concurrency-sanitizer declaration (docs/concurrency.md): trips is
+    # appended by BOTH the main thread and the deadline thread, and
+    # snapshotted by the exporter's handler threads (/healthz) — every
+    # access holds the state lock (read via trips_snapshot()).
+    # _durations is shared between step hooks and the deadline loop.
+    _GUARDED_BY = {"trips": "_lock", "_durations": "_lock"}
+
     def __init__(self, cfg, recorder=None, job_name="train"):
         """``cfg``: dict of parsed sub-configs (telemetry/config.py) —
         keys step_deadline / nan_streak / loss_spike / ttft_slo /
@@ -69,7 +77,8 @@ class Watchdog:
         self.cfg = cfg or {}
         self.recorder = recorder
         self.job_name = job_name
-        self.trips = []
+        self._lock = locksan.new_lock("watchdog.state")
+        self.trips = locksan.guarded(self, "trips", [])
         self._nan_streak = 0
         self._nan_tripped = False
         spike = self.cfg.get("loss_spike")
@@ -80,11 +89,11 @@ class Watchdog:
         self._fleet_tripped = set()     # (host, metric) already tripped
         # step-deadline thread state
         self._dl_cfg = self.cfg.get("step_deadline")
-        self._durations = deque(maxlen=64)
+        self._durations = locksan.guarded(self, "_durations",
+                                          deque(maxlen=64))
         self._step_t0 = None
         self._armed_deadline = None        # monotonic deadline, or None
         self._armed_step = None
-        self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = None
 
@@ -92,8 +101,13 @@ class Watchdog:
     def _trip(self, name, detail, action, from_thread=False):
         trip = {"watchdog": name, "detail": detail, "action": action,
                 "wall": time.time()}
-        if len(self.trips) < _MAX_TRIPS:
-            self.trips.append(trip)
+        # under the lock: the deadline thread and the main thread both
+        # trip, and the exporter's handler threads snapshot trips for
+        # /healthz — an unlocked append raced those iterations (the
+        # concurrency sanitizer's guarded_race rule keeps this honest)
+        with self._lock:
+            if len(self.trips) < _MAX_TRIPS:
+                self.trips.append(trip)
         logger.warning("watchdog %s TRIPPED (%s): %s", name, action,
                        detail)
         if action in ("dump", "raise"):
@@ -302,14 +316,24 @@ class Watchdog:
                 cfg["action"])
 
     # ------------------------------------------------------------ snapshot
+    def trips_snapshot(self):
+        """Copy of the trip list under the state lock — the one correct
+        way to read ``trips`` from another thread (the exporter's
+        /healthz handlers, the metrics sink's emit)."""
+        with self._lock:
+            return list(self.trips)
+
     def snapshot(self):
+        with self._lock:
+            trips = list(self.trips)
+            durations_tracked = len(self._durations)
         return {
-            "trips": list(self.trips),
+            "trips": trips,
             "nan_streak": self._nan_streak,
             "ttft_violations": self._ttft_violations,
             "ttft_samples": self._ttft_samples,
             "pool_events": self._pool_events,
-            "step_durations_tracked": len(self._durations),
+            "step_durations_tracked": durations_tracked,
         }
 
     def close(self):

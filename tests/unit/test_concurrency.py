@@ -1,0 +1,648 @@
+"""Concurrency sanitizer + SPMD divergence tests (ISSUE 15;
+docs/concurrency.md): the injected-defect matrix — a constructed AB/BA
+deadlock, a guarded-write-without-lock, a signal-handler non-reentrant
+acquisition, a two-host divergent plan — each firing exactly once as a
+schema-valid finding that raises under ``analysis.strict``; the clean
+engine config silent; the DSL008/DSL009 repo self-check green; the
+fleet doctor's divergence path proven jax-less by subprocess.
+
+Marker: ``concurrency`` (tier-1 — fast, CPU-only; one tiny engine
+build for the clean-config/audit-integration tests)."""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from deepspeed_tpu.analysis import astlint
+from deepspeed_tpu.analysis.concurrency import divergence, locksan
+from deepspeed_tpu.analysis.config import DeepSpeedAnalysisConfig
+from deepspeed_tpu.analysis.auditor import AuditFindingsError, dispose
+from deepspeed_tpu.analysis.findings import (AnalysisReport,
+                                             FINDING_KEYS,
+                                             validate_analysis_report)
+from deepspeed_tpu.telemetry.fleet import aggregate
+from deepspeed_tpu.telemetry.fleet.aggregate import (
+    compare_fingerprints, validate_host_manifest, write_host_manifest)
+
+pytestmark = pytest.mark.concurrency
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _load_bin(name):
+    path = os.path.join(_REPO, "bin", name + ".py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def san():
+    """A fresh installed sanitizer, uninstalled at teardown."""
+    s = locksan.install(locksan.LockSanitizer())
+    try:
+        yield s
+    finally:
+        locksan.uninstall()
+
+
+def _assert_schema_valid(findings):
+    """Every finding serializes into the analysis-report shape."""
+    report = AnalysisReport(job="concurrency")
+    report.extend(findings)
+    payload = report.to_dict()
+    assert validate_analysis_report(payload) == [], payload
+    for f in findings:
+        d = f.to_dict()
+        for key in FINDING_KEYS:
+            assert isinstance(d.get(key), str) and d[key], (key, d)
+
+
+def _strict_cfg(tmp_path=None):
+    return DeepSpeedAnalysisConfig({"analysis": {"strict": True}})
+
+
+# ------------------------------------------------- off = structurally absent
+def test_off_is_structurally_absent():
+    assert locksan.current() is None
+    lock = locksan.new_lock("x")
+    assert type(lock).__name__ in ("lock", "LockType")
+    rl = locksan.new_rlock("x")
+    assert not isinstance(rl, locksan.SanLock)
+
+    class Box:
+        _GUARDED_BY = {"items": "_lock"}
+
+    b = Box.__new__(Box)
+    items = []
+    assert locksan.guarded(b, "items", items) is items
+    locksan.note_blocking("noop")            # must not raise
+    with locksan.signal_scope():
+        pass
+
+
+# -------------------------------------------------- defect 1: AB/BA cycle
+def test_abba_deadlock_cycle_fires_exactly_once(san):
+    a = locksan.new_lock("A")
+    b = locksan.new_lock("B")
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    for fn in (ab, ba):
+        t = threading.Thread(target=fn, daemon=True)
+        t.start()
+        t.join()
+    findings = san.report()
+    cycles = [f for f in findings if f.check == "lock_order_cycle"]
+    assert len(cycles) == 1, [f.key for f in findings]
+    assert cycles[0].key == "lock_order_cycle:A:B"
+    assert cycles[0].severity == "error"
+    # first-seen acquisition stacks ride the details, per edge
+    assert set(cycles[0].details["edges"]) == {"A->B", "B->A"}
+    _assert_schema_valid(findings)
+    # raises under analysis.strict through the standard disposition
+    report = AnalysisReport(job="concurrency")
+    report.extend(findings)
+    with pytest.raises(AuditFindingsError):
+        dispose(report, _strict_cfg())
+
+
+def test_same_named_locks_do_not_conflate(san):
+    """Two DISTINCT locks sharing a name (a second engine's
+    'recorder.ring') must not fold into one order-graph node — nesting
+    them consistently is NOT a self-cycle."""
+    a1 = locksan.new_lock("recorder.ring")
+    a2 = locksan.new_lock("recorder.ring")
+    assert a2.name == "recorder.ring#2"     # unique graph node
+    with a1:
+        with a2:
+            pass
+    assert [f.key for f in san.report()] == []
+    # a GENUINE opposite-order nesting of the pair still flags
+    t = threading.Thread(target=lambda: a2.acquire() and
+                         (a1.acquire(), a1.release(), a2.release()),
+                         daemon=True)
+    t.start()
+    t.join()
+    cycles = [f for f in san.report()
+              if f.check == "lock_order_cycle"]
+    assert len(cycles) == 1, [f.key for f in san.report()]
+
+
+def test_guarded_dict_item_reads_are_checked(san):
+    """dict-shaped guarded state read via .items()/.keys()/.values()
+    without the lock is the changed-size-during-render class."""
+    class Table:
+        _GUARDED_BY = {"d": "_lock"}
+
+        def __init__(self):
+            self._lock = locksan.new_lock("table")
+            self.d = locksan.guarded(self, "d", {"a": 1})
+
+    t = Table()
+    list(t.d.items())               # unlocked snapshot = race
+    with t._lock:
+        assert sorted(t.d.keys()) == ["a"]      # locked: silent
+    keys = [f.key for f in san.report()]
+    assert "guarded_race:Table.d:items" in keys
+    assert not any(k.endswith(":keys") for k in keys)
+
+
+def test_dsl008_mutator_set_pinned_to_dynamic_checker():
+    """The AST rule's mutator table is a copy of the dynamic proxy's
+    (astlint must stay import-light for the jax-less mount) — pinned
+    equal so the static and dynamic twins cannot drift."""
+    assert astlint._DSL008_MUTATORS == locksan._MUTATORS
+    assert astlint._GUARDED_BY_NAME == locksan.GUARDED_BY_ATTR
+
+
+def test_publish_fingerprint_preserves_wall_start(tmp_path):
+    fp = _fp(["psum@data"])
+    p1 = write_host_manifest(str(tmp_path), job_name="h",
+                             wall_start=123.5)
+    p2 = write_host_manifest(str(tmp_path), job_name="h",
+                             fingerprint=fp, wall_start=123.5)
+    assert p1 == p2
+    with open(p2) as fh:
+        manifest = json.load(fh)
+    assert manifest["wall_start"] == 123.5
+    assert manifest["program_fingerprint"] == fp
+
+
+def test_consistent_order_and_reentrancy_are_silent(san):
+    a = locksan.new_lock("A")
+    b = locksan.new_lock("B")
+    r = locksan.new_rlock("R")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    with r:
+        with r:                 # reentrant re-acquisition: no self-edge
+            pass
+    assert san.report() == []
+    # 3 x (a, b) + the two r acquisitions (the nested one reentrant)
+    assert san.snapshot()["acquisitions"] == 8
+
+
+# ----------------------------------------- defect 2: guarded-state race
+def test_guarded_write_without_lock_fires_exactly_once(san):
+    class Ring:
+        _GUARDED_BY = {"items": "_lock"}
+
+        def __init__(self):
+            self._lock = locksan.new_lock("ring")
+            self.items = locksan.guarded(self, "items", [])
+
+    ring = Ring()
+    ring.items.append(1)            # race
+    ring.items.append(2)            # same site: still ONE finding
+    with ring._lock:
+        ring.items.append(3)        # guarded: silent
+        assert list(ring.items) == [1, 2, 3]
+    findings = san.report()
+    races = [f for f in findings if f.check == "guarded_race"]
+    assert [f.key for f in races] == ["guarded_race:Ring.items:append"]
+    assert races[0].details["count"] == 2
+    _assert_schema_valid(findings)
+
+
+def test_guarded_iteration_without_lock_flags(san):
+    class Ring:
+        _GUARDED_BY = {"items": "_lock"}
+
+        def __init__(self):
+            self._lock = locksan.new_lock("ring2")
+            self.items = locksan.guarded(self, "items", [4, 5])
+
+    ring = Ring()
+    assert list(ring.items) == [4, 5]       # unlocked snapshot = race
+    keys = [f.key for f in san.report()]
+    assert "guarded_race:Ring.items:__iter__" in keys
+    # undeclared attributes pass through untouched
+    assert locksan.guarded(ring, "other", [1]) == [1]
+
+
+# ------------------------------- defect 3: signal-handler acquisition
+def test_signal_handler_nonreentrant_acquisition_fires(san):
+    plain = locksan.new_lock("handler.plain")
+    rlock = locksan.new_rlock("handler.rlock")
+    with locksan.signal_scope():
+        with rlock:                 # reentrant: allowed in a handler
+            pass
+        with plain:                 # non-reentrant: the deadlock class
+            pass
+    findings = san.report()
+    sigs = [f for f in findings if f.check == "signal_unsafe"]
+    assert [f.key for f in sigs] == ["signal_unsafe:handler.plain"]
+    assert sigs[0].severity == "error"
+    _assert_schema_valid(findings)
+
+
+# ------------------------------------------------ held-blocking events
+def test_held_blocking_fires_and_is_silent_unheld(san):
+    lock = locksan.new_lock("io.lock")
+    locksan.note_blocking("free.call")     # nothing held: silent
+    with lock:
+        locksan.note_blocking("bundle.write")
+    findings = san.report()
+    held = [f for f in findings if f.check == "held_blocking"]
+    assert [f.key for f in held] == \
+        ["held_blocking:io.lock:bundle.write"]
+    assert held[0].details["locks"] == ["io.lock"]
+
+
+# ------------------------------- defect 4: two-host divergent program
+def _fp(tokens):
+    return divergence.canonical_fingerprint({"step": tokens})
+
+
+def test_two_host_divergent_plan_fires_exactly_once():
+    fp_ref = _fp(["psum@data", "all_gather@model"])
+    fp_div = _fp(["psum@data", "ppermute@model"])
+    cmp = compare_fingerprints({"h0": fp_ref, "h1": fp_div})
+    assert cmp["mismatch"] and cmp["published"] == 2
+    findings = divergence.divergence_findings(cmp)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.check == "fleet_divergence" and f.severity == "error"
+    # names the first differing family/token against the reference
+    assert f.details["family"] == "step" and f.details["index"] == 1
+    assert "all_gather@model" in f.message or \
+        f.details["reference_token"] == "all_gather@model"
+    _assert_schema_valid(findings)
+    with pytest.raises(AuditFindingsError):
+        divergence.audit_fleet(cmp, _strict_cfg())
+
+
+def test_matching_fingerprints_are_silent():
+    fp = _fp(["psum@data"])
+    cmp = compare_fingerprints({"h0": fp, "h1": fp, "h2": fp})
+    assert not cmp["mismatch"] and cmp["divergent_hosts"] == []
+    assert divergence.divergence_findings(cmp) == []
+    report = divergence.audit_fleet({"divergence": cmp}, _strict_cfg())
+    assert report.findings == []
+
+
+def test_majority_reference_names_the_single_divergent_host():
+    fp_ref = _fp(["psum@data"])
+    fp_div = _fp(["pmax@data"])
+    fps = {"host{}".format(i): fp_ref for i in range(7)}
+    fps["host3"] = fp_div
+    cmp = compare_fingerprints(fps)
+    assert cmp["divergent_hosts"] == ["host3"]
+    assert cmp["reference"] != "host3"
+    # unpublished hosts are a coverage gap, never a flag
+    fps["host9"] = None
+    cmp = compare_fingerprints(fps)
+    assert cmp["unpublished_hosts"] == ["host9"]
+    assert cmp["divergent_hosts"] == ["host3"]
+
+
+def test_fingerprint_canonical_and_validated():
+    fp1 = divergence.canonical_fingerprint(
+        {"b": ["x"], "a": ["y", "z"]})
+    fp2 = divergence.canonical_fingerprint(
+        {"a": ["y", "z"], "b": ["x"]})
+    assert fp1 == fp2                       # order-insensitive canon
+    assert divergence.validate_fingerprint(fp1) == []
+    assert divergence.validate_fingerprint({"digest": "x"}) != []
+    assert divergence.FINGERPRINT_KEYS == aggregate.FINGERPRINT_KEYS
+
+
+# ------------------------------------------------- manifest + fleet doctor
+def _host_with_fp(root, name, fp):
+    d = os.path.join(str(root), name)
+    os.makedirs(d, exist_ok=True)
+    write_host_manifest(d, job_name=name, fingerprint=fp)
+    with open(os.path.join(d, aggregate.JSONL_NAME), "w") as fh:
+        rec = {"kind": "train_step", "step": 0, "wall": 1000.0}
+        fh.write(json.dumps(rec) + "\n")
+    return d
+
+
+def test_manifest_carries_and_validates_fingerprint(tmp_path):
+    fp = _fp(["psum@data"])
+    path = write_host_manifest(str(tmp_path), job_name="h",
+                               fingerprint=fp)
+    with open(path) as fh:
+        manifest = json.load(fh)
+    assert validate_host_manifest(manifest) == []
+    assert manifest["program_fingerprint"] == fp
+    # a malformed fingerprint is flagged
+    manifest["program_fingerprint"] = {"digest": "x"}
+    assert validate_host_manifest(manifest) != []
+    # manifests without one stay valid (absence = coverage gap)
+    del manifest["program_fingerprint"]
+    assert validate_host_manifest(manifest) == []
+
+
+def test_merge_run_reports_divergence_section(tmp_path):
+    fp_ref = _fp(["psum@data"])
+    _host_with_fp(tmp_path, "h0", fp_ref)
+    _host_with_fp(tmp_path, "h1", fp_ref)
+    _host_with_fp(tmp_path, "h2", _fp(["pmax@data"]))
+    report = aggregate.merge_run(str(tmp_path))
+    div = report["divergence"]
+    assert div["mismatch"] and div["divergent_hosts"] == ["h2"]
+    assert div["published"] == 3
+    # the full merged report accepts findings conversion
+    findings = divergence.divergence_findings(div)
+    assert [f.key for f in findings] == ["fleet_divergence:h2"]
+    # audit_fleet accepts the full report shape too
+    with pytest.raises(AuditFindingsError):
+        divergence.audit_fleet(report, _strict_cfg())
+
+
+def test_fleet_report_keys_pinned_to_checker():
+    checker = _load_bin("check_bench_schema")
+    assert tuple(aggregate.FLEET_REPORT_KEYS) == \
+        tuple(checker.FLEET_REPORT_KEYS)
+    assert tuple(aggregate.HOST_MANIFEST_KEYS) == \
+        tuple(checker.HOST_MANIFEST_KEYS)
+    assert tuple(aggregate.FINGERPRINT_KEYS) == \
+        tuple(checker.FINGERPRINT_KEYS)
+
+
+def test_checker_validates_fleet_report_and_manifest(tmp_path):
+    checker = _load_bin("check_bench_schema")
+    fp = _fp(["psum@data"])
+    _host_with_fp(tmp_path, "h0", fp)
+    _host_with_fp(tmp_path, "h1", fp)
+    report = aggregate.merge_run(str(tmp_path))
+    rpath = os.path.join(str(tmp_path), "fleet_report.json")
+    with open(rpath, "w") as fh:
+        json.dump(report, fh)
+    assert checker.check_file(rpath) == []
+    mpath = os.path.join(str(tmp_path), "h0", aggregate.MANIFEST_NAME)
+    assert checker.check_file(mpath) == []
+    # a report missing its divergence section fails
+    del report["divergence"]
+    with open(rpath, "w") as fh:
+        json.dump(report, fh)
+    assert checker.check_file(rpath) != []
+
+
+def test_ds_fleet_strict_exits_2_on_divergence_without_jax(tmp_path):
+    """The whole divergence path — manifest read, comparison, report,
+    strict exit — must run on a jax-less box (the stdlib contract)."""
+    fp_ref = _fp(["psum@data"])
+    _host_with_fp(tmp_path, "h0", fp_ref)
+    _host_with_fp(tmp_path, "h1", _fp(["pmax@data"]))
+    poison = tmp_path / "poison"
+    poison.mkdir()
+    (poison / "jax.py").write_text(
+        "raise ImportError('no jax on this box (test_concurrency)')\n")
+    env = dict(os.environ, PYTHONPATH=str(poison))
+    cmd = [sys.executable, os.path.join(_REPO, "bin", "ds_fleet.py"),
+           str(tmp_path), "--strict"]
+    out = subprocess.run(cmd, capture_output=True, text=True, env=env)
+    assert out.returncode == 2, (out.stdout, out.stderr)
+    assert "PROGRAM DIVERGENCE" in out.stdout
+    assert "h1" in out.stdout
+    # agreeing fleet: strict passes
+    _host_with_fp(tmp_path, "h1", fp_ref)
+    out = subprocess.run(cmd, capture_output=True, text=True, env=env)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "all agree" in out.stdout
+
+
+# -------------------------------------------------- collective_in_branch
+def test_collective_in_branch_fires_and_loops_exempt():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from deepspeed_tpu.analysis.ir import walk
+    from deepspeed_tpu.parallel.topology import shard_map_compat
+
+    devs = jax.devices()[:2]
+    mesh = Mesh(devs, ("data",))
+
+    def branchy(flag, x):
+        def collective(v):
+            return jax.lax.psum(v, "data")
+
+        def local(v):
+            return v * 2.0
+
+        return jax.lax.cond(flag, collective, local, x)
+
+    fn = shard_map_compat(branchy, mesh=mesh,
+                          in_specs=(P(), P("data")),
+                          out_specs=P("data"), axis_names={"data"})
+    closed = jax.make_jaxpr(fn)(
+        jnp.bool_(True), jnp.zeros((2, 4), jnp.float32))
+    findings = divergence.control_flow_findings("demo", walk(closed))
+    assert [f.check for f in findings] == ["collective_in_branch"]
+    assert findings[0].details["prim"] == "psum"
+
+    def loopy(x):
+        def body(_, v):
+            return jax.lax.psum(v, "data")
+        return jax.lax.fori_loop(0, 3, body, x)
+
+    fn2 = shard_map_compat(loopy, mesh=mesh, in_specs=(P("data"),),
+                           out_specs=P("data"), axis_names={"data"})
+    closed2 = jax.make_jaxpr(fn2)(jnp.zeros((2, 4), jnp.float32))
+    assert divergence.control_flow_findings("demo2", walk(closed2)) \
+        == []
+
+
+# ----------------------------------------------------- config section
+def test_analysis_concurrency_config_matrix():
+    cfg = DeepSpeedAnalysisConfig({})
+    assert cfg.concurrency_enabled is False
+    assert cfg.concurrency_fingerprint is True
+    cfg = DeepSpeedAnalysisConfig({"analysis": {"concurrency": True}})
+    assert cfg.concurrency_enabled is True
+    cfg = DeepSpeedAnalysisConfig(
+        {"analysis": {"concurrency": {"stack_depth": 4,
+                                      "fingerprint": False}}})
+    assert cfg.concurrency_enabled is True      # presence = opt-in
+    assert cfg.concurrency_stack_depth == 4
+    assert cfg.concurrency_fingerprint is False
+    cfg = DeepSpeedAnalysisConfig(
+        {"analysis": {"concurrency": {"enabled": False}}})
+    assert cfg.concurrency_enabled is False
+    with pytest.raises(ValueError):
+        DeepSpeedAnalysisConfig(
+            {"analysis": {"concurrency": {"stack_depth": 0}}})
+    with pytest.raises(ValueError):
+        DeepSpeedAnalysisConfig({"analysis": {"concurrency": 3}})
+    # unknown sub-keys raise under strict (the no-silent-no-ops policy)
+    with pytest.raises(ValueError):
+        DeepSpeedAnalysisConfig(
+            {"analysis": {"strict": True,
+                          "concurrency": {"enalbed": True}}})
+
+
+# ------------------------------------------------------- DSL008/DSL009
+_DSL_DEFECT = '''
+import threading
+
+class Ring:
+    _GUARDED_BY = {"items": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []          # __init__ exempt
+
+    def bad(self, x):
+        self.items.append(x)
+
+    def bad_sub(self, k, v):
+        self.items[k] = v
+
+    def good(self, x):
+        with self._lock:
+            self.items.append(x)
+
+    def spawn_bad(self):
+        threading.Thread(target=self.good).start()
+
+    def spawn_good(self):
+        threading.Thread(target=self.good, daemon=True).start()
+'''
+
+
+def test_dsl008_dsl009_fire_on_defects(tmp_path):
+    path = tmp_path / "defect.py"
+    path.write_text(_DSL_DEFECT)
+    violations = astlint.lint_file(str(path), "defect.py")
+    by_rule = {}
+    for rule, qual, lineno, msg in violations:
+        by_rule.setdefault(rule, []).append(qual)
+    assert sorted(by_rule.get("DSL008", [])) == \
+        ["Ring.bad", "Ring.bad_sub"]
+    assert by_rule.get("DSL009") == ["Ring.spawn_bad"]
+    assert set(by_rule) == {"DSL008", "DSL009"}
+
+
+def test_dsl008_inert_without_declaration(tmp_path):
+    path = tmp_path / "nodecl.py"
+    path.write_text(
+        "class Plain:\n"
+        "    def __init__(self):\n"
+        "        self.items = []\n"
+        "    def mutate(self, x):\n"
+        "        self.items.append(x)\n")
+    assert astlint.lint_file(str(path), "nodecl.py") == []
+
+
+def test_repo_self_check_green_for_new_rules():
+    """DSL008/DSL009 over deepspeed_tpu/ vs the committed baseline:
+    zero NEW occurrences (the declarations added with the sanitizer
+    are all lock-disciplined, and every thread declares daemon=)."""
+    findings = astlint.lint_paths(
+        [os.path.join(_REPO, "deepspeed_tpu")], base=_REPO)
+    baseline = astlint.load_baseline(
+        os.path.join(_REPO, "bin", "ds_lint_baseline.json"))
+    new, _stale = astlint.diff_baseline(findings, baseline)
+    offenders = [f.key for f in new
+                 if f.rule in ("DSL008", "DSL009")]
+    assert offenders == [], offenders
+
+
+# ----------------------------------------- clean engine + audit seam
+@pytest.fixture(scope="module")
+def clean_engine():
+    import numpy as np
+
+    import deepspeed_tpu as deepspeed
+    from deepspeed_tpu.models import gpt2
+    locksan.uninstall()         # a fresh process-global sanitizer
+    cfg = gpt2.GPT2Config(vocab_size=128, max_seq_len=32, n_layers=1,
+                          n_heads=2, d_model=32,
+                          use_flash_attention=False, remat=False,
+                          loss_chunk=0)
+    engine, _, _, _ = deepspeed.initialize(
+        model=gpt2.make_gpt2_model(config=cfg), config_params={
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 1,
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 1},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+            "steps_per_print": 10 ** 9,
+            "telemetry": {"enabled": True,
+                          "output_path": "/tmp/ds_test_concurrency",
+                          "metrics": {"enabled": True, "port": 0},
+                          "flight_recorder": {},
+                          "watchdog": {"nan_streak": True}},
+            "analysis": {"concurrency": {"enabled": True}},
+        })
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 128, size=(engine.train_batch_size(),
+                                    32)).astype(np.int32)
+    try:
+        yield engine, ids
+    finally:
+        if engine.telemetry is not None:
+            engine.telemetry.close()
+        locksan.uninstall()
+
+
+def test_clean_engine_config_is_silent(clean_engine):
+    engine, ids = clean_engine
+    san_ = locksan.current()
+    assert san_ is not None, "engine init must install the sanitizer"
+    for _ in range(2):
+        loss = engine(ids, ids.copy())
+        engine.backward(loss)
+        engine.step()
+    assert san_.snapshot()["acquisitions"] > 0, \
+        "instrumented locks never exercised — the shim is not wired"
+    assert [f.key for f in san_.report()] == []
+
+
+def test_audit_publishes_fingerprint_and_stays_clean(clean_engine):
+    engine, ids = clean_engine
+    report = engine.audit(batch=(ids, ids.copy()))
+    assert report.findings == []
+    fp = report.fingerprint
+    assert fp is not None and divergence.validate_fingerprint(fp) == []
+    assert any(t.startswith("#ops:")
+               for toks in fp["families"].values() for t in toks)
+    payload = report.to_dict()
+    assert payload["fingerprint"]["digest"] == fp["digest"]
+    assert validate_analysis_report(payload) == []
+    # published into the live host manifest, still schema-valid
+    man_path = os.path.join(engine.telemetry.output_dir,
+                            aggregate.MANIFEST_NAME)
+    with open(man_path) as fh:
+        manifest = json.load(fh)
+    assert validate_host_manifest(manifest) == []
+    assert manifest["program_fingerprint"]["digest"] == fp["digest"]
+    # deterministic: a second audit derives the identical digest
+    report2 = engine.audit(batch=(ids, ids.copy()))
+    assert report2.fingerprint["digest"] == fp["digest"]
+
+
+def test_instrumented_collector_scrape_and_recorder_dump(clean_engine):
+    """The wrapped fleet locks and guarded rings keep working: a
+    scrape renders through SanLocks, and a recorder dump snapshots the
+    proxied rings without findings."""
+    engine, _ = clean_engine
+    tel = engine.telemetry
+    assert isinstance(tel.metrics.registry._lock, locksan.SanLock)
+    assert isinstance(tel.recorder._lock, locksan.SanLock)
+    assert tel.recorder._lock.reentrant
+    scrape = tel.metrics_scrape()
+    assert scrape["series"] >= 1 and "# TYPE " in scrape["scrape"]
+    path = tel.recorder.dump("concurrency-test")
+    assert path is not None and os.path.exists(path)
+    assert [f.key for f in locksan.current().report()] == []

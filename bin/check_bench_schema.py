@@ -121,7 +121,7 @@ def check_segment_stats(stats, where):
 CRASH_BUNDLE_KEYS = (
     "kind", "reason", "wall", "job_name", "exception",
     "records", "spans", "open_spans", "log_events",
-    "ds_config", "env", "programs", "watchdog", "state",
+    "ds_config", "env", "programs", "watchdog", "topology", "state",
 )
 
 
@@ -385,6 +385,9 @@ def check_crash_bundle(bundle):
     for key in ("env", "programs", "state"):
         if not isinstance(bundle[key], dict):
             problems.append("{} is not a dict".format(key))
+    for key in ("exception", "ds_config", "watchdog", "topology"):
+        if bundle[key] is not None and not isinstance(bundle[key], dict):
+            problems.append("{} is neither null nor a dict".format(key))
     if isinstance(bundle.get("programs"), dict) and \
             "programs" not in bundle["programs"]:
         problems.append("programs is not a registry snapshot "
@@ -461,7 +464,15 @@ def check_analysis_report(payload):
 # pinned equal by tests/unit/test_concurrency.py).
 FLEET_REPORT_KEYS = (
     "kind", "run_dir", "n_hosts", "hosts", "offsets", "records", "gaps",
-    "straggler", "ici_health", "trace", "divergence",
+    "straggler", "ici_health", "trace", "divergence", "rescale",
+)
+# Local copy of runtime/elastic/events.py RESCALE_EVENT_KEYS (same
+# stdlib-only constraint; pinned equal by
+# tests/unit/test_elastic_rescale.py).
+RESCALE_EVENT_KEYS = (
+    "kind", "event", "wall", "reason", "attempt",
+    "old_world", "new_world", "old_mesh", "new_mesh",
+    "outcome", "detail",
 )
 HOST_MANIFEST_KEYS = (
     "kind", "job_name", "host", "pid", "process_index", "wall_start",
@@ -546,6 +557,32 @@ def check_fleet_report(payload):
         if div.get("mismatch") and not div.get("divergent_hosts"):
             problems.append(
                 "divergence.mismatch set with no divergent_hosts")
+    rescale = payload.get("rescale")
+    if not isinstance(rescale, dict):
+        problems.append("rescale is not a dict")
+    else:
+        for key in ("count", "completed"):
+            if not isinstance(rescale.get(key), int) or \
+                    isinstance(rescale.get(key), bool):
+                problems.append(
+                    "rescale.{} is not an int".format(key))
+        events = rescale.get("events")
+        if not isinstance(events, list):
+            problems.append("rescale.events is not a list")
+        else:
+            for i, ev in enumerate(events):
+                if not isinstance(ev, dict) or \
+                        ev.get("kind") != "rescale_event":
+                    problems.append(
+                        "rescale.events[{}] is not a rescale_event"
+                        .format(i))
+                    break
+                missing = [k for k in RESCALE_EVENT_KEYS if k not in ev]
+                if missing:
+                    problems.append(
+                        "rescale.events[{}] missing {}".format(
+                            i, missing))
+                    break
     return problems
 
 

@@ -116,6 +116,29 @@ def print_report(report):
     else:
         print("program fingerprints: none published (hosts ran without "
               "an audit/fingerprint pass; see docs/concurrency.md)")
+    rescale = report.get("rescale") or {}
+    print()
+    if rescale.get("events"):
+        print("RESCALE EVENTS ({} total, {} completed topology "
+              "change(s); docs/elasticity.md):".format(
+                  rescale.get("count", 0), rescale.get("completed", 0)))
+        for ev in rescale["events"]:
+            arrow = "-"
+            if ev.get("old_world") is not None or \
+                    ev.get("new_world") is not None:
+                arrow = "{} -> {}".format(ev.get("old_world", "?"),
+                                          ev.get("new_world", "?"))
+            extras = []
+            if ev.get("attempt") is not None:
+                extras.append("attempt {}".format(ev["attempt"]))
+            if ev.get("outcome"):
+                extras.append(ev["outcome"])
+            print("  - [{}] {:<18} world {:<10} {}{}".format(
+                ev.get("host", "?"), ev.get("event", "?"), arrow,
+                ev.get("reason", ""),
+                " ({})".format(", ".join(extras)) if extras else ""))
+    else:
+        print("no rescale events (the run never changed topology)")
 
 
 def main(argv=None):

@@ -7,7 +7,13 @@ from .constants import (
     MIN_GPUS, MIN_GPUS_DEFAULT, MAX_GPUS, MAX_GPUS_DEFAULT, MIN_TIME,
     MIN_TIME_DEFAULT, VERSION, VERSION_DEFAULT, PREFER_LARGER_BATCH,
     PREFER_LARGER_BATCH_DEFAULT, IGNORE_NON_ELASTIC_BATCH_INFO,
-    IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT)
+    IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT, RESCALE_RETRIES,
+    RESCALE_RETRIES_DEFAULT, RESCALE_BACKOFF_SECONDS,
+    RESCALE_BACKOFF_SECONDS_DEFAULT, EVICTION_SEVERITY,
+    EVICTION_SEVERITY_DEFAULT, EVICTION_WINDOWS,
+    EVICTION_WINDOWS_DEFAULT, PREEMPTION_NOTICE_FILE,
+    PREEMPTION_NOTICE_FILE_DEFAULT, FINGERPRINT_GATE,
+    FINGERPRINT_GATE_DEFAULT)
 
 
 class ElasticityError(Exception):
@@ -74,6 +80,33 @@ class ElasticityConfig:
                                                        PREFER_LARGER_BATCH_DEFAULT)
         self.ignore_non_elastic_batch_info = param_dict.get(
             IGNORE_NON_ELASTIC_BATCH_INFO, IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT)
+
+        # runtime rescale policy (ISSUE 16, runtime/elastic/) — outside
+        # the immutable solver fingerprint, tunable between runs
+        self.rescale_retries = int(param_dict.get(
+            RESCALE_RETRIES, RESCALE_RETRIES_DEFAULT))
+        if self.rescale_retries < 0:
+            raise ElasticityConfigError(
+                "rescale_retries must be >= 0, got {}".format(
+                    self.rescale_retries))
+        self.rescale_backoff_seconds = float(param_dict.get(
+            RESCALE_BACKOFF_SECONDS, RESCALE_BACKOFF_SECONDS_DEFAULT))
+        if self.rescale_backoff_seconds < 0:
+            raise ElasticityConfigError(
+                "rescale_backoff_seconds must be >= 0, got {}".format(
+                    self.rescale_backoff_seconds))
+        self.eviction_severity = float(param_dict.get(
+            EVICTION_SEVERITY, EVICTION_SEVERITY_DEFAULT))
+        self.eviction_windows = int(param_dict.get(
+            EVICTION_WINDOWS, EVICTION_WINDOWS_DEFAULT))
+        if self.eviction_windows < 1:
+            raise ElasticityConfigError(
+                "eviction_windows must be >= 1, got {}".format(
+                    self.eviction_windows))
+        self.preemption_notice_file = param_dict.get(
+            PREEMPTION_NOTICE_FILE, PREEMPTION_NOTICE_FILE_DEFAULT)
+        self.fingerprint_gate = bool(param_dict.get(
+            FINGERPRINT_GATE, FINGERPRINT_GATE_DEFAULT))
 
     def repr(self):
         return self.__dict__

@@ -30,6 +30,29 @@ IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT = False
 VERSION = "version"
 VERSION_DEFAULT = LATEST_ELASTICITY_VERSION
 
+# --- runtime elasticity keys (ISSUE 16, runtime/elastic/): how the
+# ElasticRunner detects, retries, and gates a rescale. These do NOT
+# enter the immutable solver fingerprint (ensure_immutable_elastic_config
+# compares only the batch-math keys) — an operator may tune retry or
+# eviction policy mid-campaign without invalidating the schedule.
+RESCALE_RETRIES = "rescale_retries"
+RESCALE_RETRIES_DEFAULT = 2
+
+RESCALE_BACKOFF_SECONDS = "rescale_backoff_seconds"
+RESCALE_BACKOFF_SECONDS_DEFAULT = 0.5
+
+EVICTION_SEVERITY = "eviction_severity"
+EVICTION_SEVERITY_DEFAULT = 2.0
+
+EVICTION_WINDOWS = "eviction_windows"
+EVICTION_WINDOWS_DEFAULT = 3
+
+PREEMPTION_NOTICE_FILE = "preemption_notice_file"
+PREEMPTION_NOTICE_FILE_DEFAULT = None
+
+FINGERPRINT_GATE = "fingerprint_gate"
+FINGERPRINT_GATE_DEFAULT = False
+
 MINIMUM_DEEPSPEED_VERSION = "0.1.0"
 
 DEEPSPEED_ELASTICITY_CONFIG = "DEEPSPEED_ELASTICITY_CONFIG"

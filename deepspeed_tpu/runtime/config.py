@@ -590,6 +590,55 @@ class DeepSpeedConfig(object):
         self._param_dict[TRAIN_MICRO_BATCH_SIZE_PER_GPU] = micro_batch_size
         self._param_dict[GRADIENT_ACCUMULATION_STEPS] = gradient_accu_steps
 
+    def validate_elastic_world_size(self, world_size):
+        """Preflight a PROPOSED world size for an elastic rescale
+        (runtime/elastic/): the same candidate-batch math that ran at
+        init, re-run for the target topology BEFORE any teardown.
+        Raises ``ElasticityIncompatibleWorldSize`` (with the valid
+        counts, or the divisibility that failed) when the target cannot
+        preserve the global batch; returns the
+        ``(final_batch, micro_batch, grad_accum)`` triple the rescaled
+        engine will train with."""
+        from ..elasticity import (ElasticityIncompatibleWorldSize,
+                                  compute_elastic_config)
+        from ..version import __version__
+        world_size = int(world_size)
+        if world_size < 1:
+            raise ElasticityIncompatibleWorldSize(
+                "world size {} is not positive".format(world_size))
+        if self.elasticity_enabled:
+            final_batch, _valid, micro = compute_elastic_config(
+                ds_config=self._param_dict,
+                target_deepspeed_version=__version__,
+                world_size=world_size)
+            return (final_batch, micro,
+                    final_batch // (micro * world_size))
+        # non-elastic config: the rescale must keep the SAME global
+        # batch by re-deriving the batch triple for the TARGET world
+        # from the EXPLICIT keys only — the values this config derived
+        # for ITS world (e.g. micro = batch/world) do not transfer
+        batch = get_train_batch_size(self._param_dict)
+        micro = get_train_micro_batch_size_per_gpu(self._param_dict)
+        grad_acc = get_gradient_accumulation_steps(self._param_dict)
+        if batch is None:
+            # no pinned global batch — any world works (micro * accum
+            # scales the global batch with the world, like init does)
+            return (None, micro, grad_acc or 1)
+        fixed = (micro if micro is not None else grad_acc) or 1
+        if batch % (fixed * world_size) != 0:
+            raise ElasticityIncompatibleWorldSize(
+                "world size {} cannot preserve train_batch_size={} "
+                "({} {} x world {} does not divide it; add an "
+                "elasticity section for candidate world sizes)".format(
+                    world_size, batch,
+                    "micro batch" if micro is not None
+                    else "grad-accum", fixed, world_size))
+        if micro is not None:
+            return (batch, micro, batch // (micro * world_size))
+        if grad_acc is not None:
+            return (batch, batch // (grad_acc * world_size), grad_acc)
+        return (batch, batch // world_size, 1)
+
     def _initialize_params(self, param_dict):
         self.train_batch_size = get_train_batch_size(param_dict)
         self.train_micro_batch_size_per_gpu = \
@@ -786,7 +835,12 @@ class DeepSpeedConfig(object):
         "elasticity": {"enabled", "max_train_batch_size",
                        "micro_batch_sizes", "min_gpus", "max_gpus",
                        "min_time", "prefer_larger_batch",
-                       "ignore_non_elastic_batch_info", "version"},
+                       "ignore_non_elastic_batch_info", "version",
+                       # runtime rescale policy (ISSUE 16,
+                       # runtime/elastic/, docs/elasticity.md)
+                       "rescale_retries", "rescale_backoff_seconds",
+                       "eviction_severity", "eviction_windows",
+                       "preemption_notice_file", "fingerprint_gate"},
         # optimizer/scheduler "params" and "amp" bodies are free-form
         # passthrough (per-type / apex-parity); sparse_attention keys vary
         # by mode and are validated by the layout builders themselves

@@ -1,0 +1,20 @@
+"""Elastic self-healing training: preemption-native rescale.
+
+Detection (:mod:`.monitor`), execution with resharded restore and
+bounded retry (:mod:`.rescale`), and the rescale-event schema every
+surface shares (:mod:`.events`)."""
+from .events import (KIND_RESCALE_EVENT, RESCALE_EVENT_KEYS,
+                     RESCALE_EVENT_NAMES, RESCALE_EVENTS_JSONL,
+                     append_rescale_event, make_rescale_event,
+                     read_rescale_events, validate_rescale_event)
+from .monitor import ElasticDecision, ElasticityMonitor, EvictionPolicy
+from .rescale import (ElasticRunner, EnrollmentRefused, RescaleError,
+                      enroll_check)
+
+__all__ = [
+    "KIND_RESCALE_EVENT", "RESCALE_EVENT_KEYS", "RESCALE_EVENT_NAMES",
+    "RESCALE_EVENTS_JSONL", "append_rescale_event", "make_rescale_event",
+    "read_rescale_events", "validate_rescale_event",
+    "ElasticDecision", "ElasticityMonitor", "EvictionPolicy",
+    "ElasticRunner", "EnrollmentRefused", "RescaleError", "enroll_check",
+]

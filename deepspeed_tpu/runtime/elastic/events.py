@@ -1,0 +1,139 @@
+"""Rescale-event schema + JSONL plumbing for elastic training.
+
+Every topology change an :class:`~.rescale.ElasticRunner` performs (or
+refuses) is one validated event: preemption notices, proactive
+evictions, each bounded-retry attempt, the completed rescale with the
+old/new topology, and enrollment refusals. Events land in THREE
+surfaces so a post-mortem can always reconstruct the topology history:
+
+* the runner's in-memory ``events`` list (shared with every engine it
+  builds, so the flight recorder's ``topology`` bundle section carries
+  the full rescale history at crash time — telemetry/recorder.py);
+* ``rescale_events.jsonl`` in the host's telemetry directory, one JSON
+  object per line, append-only — the fleet doctor
+  (telemetry/fleet/aggregate.py, stdlib-only, which duplicates the
+  names below under its import contract) merges them into the fleet
+  report and ``bin/ds_fleet.py`` prints them;
+* the engine log at warning level, so the flight recorder's log-event
+  ring sees them too.
+"""
+import json
+import os
+import time
+
+# file name + schema duplicated in telemetry/fleet/aggregate.py
+# (stdlib-import contract); pinned equal by tests/unit/test_elastic_rescale.py
+RESCALE_EVENTS_JSONL = "rescale_events.jsonl"
+KIND_RESCALE_EVENT = "rescale_event"
+
+# every rescale event carries exactly these keys
+RESCALE_EVENT_KEYS = (
+    "kind", "event", "wall", "reason", "attempt",
+    "old_world", "new_world", "old_mesh", "new_mesh",
+    "outcome", "detail",
+)
+
+# the event vocabulary: what happened at this point of the lifecycle
+RESCALE_EVENT_NAMES = (
+    "preemption_notice",   # SIGTERM / notice file / injected kill seen
+    "eviction",            # straggler/ICI policy evicted a host
+    "rescale_attempt",     # one bounded-retry attempt started/failed
+    "rescale",             # a completed topology change
+    "rescale_refused",     # world size rejected before any teardown
+    "enroll_refused",      # divergent fingerprint refused at enrollment
+)
+
+
+def make_rescale_event(event, reason, old_world=None, new_world=None,
+                       old_mesh=None, new_mesh=None, attempt=None,
+                       outcome=None, detail=None, wall=None):
+    """Build one schema-complete rescale event dict."""
+    return {
+        "kind": KIND_RESCALE_EVENT,
+        "event": event,
+        "wall": float(time.time() if wall is None else wall),
+        "reason": str(reason),
+        "attempt": attempt,
+        "old_world": old_world,
+        "new_world": new_world,
+        "old_mesh": dict(old_mesh) if old_mesh else None,
+        "new_mesh": dict(new_mesh) if new_mesh else None,
+        "outcome": outcome,
+        "detail": detail,
+    }
+
+
+def validate_rescale_event(event):
+    """Schema check for one rescale event. Returns a list of problem
+    strings; empty list = valid."""
+    problems = []
+    if not isinstance(event, dict):
+        return ["event is not a dict: {!r}".format(type(event).__name__)]
+    if event.get("kind") != KIND_RESCALE_EVENT:
+        return ["unknown event kind {!r}".format(event.get("kind"))]
+    for key in RESCALE_EVENT_KEYS:
+        if key not in event:
+            problems.append("missing key {!r}".format(key))
+    if problems:
+        return problems
+    if event["event"] not in RESCALE_EVENT_NAMES:
+        problems.append("event {!r} not one of {}".format(
+            event["event"], RESCALE_EVENT_NAMES))
+    if isinstance(event["wall"], bool) or \
+            not isinstance(event["wall"], (int, float)):
+        problems.append("wall is not a number")
+    if not isinstance(event["reason"], str) or not event["reason"]:
+        problems.append("reason is not a non-empty string")
+    for key in ("old_world", "new_world", "attempt"):
+        val = event[key]
+        if val is not None and (isinstance(val, bool)
+                                or not isinstance(val, int)):
+            problems.append("{} is neither null nor an int".format(key))
+    for key in ("old_mesh", "new_mesh"):
+        val = event[key]
+        if val is not None and not isinstance(val, dict):
+            problems.append("{} is neither null nor a dict".format(key))
+    for key in ("outcome", "detail"):
+        val = event[key]
+        if val is not None and not isinstance(val, str):
+            problems.append("{} is neither null nor a string".format(key))
+    return problems
+
+
+def append_rescale_event(output_dir, event):
+    """Append one validated event to ``rescale_events.jsonl`` under
+    ``output_dir`` (a host telemetry directory). Returns the path.
+    Line-at-a-time append + flush: a crash mid-run leaves whole JSON
+    lines behind, which the fleet merger reads tolerantly."""
+    problems = validate_rescale_event(event)
+    if problems:
+        raise ValueError("invalid rescale event: {}".format(problems))
+    os.makedirs(output_dir, exist_ok=True)
+    path = os.path.join(output_dir, RESCALE_EVENTS_JSONL)
+    with open(path, "a") as fh:
+        fh.write(json.dumps(event, sort_keys=True) + "\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+    return path
+
+
+def read_rescale_events(output_dir):
+    """Tolerant read of a host directory's rescale events (torn last
+    line skipped, like the fleet merger's JSONL reader)."""
+    path = os.path.join(output_dir, RESCALE_EVENTS_JSONL)
+    if not os.path.isfile(path):
+        return []
+    out = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and \
+                    rec.get("kind") == KIND_RESCALE_EVENT:
+                out.append(rec)
+    return out

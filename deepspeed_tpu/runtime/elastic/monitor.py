@@ -1,0 +1,177 @@
+"""Mesh-change detection: when should an elastic run change topology?
+
+Three signal classes feed one decision type (:class:`ElasticDecision`):
+
+* **preemption** — a SIGTERM (chained behind the flight recorder's own
+  handler), a cloud preemption-notice file appearing on disk
+  (``elasticity.preemption_notice_file``), or an injected
+  ``SimulatedKill`` surfacing through the guarded step/checkpoint
+  paths (utils/fault_injection.py);
+* **proactive eviction** — the PR 14 straggler/ICI attribution
+  (telemetry/fleet/) flagging the same host at or above a configured
+  severity for ``k`` consecutive observation windows
+  (``elasticity.eviction_severity`` / ``elasticity.eviction_windows``);
+* **device-count change** — the world the scheduler hands us at
+  (re)init differs from the engine's mesh.
+
+The monitor only *decides*; :class:`~.rescale.ElasticRunner` executes
+(checkpoint, teardown, rebuild, resharded restore, fingerprint gate).
+"""
+import os
+import signal
+import threading
+from typing import NamedTuple, Optional, Tuple
+
+from ...utils.logging import logger
+
+
+class ElasticDecision(NamedTuple):
+    """One detection outcome: what to do, why, and to which topology.
+    ``target_world`` of None means "next smaller candidate" — the
+    runner resolves it against the elasticity config's valid counts."""
+    action: str                       # "rescale" | "evict"
+    reason: str
+    target_world: Optional[int] = None
+    hosts: Tuple[str, ...] = ()       # hosts being evicted, if any
+
+
+class EvictionPolicy:
+    """Turn straggler/ICI flags into an eviction decision once the same
+    host stays flagged at/above ``severity`` (worst host/median ratio)
+    for ``windows`` CONSECUTIVE observations. A window where the host
+    is clean resets its streak — one noisy window never evicts."""
+
+    def __init__(self, severity=0.0, windows=3):
+        if windows < 1:
+            raise ValueError("eviction windows must be >= 1, got "
+                             "{}".format(windows))
+        self.severity = float(severity)
+        self.windows = int(windows)
+        self.streaks = {}
+        self.evicted = []
+
+    def observe(self, report):
+        """Feed one fleet observation (a merged fleet report, a
+        ``telemetry_snapshot()["fleet"]`` sub-dict, or a bare flags
+        list); returns an "evict" :class:`ElasticDecision` when a host
+        crosses the streak threshold, else None."""
+        if isinstance(report, (list, tuple)):
+            flags = list(report)
+        else:
+            flags = report.get("straggler_flags") \
+                or report.get("straggler", {}).get("flags", [])
+        worst = {}
+        for flag in flags:
+            host = flag.get("host")
+            if host is None:
+                continue
+            ratio = flag.get("worst_ratio")
+            ratio = float("inf") if ratio is None else float(ratio)
+            worst[host] = max(worst.get(host, 0.0), ratio)
+        for host in list(self.streaks):
+            if host not in worst:
+                del self.streaks[host]      # clean window resets
+        offenders = []
+        for host, ratio in worst.items():
+            if ratio < self.severity:
+                self.streaks.pop(host, None)
+                continue
+            self.streaks[host] = self.streaks.get(host, 0) + 1
+            if self.streaks[host] >= self.windows and \
+                    host not in self.evicted:
+                offenders.append((host, ratio, self.streaks[host]))
+        if not offenders:
+            return None
+        offenders.sort(key=lambda t: -t[1])
+        hosts = tuple(h for h, _, _ in offenders)
+        self.evicted.extend(hosts)
+        detail = ", ".join(
+            "{} ({:.2f}x for {} window(s))".format(h, r, s)
+            for h, r, s in offenders)
+        return ElasticDecision(
+            action="evict",
+            reason="straggler flagged {} consecutive window(s): {}".format(
+                self.windows, detail),
+            hosts=hosts)
+
+
+class ElasticityMonitor:
+    """Aggregates the preemption + eviction + world-change signals.
+
+    Thread/signal-safe by construction: signal handlers and watcher
+    threads only SET flags; ``poll()`` (called from the training loop)
+    reads and consumes them — no locks are taken in the handler, the
+    exact discipline the concurrency sanitizer enforces on the flight
+    recorder's SIGTERM path."""
+
+    def __init__(self, notice_file=None, eviction=None):
+        self.notice_file = notice_file
+        self.eviction = eviction or EvictionPolicy()
+        self._preempted = threading.Event()
+        self._preempt_reason = "preemption"
+        self._prev_sigterm = None
+        self._pending = []
+
+    # ------------------------------------------------------- preemption
+    def notice_preemption(self, reason="preemption"):
+        """Flag a preemption (SIGTERM handler, notice file, or the
+        guarded step path catching an injected kill)."""
+        self._preempt_reason = str(reason)
+        self._preempted.set()
+
+    def preemption_requested(self):
+        return self._preempted.is_set()
+
+    def install_sigterm(self):
+        """Chain a preemption-notice handler behind whatever SIGTERM
+        handler is installed (the flight recorder dumps first — its
+        handler chains to us, ours chains to whatever preceded it).
+        Main-thread only; a no-op off it."""
+        if threading.current_thread() is not threading.main_thread():
+            return False
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def _handler(signum, frame):
+            self.notice_preemption("sigterm")
+            if callable(prev) and prev not in (signal.SIG_IGN,
+                                               signal.SIG_DFL):
+                prev(signum, frame)
+
+        self._prev_sigterm = prev
+        signal.signal(signal.SIGTERM, _handler)
+        return True
+
+    # ------------------------------------------------------------ polls
+    def check_world(self, current_world, desired_world):
+        """Device-count change at (re)init: the scheduler says
+        ``desired_world`` but the engine's mesh has ``current_world``."""
+        if desired_world is None or desired_world == current_world:
+            return None
+        return ElasticDecision(
+            action="rescale",
+            reason="device count changed: {} -> {}".format(
+                current_world, desired_world),
+            target_world=int(desired_world))
+
+    def observe_fleet(self, report):
+        """Feed one fleet observation to the eviction policy; a
+        resulting decision is queued for the next ``poll()``."""
+        decision = self.eviction.observe(report)
+        if decision is not None:
+            logger.warning("elastic monitor: %s", decision.reason)
+            self._pending.append(decision)
+        return decision
+
+    def poll(self):
+        """The training-loop seam: returns the next pending decision
+        (preemption first, then queued evictions), or None."""
+        if self.notice_file and os.path.exists(self.notice_file):
+            self.notice_preemption(
+                "preemption notice file {}".format(self.notice_file))
+        if self._preempted.is_set():
+            self._preempted.clear()
+            return ElasticDecision(action="rescale",
+                                   reason=self._preempt_reason)
+        if self._pending:
+            return self._pending.pop(0)
+        return None

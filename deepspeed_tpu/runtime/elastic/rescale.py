@@ -1,0 +1,411 @@
+"""Preemption-native rescale: the execution half of elastic training.
+
+:class:`ElasticRunner` wraps an engine lifecycle so "a host died" is a
+recorded topology change instead of a crash:
+
+1. **detection** is delegated to :class:`~.monitor.ElasticityMonitor`
+   (SIGTERM/notice file, straggler eviction, world change at re-init);
+   the guarded ``train_step``/``checkpoint`` paths additionally catch
+   a hard preemption (``SimulatedKill`` in the fault harness, or any
+   configured preemption exception) mid-step;
+2. **resharded restore**: teardown, ``build_mesh`` for the new world,
+   a fresh engine whose ``ZeroShardingPlan`` matches the new topology,
+   and ``load_checkpoint`` from the last crash-safe manifest (PR 1's
+   fallback scan picks the newest COMPLETE tag, so a kill mid-save or
+   mid-load falls back instead of wedging). World-size-dependent
+   optimizer state (1-bit Adam error feedback) is canonicalised by the
+   optimizer's ``reshard_state`` hook in the engine load path;
+3. **safe resume**: the target world is validated against the
+   elasticity config BEFORE any teardown
+   (``ElasticityIncompatibleWorldSize`` refuses the rescale with the
+   old engine untouched), and an optional fingerprint gate re-derives
+   the PR 15 program fingerprint and refuses to enroll a divergent
+   host by name (:class:`EnrollmentRefused`);
+4. **bounded retry**: each rescale rides ``utils/retry.py`` with every
+   attempt recorded as a rescale event (events.py) — in the runner's
+   shared history (crash-bundle ``topology`` section), in
+   ``rescale_events.jsonl`` (fleet doctor), and in the log ring.
+"""
+import copy
+import os
+import socket
+
+from ...elasticity import (ElasticityIncompatibleWorldSize,
+                           compute_elastic_config, elasticity_enabled)
+from ...utils.fault_injection import SimulatedKill
+from ...utils.logging import logger
+from ...utils.retry import RetryPolicy, retry_call
+from ...version import __version__ as ds_version
+from .events import append_rescale_event, make_rescale_event
+from .monitor import ElasticityMonitor, EvictionPolicy
+
+
+class RescaleError(RuntimeError):
+    """A rescale attempt failed in a way worth retrying (restore found
+    no checkpoint, engine rebuild failed transiently)."""
+
+
+class EnrollmentRefused(RuntimeError):
+    """A host's program fingerprint diverges from the fleet's — it must
+    not enroll (the mesh would hang at its first divergent collective).
+    ``host`` names the refused host."""
+
+    def __init__(self, host, message):
+        super().__init__(message)
+        self.host = host
+
+
+def enroll_check(run_dir, host, fingerprint):
+    """Fingerprint gate at enrollment: compare ``host``'s freshly
+    derived ``fingerprint`` against every fingerprint published in the
+    run directory's host manifests (PR 15 / fleet contract). Raises
+    :class:`EnrollmentRefused` naming the host when it diverges from
+    the fleet majority; returns the comparison payload otherwise."""
+    from ...telemetry.fleet.aggregate import (MANIFEST_FINGERPRINT_KEY,
+                                              MANIFEST_NAME,
+                                              compare_fingerprints,
+                                              load_host)
+    fingerprints = {}
+    if run_dir and os.path.isdir(run_dir):
+        for name in sorted(os.listdir(run_dir)):
+            path = os.path.join(run_dir, name)
+            if not os.path.isfile(os.path.join(path, MANIFEST_NAME)):
+                continue
+            view = load_host(path, name=name)
+            if view.manifest is not None:
+                fingerprints[name] = view.manifest.get(
+                    MANIFEST_FINGERPRINT_KEY)
+    fingerprints[host] = fingerprint
+    comparison = compare_fingerprints(fingerprints)
+    if comparison["mismatch"] and host in comparison["divergent_hosts"]:
+        from ...analysis.concurrency.divergence import divergence_findings
+        try:
+            detail = "; ".join(
+                f.message for f in divergence_findings(comparison)
+                if host in f.message)
+        except Exception:  # noqa: BLE001 - families may be raw counts
+            detail = ""
+        detail = detail or "digest {} != reference host {}".format(
+            comparison["digests"].get(host), comparison["reference"])
+        raise EnrollmentRefused(
+            host,
+            "host {!r} refused enrollment: program fingerprint "
+            "diverges from the fleet ({})".format(host, detail))
+    return comparison
+
+
+class ElasticRunner:
+    """Owns one engine at a time and rebuilds it across topologies.
+
+    ``model_factory`` is a zero-arg callable returning a FRESH model
+    (params are restored from the checkpoint, so the factory's init
+    values never survive a rescale). ``config`` is the ds_config dict;
+    the runner adapts its batch parameters per world so the GLOBAL
+    batch is preserved (elastic configs re-solve grad-accum via the
+    HCN candidates, non-elastic ones re-derive it from
+    train_batch/micro)."""
+
+    def __init__(self, model_factory, config, checkpoint_dir,
+                 candidate_worlds=None, monitor=None, retry_policy=None,
+                 fingerprint_gate=None, preemption_exceptions=None,
+                 mesh_kwargs=None, world=None, events_dir=None,
+                 sleep=None):
+        import jax
+
+        self.model_factory = model_factory
+        self.base_config = copy.deepcopy(config)
+        self.checkpoint_dir = str(checkpoint_dir)
+        self.mesh_kwargs = dict(mesh_kwargs or {})
+        self.events = []
+        self.rescales = 0
+        self._events_dir_override = events_dir
+        self._sleep = sleep
+
+        elas = dict(self.base_config.get("elasticity") or {})
+        if candidate_worlds is None and elasticity_enabled(
+                self.base_config):
+            _batch, valid = compute_elastic_config(
+                self.base_config, ds_version)[:2]
+            candidate_worlds = valid
+        self.candidate_worlds = sorted(int(w) for w in candidate_worlds) \
+            if candidate_worlds else None
+        self.retry_policy = retry_policy or RetryPolicy(
+            retries=int(elas.get("rescale_retries", 2)),
+            backoff_seconds=float(elas.get("rescale_backoff_seconds",
+                                           0.5)))
+        self.fingerprint_gate = bool(elas.get("fingerprint_gate", False)
+                                     if fingerprint_gate is None
+                                     else fingerprint_gate)
+        self.preemption_exceptions = tuple(
+            preemption_exceptions
+            if preemption_exceptions is not None else (SimulatedKill,))
+        self.monitor = monitor or ElasticityMonitor(
+            notice_file=elas.get("preemption_notice_file"),
+            eviction=EvictionPolicy(
+                severity=float(elas.get("eviction_severity", 2.0)),
+                windows=int(elas.get("eviction_windows", 3))))
+        if world is None:
+            world = len(jax.devices())
+        self.engine = self._build(int(world))
+
+    # ------------------------------------------------------- topology
+    @property
+    def world(self):
+        return int(dict(self.engine.mesh.shape).get("data", 1))
+
+    def _mesh_shape(self, engine=None):
+        engine = engine or self.engine
+        if engine is None:
+            return None
+        return {k: int(v) for k, v in dict(engine.mesh.shape).items()}
+
+    def _config_for_world(self, world):
+        """Per-world ds_config: global batch preserved, grad-accum
+        re-derived. Elastic configs re-solve through
+        ``_configure_elasticity``; non-elastic ones drop a pinned
+        grad-accum so train_batch/micro re-derive it for the new
+        world (an indivisible combination is caught by preflight)."""
+        cfg = copy.deepcopy(self.base_config)
+        if not elasticity_enabled(cfg) and \
+                cfg.get("train_batch_size") is not None and \
+                cfg.get("train_micro_batch_size_per_gpu") is not None:
+            cfg.pop("gradient_accumulation_steps", None)
+        return cfg
+
+    def _build(self, world):
+        from ...parallel.topology import build_mesh
+        from ..engine import DeepSpeedEngine
+        mesh = build_mesh(data=world, **self.mesh_kwargs)
+        engine = DeepSpeedEngine(model=self.model_factory(),
+                                 config_params=self._config_for_world(
+                                     world),
+                                 mesh=mesh)
+        # share ONE history across every engine generation so the
+        # flight recorder's topology section always carries the full
+        # rescale trail, whichever engine is live at crash time
+        engine._rescale_history = self.events
+        tel = getattr(engine, "telemetry", None)
+        if tel is not None:
+            # the live ds_fleet seam: every ingested fleet report also
+            # feeds the eviction policy (telemetry/collector.py)
+            tel.set_elastic_observer(self.observe_fleet)
+        return engine
+
+    # --------------------------------------------------------- events
+    def _events_dir(self):
+        if self._events_dir_override:
+            return self._events_dir_override
+        tel = getattr(self.engine, "telemetry", None) \
+            if self.engine is not None else None
+        return getattr(tel, "output_dir", None)
+
+    def _record(self, event, reason, **kw):
+        evt = make_rescale_event(event, reason, **kw)
+        self.events.append(evt)
+        logger.warning("elastic: %s (%s)", event, reason)
+        out_dir = self._events_dir()
+        if out_dir:
+            try:
+                append_rescale_event(out_dir, evt)
+            except OSError as err:
+                logger.warning("elastic: could not persist rescale "
+                               "event (%s)", err)
+        return evt
+
+    # ------------------------------------------------------ guarded io
+    def checkpoint(self, tag=None, client_state=None):
+        """Guarded save: a preemption mid-save becomes a rescale-down
+        restored from the last COMPLETE manifest (the torn tag is
+        skipped by the PR 1 fallback scan — no data beyond the last
+        durable checkpoint is lost, which is all a hard kill can
+        promise)."""
+        try:
+            return self.engine.save_checkpoint(
+                self.checkpoint_dir, tag=tag,
+                client_state=client_state or {})
+        except self.preemption_exceptions as kill:
+            self._on_preemption("preempted during checkpoint: "
+                                "{}".format(kill))
+            return None
+
+    def train_step(self, fn):
+        """Guarded step: ``fn(engine)`` runs the caller's forward/
+        backward/step; a preemption mid-step triggers the same
+        rescale-down path as a mid-save kill. Returns ``(result,
+        rescaled)``."""
+        try:
+            return fn(self.engine), False
+        except self.preemption_exceptions as kill:
+            self._on_preemption("preempted during step: "
+                                "{}".format(kill))
+            return None, True
+
+    def _on_preemption(self, reason):
+        self.monitor.notice_preemption(reason)
+        self.monitor.poll()       # consume: this handler IS the react
+        self._record("preemption_notice", reason,
+                     old_world=self.world,
+                     old_mesh=self._mesh_shape())
+        target = self._downscale_target()
+        self.rescale(target, reason, save_first=False)
+
+    def _downscale_target(self, current=None):
+        import jax
+        current = self.world if current is None else current
+        avail = len(jax.devices())
+        candidates = self.candidate_worlds or \
+            [w for w in (current // 2, current // 4, 1) if w >= 1]
+        smaller = [w for w in candidates if w < current and w <= avail]
+        if not smaller:
+            raise RescaleError(
+                "no candidate world below {} to rescale down to "
+                "(candidates: {})".format(current, candidates))
+        return max(smaller)
+
+    # ------------------------------------------------------ monitoring
+    def observe_fleet(self, report):
+        """Feed a fleet observation (merged report or snapshot) to the
+        eviction policy; see ``maybe_rescale`` for acting on it."""
+        return self.monitor.observe_fleet(report)
+
+    def maybe_rescale(self):
+        """Training-loop seam: poll the monitor and execute any pending
+        decision. Graceful paths (notice file, eviction) checkpoint
+        FIRST — rescale without data loss; returns the decision acted
+        on, or None."""
+        decision = self.monitor.poll()
+        if decision is None:
+            return None
+        if decision.action == "evict":
+            self._record("eviction", decision.reason,
+                         old_world=self.world,
+                         old_mesh=self._mesh_shape(),
+                         detail="evicting host(s): {}".format(
+                             ", ".join(decision.hosts)))
+            target = decision.target_world or self._downscale_target()
+            self.rescale(target, decision.reason, save_first=True)
+            return decision
+        target = decision.target_world
+        if target is None:
+            self._record("preemption_notice", decision.reason,
+                         old_world=self.world,
+                         old_mesh=self._mesh_shape())
+            target = self._downscale_target()
+            self.rescale(target, decision.reason, save_first=True)
+        elif target != self.world:
+            self.rescale(target, decision.reason, save_first=True)
+        return decision
+
+    # --------------------------------------------------------- rescale
+    def rescale(self, new_world, reason, save_first=True):
+        """Change topology to ``new_world`` with bounded retry. The
+        target is validated BEFORE any teardown: an incompatible world
+        is recorded as ``rescale_refused`` and raised with the current
+        engine untouched."""
+        new_world = int(new_world)
+        old_world = self.world
+        old_mesh = self._mesh_shape()
+        try:
+            self._preflight(new_world)
+        except ElasticityIncompatibleWorldSize as err:
+            self._record("rescale_refused", reason,
+                         old_world=old_world, new_world=new_world,
+                         old_mesh=old_mesh, outcome="refused",
+                         detail=str(err))
+            raise
+        attempts = {"n": 0}
+
+        def _attempt():
+            attempts["n"] += 1
+            self._record("rescale_attempt", reason,
+                         attempt=attempts["n"], old_world=old_world,
+                         new_world=new_world, old_mesh=old_mesh)
+            return self._attempt_rescale(new_world, save_first
+                                         and attempts["n"] == 1)
+
+        def _on_retry(attempt, exc, delay):
+            self._record("rescale_attempt", reason, attempt=attempt + 1,
+                         old_world=old_world, new_world=new_world,
+                         old_mesh=old_mesh, outcome="retrying",
+                         detail="{}; retry in {:.2f}s".format(exc,
+                                                              delay))
+
+        kw = {}
+        if self._sleep is not None:
+            kw["sleep"] = self._sleep
+        engine = retry_call(_attempt, policy=self.retry_policy,
+                            retry_on=(RescaleError, OSError),
+                            on_retry=_on_retry, **kw)
+        self.engine = engine
+        self.rescales += 1
+        self._record("rescale", reason, attempt=attempts["n"],
+                     old_world=old_world, new_world=new_world,
+                     old_mesh=old_mesh,
+                     new_mesh=self._mesh_shape(engine),
+                     outcome="ok",
+                     detail="resumed at step {}".format(
+                         engine.global_steps))
+        return engine
+
+    def _preflight(self, new_world):
+        import jax
+        if new_world < 1:
+            raise ElasticityIncompatibleWorldSize(
+                "world size {} is not positive".format(new_world))
+        if new_world > len(jax.devices()):
+            raise ElasticityIncompatibleWorldSize(
+                "world size {} exceeds the {} visible device(s)".format(
+                    new_world, len(jax.devices())))
+        if self.candidate_worlds and new_world not in \
+                self.candidate_worlds:
+            raise ElasticityIncompatibleWorldSize(
+                "world size {} is not an elastic candidate "
+                "(valid: {})".format(new_world, self.candidate_worlds))
+        config = getattr(self.engine, "_config", None)
+        if config is not None:
+            config.validate_elastic_world_size(new_world)
+
+    def _attempt_rescale(self, new_world, save_first):
+        if self.engine is not None:
+            if save_first:
+                self.engine.save_checkpoint(self.checkpoint_dir)
+            close = getattr(self.engine, "close", None)
+            if callable(close):
+                close()       # releases the telemetry dir claim so the
+            self.engine = None  # new engine reuses THIS host's dir
+        engine = self._build(new_world)
+        load_path, _client = engine.load_checkpoint(self.checkpoint_dir)
+        if load_path is None:
+            raise RescaleError(
+                "restore found no loadable checkpoint under "
+                "{!r}".format(self.checkpoint_dir))
+        if self.fingerprint_gate:
+            self._enroll(engine)
+        return engine
+
+    def _enroll(self, engine):
+        from ...analysis.concurrency.divergence import (
+            fingerprint_engine, publish_fingerprint)
+        fingerprint = fingerprint_engine(engine)
+        publish_fingerprint(engine, fingerprint)
+        tel = getattr(engine, "telemetry", None)
+        host_dir = getattr(tel, "output_dir", None) if tel is not None \
+            else None
+        run_dir = os.path.dirname(host_dir) if host_dir else None
+        host = os.path.basename(host_dir) if host_dir \
+            else socket.gethostname()
+        try:
+            return enroll_check(run_dir, host, fingerprint)
+        except EnrollmentRefused as err:
+            self._record("enroll_refused", str(err),
+                         new_world=self._mesh_shape(engine).get("data"),
+                         new_mesh=self._mesh_shape(engine),
+                         outcome="refused", detail=err.host)
+            raise
+
+    def close(self):
+        if self.engine is not None:
+            close = getattr(self.engine, "close", None)
+            if callable(close):
+                close()
+            self.engine = None

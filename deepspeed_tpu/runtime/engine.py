@@ -178,6 +178,12 @@ class DeepSpeedEngine:
         self._executor_mode = "serial" \
             if self._config.runtime_executor == "off" else "overlap"
         self._plan_executor = None
+        # elastic rescale trail (runtime/elastic/): an ElasticRunner
+        # swaps in its SHARED events list so the crash bundle's topology
+        # section survives engine rebuilds; a never-rescaled engine
+        # carries an empty history
+        self._rescale_history = []
+        self._onebit_pristine = None
         if self.telemetry is not None and \
                 self.telemetry.recorder is not None:
             # flight recorder context (docs/diagnostics.md): resolved at
@@ -186,6 +192,8 @@ class DeepSpeedEngine:
                 "ds_config", lambda: self._config._param_dict)
             self.telemetry.recorder.set_context(
                 "engine", self._flight_state)
+            self.telemetry.recorder.set_context(
+                "topology", self._topology_context)
         self._check_memory_breakdown()
 
         self.timers = SynchronizedWallClockTimer()
@@ -1793,6 +1801,21 @@ class DeepSpeedEngine:
             "jit_programs": sorted(str(k) for k in self._jit_cache),
         }
 
+    def _topology_context(self):
+        """Crash-bundle ``topology`` section (resolved at dump time):
+        which topology was LIVE at the crash, plus the elastic rescale
+        history shared across engine generations by an ElasticRunner."""
+        import jax
+        return {
+            "mesh": {str(k): int(v) for k, v in self.mesh.shape.items()},
+            "process_index": jax.process_index(),
+            "process_count": jax.process_count(),
+            "dp_world_size": self.dp_world_size,
+            "zero_plan": self.zero_plan.topology()
+            if getattr(self, "zero_plan", None) is not None else None,
+            "rescale_history": list(self._rescale_history),
+        }
+
     def _tele_crash(self, where, err):
         """Flight-recorder hook for unhandled step-path exceptions: dump
         a crash bundle (once per exception object — nested wrappers and
@@ -2694,6 +2717,15 @@ class DeepSpeedEngine:
                  "cur_iter": self.state["scaler"].cur_iter}),
             "lr_scheduler": self.lr_scheduler.state_dict()
                 if self.lr_scheduler is not None else None,
+            # qgZ error feedback (docs/zeropp.md): leaves are
+            # param-shaped, so the gathered tree reshards structurally
+            # on an elastic restore like master/opt do; the zero-sharded
+            # path carries it in the per-process shard files instead
+            "qg_error": ckpt.tree_to_numpy(self.state["qg_error"])
+                if (self.state is not None
+                    and self.state.get("qg_error") is not None
+                    and not offload_sharded and not zero_sharded)
+                else None,
             "csr_tensor_module_names": set(self.csr_tensor_module_names),
             "skipped_steps": self.skipped_steps,
             "global_steps": self.global_steps,
@@ -2701,6 +2733,15 @@ class DeepSpeedEngine:
             "dp_world_size": self.dp_world_size,
             "mp_world_size": self.mp_world_size,
         }
+        pristine = getattr(self, "_onebit_pristine", None)
+        if pristine is not None and \
+                pristine.get("steps") == self.global_steps:
+            # 1-bit elastic pass-through: no step has consumed the
+            # folded worker residuals since the resharded load, so the
+            # ORIGINAL per-worker rows are still the truth — re-emit
+            # them and a later rescale back to their world restores the
+            # error feedback bit-exactly (runtime/fp16/onebit_adam.py)
+            sd["onebit_pristine"] = pristine["payload"]
         if self.host_state is not None and "torn_step" in self.host_state:
             # a failed overlapped offload step left the host masters
             # PARTIALLY stepped (see _host_apply_step's disaster path);
@@ -2819,6 +2860,34 @@ class DeepSpeedEngine:
         self._drain_ckpt_writes()
         ckpt.wait_pending_writes()
 
+    def close(self):
+        """Tear this engine down for replacement (elastic rescale): land
+        in-flight checkpoint writes, stop the background upload worker,
+        release streamed-offload buffers, and close telemetry/monitor —
+        the collector's close() releases its claimed host directory so
+        the NEXT engine generation reuses the same telemetry dir
+        (append-mode JSONL keeps one continuous record stream).
+        Idempotent; the engine must not step afterwards."""
+        if getattr(self, "_closed", False):
+            return
+        self._closed = True
+        try:
+            self._drain_ckpt_writes()
+            ckpt.wait_pending_writes()
+        except BaseException:  # noqa: BLE001 - teardown must not mask
+            logger.warning("close: pending checkpoint writes failed",
+                           exc_info=True)
+        if getattr(self, "stream_runner", None) is not None:
+            self.stream_runner.release()
+        pool = getattr(self, "_h2d_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=True)
+            self._h2d_pool = None
+        if self.telemetry is not None:
+            self.telemetry.close()
+        if self.monitor is not None:
+            self.monitor.close()
+
     def _drain_ckpt_writes(self):
         """Block on any in-flight async checkpoint writes (re-raising the
         first background failure). Called before the next save, before a
@@ -2848,6 +2917,9 @@ class DeepSpeedEngine:
                       else ckpt.shard_lists_of_tree(val, is_writer))
                 for key, val in self.state["opt"].items()
             },
+            "qg_error": ckpt.shard_lists_of_tree(
+                self.state["qg_error"], is_writer)
+            if self.state.get("qg_error") is not None else None,
         }
         return payload
 
@@ -2942,6 +3014,10 @@ class DeepSpeedEngine:
                     [d["opt"][key] for d in device], "opt/" + key)
                 opt[key] = jax.tree_util.tree_unflatten(tmpl_def, leaves)
             sd["optimizer"] = opt
+        if device[0].get("qg_error") is not None:
+            qg = ckpt.assemble_shard_lists(
+                [d["qg_error"] for d in device], "qg_error")
+            sd["qg_error"] = jax.tree_util.tree_unflatten(params_def, qg)
 
     def _load_host_state(self, load_dir, tag, sd, load_optimizer_states,
                          load_from_fp32_weights):
@@ -3202,6 +3278,25 @@ class DeepSpeedEngine:
         if self.host_state is None and load_optimizer_states and \
                 sd.get("optimizer") is not None:
             opt = sd["optimizer"]
+            saved_dp = sd.get("dp_world_size")
+            pristine = sd.get("onebit_pristine")
+            reshard = getattr(self.optimizer, "reshard_state", None)
+            self._onebit_pristine = None
+            if callable(reshard) and saved_dp is not None and \
+                    int(saved_dp) != int(self.dp_world_size):
+                # elastic restore across world sizes: world-size-
+                # dependent subtrees (1-bit error feedback) are
+                # canonicalised to this engine's layout; world-agnostic
+                # ones pass through untouched
+                opt = reshard(opt, int(saved_dp), pristine=pristine)
+                pristine = getattr(self.optimizer, "_reshard_pristine",
+                                   pristine)
+            if pristine is not None:
+                # carry the original per-worker error rows until a step
+                # consumes them (save_checkpoint re-emits the sidecar
+                # only while global_steps is unchanged)
+                self._onebit_pristine = {"payload": pristine,
+                                         "steps": None}
             # shardings from each subtree's own leaf shapes (error buffers
             # etc. are not param-shaped)
             self.state["opt"] = {
@@ -3211,6 +3306,15 @@ class DeepSpeedEngine:
                     val, self._opt_state_shardings(key, val))
                 for key, val in opt.items()
             }
+
+        if sd.get("qg_error") is not None and self.state is not None \
+                and self.state.get("qg_error") is not None:
+            # param-shaped leaves: device_put onto the LIVE buffers'
+            # shardings reshards structurally across world sizes
+            self.state["qg_error"] = jax.tree_util.tree_map(
+                lambda x, live: jax.device_put(
+                    jnp.asarray(x, jnp.float32), live.sharding),
+                sd["qg_error"], self.state["qg_error"])
 
         if sd.get("scaler") is not None:
             sc = sd["scaler"]
@@ -3226,6 +3330,8 @@ class DeepSpeedEngine:
             self.lr_scheduler.load_state_dict(sd["lr_scheduler"])
 
         self.global_steps = sd.get("global_steps", 0)
+        if getattr(self, "_onebit_pristine", None) is not None:
+            self._onebit_pristine["steps"] = self.global_steps
         self.global_samples = sd.get(
             "global_samples", self.global_steps * self.train_batch_size())
         self.skipped_steps = sd.get("skipped_steps", 0)
@@ -3235,8 +3341,9 @@ class DeepSpeedEngine:
         self.loaded_checkpoint_dp_world_size = sd.get("dp_world_size")
 
         known = {"module", "optimizer", "master", "scaler", "lr_scheduler",
-                 "csr_tensor_module_names", "skipped_steps", "global_steps",
-                 "global_samples", "dp_world_size", "mp_world_size"}
+                 "qg_error", "onebit_pristine", "csr_tensor_module_names",
+                 "skipped_steps", "global_steps", "global_samples",
+                 "dp_world_size", "mp_world_size"}
         client_state = {k: v for k, v in sd.items() if k not in known}
         logger.info("Loaded checkpoint: {} @ global_step={}".format(
             path, self.global_steps))

@@ -149,6 +149,99 @@ class OnebitAdam(FusedAdam):
         return ["exp_avg", "exp_avg_sq", "worker_error", "server_error",
                 "step"]
 
+    def reshard_state(self, opt, saved_world, pristine=None):
+        """Canonicalise a gathered checkpoint state dict saved at
+        ``saved_world`` workers to THIS optimizer's world (the engine's
+        elastic-restore hook; called with numpy trees before placement).
+
+        The fused buffers are world-size dependent only through their
+        PADDING (``onebit_padded_size(numel, w)``) and, for the error
+        tensors, the per-worker row layout — the exchange masks every
+        lane >= numel to zero each step (comm/onebit.py), so truncating
+        to ``numel`` and re-padding is bitwise lossless:
+
+        * ``exp_avg``: truncate/re-pad the flat momentum — bitwise;
+        * ``server_error``: rows concatenate to one flat residual whose
+          chunk boundaries move with the world; truncate/re-pad/re-chunk
+          keeps every lane's value — bitwise per position;
+        * ``worker_error``: per-worker residuals are consumed
+          NONLINEARLY (each worker compresses its own ``m_i + we_i``),
+          so no M-row layout can stand in for a different N-row one
+          once a step runs. Two cases:
+
+          - ``pristine`` (the checkpoint's ``onebit_pristine`` sidecar:
+            the original per-worker rows, carried while NO step has
+            consumed them) matches this world → the exact decomposition
+            is reconstructed bit for bit: an 8→4→8 rescale with no
+            steps at 4 restores the 8-way rows exactly;
+          - otherwise the rows are summed in fixed index order and
+            folded into row 0 (rows 1..M-1 zero): the total residual —
+            the conserved quantity of error feedback — is preserved
+            bitwise, and the sidecar it stashes on ``self``
+            (``_reshard_pristine``) lets the engine re-emit the
+            original rows if this host saves before stepping.
+
+        World-agnostic subtrees (``step``, ``exp_avg_sq``) pass through
+        untouched. A same-world call (or a state dict without the fused
+        buffers — saved under a different optimizer) returns ``opt``
+        unchanged."""
+        import functools
+        if self._layout is None:
+            raise RuntimeError(
+                "OnebitAdam.reshard_state before init_state (the "
+                "flat-buffer layout supplies numel/padding)")
+        w_new = self.world_size
+        self._reshard_pristine = pristine
+        if int(saved_world) == w_new:
+            return opt
+        fused = ("exp_avg", "worker_error", "server_error")
+        if not all(isinstance(opt.get(k), dict) and "_flat" in opt[k]
+                   for k in fused):
+            return opt
+        numel, padded_new = self._layout.numel, self._layout.padded
+
+        def repad(flat):
+            flat = np.asarray(flat, np.float32).reshape(-1)[:numel]
+            out = np.zeros(padded_new, np.float32)
+            out[:numel] = flat
+            return out
+
+        out = dict(opt)
+        out["exp_avg"] = {"_flat": repad(opt["exp_avg"]["_flat"])}
+        out["server_error"] = {"_flat": repad(
+            opt["server_error"]["_flat"]).reshape(w_new,
+                                                  padded_new // w_new)}
+        if pristine is not None and \
+                int(pristine.get("world", -1)) == w_new:
+            # exact reconstruction: the original w_new-way rows rode
+            # the sidecar through the intermediate world untouched
+            rows = np.asarray(pristine["rows"], np.float32)
+            we = np.zeros((w_new, padded_new), np.float32)
+            we[:, :numel] = rows[:, :numel]
+            out["worker_error"] = {"_flat": we}
+            logger.info(
+                "OneBitAdam: resharded error-feedback state %d -> %d "
+                "workers (pristine %d-way worker residuals restored "
+                "bit-exactly)", int(saved_world), w_new, w_new)
+        else:
+            rows = [np.asarray(r, np.float32)
+                    for r in opt["worker_error"]["_flat"]]
+            total = functools.reduce(np.add, rows)  # fixed index order
+            we = np.zeros((w_new, padded_new), np.float32)
+            we[0] = repad(total)
+            out["worker_error"] = {"_flat": we}
+            if pristine is None:
+                self._reshard_pristine = {
+                    "world": int(saved_world),
+                    "rows": np.stack([r[:numel] for r in rows]),
+                }
+            logger.info(
+                "OneBitAdam: resharded error-feedback state %d -> %d "
+                "workers (momentum/server residual bitwise; worker "
+                "residuals folded to their sum, original rows kept as "
+                "the pristine sidecar)", int(saved_world), w_new)
+        return out
+
     # ------------------------------------------------------------- update
     def _exchange(self, gflat, m, we, se, beta1, wd_flat):
         """The frozen-phase compressed momentum exchange: per-worker

@@ -86,6 +86,20 @@ class ZeroShardingPlan:
     def replicated(self):
         return self._named(P())
 
+    def topology(self):
+        """JSON-able summary of the topology this plan shards for —
+        the rescale events' ``old_mesh``/``new_mesh`` payload and the
+        crash bundle's topology section share this shape, so a
+        post-mortem can diff two plans without reconstructing them."""
+        return {
+            "mesh": {str(k): int(v) for k, v in self.mesh.shape.items()},
+            "stage": int(self.stage),
+            "dp_size": int(self.dp_size),
+            "param_shard_size": int(self.param_shard_size),
+            "data_axes": [str(a) for a in self.data_axes],
+            "hierarchical": bool(self.hierarchical),
+        }
+
     def _tp_spec(self, path, shape):
         if self.model_spec_fn is None:
             return None

@@ -172,6 +172,17 @@ class StreamedOffloadRunner:
             "elements, terminal {:,})".format(
                 self.n_layers, len(groups), budget, terminal), ranks=[0])
 
+    def release(self):
+        """Drop this runner's compiled programs and live device buffers.
+        ``engine.close()`` calls it on elastic teardown so the outgoing
+        topology's HBM is free before the replacement engine compiles;
+        the runner stays structurally valid (a later step would simply
+        re-trace)."""
+        self._jit_cache.clear()
+        self._grad_bufs = None
+        self._micro_finites = []
+        self._micro_sumsqs = []
+
     # ------------------------------------------------------------- uploads
     def _start_upload(self, leaves):
         """Queue a segment's host leaves for coalesced upload to every

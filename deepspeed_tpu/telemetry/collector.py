@@ -196,6 +196,7 @@ class TelemetryCollector(AtexitCloseMixin):
         # thread), like the other PR 8 subsystems. The MetricsSink rides
         # the existing record stream: zero new hot-path instrumentation.
         self.fleet = None
+        self.elastic_observer = None
         self.metrics = None
         self.exporter = None
         # healthz() reads _wall_start and the exporter thread serves it
@@ -445,6 +446,21 @@ class TelemetryCollector(AtexitCloseMixin):
                 divergence.get("reference"))
         if self.watchdog is not None:
             self.watchdog.observe_fleet(report)
+        if self.elastic_observer is not None:
+            # the ElasticRunner's eviction policy rides the same live
+            # seam: k consecutive ingests flagging one host turn into a
+            # proactive rescale (runtime/elastic/, docs/elasticity.md)
+            try:
+                self.elastic_observer(report)
+            except Exception:  # noqa: BLE001 - an eviction decision
+                # must never poison the telemetry ingest path
+                logger.warning("elastic observer failed on fleet ingest",
+                               exc_info=True)
+
+    def set_elastic_observer(self, fn):
+        """Register a callable fed every ingested fleet report (the
+        ElasticRunner's ``observe_fleet``); pass None to detach."""
+        self.elastic_observer = fn
 
     def healthz(self):
         """The ``/healthz`` JSON payload: watchdog trips, rolling-window

@@ -20,12 +20,13 @@ from .aggregate import (CHROME_TRACE_NAME, FLEET_HOST_KEYS,
                         FLEET_REPORT_KEYS, FLEET_STEP_KEYS,
                         HOST_MANIFEST_KEYS, HostView, KIND_FLEET_REPORT,
                         KIND_FLEET_STEP, KIND_MANIFEST,
-                        MANIFEST_FINGERPRINT_KEY, MANIFEST_NAME,
-                        compare_fingerprints, discover_hosts,
-                        estimate_offsets, load_host, merge_chrome_traces,
-                        merge_records, merge_run, read_jsonl_tolerant,
-                        validate_fleet_record, validate_host_manifest,
-                        write_host_manifest)
+                        KIND_RESCALE_EVENT, MANIFEST_FINGERPRINT_KEY,
+                        MANIFEST_NAME, RESCALE_EVENT_KEYS,
+                        RESCALE_EVENTS_JSONL, compare_fingerprints,
+                        discover_hosts, estimate_offsets, load_host,
+                        merge_chrome_traces, merge_records, merge_run,
+                        read_jsonl_tolerant, validate_fleet_record,
+                        validate_host_manifest, write_host_manifest)
 from .export import MetricsExporter
 from .metrics import (FleetLocalState, Metric, MetricsRegistry,
                       MetricsSink, parse_prometheus_text)

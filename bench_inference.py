@@ -18,6 +18,17 @@ INTERLEAVED per the PR 5/6 microbench discipline, reporting p50/p95
 TTFT, p50/p95 per-output-token latency, and goodput (completed-request
 tokens/s). The artifact (default tests/perf/BENCH_SERVING.json) is
 validated by bin/check_bench_schema.py.
+
+``--disagg [--out PATH]`` runs the DISAGGREGATED rung (ISSUE 17): the
+same Zipf/Poisson trace at 10x the load (560 requests) against two
+configs at EQUAL aggregate KV budget — ``single`` (one paged chunked-
+prefill monolith owning the whole page budget) vs ``disagg`` (a
+DisaggServer fleet: 1 prefill host + 2 decode hosts on the simulated
+multi-host CPU mesh, KV moving over the serialized page-slice wire,
+placement through the SLO router). The artifact (default
+tests/perf/BENCH_SERVING_r17.json) carries the handoff/router evidence
+in ``extra.serving_trace.disagg`` and feeds bin/ds_scoreboard.py's
+serving trajectory gate.
 """
 import json
 import sys
@@ -307,7 +318,184 @@ def serving_trace_main(out_path):
     return 0
 
 
+# ---------------------------------------------------------------------
+# disaggregated rung (ISSUE 17): single paged monolith vs a 1-prefill +
+# 2-decode DisaggServer fleet at equal AGGREGATE page budget, 10x load
+# ---------------------------------------------------------------------
+
+DISAGG_REQUESTS = 560             # 10x the ISSUE 7 trace
+DISAGG_DECODE_HOSTS = 2
+
+
+def run_disagg_trace(server_factory, requests):
+    """Replay the trace against a fresh DisaggServer, mirroring
+    run_trace's arrival-anchored discipline: submit each request when
+    its offset elapses (TTFT anchored at the TRACE arrival), pump
+    ``server.step()`` continuously. Returns (metrics summary, server)."""
+    server = server_factory()
+    pending = sorted(requests, key=lambda r: r["arrival_s"])
+    t0 = time.perf_counter()
+    idx = 0
+    while idx < len(pending) or server.has_work:
+        now = time.perf_counter() - t0
+        while idx < len(pending) and pending[idx]["arrival_s"] <= now:
+            req = pending[idx]
+            server.submit(req["prompt"],
+                          max_new_tokens=req["max_new_tokens"],
+                          arrival_t=t0 + req["arrival_s"])
+            idx += 1
+        if server.has_work:
+            server.step()
+        elif idx < len(pending):
+            time.sleep(min(0.005, pending[idx]["arrival_s"] - now))
+    wall = time.perf_counter() - t0
+    snap = server.metrics.snapshot()
+    return {
+        "wall_seconds": round(wall, 3),
+        "goodput_tokens_per_sec": round(snap["completed_tokens"] / wall, 2),
+        "completed_requests": snap["completed_requests"],
+        "completed_tokens": snap["completed_tokens"],
+        "decode_tokens_per_sec": snap["decode_tokens_per_sec"],
+        "decode_steps": snap["decode_steps"],
+        "ttft_p50_s": snap["ttft"]["p50_s"],
+        "ttft_p95_s": snap["ttft"]["p95_s"],
+        "tpot_p50_s": snap["tpot"]["p50_s"],
+        "tpot_p95_s": snap["tpot"]["p95_s"],
+        "mean_slot_occupancy": snap["mean_slot_occupancy"],
+        "peak_queue_depth": snap["peak_queue_depth"],
+        "preemptions": server.preemptions,
+    }, server
+
+
+def disagg_trace_main(out_path):
+    import jax
+    import deepspeed_tpu as deepspeed
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.inference.fleet import DisaggServer
+    from deepspeed_tpu.utils.monitor import ServingMetrics
+
+    cfg = gpt2.GPT2Config(vocab_size=512, max_seq_len=TRACE_MAX_SEQ,
+                          n_layers=2, n_heads=4, d_model=128,
+                          use_flash_attention=False, remat=False)
+    model = gpt2.make_gpt2_model(config=cfg)
+    requests = build_trace(cfg.vocab_size, n_requests=DISAGG_REQUESTS)
+
+    # equal AGGREGATE budget: each fleet host owns a 64-page pool
+    # (63 usable + 1 garbage); the monolith owns the fleet's whole
+    # page count in one pool (191 usable + 1 garbage = 3 x 64)
+    per_host = HBM_BUDGET_TOKENS // TRACE_PAGE - 1
+    n_hosts = 1 + DISAGG_DECODE_HOSTS
+    base = {"max_seq_len": TRACE_MAX_SEQ, "dtype": "fp32", "greedy": True,
+            "prefill_buckets": [32, 64, 128, 256], "kv_layout": "paged",
+            "kv_block_size": TRACE_PAGE, "prefill_chunk_tokens": 64}
+    mono = deepspeed.init_inference(model=model, config={"inference": dict(
+        base, max_batch_size=12 * DISAGG_DECODE_HOSTS,
+        num_pages=n_hosts * (per_host + 1) - 1)})
+    pre = deepspeed.init_inference(model=model, config={"inference": dict(
+        base, max_batch_size=4, num_pages=per_host,
+        fleet={"enabled": True, "role": "prefill"})})
+    decs = [deepspeed.init_inference(model=model, config={"inference": dict(
+        base, max_batch_size=12, num_pages=per_host,
+        fleet={"enabled": True, "role": "decode"})})
+        for _ in range(DISAGG_DECODE_HOSTS)]
+    fleet_nbytes = pre.kv.nbytes + sum(d.kv.nbytes for d in decs)
+    assert mono.kv.nbytes == fleet_nbytes, (mono.kv.nbytes, fleet_nbytes)
+
+    def make_server():
+        return DisaggServer(
+            {"prefill0": pre},
+            {"decode{}".format(i): d for i, d in enumerate(decs)},
+            metrics=ServingMetrics())
+
+    # warmup: compile every bucket + the decode fns off the clock, on
+    # the monolith AND through the fleet wire
+    warm = requests[:len(base["prefill_buckets"])]
+    mono.generate([r["prompt"] for r in warm], max_new_tokens=8)
+    warm_server = make_server()
+    for req in warm:
+        warm_server.submit(req["prompt"], max_new_tokens=8)
+    warm_server.run()
+
+    rounds = 3                  # odd: the middle of the sort IS a median
+    singles, disaggs, servers = [], [], []
+    for _ in range(rounds):
+        # interleaved rounds: machine drift hits every config equally
+        singles.append(run_trace(mono, requests))
+        result, server = run_disagg_trace(make_server, requests)
+        disaggs.append(result)
+        servers.append(server)
+
+    def median_i(runs):
+        order = sorted(range(len(runs)),
+                       key=lambda i: runs[i]["goodput_tokens_per_sec"])
+        return order[len(runs) // 2]
+
+    mi = median_i(disaggs)
+    configs = {"single": singles[median_i(singles)], "disagg": disaggs[mi]}
+    server = servers[mi]
+    stats = server.handoff_stats()
+    ratio = (configs["disagg"]["goodput_tokens_per_sec"] /
+             configs["single"]["goodput_tokens_per_sec"])
+    payload = {
+        "metric": "gpt2_serving_disagg_goodput_ratio_vs_single",
+        "value": round(ratio, 3),
+        "unit": "x",
+        # acceptance floor: the fleet holds >= 0.8x the monolith's
+        # goodput at equal aggregate budget while paying the real
+        # serialized-handoff wire cost (its win is TTFT isolation)
+        "vs_baseline": round(ratio / 0.8, 4),
+        "extra": {
+            "serving_trace": {
+                "trace": {"requests": len(requests), "seed": TRACE_SEED,
+                          "prompt_len_max": max(len(r["prompt"])
+                                                for r in requests),
+                          "output_len_max": max(r["max_new_tokens"]
+                                                for r in requests),
+                          "span_s": round(requests[-1]["arrival_s"], 2)},
+                "hbm_budget_tokens": n_hosts * HBM_BUDGET_TOKENS,
+                "kv_bytes_per_config": mono.kv.nbytes,
+                "rounds": rounds,
+                "configs": configs,
+                "disagg": {
+                    "prefill_hosts": 1,
+                    "decode_hosts": DISAGG_DECODE_HOSTS,
+                    "handoff": {"handoffs": stats["handoffs"],
+                                "payload_bytes": stats["payload_bytes"]},
+                    "router_decisions": server.router.decision_counts(),
+                },
+            },
+            "ttft_p95_ratio_single_vs_disagg": round(
+                configs["single"]["ttft_p95_s"] /
+                max(configs["disagg"]["ttft_p95_s"], 1e-9), 3),
+            "device": getattr(jax.devices()[0], "device_kind", "cpu"),
+            "backend": jax.default_backend(),
+        },
+    }
+    line = json.dumps(payload)
+    print(line)
+    if out_path:
+        with open(out_path, "w") as fh:
+            fh.write(line + "\n")
+    return 0
+
+
 if __name__ == "__main__":
+    if "--disagg" in sys.argv:
+        out = "tests/perf/BENCH_SERVING_r17.json"
+        if "--out" in sys.argv:
+            idx = sys.argv.index("--out") + 1
+            if idx >= len(sys.argv):
+                emit_error_json(
+                    "gpt2_serving_disagg_goodput_ratio_vs_single",
+                    ValueError("--out needs a path argument"))
+                sys.exit(1)
+            out = sys.argv[idx]
+        try:
+            sys.exit(disagg_trace_main(out))
+        except Exception as err:  # noqa: BLE001 - parseable JSON always
+            emit_error_json("gpt2_serving_disagg_goodput_ratio_vs_single",
+                            err)
+            sys.exit(1)
     if "--serving-trace" in sys.argv:
         out = "tests/perf/BENCH_SERVING.json"
         if "--out" in sys.argv:

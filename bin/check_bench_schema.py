@@ -58,6 +58,21 @@ SERVING_SUBDICT_KEYS = {
     "speculative": ("proposed", "accepted", "acceptance_rate"),
 }
 
+# Local copy of telemetry/record.py SERVING_ROLES (ISSUE 17): the
+# closed role vocabulary a serving_step record / fleet host summary may
+# carry. Pinned equal by tests/unit/test_serving_fleet.py.
+SERVING_ROLES = ("monolith", "prefill", "decode", "router")
+
+# Local copies of inference/fleet/events.py ROUTER_EVENT_KEYS /
+# ROUTER_DECISIONS (same stdlib-only constraint; pinned equal by
+# tests/unit/test_serving_fleet.py).
+ROUTER_EVENT_KEYS = (
+    "kind", "wall", "decision", "request_uid", "host", "reason",
+    "predicted_cost_s", "detail",
+)
+ROUTER_DECISIONS = ("admit", "deny", "route_away", "preempt_migrate",
+                    "enroll", "enroll_refusal")
+
 # Local copy of telemetry/record.py SEGMENT_KEYS /
 # SEGMENT_KIND_KEYS / SEGMENT_OPTIONAL_KEYS (same stdlib-only
 # constraint; pinned equal by tests/unit/test_executor.py): the
@@ -243,6 +258,32 @@ def check_scoreboard(payload):
         val = payload.get(key)
         if val is not None and not _is_num(val):
             problems.append("{} is neither null nor a number".format(key))
+    serving = payload.get("serving")
+    if serving is not None:
+        # disaggregated-serving trajectory (ISSUE 17): goodput/p95-TTFT
+        # rungs over BENCH_SERVING*.json with the same >10% gate
+        if not isinstance(serving, dict):
+            problems.append("serving is neither null nor a dict")
+            return problems
+        srows = serving.get("rows")
+        if not isinstance(srows, list):
+            problems.append("serving.rows is not a list")
+        else:
+            for i, row in enumerate(srows):
+                if not isinstance(row, dict):
+                    problems.append(
+                        "serving.rows[{}] is not an object".format(i))
+                    break
+                for key in ("rung", "file", "config", "device",
+                            "goodput_tokens_per_sec", "ttft_p95_s"):
+                    if key not in row:
+                        problems.append(
+                            "serving.rows[{}] missing {!r}".format(
+                                i, key))
+                if problems:
+                    break
+        if not isinstance(serving.get("regression"), bool):
+            problems.append("serving.regression is not a bool")
     return problems
 
 
@@ -262,8 +303,11 @@ def check_serving_trace(trace):
     configs = trace.get("configs")
     if not isinstance(configs, dict) or not configs:
         return ["serving_trace.configs is not a non-empty dict"]
-    if "slot" not in configs:
-        problems.append("serving_trace.configs lacks the 'slot' baseline")
+    # 'slot' is the single-engine trace's baseline; the disaggregated
+    # trace (ISSUE 17) compares against the 'single' paged monolith
+    if "slot" not in configs and "single" not in configs:
+        problems.append("serving_trace.configs lacks a baseline "
+                        "('slot' or 'single')")
     for name, cfg in configs.items():
         if not isinstance(cfg, dict):
             problems.append(
@@ -276,6 +320,31 @@ def check_serving_trace(trace):
                     "{!r}".format(name, key, cfg.get(key)))
     if not _is_num(trace.get("hbm_budget_tokens")):
         problems.append("serving_trace.hbm_budget_tokens is not a number")
+    disagg = trace.get("disagg")
+    if disagg is not None:
+        # the disaggregated rung's router/handoff evidence (ISSUE 17)
+        if not isinstance(disagg, dict):
+            problems.append("serving_trace.disagg is not a dict")
+            return problems
+        handoff = disagg.get("handoff")
+        if not isinstance(handoff, dict):
+            problems.append("serving_trace.disagg.handoff is not a dict")
+        else:
+            for key in ("handoffs", "payload_bytes"):
+                if not _is_num(handoff.get(key)):
+                    problems.append(
+                        "serving_trace.disagg.handoff.{} is not a "
+                        "number".format(key))
+        decisions = disagg.get("router_decisions")
+        if not isinstance(decisions, dict):
+            problems.append(
+                "serving_trace.disagg.router_decisions is not a dict")
+        else:
+            unknown = sorted(set(decisions) - set(ROUTER_DECISIONS))
+            if unknown:
+                problems.append(
+                    "serving_trace.disagg.router_decisions has unknown "
+                    "decision(s) {}".format(unknown))
     return problems
 
 
@@ -465,6 +534,7 @@ def check_analysis_report(payload):
 FLEET_REPORT_KEYS = (
     "kind", "run_dir", "n_hosts", "hosts", "offsets", "records", "gaps",
     "straggler", "ici_health", "trace", "divergence", "rescale",
+    "router",
 )
 # Local copy of runtime/elastic/events.py RESCALE_EVENT_KEYS (same
 # stdlib-only constraint; pinned equal by
@@ -582,6 +652,44 @@ def check_fleet_report(payload):
                     problems.append(
                         "rescale.events[{}] missing {}".format(
                             i, missing))
+                    break
+    router = payload.get("router")
+    if not isinstance(router, dict):
+        problems.append("router is not a dict")
+    else:
+        if not isinstance(router.get("count"), int) or \
+                isinstance(router.get("count"), bool):
+            problems.append("router.count is not an int")
+        decisions = router.get("decisions")
+        if not isinstance(decisions, dict):
+            problems.append("router.decisions is not a dict")
+        else:
+            unknown = sorted(set(decisions) - set(ROUTER_DECISIONS))
+            if unknown:
+                problems.append(
+                    "router.decisions has unknown decision(s) "
+                    "{}".format(unknown))
+        events = router.get("events")
+        if not isinstance(events, list):
+            problems.append("router.events is not a list")
+        else:
+            for i, ev in enumerate(events):
+                if not isinstance(ev, dict) or \
+                        ev.get("kind") != "router_event":
+                    problems.append(
+                        "router.events[{}] is not a router_event"
+                        .format(i))
+                    break
+                missing = [k for k in ROUTER_EVENT_KEYS if k not in ev]
+                if missing:
+                    problems.append(
+                        "router.events[{}] missing {}".format(
+                            i, missing))
+                    break
+                if ev.get("decision") not in ROUTER_DECISIONS:
+                    problems.append(
+                        "router.events[{}] has unknown decision "
+                        "{!r}".format(i, ev.get("decision")))
                     break
     return problems
 

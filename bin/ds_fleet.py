@@ -52,15 +52,23 @@ def print_report(report):
     print("fleet report: {} host(s), {} merged step(s)  [{}]".format(
         report["n_hosts"], len(report["records"]), report["run_dir"]))
     print()
-    print("{:<24} {:>6} {:>8} {:>9} {:>8}  {}".format(
-        "host", "steps", "offset_s", "crashed", "manifest", "gaps"))
+    print("{:<24} {:>6} {:>8} {:>9} {:>8} {:>16}  {}".format(
+        "host", "steps", "offset_s", "crashed", "manifest", "roles",
+        "gaps"))
     offsets = report["offsets"]
     for host in report["hosts"]:
-        print("{:<24} {:>6} {:>8} {:>9} {:>8}  {}".format(
+        # serving-role attribution (ISSUE 17): per-role serving_step
+        # counts, so a disaggregated fleet's prefill/decode split is
+        # visible in the host table
+        roles = host.get("serving_roles") or {}
+        role_str = ",".join("{}:{}".format(r, n)
+                            for r, n in sorted(roles.items())) or "-"
+        print("{:<24} {:>6} {:>8} {:>9} {:>8} {:>16}  {}".format(
             host["name"], host["steps"],
             "{:+.3f}".format(offsets.get(host["name"], 0.0)),
             "yes" if host["crashed"] else "no",
             "yes" if host["manifest"] else "MISSING",
+            role_str,
             "; ".join(host["gaps"]) or "-"))
     if report["records"]:
         last = report["records"][-1]
@@ -139,6 +147,31 @@ def print_report(report):
                 " ({})".format(", ".join(extras)) if extras else ""))
     else:
         print("no rescale events (the run never changed topology)")
+    router = report.get("router") or {}
+    print()
+    if router.get("events"):
+        decisions = router.get("decisions") or {}
+        print("ROUTER DECISIONS ({} event(s): {}; docs/fleet.md):".format(
+            router.get("count", 0),
+            ", ".join("{} {}".format(n, d)
+                      for d, n in sorted(decisions.items()))))
+        for ev in router["events"]:
+            extras = []
+            if ev.get("request_uid") is not None:
+                extras.append("req {}".format(ev["request_uid"]))
+            if ev.get("predicted_cost_s") is not None:
+                extras.append("cost {:.4f}s".format(
+                    ev["predicted_cost_s"]))
+            detail = ev.get("detail") or {}
+            if detail.get("to"):
+                extras.append("-> {}".format(detail["to"]))
+            print("  - [{}] {:<16} {}{}".format(
+                ev.get("host") or "-", ev.get("decision", "?"),
+                ev.get("reason", ""),
+                " ({})".format(", ".join(extras)) if extras else ""))
+    else:
+        print("no router decisions (the run served without a fleet "
+              "front-end)")
 
 
 def main(argv=None):

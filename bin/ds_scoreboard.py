@@ -15,6 +15,12 @@ keeps the MFU trajectory from silently decaying. Failed rungs (rc != 0
 / ``value: null``) stay in the table with their error, excluded from
 the regression math.
 
+Serving rungs (``BENCH_SERVING*.json``, swept from ``tests/perf/`` and
+the repo root) get their own trajectory: per-config goodput / p95 TTFT
+rows plus the same >10% same-device gate — goodput falling or p95 TTFT
+rising past the threshold against the best prior rung exits 1 (CPU
+rungs exempt unless ``--gate-cpu``).
+
 Repo-root ``BENCH_r*.json`` files are driver run records
 (``{"n", "cmd", "rc", "tail"}``) whose bench JSON line is embedded in
 the tail — the same unwrap ``bin/check_bench_schema.py`` applies.
@@ -37,6 +43,14 @@ SCOREBOARD_ROW_KEYS = (
     "rung", "file", "rc", "metric", "value", "unit", "mfu",
     "tokens_per_sec_per_chip", "goodput_tokens_per_sec", "reduction_x",
     "device", "error",
+)
+
+# every serving-trajectory row (one per BENCH_SERVING*.json config)
+# carries exactly these keys — check_bench_schema.check_scoreboard
+# pins them on the artifact
+SERVING_ROW_KEYS = (
+    "rung", "file", "config", "device",
+    "goodput_tokens_per_sec", "ttft_p95_s",
 )
 
 
@@ -110,7 +124,128 @@ def load_rung(path):
     return row
 
 
-def build_scoreboard(paths, regression_pct=10.0, gate_cpu=False):
+def _serving_rung_index(path, payload):
+    m = re.search(r"BENCH_SERVING_r(\d+)", os.path.basename(path))
+    if m:
+        return int(m.group(1))
+    if isinstance(payload.get("n"), int):
+        return payload["n"]
+    return -1
+
+
+def load_serving_rung(path):
+    """-> list of serving-trajectory rows (one per serving_trace
+    config) for one BENCH_SERVING*.json file. Files without a
+    serving_trace yield no rows (they were a failed or foreign rung)."""
+    with open(path) as fh:
+        payload = json.load(fh)
+    inner = unwrap_driver_record(payload) if "tail" in payload \
+        else payload
+    if inner is None:
+        return []
+    extra = inner.get("extra") or {}
+    trace = extra.get("serving_trace") or {}
+    rung = _serving_rung_index(path, payload)
+    rows = []
+    for name, cfg in sorted((trace.get("configs") or {}).items()):
+        if not isinstance(cfg, dict):
+            continue
+        rows.append({
+            "rung": rung,
+            "file": os.path.basename(path),
+            "config": name,
+            "device": extra.get("device"),
+            "goodput_tokens_per_sec": cfg.get("goodput_tokens_per_sec"),
+            "ttft_p95_s": cfg.get("ttft_p95_s"),
+        })
+    return rows
+
+
+def build_serving_board(paths, regression_pct=10.0, gate_cpu=False):
+    """Serving regression gate (ISSUE 17): the newest rung's headline
+    numbers against the best PRIOR rung of the same device kind —
+    goodput (higher-better) must not drop more than ``regression_pct``
+    below the best prior, and p95 TTFT (lower-better) must not rise
+    more than ``regression_pct`` above the best prior. A rung's
+    headline is its best config (max goodput / min ttft_p95 across the
+    configs it measured), so adding a slower comparison config never
+    trips the gate."""
+    rows = []
+    for path in sorted(paths):
+        rows.extend(load_serving_rung(path))
+    rows.sort(key=lambda r: (r["rung"], r["file"], r["config"]))
+    per_rung = {}
+    for row in rows:
+        key = (row["rung"], row["file"])
+        slot = per_rung.setdefault(key, {
+            "rung": row["rung"], "file": row["file"],
+            "device": row["device"], "goodput": None, "ttft_p95": None})
+        val = row["goodput_tokens_per_sec"]
+        if val is not None and (slot["goodput"] is None or
+                                val > slot["goodput"]):
+            slot["goodput"] = val
+        val = row["ttft_p95_s"]
+        if val is not None and (slot["ttft_p95"] is None or
+                                val < slot["ttft_p95"]):
+            slot["ttft_p95"] = val
+    rungs = [per_rung[k] for k in sorted(per_rung)
+             if per_rung[k]["goodput"] is not None]
+    latest = rungs[-1] if rungs else None
+    regression = False
+    gate = None
+    best_prior = None
+    if latest is not None:
+        same_device = [r for r in rungs[:-1]
+                       if r["device"] == latest["device"]]
+        if latest["device"] == "cpu" and not gate_cpu:
+            gate = "skipped: latest serving rung is a cpu-fallback " \
+                   "rung (pass --gate-cpu to include)"
+        elif not same_device:
+            gate = "skipped: no prior serving rung on device " \
+                   "{!r}".format(latest["device"])
+        else:
+            best_prior = {
+                "rung": max(same_device,
+                            key=lambda r: r["goodput"])["rung"],
+                "goodput": max(r["goodput"] for r in same_device),
+                "ttft_p95": min((r["ttft_p95"] for r in same_device
+                                 if r["ttft_p95"] is not None),
+                                default=None),
+            }
+            frac = regression_pct / 100.0
+            goodput_bad = latest["goodput"] < \
+                best_prior["goodput"] * (1.0 - frac)
+            ttft_bad = (latest["ttft_p95"] is not None and
+                        best_prior["ttft_p95"] is not None and
+                        latest["ttft_p95"] >
+                        best_prior["ttft_p95"] * (1.0 + frac))
+            regression = goodput_bad or ttft_bad
+            if regression:
+                gate = "tripped: " + ", ".join(
+                    name for name, bad in (("goodput", goodput_bad),
+                                           ("ttft_p95", ttft_bad))
+                    if bad)
+            else:
+                gate = "passed"
+    return {
+        "rows": rows,
+        "measured_rungs": len(rungs),
+        "latest_rung": latest["rung"] if latest else None,
+        "latest_goodput": latest["goodput"] if latest else None,
+        "latest_ttft_p95_s": latest["ttft_p95"] if latest else None,
+        "best_prior_rung": best_prior["rung"] if best_prior else None,
+        "best_prior_goodput": best_prior["goodput"]
+        if best_prior else None,
+        "best_prior_ttft_p95_s": best_prior["ttft_p95"]
+        if best_prior else None,
+        "regression_pct": regression_pct,
+        "regression": regression,
+        "gate": gate,
+    }
+
+
+def build_scoreboard(paths, regression_pct=10.0, gate_cpu=False,
+                     serving_paths=None):
     """MFU regression gate: the newest measured rung against the best
     PRIOR rung **of the same device kind** — MFU is a fraction of that
     chip's peak, so a TPU rung never gates against a CPU one. CPU
@@ -138,9 +273,13 @@ def build_scoreboard(paths, regression_pct=10.0, gate_cpu=False):
             regression = latest["mfu"] < \
                 best_prior["mfu"] * (1.0 - regression_pct / 100.0)
             gate = "tripped" if regression else "passed"
+    serving = build_serving_board(
+        serving_paths, regression_pct=regression_pct,
+        gate_cpu=gate_cpu) if serving_paths else None
     return {
         "kind": KIND_SCOREBOARD,
         "rows": rows,
+        "serving": serving,
         "measured_rungs": len(measured),
         "best_prior_mfu": best_prior["mfu"] if best_prior else None,
         "best_prior_rung": best_prior["rung"] if best_prior else None,
@@ -195,6 +334,46 @@ def render_markdown(board):
                          _fmt(board["latest_mfu"]),
                          _fmt(board["best_prior_mfu"]),
                          board["gate"] or "n/a"))
+    serving = board.get("serving")
+    if serving and serving["rows"]:
+        lines += [
+            "",
+            "## Serving trajectory",
+            "",
+            "| rung | file | config | goodput tok/s | ttft p95 s | "
+            "device |",
+            "|---:|---|---|---:|---:|---|",
+        ]
+        for row in serving["rows"]:
+            lines.append(
+                "| {rung} | {file} | {config} | {goodput} | {ttft} | "
+                "{device} |".format(
+                    rung=row["rung"], file=row["file"],
+                    config=row["config"],
+                    goodput=_fmt(row["goodput_tokens_per_sec"],
+                                 "{:.1f}"),
+                    ttft=_fmt(row["ttft_p95_s"], "{:.4f}"),
+                    device=row["device"] or "-"))
+        lines.append("")
+        if serving["regression"]:
+            lines.append(
+                "**SERVING REGRESSION**: rung {} goodput {} / ttft_p95 "
+                "{} against best prior rung {} (goodput {}, ttft_p95 "
+                "{}) breaches the {}% gate ({}).".format(
+                    serving["latest_rung"],
+                    _fmt(serving["latest_goodput"], "{:.1f}"),
+                    _fmt(serving["latest_ttft_p95_s"], "{:.4f}"),
+                    serving["best_prior_rung"],
+                    _fmt(serving["best_prior_goodput"], "{:.1f}"),
+                    _fmt(serving["best_prior_ttft_p95_s"], "{:.4f}"),
+                    serving["regression_pct"], serving["gate"]))
+        else:
+            lines.append(
+                "Serving trajectory healthy: latest goodput {} tok/s, "
+                "ttft_p95 {} s (gate {}).".format(
+                    _fmt(serving["latest_goodput"], "{:.1f}"),
+                    _fmt(serving["latest_ttft_p95_s"], "{:.4f}"),
+                    serving["gate"] or "n/a"))
     return "\n".join(lines) + "\n"
 
 
@@ -213,14 +392,26 @@ def main(argv=None):
                              "rungs too (off: cpu MFU swings with box "
                              "co-tenancy)")
     args = parser.parse_args(argv)
-    paths = args.paths or sorted(glob.glob(
-        os.path.join(_REPO, "BENCH_r*.json")))
+    # serving rungs (BENCH_SERVING*.json) ride along whatever path list
+    # is in play: explicitly passed ones are split out by name, and the
+    # default glob also sweeps tests/perf + the repo root for them
+    explicit = args.paths or []
+    serving_paths = [p for p in explicit
+                     if os.path.basename(p).startswith("BENCH_SERVING")]
+    paths = [p for p in explicit if p not in serving_paths]
+    if not explicit:
+        paths = sorted(glob.glob(os.path.join(_REPO, "BENCH_r*.json")))
+        serving_paths = sorted(
+            glob.glob(os.path.join(_REPO, "tests", "perf",
+                                   "BENCH_SERVING*.json")) +
+            glob.glob(os.path.join(_REPO, "BENCH_SERVING*.json")))
     if not paths:
         print("ds_scoreboard: no BENCH_r*.json rungs found",
               file=sys.stderr)
         return 1
     board = build_scoreboard(paths, regression_pct=args.regression_pct,
-                             gate_cpu=args.gate_cpu)
+                             gate_cpu=args.gate_cpu,
+                             serving_paths=serving_paths)
     md = render_markdown(board)
     if args.json_out:
         with open(args.json_out, "w") as fh:
@@ -231,6 +422,11 @@ def main(argv=None):
     print(md, end="")
     if board["regression"]:
         print("ds_scoreboard: REGRESSION gate tripped (>{}% MFU drop)"
+              .format(args.regression_pct), file=sys.stderr)
+        return 1
+    if board.get("serving") and board["serving"]["regression"]:
+        print("ds_scoreboard: SERVING regression gate tripped (>{}% "
+              "goodput drop or ttft_p95 rise)"
               .format(args.regression_pct), file=sys.stderr)
         return 1
     return 0

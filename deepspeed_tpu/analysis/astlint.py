@@ -55,6 +55,14 @@ AFTER the cost is paid:
     constructed without a ``daemon=`` keyword: the thread's lifetime is
     undeclared, and a non-daemon thread with no join/close path holds
     the interpreter open on every crash (docs/concurrency.md).
+  * **DSL010 serving-field-outside-schema** — a dict literal tagged
+    ``"kind": "serving_step"`` carrying a string key that is NOT in
+    telemetry/record.py's pinned ``SERVING_STEP_KEYS`` /
+    ``SERVING_SUBDICT_KEYS`` tables: a hand-rolled serving record with
+    a freelance field ships a schema drift the validators then chase
+    (record.py itself is exempt — it IS the schema; the rule is inert
+    when the schema file is absent, so partial checkouts never
+    false-fail).
 
 Violations key as ``DSL###:<relpath>::<qualname>`` and count per key —
 the committed baseline file maps keys to accepted counts, so existing
@@ -76,6 +84,7 @@ LINT_RULES = {
     "DSL007": "metric-name-outside-catalog",
     "DSL008": "guarded-mutation-outside-lock",
     "DSL009": "thread-without-daemon-story",
+    "DSL010": "serving-field-outside-schema",
 }
 
 # DSL008: mutating container methods (the static twin of the dynamic
@@ -111,6 +120,44 @@ def load_metric_catalog(base):
         return None
     with open(path) as fh:
         return fh.read()
+
+
+# DSL010: the module that IS the serving-record schema (exempt from
+# the rule), and the two pinned tables the rule reads out of it
+_SERVING_SCHEMA_MODULE = "deepspeed_tpu/telemetry/record.py"
+
+
+def load_serving_schema(base):
+    """The serving-record field vocabulary (SERVING_STEP_KEYS +
+    SERVING_SUBDICT_KEYS keys), AST-read from telemetry/record.py —
+    None (DSL010 inert) when the schema file is absent or unreadable
+    so partial checkouts never false-fail."""
+    path = os.path.join(base or ".", *_SERVING_SCHEMA_MODULE.split("/"))
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        try:
+            tree = ast.parse(fh.read(), filename=path)
+        except SyntaxError:
+            return None
+    fields = set()
+    for node in ast.iter_child_nodes(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        names = {t.id for t in node.targets if isinstance(t, ast.Name)}
+        if "SERVING_STEP_KEYS" in names and \
+                isinstance(node.value, (ast.Tuple, ast.List)):
+            fields.update(
+                elt.value for elt in node.value.elts
+                if isinstance(elt, ast.Constant) and
+                isinstance(elt.value, str))
+        if "SERVING_SUBDICT_KEYS" in names and \
+                isinstance(node.value, ast.Dict):
+            fields.update(
+                k.value for k in node.value.keys
+                if isinstance(k, ast.Constant) and
+                isinstance(k.value, str))
+    return frozenset(fields) or None
 
 # DSL005: the one directory kernels may live in
 _OPS_PREFIX = "deepspeed_tpu/ops/"
@@ -269,6 +316,29 @@ class _FunctionLint(ast.NodeVisitor):
             self.telemetry_uses.append(node.lineno)
         self.generic_visit(node)
 
+    # ------------------------------------------------------------ DSL010
+    def visit_Dict(self, node):
+        schema = self.linter.serving_schema
+        if schema is not None and not self.linter.is_serving_schema:
+            keys = {}
+            for k, v in zip(node.keys, node.values):
+                if isinstance(k, ast.Constant) and \
+                        isinstance(k.value, str):
+                    keys[k.value] = v
+            kind = keys.get("kind")
+            if isinstance(kind, ast.Constant) and \
+                    kind.value == "serving_step":
+                for name in sorted(set(keys) - set(schema)):
+                    self.linter.report(
+                        "DSL010", self.qualname, node.lineno,
+                        "serving_step record literal carries field "
+                        "{!r} outside telemetry/record.py's pinned "
+                        "SERVING_STEP_KEYS/SERVING_SUBDICT_KEYS — "
+                        "extend the schema tables (and their stdlib "
+                        "copies) instead of freelancing a "
+                        "field".format(name))
+        self.generic_visit(node)
+
     def visit_Call(self, node):
         fn = node.func
         chain = _attr_chain(fn) if isinstance(fn, ast.Attribute) else ""
@@ -359,12 +429,15 @@ class _FunctionLint(ast.NodeVisitor):
 
 
 class FileLinter:
-    def __init__(self, relpath, metric_catalog=None):
+    def __init__(self, relpath, metric_catalog=None,
+                 serving_schema=None):
         self.relpath = relpath
         norm = relpath.replace(os.sep, "/")
         self.in_ops = norm.startswith(_OPS_PREFIX)
         self.in_executor = norm.startswith(_EXECUTOR_PREFIX)
         self.metric_catalog = metric_catalog
+        self.serving_schema = serving_schema
+        self.is_serving_schema = norm == _SERVING_SCHEMA_MODULE
         self.violations = []       # [(rule, qualname, lineno, message)]
 
     def report(self, rule, qualname, lineno, message):
@@ -418,7 +491,8 @@ class FileLinter:
         return self.violations
 
 
-def lint_file(path, relpath=None, metric_catalog=None):
+def lint_file(path, relpath=None, metric_catalog=None,
+              serving_schema=None):
     relpath = relpath or path
     with open(path) as fh:
         source = fh.read()
@@ -427,15 +501,19 @@ def lint_file(path, relpath=None, metric_catalog=None):
     except SyntaxError as err:
         return [("DSL000", "<module>", getattr(err, "lineno", 0),
                  "unparseable: {}".format(err))]
-    return FileLinter(relpath, metric_catalog=metric_catalog).run(tree)
+    return FileLinter(relpath, metric_catalog=metric_catalog,
+                      serving_schema=serving_schema).run(tree)
 
 
-def lint_paths(paths, base=None, metric_catalog=None):
+def lint_paths(paths, base=None, metric_catalog=None,
+               serving_schema=None):
     """-> {key: [Finding, ...]} over every .py file under ``paths``
     (key = 'RULE:relpath::qualname'; ``base`` anchors the relpaths —
     pass the repo root so baseline keys are stable under any cwd).
     ``metric_catalog``: DSL007's documented-name text; defaults to
-    ``base``/docs/fleet.md when present."""
+    ``base``/docs/fleet.md when present. ``serving_schema``: DSL010's
+    field vocabulary; defaults to the tables AST-read from
+    ``base``/deepspeed_tpu/telemetry/record.py when present."""
     findings = {}
     files = []
     for root in paths:
@@ -448,10 +526,13 @@ def lint_paths(paths, base=None, metric_catalog=None):
     base = base or os.getcwd()
     if metric_catalog is None:
         metric_catalog = load_metric_catalog(base)
+    if serving_schema is None:
+        serving_schema = load_serving_schema(base)
     for path in sorted(files):
         rel = os.path.relpath(path, base)
         for rule, qual, lineno, message in lint_file(
-                path, rel, metric_catalog=metric_catalog):
+                path, rel, metric_catalog=metric_catalog,
+                serving_schema=serving_schema):
             key = "{}:{}::{}".format(rule, rel.replace(os.sep, "/"), qual)
             findings.setdefault(key, []).append(Finding(
                 rule=rule, check=LINT_RULES.get(rule, rule),

@@ -103,6 +103,23 @@ SPEC_KNOWN_KEYS = {SPEC_ENABLED, SPEC_METHOD, SPEC_NUM_DRAFT_TOKENS,
                    SPEC_NGRAM_MAX, SPEC_NGRAM_MIN}
 _SPEC_METHODS = ("ngram", "model")
 
+# ---- disaggregated serving fleet (docs/inference.md, docs/fleet.md) --
+INFERENCE_FLEET = "fleet"
+FLEET_ENABLED = "enabled"
+FLEET_ROLE = "role"                       # null | "prefill" | "decode"
+FLEET_HANDOFF_QUANTIZE = "handoff_quantize"
+FLEET_HANDOFF_BLOCK_SIZE = "handoff_block_size"
+FLEET_TTFT_SLO_S = "ttft_slo_s"
+FLEET_TPOT_SLO_S = "tpot_slo_s"
+FLEET_ADMIT_BUDGET_FACTOR = "admit_budget_factor"
+FLEET_MAX_ADAPTERS = "max_adapters"
+FLEET_ADAPTER_RANK = "adapter_rank"
+FLEET_KNOWN_KEYS = {FLEET_ENABLED, FLEET_ROLE, FLEET_HANDOFF_QUANTIZE,
+                    FLEET_HANDOFF_BLOCK_SIZE, FLEET_TTFT_SLO_S,
+                    FLEET_TPOT_SLO_S, FLEET_ADMIT_BUDGET_FACTOR,
+                    FLEET_MAX_ADAPTERS, FLEET_ADAPTER_RANK}
+_FLEET_ROLES = ("prefill", "decode")
+
 
 class DeepSpeedInferenceConfigError(Exception):
     pass
@@ -126,6 +143,7 @@ class DeepSpeedInferenceConfig:
         INFERENCE_NUM_PAGES, INFERENCE_KV_POOL_FRACTION,
         INFERENCE_PREFIX_CACHING, INFERENCE_PREFILL_CHUNK_TOKENS,
         INFERENCE_PAGED_ATTENTION_KERNEL, INFERENCE_SPECULATIVE,
+        INFERENCE_FLEET,
     }
 
     def __init__(self, param_dict=None):
@@ -291,6 +309,72 @@ class DeepSpeedInferenceConfig:
         _require(self.spec_ngram_min <= self.spec_ngram_max,
                  "{}.{} must be <= {}".format(
                      INFERENCE_SPECULATIVE, SPEC_NGRAM_MIN, SPEC_NGRAM_MAX))
+
+        # ---- disaggregated serving fleet -----------------------------
+        fleet = sub.get(INFERENCE_FLEET, {})
+        _require(isinstance(fleet, dict),
+                 "{} must be a dict, got {}".format(
+                     INFERENCE_FLEET, type(fleet).__name__))
+        unknown = sorted(set(fleet) - FLEET_KNOWN_KEYS)
+        _require(not unknown,
+                 "unknown key(s) {} in {!r} (known: {})".format(
+                     unknown, INFERENCE_FLEET, sorted(FLEET_KNOWN_KEYS)))
+        self.fleet_enabled = bool(fleet.get(FLEET_ENABLED, False))
+        self.fleet_role = fleet.get(FLEET_ROLE, None)
+        _require(self.fleet_role is None or
+                 self.fleet_role in _FLEET_ROLES,
+                 "{}.{} must be one of {} or null, got {!r}".format(
+                     INFERENCE_FLEET, FLEET_ROLE, _FLEET_ROLES,
+                     self.fleet_role))
+        _require(not (self.fleet_role is not None and
+                      self.kv_layout != "paged"),
+                 "{}.{} needs {} \"paged\" (page-table slices are the "
+                 "handoff format)".format(INFERENCE_FLEET, FLEET_ROLE,
+                                          INFERENCE_KV_LAYOUT))
+        self.fleet_handoff_quantize = bool(
+            fleet.get(FLEET_HANDOFF_QUANTIZE, False))
+        self.fleet_handoff_block_size = fleet.get(
+            FLEET_HANDOFF_BLOCK_SIZE, 256)
+        _require(isinstance(self.fleet_handoff_block_size, int) and
+                 not isinstance(self.fleet_handoff_block_size, bool) and
+                 self.fleet_handoff_block_size >= 1,
+                 "{}.{} must be an int >= 1, got {!r}".format(
+                     INFERENCE_FLEET, FLEET_HANDOFF_BLOCK_SIZE,
+                     self.fleet_handoff_block_size))
+        for key, attr in ((FLEET_TTFT_SLO_S, "fleet_ttft_slo_s"),
+                          (FLEET_TPOT_SLO_S, "fleet_tpot_slo_s")):
+            val = fleet.get(key, None)
+            _require(val is None or (isinstance(val, (int, float)) and
+                                     not isinstance(val, bool) and
+                                     val > 0),
+                     "{}.{} must be a number > 0 or null, got "
+                     "{!r}".format(INFERENCE_FLEET, key, val))
+            setattr(self, attr, None if val is None else float(val))
+        self.fleet_admit_budget_factor = fleet.get(
+            FLEET_ADMIT_BUDGET_FACTOR, 1.0)
+        _require(isinstance(self.fleet_admit_budget_factor,
+                            (int, float)) and
+                 not isinstance(self.fleet_admit_budget_factor, bool) and
+                 self.fleet_admit_budget_factor > 0,
+                 "{}.{} must be a number > 0, got {!r}".format(
+                     INFERENCE_FLEET, FLEET_ADMIT_BUDGET_FACTOR,
+                     self.fleet_admit_budget_factor))
+        self.fleet_admit_budget_factor = float(
+            self.fleet_admit_budget_factor)
+        self.fleet_max_adapters = fleet.get(FLEET_MAX_ADAPTERS, 0)
+        _require(isinstance(self.fleet_max_adapters, int) and
+                 not isinstance(self.fleet_max_adapters, bool) and
+                 self.fleet_max_adapters >= 0,
+                 "{}.{} must be an int >= 0, got {!r}".format(
+                     INFERENCE_FLEET, FLEET_MAX_ADAPTERS,
+                     self.fleet_max_adapters))
+        self.fleet_adapter_rank = fleet.get(FLEET_ADAPTER_RANK, 8)
+        _require(isinstance(self.fleet_adapter_rank, int) and
+                 not isinstance(self.fleet_adapter_rank, bool) and
+                 self.fleet_adapter_rank >= 1,
+                 "{}.{} must be an int >= 1, got {!r}".format(
+                     INFERENCE_FLEET, FLEET_ADAPTER_RANK,
+                     self.fleet_adapter_rank))
 
     def resolve_num_pages(self, slots, max_seq_len):
         """Usable page-pool size for a concrete engine geometry: the

@@ -182,6 +182,18 @@ class InferenceEngine:
         # the cache); the scheduler owns slot assignment on top of this
         self.lengths = np.zeros((self.num_slots,), np.int32)
 
+        # ------------------------------------ disaggregated-fleet state
+        # role label for serving telemetry (None = monolith; fleet roles
+        # stamp "prefill"/"decode" on every serving_step record)
+        self.serving_role = ic.fleet_role
+        # multi-tenant LoRA-style adapters (inference/fleet/adapters.py):
+        # a readout-only logits delta per slot, so ONE page pool serves
+        # every tenant. adapter id 0 is the all-zero base (byte-identical
+        # to the adapter-free program on the same inputs).
+        self.adapters = None
+        self._adapter_stack = None
+        self.slot_adapters = np.zeros((self.num_slots,), np.int32)
+
         # ------------------------------------------- speculative decoding
         self.drafter = None
         self.spec_k = 0
@@ -350,6 +362,46 @@ class InferenceEngine:
                                             shardings)
         return params
 
+    # ---------------------------------------------- multi-tenant adapters
+
+    def attach_adapters(self, adapter_set):
+        """Attach an :class:`inference.fleet.adapters.AdapterSet`: every
+        slot gains a per-request LoRA-style logits delta served from the
+        shared page pool (the KV path is adapter-independent — only the
+        readout changes). Switches the engine onto the adapter-aware
+        program family; slots default to adapter 0 (the all-zero base,
+        byte-identical to the adapter-free programs)."""
+        assert adapter_set.d_model == self.model_config.d_model, \
+            "adapter d_model {} != model d_model {}".format(
+                adapter_set.d_model, self.model_config.d_model)
+        assert adapter_set.vocab_size == self.model_config.vocab_size, \
+            "adapter vocab {} != model vocab {}".format(
+                adapter_set.vocab_size, self.model_config.vocab_size)
+        self.adapters = adapter_set
+        self._adapter_stack = adapter_set.stacked(dtype=self.dtype,
+                                                  mesh=self.mesh)
+        self.slot_adapters[:] = 0
+
+    def assign_adapter(self, slot, adapter_id):
+        """Pin ``slot``'s requests to one tenant's adapter (0 = base)."""
+        assert self.adapters is not None, \
+            "assign_adapter before attach_adapters"
+        assert 0 <= adapter_id < len(self.adapters), \
+            "adapter id {} out of range [0, {})".format(
+                adapter_id, len(self.adapters))
+        self.slot_adapters[slot] = adapter_id
+
+    def _prefix_namespace(self, slot):
+        """Prefix-cache namespace for ``slot``: tenants never cross-hit
+        each other's cached prompt pages (the pages hold adapter-
+        independent K/V, but a cross-tenant hit would leak prompt
+        CONTENT between tenants through timing). Base traffic (adapter
+        0, or no adapters attached) keeps the unnamespaced chain."""
+        if self.adapters is None:
+            return None
+        aid = int(self.slot_adapters[slot])
+        return aid if aid else None
+
     # ----------------------------------------------------------- jit fns
 
     def _sampling_key(self, sampling):
@@ -372,7 +424,10 @@ class InferenceEngine:
         return hidden @ params["wte"].astype(hidden.dtype).T
 
     def _get_prefill_fn(self, bucket, greedy, top_k):
-        key = (bucket, greedy, top_k)
+        # attached adapters switch to an extended program family (extra
+        # LoRA readout operands); the base family's traces stay valid
+        key = (bucket, greedy, top_k, "adapters") \
+            if self.adapters is not None else (bucket, greedy, top_k)
         fn = self._prefill_fns.get(key)
         if fn is not None:
             return fn
@@ -383,22 +438,29 @@ class InferenceEngine:
 
         if paged:
             def prefill(params, k_cache, v_cache, ids, page_row, start,
-                        length, rng, temperature, top_p):
+                        length, rng, temperature, top_p, *adapter_args):
                 # ids (1, bucket); page_row (max_pages,); start/length
                 # scalar int32 — the chunk covers positions
                 # [start, start+length); padded tokens redirect to the
-                # garbage page via the masked scatter.
+                # garbage page via the masked scatter. adapter_args
+                # (when attached): (a_stack (n,r,d), b_stack (n,V,r),
+                # adapter_id scalar) — a per-tenant logits delta; the
+                # KV write path is adapter-independent.
                 hidden, (k_cache, v_cache) = gpt2.forward_hidden(
                     params, ids, cfg, cache=(k_cache, v_cache),
                     positions=start[None], page_tables=page_row[None],
                     valid_lens=length[None], page_size=ps)
                 last = jnp.take(hidden[0], length - 1, axis=0)     # (d,)
                 logits = self._last_logits(params, last[None])     # (1, V)
+                if adapter_args:
+                    a_stack, b_stack, aid = adapter_args
+                    logits = logits + \
+                        (b_stack[aid] @ (a_stack[aid] @ last))[None]
                 token = sampler(logits, rng, temperature, top_p)[0]
                 return k_cache, v_cache, token, logits[0]
         else:
             def prefill(params, k_cache, v_cache, ids, slot, start,
-                        length, rng, temperature, top_p):
+                        length, rng, temperature, top_p, *adapter_args):
                 # ids (1, bucket); slot/start/length scalar int32. The
                 # request's cache rows are sliced out, filled from
                 # position `start`, and written back.
@@ -415,6 +477,10 @@ class InferenceEngine:
                     v_cache, v_row, slot, axis=0)
                 last = jnp.take(hidden[0], length - 1, axis=0)     # (d,)
                 logits = self._last_logits(params, last[None])     # (1, V)
+                if adapter_args:
+                    a_stack, b_stack, aid = adapter_args
+                    logits = logits + \
+                        (b_stack[aid] @ (a_stack[aid] @ last))[None]
                 token = sampler(logits, rng, temperature, top_p)[0]
                 return k_cache, v_cache, token, logits[0]
 
@@ -431,7 +497,8 @@ class InferenceEngine:
         """The fused all-slot decode program: ``width`` new tokens per
         slot (1 = plain decode; k+1 = the speculative verify pass —
         one program family serves both)."""
-        key = (width, greedy, top_k)
+        key = (width, greedy, top_k, "adapters") \
+            if self.adapters is not None else (width, greedy, top_k)
         fn = self._decode_fns.get(key)
         if fn is not None:
             return fn
@@ -446,9 +513,19 @@ class InferenceEngine:
         sampler = make_sampler(greedy, top_k)
         paged, ps = self.kv_layout == "paged", self.page_size
 
+        def _adapter_delta(hidden, a_stack, b_stack, adapter_ids):
+            # per-slot LoRA readout: gather each slot's (A, B) pair and
+            # add its low-rank logits delta. adapter_ids (slots,) int32;
+            # hidden (slots, width, d).
+            a = a_stack[adapter_ids]                   # (slots, r, d)
+            h = jnp.einsum("swd,srd->swr", hidden, a)  # (slots, width, r)
+            return jnp.einsum("swr,svr->swv", h,
+                              b_stack[adapter_ids])    # (slots, width, V)
+
         if paged:
             def decode(params, k_cache, v_cache, tokens, lengths,
-                       page_tables, rng, temperature, top_p):
+                       page_tables, rng, temperature, top_p,
+                       *adapter_args):
                 # tokens (slots, width); lengths (slots,) int32
                 hidden, (k_cache, v_cache) = gpt2.forward_hidden(
                     params, tokens, cfg, cache=(k_cache, v_cache),
@@ -456,17 +533,23 @@ class InferenceEngine:
                     valid_lens=jnp.full_like(lengths, tokens.shape[1]),
                     page_size=ps)
                 logits = self._last_logits(params, hidden)
+                if adapter_args:
+                    logits = logits + _adapter_delta(hidden,
+                                                     *adapter_args)
                 flat = logits.reshape(-1, logits.shape[-1])
                 chosen = sampler(flat, rng, temperature,
                                  top_p).reshape(tokens.shape)
                 return k_cache, v_cache, chosen, logits
         else:
             def decode(params, k_cache, v_cache, tokens, lengths, rng,
-                       temperature, top_p):
+                       temperature, top_p, *adapter_args):
                 hidden, (k_cache, v_cache) = gpt2.forward_hidden(
                     params, tokens, cfg, cache=(k_cache, v_cache),
                     positions=lengths)
                 logits = self._last_logits(params, hidden)
+                if adapter_args:
+                    logits = logits + _adapter_delta(hidden,
+                                                     *adapter_args)
                 flat = logits.reshape(-1, logits.shape[-1])
                 chosen = sampler(flat, rng, temperature,
                                  top_p).reshape(tokens.shape)
@@ -518,7 +601,8 @@ class InferenceEngine:
         if self.prefix_cache is not None:
             # cap the match below the full prompt: the first sampled
             # token's logits must come from at least one real forward
-            matched, _ = self.prefix_cache.match(context, n - 1)
+            matched, _ = self.prefix_cache.match(
+                context, n - 1, namespace=self._prefix_namespace(slot))
         need = self.pages_for(n) - len(matched)
         if not self.allocator.can_alloc(need) and \
                 self.prefix_cache is not None:
@@ -551,7 +635,7 @@ class InferenceEngine:
             return 0
         extra, _ = self.prefix_cache.match(
             context, len(context) - 1, skip_pages=have,
-            count_lookup=False)
+            count_lookup=False, namespace=self._prefix_namespace(slot))
         row = self.page_tables[slot]
         for j, page in enumerate(extra, start=have):
             self.allocator.free(int(row[j]))
@@ -587,7 +671,8 @@ class InferenceEngine:
         full = len(context) // self.page_size
         if full:
             self.prefix_cache.register(
-                context, self.page_tables[slot, :full].tolist())
+                context, self.page_tables[slot, :full].tolist(),
+                namespace=self._prefix_namespace(slot))
 
     def _page_copy(self, src, dst):
         if self._page_copy_fn is None:
@@ -646,13 +731,18 @@ class InferenceEngine:
         fn = self._get_prefill_fn(bucket, greedy, top_k)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :n] = np.asarray(tokens, np.int32)
+        extra = ()
+        if self.adapters is not None:
+            a_stack, b_stack = self._adapter_stack
+            extra = (a_stack, b_stack,
+                     jnp.int32(int(self.slot_adapters[slot])))
         if self.kv_layout == "paged":
             self._cow_writes(slot, start, start + n - 1)
             k, v, token, _ = fn(
                 self.params, self.kv.k, self.kv.v, jnp.asarray(ids),
                 jnp.asarray(self.page_tables[slot]), jnp.int32(start),
                 jnp.int32(n), self._next_rng(),
-                jnp.float32(temperature), jnp.float32(top_p))
+                jnp.float32(temperature), jnp.float32(top_p), *extra)
         else:
             # the slot layout writes the padded bucket with one
             # dynamic_update_slice — paging.plan_chunks guarantees
@@ -665,7 +755,7 @@ class InferenceEngine:
                 self.params, self.kv.k, self.kv.v, jnp.asarray(ids),
                 jnp.int32(slot), jnp.int32(start), jnp.int32(n),
                 self._next_rng(), jnp.float32(temperature),
-                jnp.float32(top_p))
+                jnp.float32(top_p), *extra)
         self.kv.update((k, v))
         self.lengths[slot] = start + n
         return int(token)
@@ -698,6 +788,11 @@ class InferenceEngine:
         width = tokens.shape[1]
         greedy, top_k, temperature, top_p = self._sampling_key(sampling)
         fn = self._get_decode_fn(greedy, top_k, width=width)
+        extra = ()
+        if self.adapters is not None:
+            a_stack, b_stack = self._adapter_stack
+            extra = (a_stack, b_stack,
+                     jnp.asarray(self.slot_adapters, jnp.int32))
         if self.kv_layout == "paged":
             for slot in range(self.num_slots):
                 if self.lengths[slot] > 0:
@@ -707,12 +802,12 @@ class InferenceEngine:
                 self.params, self.kv.k, self.kv.v, jnp.asarray(tokens),
                 jnp.asarray(self.lengths), jnp.asarray(self.page_tables),
                 self._next_rng(), jnp.float32(temperature),
-                jnp.float32(top_p))
+                jnp.float32(top_p), *extra)
         else:
             k, v, chosen, _ = fn(
                 self.params, self.kv.k, self.kv.v, jnp.asarray(tokens),
                 jnp.asarray(self.lengths), self._next_rng(),
-                jnp.float32(temperature), jnp.float32(top_p))
+                jnp.float32(temperature), jnp.float32(top_p), *extra)
         self.kv.update((k, v))
         chosen = np.asarray(chosen)
         return chosen[:, 0] if squeeze else chosen
@@ -744,6 +839,7 @@ class InferenceEngine:
             self.page_counts[slot] = 0
             self._admit_matched.pop(slot, None)
         self.lengths[slot] = 0
+        self.slot_adapters[slot] = 0
 
     def generate(self, prompts, max_new_tokens=None, sampling=None,
                  eos_token_id=_UNSET, metrics=None):

@@ -1,0 +1,28 @@
+"""Disaggregated serving fleet (docs/inference.md, docs/fleet.md).
+
+Prefill/decode engine roles over the PR 7 paged engine, a serialized
+KV page-slice handoff between them (bitwise fp oracle + opt-in
+blockwise-int8 wire), an SLO-driven front-end router whose every
+decision is a schema-pinned event, and multi-tenant LoRA-style
+adapters served from one page pool.
+"""
+from .adapters import AdapterSet
+from .events import (KIND_ROUTER_EVENT, ROUTER_DECISIONS,
+                     ROUTER_EVENT_KEYS, ROUTER_EVENTS_JSONL,
+                     RouterEventLog, make_router_event,
+                     validate_router_event)
+from .handoff import (HandoffError, PageSlice, can_import,
+                      deserialize_slice, export_slice, import_slice,
+                      serialize_slice)
+from .roles import DecodeRole, PrefillRole
+from .router import FleetRouter
+from .serve import DisaggServer
+
+__all__ = [
+    "AdapterSet", "DecodeRole", "DisaggServer", "FleetRouter",
+    "HandoffError", "KIND_ROUTER_EVENT", "PageSlice", "PrefillRole",
+    "ROUTER_DECISIONS", "ROUTER_EVENTS_JSONL", "ROUTER_EVENT_KEYS",
+    "RouterEventLog", "can_import", "deserialize_slice", "export_slice",
+    "import_slice", "make_router_event", "serialize_slice",
+    "validate_router_event",
+]

@@ -1,0 +1,102 @@
+"""Multi-tenant LoRA-style adapters: many model variants, one page pool.
+
+An adapter is a low-rank logits delta over the base model's tied
+LM head: for adapter ``a`` with leaves ``A_a (rank, d_model)`` and
+``B_a (vocab, rank)``, the served logits become ``hidden @ wte.T +
+(hidden @ A_a.T) @ B_a.T * (alpha / rank)``. Adapters are EXTRA
+sharded leaves next to the base params — the KV pages they produce are
+identical to the base model's (the delta touches only the readout), so
+every tenant shares ONE paged pool and one decode program.
+
+Engine integration (inference/engine.py):
+
+  * ``engine.attach_adapters(adapter_set)`` stacks the leaves into
+    ``(n_adapters, rank, d_model)`` / ``(n_adapters, vocab, rank)``
+    device arrays (row 0 is the all-zero BASE adapter, so serving
+    adapter id 0 is the byte-identical oracle for the adapter-aware
+    programs);
+  * the scheduler assigns each request's adapter id to its slot; the
+    fused decode gathers each slot's ``(A, B)`` rows inside the jitted
+    program, so one decode step serves a mixed-tenant batch;
+  * prefix-cache keys gain the adapter id as a hash namespace
+    (paging.PrefixCache ``namespace=``): two tenants with the same
+    prompt never cross-hit each other's pages. KV pages are adapter-
+    independent here (readout-only delta), but the namespace keeps the
+    contract honest for adapters that later grow attention deltas.
+"""
+import numpy as np
+
+
+class AdapterSet:
+    """Registry of LoRA-style adapter leaves over one base model.
+
+    Adapter id 0 is always the reserved BASE adapter (all-zero delta).
+    ``add`` registers a named variant and returns its id; leaves
+    default to the classic LoRA init (A random normal, B zero — a
+    freshly added adapter serves exactly the base model until its B
+    trains away from zero) unless explicit arrays are given.
+    """
+
+    def __init__(self, d_model, vocab_size, rank=8, alpha=None, seed=0):
+        assert rank >= 1, "adapter rank must be >= 1"
+        self.d_model = int(d_model)
+        self.vocab_size = int(vocab_size)
+        self.rank = int(rank)
+        self.alpha = float(alpha if alpha is not None else rank)
+        self._rng = np.random.RandomState(seed)
+        self._A = [np.zeros((self.rank, self.d_model), np.float32)]
+        self._B = [np.zeros((self.vocab_size, self.rank), np.float32)]
+        self.names = {"base": 0}
+
+    def __len__(self):
+        return len(self._A)
+
+    def add(self, name, A=None, B=None):
+        """Register adapter ``name``; returns its integer id."""
+        assert name not in self.names, \
+            "adapter {!r} already registered".format(name)
+        if A is None:
+            A = self._rng.normal(
+                0.0, 1.0 / self.rank,
+                size=(self.rank, self.d_model)).astype(np.float32)
+        if B is None:
+            B = np.zeros((self.vocab_size, self.rank), np.float32)
+        A = np.asarray(A, np.float32)
+        B = np.asarray(B, np.float32)
+        assert A.shape == (self.rank, self.d_model), \
+            "A shape {} != {}".format(A.shape, (self.rank, self.d_model))
+        assert B.shape == (self.vocab_size, self.rank), \
+            "B shape {} != {}".format(B.shape,
+                                      (self.vocab_size, self.rank))
+        aid = len(self._A)
+        self._A.append(A)
+        self._B.append(B)
+        self.names[name] = aid
+        return aid
+
+    def id_of(self, name):
+        return self.names[name]
+
+    def stacked(self, dtype=None, mesh=None):
+        """-> device arrays ``(A (n, rank, d_model), B (n, vocab,
+        rank))`` with the ``alpha / rank`` LoRA scale folded into B
+        (one multiply at stack time instead of every step). Sharded
+        like the base params' vocab dim when a mesh is given (extra
+        sharded leaves, not a host-side side table)."""
+        import jax
+        import jax.numpy as jnp
+        A = jnp.asarray(np.stack(self._A))
+        B = jnp.asarray(np.stack(self._B) * (self.alpha / self.rank))
+        if dtype is not None:
+            A, B = A.astype(dtype), B.astype(dtype)
+        if mesh is not None:
+            A, B = jax.device_put(A), jax.device_put(B)
+        return A, B
+
+    def logits_delta(self, hidden, adapter_id):
+        """Host-side oracle: the delta the jitted path must reproduce
+        (fp32 numpy; tests pin the jitted gather against this)."""
+        h = np.asarray(hidden, np.float32)
+        a = self._A[adapter_id]
+        b = self._B[adapter_id] * (self.alpha / self.rank)
+        return (h @ a.T) @ b.T

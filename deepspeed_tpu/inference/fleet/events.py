@@ -1,0 +1,126 @@
+"""Router-decision event schema for the disaggregated serving fleet.
+
+Every decision the front-end router takes — admit/deny by predicted
+cost, route-away from a straggler-flagged host, preempt-and-migrate of
+a decode slot, host enrollment and its fingerprint refusal — lands as
+ONE schema-pinned JSON event, appended to ``router_events.jsonl``
+inside the router's telemetry job directory. The fleet merger
+(telemetry/fleet/aggregate.py) reads the per-host files the same way
+it reads rescale events and surfaces them in the fleet report's
+``router`` section (bin/ds_fleet.py prints the decision table).
+
+Stdlib-only by contract: ``aggregate.py`` and ``check_bench_schema.py``
+carry local copies of :data:`ROUTER_EVENT_KEYS` /
+:data:`ROUTER_DECISIONS` (pinned equal by
+tests/unit/test_serving_fleet.py) so doctoring a crashed run never
+needs jax importable.
+"""
+import json
+import os
+import time
+
+KIND_ROUTER_EVENT = "router_event"
+
+# per-host file name inside a telemetry job directory (the rescale-
+# events discipline: one JSONL per host, merged wall-ordered)
+ROUTER_EVENTS_JSONL = "router_events.jsonl"
+
+# the decision vocabulary — the router emits nothing outside this set
+ROUTER_DECISIONS = ("admit", "deny", "route_away", "preempt_migrate",
+                    "enroll", "enroll_refusal")
+
+# every router_event carries exactly these top-level keys
+ROUTER_EVENT_KEYS = ("kind", "wall", "decision", "request_uid", "host",
+                     "reason", "predicted_cost_s", "detail")
+
+
+def make_router_event(*, decision, request_uid=None, host=None,
+                      reason="", predicted_cost_s=None, detail=None,
+                      wall=None):
+    return {
+        "kind": KIND_ROUTER_EVENT,
+        "wall": float(wall if wall is not None else time.time()),
+        "decision": str(decision),
+        "request_uid": None if request_uid is None else int(request_uid),
+        "host": None if host is None else str(host),
+        "reason": str(reason),
+        "predicted_cost_s": (None if predicted_cost_s is None
+                             else float(predicted_cost_s)),
+        "detail": detail,
+    }
+
+
+def validate_router_event(ev):
+    """Schema check for one router_event dict. Returns a list of
+    problem strings; empty list = valid."""
+    problems = []
+    if not isinstance(ev, dict):
+        return ["router event is not a dict: {!r}".format(
+            type(ev).__name__)]
+    for key in ROUTER_EVENT_KEYS:
+        if key not in ev:
+            problems.append("missing key {!r}".format(key))
+    extra = sorted(set(ev) - set(ROUTER_EVENT_KEYS))
+    if extra:
+        problems.append("unexpected key(s) {}".format(extra))
+    if problems:
+        return problems
+    if ev["kind"] != KIND_ROUTER_EVENT:
+        problems.append("kind is {!r}, want {!r}".format(
+            ev["kind"], KIND_ROUTER_EVENT))
+    if ev["decision"] not in ROUTER_DECISIONS:
+        problems.append("decision {!r} not in {}".format(
+            ev["decision"], ROUTER_DECISIONS))
+    if isinstance(ev["wall"], bool) or \
+            not isinstance(ev["wall"], (int, float)):
+        problems.append("wall is not a number: {!r}".format(ev["wall"]))
+    if ev["request_uid"] is not None and (
+            isinstance(ev["request_uid"], bool) or
+            not isinstance(ev["request_uid"], int)):
+        problems.append("request_uid is neither null nor an int: "
+                        "{!r}".format(ev["request_uid"]))
+    if ev["host"] is not None and not isinstance(ev["host"], str):
+        problems.append("host is neither null nor a string: "
+                        "{!r}".format(ev["host"]))
+    if ev["predicted_cost_s"] is not None and (
+            isinstance(ev["predicted_cost_s"], bool) or
+            not isinstance(ev["predicted_cost_s"], (int, float))):
+        problems.append("predicted_cost_s is neither null nor a number: "
+                        "{!r}".format(ev["predicted_cost_s"]))
+    if ev["detail"] is not None and not isinstance(ev["detail"], dict):
+        problems.append("detail is neither null nor a dict: "
+                        "{!r}".format(ev["detail"]))
+    return problems
+
+
+class RouterEventLog:
+    """In-memory event list + optional JSONL append (one line per
+    decision, flushed per event so a crashed router leaves every
+    decision it took on disk — the torn-tail tolerance lives in the
+    merger's ``read_jsonl_tolerant``)."""
+
+    def __init__(self, output_dir=None):
+        self.events = []
+        self.path = None
+        if output_dir is not None:
+            os.makedirs(output_dir, exist_ok=True)
+            self.path = os.path.join(output_dir, ROUTER_EVENTS_JSONL)
+
+    def emit(self, **kwargs):
+        ev = make_router_event(**kwargs)
+        problems = validate_router_event(ev)
+        assert not problems, "router event failed its own schema: " \
+            "{}".format(problems)
+        self.events.append(ev)
+        if self.path is not None:
+            with open(self.path, "a") as fh:
+                fh.write(json.dumps(ev) + "\n")
+                fh.flush()
+        return ev
+
+    def decisions(self):
+        """{decision: count} over everything emitted so far."""
+        counts = {}
+        for ev in self.events:
+            counts[ev["decision"]] = counts.get(ev["decision"], 0) + 1
+        return counts

@@ -1,0 +1,238 @@
+"""SLO-driven front-end router for the disaggregated serving fleet.
+
+The router is pure host-side policy — no device code. It owns four
+decisions, every one of which lands in the schema-pinned event log
+(events.py) that ``bin/ds_fleet.py`` surfaces:
+
+  * **enroll / enroll_refusal** — a host joins the fleet only if its
+    program fingerprint (analysis/concurrency/divergence.py) matches
+    the fleet's reference digest. A divergent host would lower a
+    different program family and desynchronize the fleet; it is
+    REFUSED, not warned about.
+  * **admit / deny** — admission by predicted cost: prompt length maps
+    to a prefill bucket, and the router prices each bucket with an
+    EWMA of measured prefill walls (the compile observatory's bucket
+    discipline: one jit program per bucket, so per-bucket pricing is
+    the natural granularity). A request whose predicted TTFT cannot
+    meet the ``ttft_slo_s`` budget is denied at the door instead of
+    burning the SLO for everyone behind it.
+  * **route_away** — decode placement skips hosts the straggler
+    detector flagged (``ingest_fleet_report``) or whose ``/healthz``
+    went degraded (``observe_healthz``): a flagged host receives NO
+    new decode work until its flag clears.
+  * **preempt_migrate** — instead of merely warning when a decode host
+    degrades mid-stream, the router lifts its youngest decoding slot
+    off (roles.DecodeRole.export_request) and re-homes it on a healthy
+    host, stream intact.
+"""
+from .events import RouterEventLog
+
+# EWMA weight for bucket pricing: recent walls dominate (compile-time
+# outliers from the first trace wash out after a few requests)
+_PRICE_ALPHA = 0.4
+
+
+class _Host:
+    __slots__ = ("name", "kind", "role", "digest", "straggler",
+                 "unhealthy", "decode_assignments")
+
+    def __init__(self, name, kind, role, digest):
+        self.name = name
+        self.kind = kind              # "prefill" | "decode"
+        self.role = role              # PrefillRole / DecodeRole / None
+        self.digest = digest
+        self.straggler = False
+        self.unhealthy = False
+        self.decode_assignments = 0
+
+
+class FleetRouter:
+
+    def __init__(self, ttft_slo_s=None, tpot_slo_s=None,
+                 admit_budget_factor=1.0, event_dir=None, watchdog=None):
+        self.ttft_slo_s = ttft_slo_s
+        self.tpot_slo_s = tpot_slo_s
+        self.admit_budget_factor = float(admit_budget_factor)
+        self.events = RouterEventLog(event_dir)
+        self.watchdog = watchdog
+        self.hosts = {}
+        self.reference_digest = None
+        self._bucket_price = {}       # bucket -> EWMA prefill seconds
+        self.denied = []              # uids denied at the door
+        self.migrations = 0
+
+    # ------------------------------------------------------ enrollment
+
+    def enroll(self, name, kind, role=None, fingerprint=None):
+        """Enroll a host. ``fingerprint`` is the PR 15 program
+        fingerprint dict ({version, digest, families}); the first
+        fingerprinted host sets the fleet's reference digest, and any
+        later host with a DIFFERENT digest is refused. Returns True on
+        enrollment."""
+        assert kind in ("prefill", "decode"), kind
+        digest = None if fingerprint is None else fingerprint["digest"]
+        if digest is not None:
+            if self.reference_digest is None:
+                self.reference_digest = digest
+            elif digest != self.reference_digest:
+                self.events.emit(
+                    decision="enroll_refusal", host=name,
+                    reason="program fingerprint diverges from the "
+                           "fleet reference",
+                    detail={"digest": digest,
+                            "reference": self.reference_digest})
+                return False
+        self.hosts[name] = _Host(name, kind, role, digest)
+        self.events.emit(decision="enroll", host=name,
+                         reason="joined as {} host".format(kind),
+                         detail={"digest": digest})
+        return True
+
+    # --------------------------------------------- health / stragglers
+
+    def mark_straggler(self, name, flagged=True):
+        if name in self.hosts:
+            self.hosts[name].straggler = bool(flagged)
+
+    def ingest_fleet_report(self, report):
+        """Feed a fleet_report (telemetry/fleet/aggregate.merge_run):
+        every host named in the straggler flags loses decode
+        eligibility until a later report clears it."""
+        flagged = {f["host"] for f in
+                   (report.get("straggler") or {}).get("flags", [])}
+        for host in self.hosts.values():
+            host.straggler = host.name in flagged
+
+    def observe_healthz(self, name, payload):
+        """Feed one host's /healthz payload (telemetry collector
+        healthz()): a degraded status (SLO burn, watchdog trip) marks
+        the host unhealthy for decode placement."""
+        if name not in self.hosts:
+            return
+        status = (payload or {}).get("status")
+        self.hosts[name].unhealthy = status not in (None, "ok")
+
+    def _eligible_decode(self):
+        return [h for h in self.hosts.values()
+                if h.kind == "decode" and not h.straggler and
+                not h.unhealthy]
+
+    def _flagged_decode(self):
+        return [h for h in self.hosts.values()
+                if h.kind == "decode" and (h.straggler or h.unhealthy)]
+
+    # -------------------------------------------------------- pricing
+
+    def observe_prefill(self, bucket, seconds):
+        """Fold one measured prefill wall into the bucket's EWMA price."""
+        prev = self._bucket_price.get(bucket)
+        self._bucket_price[bucket] = seconds if prev is None else \
+            _PRICE_ALPHA * seconds + (1.0 - _PRICE_ALPHA) * prev
+
+    def predicted_cost(self, prompt_len, bucket_for):
+        """Predicted prefill seconds for a prompt: its bucket's EWMA
+        price; unpriced buckets interpolate linearly from the nearest
+        priced one (cost scales ~linearly with bucket tokens); no
+        prices at all -> None (the router admits on faith until the
+        first walls land)."""
+        bucket = bucket_for(prompt_len)
+        price = self._bucket_price.get(bucket)
+        if price is not None:
+            return price
+        if not self._bucket_price:
+            return None
+        ref_bucket = min(self._bucket_price,
+                         key=lambda b: abs(b - bucket))
+        return self._bucket_price[ref_bucket] * bucket / ref_bucket
+
+    # ------------------------------------------------------ decisions
+
+    def admit(self, uid, prompt_len, bucket_for, queue_depth=0):
+        """Admission by predicted cost against the TTFT SLO budget:
+        predicted prefill cost (scaled by the queue ahead) must fit
+        ``ttft_slo_s * admit_budget_factor``. No SLO configured, or no
+        pricing yet -> always admit."""
+        cost = self.predicted_cost(prompt_len, bucket_for)
+        budget = None if self.ttft_slo_s is None else \
+            self.ttft_slo_s * self.admit_budget_factor
+        if budget is not None and cost is not None and \
+                cost * (1 + queue_depth) > budget:
+            self.events.emit(
+                decision="deny", request_uid=uid,
+                reason="predicted TTFT {:.4f}s x (1+{} queued) exceeds "
+                       "the {:.4f}s budget".format(cost, queue_depth,
+                                                   budget),
+                predicted_cost_s=cost)
+            self.denied.append(uid)
+            return False
+        self.events.emit(decision="admit", request_uid=uid,
+                         reason="within TTFT budget",
+                         predicted_cost_s=cost)
+        return True
+
+    def observe_ttft(self, seconds):
+        """Feed a realized TTFT into the PR 8 ttft_slo watchdog (when
+        the fleet shares one)."""
+        if self.watchdog is not None:
+            self.watchdog.observe_ttft(seconds)
+
+    def pick_decode_host(self, uid=None):
+        """Least-loaded eligible decode host (free slots, then fewest
+        assignments). Emits one route_away per flagged host that had
+        free capacity the router refused to use. Returns the host
+        NAME, or None when no eligible host has a free slot."""
+        eligible = self._eligible_decode()
+        with_slots = [h for h in eligible
+                      if h.role is None or h.role.free_slots() > 0]
+        for flagged in self._flagged_decode():
+            if flagged.role is None or flagged.role.free_slots() > 0:
+                self.events.emit(
+                    decision="route_away", request_uid=uid,
+                    host=flagged.name,
+                    reason="straggler-flagged" if flagged.straggler
+                    else "healthz degraded")
+        if not with_slots:
+            return None
+        best = min(with_slots,
+                   key=lambda h: (-(h.role.free_slots()
+                                    if h.role is not None else 0),
+                                  h.decode_assignments, h.name))
+        best.decode_assignments += 1
+        return best.name
+
+    def preempt_migrate(self, src_name, quantize=False):
+        """Lift the youngest decoding request off a degraded host and
+        re-home it on a healthy one. Returns the migrated request, or
+        None when there is no victim or no destination (the event log
+        says which)."""
+        src = self.hosts[src_name]
+        assert src.role is not None, \
+            "host {!r} enrolled without a live role object".format(
+                src_name)
+        victim = src.role.youngest()
+        if victim is None:
+            return None
+        dst_name = self.pick_decode_host(uid=victim.uid)
+        if dst_name is None or dst_name == src_name:
+            return None
+        sl = src.role.export_request(victim, quantize=quantize)
+        req = self.hosts[dst_name].role.accept_migrated(sl, victim)
+        if req is None:
+            # destination filled up between pick and import: put the
+            # victim back where it was (its pages still fit there)
+            req = src.role.accept_migrated(sl, victim)
+            assert req is not None, \
+                "migration rollback failed: source host {!r} could " \
+                "not re-import its own slice".format(src_name)
+            return None
+        self.migrations += 1
+        self.events.emit(
+            decision="preempt_migrate", request_uid=victim.uid,
+            host=src_name,
+            reason="decode slot migrated off degraded host",
+            detail={"to": dst_name,
+                    "generated": len(victim.generated)})
+        return req
+
+    def decision_counts(self):
+        return self.events.decisions()

@@ -138,16 +138,22 @@ class PrefixCache:
         self.hit_pages = 0     # total pages mapped from the cache
         self.tokens_saved = 0  # prompt tokens NOT re-embedded
 
-    def _chain_keys(self, tokens):
-        """Chained hash per full page of ``tokens``."""
-        keys, key = [], None
+    def _chain_keys(self, tokens, namespace=None):
+        """Chained hash per full page of ``tokens``. A non-None
+        ``namespace`` (e.g. a tenant's adapter id) seeds the chain, so
+        namespaced entries never collide with the base chain or with
+        other namespaces — tenants cannot cross-hit each other's
+        prompts."""
+        keys = []
+        key = None if namespace is None else ("ns", namespace)
         ps = self.page_size
         for j in range(len(tokens) // ps):
             key = hash((key, tuple(tokens[j * ps:(j + 1) * ps])))
             keys.append(key)
         return keys
 
-    def match(self, tokens, max_tokens, skip_pages=0, count_lookup=True):
+    def match(self, tokens, max_tokens, skip_pages=0, count_lookup=True,
+              namespace=None):
         """-> (new_pages list, new_token_count) for the longest
         registered full-page prefix of ``tokens`` BEYOND the first
         ``skip_pages`` pages (already held by the caller), capped at
@@ -163,7 +169,8 @@ class PrefixCache:
             self.lookups += 1
         pages = []
         cap_pages = max(0, int(max_tokens)) // self.page_size
-        for key in self._chain_keys(tokens)[:cap_pages]:
+        for key in self._chain_keys(tokens,
+                                    namespace=namespace)[:cap_pages]:
             page = self._entries.get(key)
             if page is None:
                 break
@@ -179,12 +186,13 @@ class PrefixCache:
             self.tokens_saved += len(new) * self.page_size
         return new, len(new) * self.page_size
 
-    def register(self, tokens, pages):
+    def register(self, tokens, pages, namespace=None):
         """Record a prompt's full pages. ``pages[j]`` must hold tokens
         ``[j*ps, (j+1)*ps)``; entries already present are skipped (the
         existing shared page wins — the new duplicate stays owned by
         its sequence alone)."""
-        for j, key in enumerate(self._chain_keys(tokens)):
+        for j, key in enumerate(self._chain_keys(tokens,
+                                                 namespace=namespace)):
             if j >= len(pages):
                 break
             if key in self._entries:

@@ -44,7 +44,7 @@ class InferenceRequest:
     __slots__ = ("uid", "prompt", "max_new_tokens", "eos_token_id",
                  "generated", "slot", "state", "context", "chunks",
                  "chunk_idx", "arrival_t", "first_token_t", "resumed",
-                 "admit_order", "span")
+                 "admit_order", "span", "adapter")
 
     def __init__(self, uid, prompt, max_new_tokens, eos_token_id):
         self.uid = uid
@@ -62,6 +62,7 @@ class InferenceRequest:
         self.resumed = False         # re-admitted after preemption
         self.admit_order = -1        # preemption picks the youngest
         self.span = None             # request trace (telemetry.spans)
+        self.adapter = 0             # tenant adapter id (0 = base model)
 
 
 class ContinuousBatchingScheduler:
@@ -100,8 +101,11 @@ class ContinuousBatchingScheduler:
 
     # ------------------------------------------------------------- intake
 
-    def submit(self, prompt, max_new_tokens=None, eos_token_id=_UNSET):
-        """Queue a request; returns its uid (results keyed by it)."""
+    def submit(self, prompt, max_new_tokens=None, eos_token_id=_UNSET,
+               adapter=0):
+        """Queue a request; returns its uid (results keyed by it).
+        ``adapter`` pins the request to one tenant's LoRA adapter (0 =
+        the base model; needs ``engine.attach_adapters``)."""
         ic = self.engine.inference_config
         prompt = list(prompt)
         assert len(prompt) >= 1, "empty prompt"
@@ -113,11 +117,19 @@ class ContinuousBatchingScheduler:
             "{})".format(len(prompt), self.engine.max_seq_len)
         assert max_new_tokens is None or max_new_tokens >= 1, \
             "max_new_tokens must be >= 1, got {!r}".format(max_new_tokens)
+        if adapter:
+            assert self.engine.adapters is not None, \
+                "submit(adapter={}) needs engine.attach_adapters".format(
+                    adapter)
+            assert 0 <= adapter < len(self.engine.adapters), \
+                "adapter id {} out of range [0, {})".format(
+                    adapter, len(self.engine.adapters))
         req = InferenceRequest(
             self._next_uid, prompt,
             max_new_tokens if max_new_tokens is not None
             else ic.max_new_tokens,
             ic.eos_token_id if eos_token_id is _UNSET else eos_token_id)
+        req.adapter = int(adapter)
         self._next_uid += 1
         self.queue.append(req)
         return req.uid
@@ -214,6 +226,10 @@ class ContinuousBatchingScheduler:
             if self.slots[slot] is not None or not self.queue:
                 continue
             req = self.queue[0]
+            if self.engine.adapters is not None:
+                # BEFORE try_admit: the prefix match runs under the
+                # tenant's namespace
+                self.engine.assign_adapter(slot, req.adapter)
             if not self.engine.try_admit(slot, req.context):
                 if self._watchdog is not None:
                     self._watchdog.observe_pool_event("admission_blocked")
@@ -495,7 +511,8 @@ class ContinuousBatchingScheduler:
                 active_slots=self.num_active,
                 queue_depth=len(self.queue), occupancy=occupancy,
                 page_pool=self.engine.page_pool_stats(),
-                prefix=self.engine.prefix_stats())
+                prefix=self.engine.prefix_stats(),
+                role=getattr(self.engine, "serving_role", None))
         return retired
 
     def run(self):

@@ -354,7 +354,7 @@ class TelemetryCollector(AtexitCloseMixin):
 
     def emit_serving_step(self, *, step, metrics, active_slots,
                           queue_depth, occupancy, page_pool=None,
-                          prefix=None):
+                          prefix=None, role=None):
         rec = rec_mod.make_serving_record(
             step=step, slot_occupancy=occupancy, queue_depth=queue_depth,
             active_slots=active_slots,
@@ -367,7 +367,8 @@ class TelemetryCollector(AtexitCloseMixin):
             tpot=metrics.tpot_dist(),
             page_pool=page_pool,
             prefix=prefix,
-            speculative=metrics.spec_dist())
+            speculative=metrics.spec_dist(),
+            role=role)
         self.sinks.emit(rec)
         if self.watchdog is not None:
             self.watchdog.step_end()

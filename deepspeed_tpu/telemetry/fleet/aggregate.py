@@ -67,6 +67,7 @@ FINGERPRINT_KEYS = ("version", "digest", "families")
 FLEET_REPORT_KEYS = (
     "kind", "run_dir", "n_hosts", "hosts", "offsets", "records", "gaps",
     "straggler", "ici_health", "trace", "divergence", "rescale",
+    "router",
 )
 
 # elastic rescale events (ISSUE 16): file name + kind + schema
@@ -79,6 +80,21 @@ RESCALE_EVENT_KEYS = (
     "old_world", "new_world", "old_mesh", "new_mesh",
     "outcome", "detail",
 )
+
+# disaggregated-serving router events (ISSUE 17): file name + kind +
+# schema duplicated from inference/fleet/events.py (stdlib-import
+# contract); pinned equal by tests/unit/test_serving_fleet.py
+ROUTER_EVENTS_JSONL = "router_events.jsonl"
+KIND_ROUTER_EVENT = "router_event"
+ROUTER_EVENT_KEYS = (
+    "kind", "wall", "decision", "request_uid", "host", "reason",
+    "predicted_cost_s", "detail",
+)
+ROUTER_DECISIONS = ("admit", "deny", "route_away", "preempt_migrate",
+                    "enroll", "enroll_refusal")
+# serving-role vocabulary duplicated from telemetry/record.py
+# (SERVING_ROLES), same pin
+SERVING_ROLES = ("monolith", "prefill", "decode", "router")
 
 # every merged fleet-step record carries exactly these keys
 FLEET_STEP_KEYS = (
@@ -247,6 +263,9 @@ class HostView:
         self.manifest = None
         self.records = []           # train_step records, step order
         self.serving_steps = 0
+        # serving-step counts per fleet role ("monolith"/"prefill"/
+        # "decode"/"router"; records with role null count as monolith)
+        self.serving_roles = {}
         self.crashed = False
         self.crash_reason = None
         self.gaps = []
@@ -256,6 +275,7 @@ class HostView:
             "name": self.name,
             "steps": len(self.records),
             "serving_steps": self.serving_steps,
+            "serving_roles": dict(self.serving_roles),
             "manifest": self.manifest is not None,
             "crashed": self.crashed,
             "crash_reason": self.crash_reason,
@@ -316,6 +336,10 @@ def load_host(path, name=None):
                 dropped += 1
         elif rec.get("kind") == "serving_step":
             host.serving_steps += 1
+            role = rec.get("role")
+            role = role if isinstance(role, str) and \
+                role in SERVING_ROLES else "monolith"
+            host.serving_roles[role] = host.serving_roles.get(role, 0) + 1
     if dropped:
         host.gaps.append("{} train record(s) without a usable "
                          "step/wall skipped".format(dropped))
@@ -566,6 +590,37 @@ def merge_run(run_dir, factor=None, k=None, min_hosts=None,
                          if ev.get("event") == "rescale"),
         "events": rescale_events,
     }
+    # disaggregated-serving router decisions (ISSUE 17): the front-end
+    # router's event log rides the same per-host JSONL discipline as
+    # rescale events; the fleet view is the wall-ordered union plus a
+    # per-decision tally, so `ds_fleet` can show WHY each host did or
+    # did not receive serving work
+    router_events = []
+    for host in hosts:
+        path = os.path.join(host.path, ROUTER_EVENTS_JSONL)
+        if not os.path.exists(path):
+            continue
+        events, problems = read_jsonl_tolerant(path)
+        host.gaps.extend(problems)
+        gaps.extend("{}: {}".format(host.name, p) for p in problems)
+        for ev in events:
+            if isinstance(ev, dict) and \
+                    ev.get("kind") == KIND_ROUTER_EVENT:
+                router_events.append(dict(ev, source=host.name))
+    router_events.sort(
+        key=lambda ev: ev["wall"]
+        if isinstance(ev.get("wall"), _NUMERIC)
+        and not isinstance(ev.get("wall"), bool) else 0.0)
+    decisions = {}
+    for ev in router_events:
+        d = ev.get("decision")
+        if isinstance(d, str):
+            decisions[d] = decisions.get(d, 0) + 1
+    router = {
+        "count": len(router_events),
+        "decisions": decisions,
+        "events": router_events,
+    }
     return {
         "kind": KIND_FLEET_REPORT,
         "run_dir": os.path.abspath(run_dir),
@@ -579,6 +634,7 @@ def merge_run(run_dir, factor=None, k=None, min_hosts=None,
         "trace": trace,
         "divergence": divergence,
         "rescale": rescale,
+        "router": router,
     }
 
 

@@ -40,7 +40,14 @@ SERVING_STEP_KEYS = (
     # hits, hit_rate, ...} (prefix_caching only); speculative
     # {proposed, accepted, acceptance_rate} (speculative only)
     "ttft", "tpot", "page_pool", "prefix", "speculative",
+    # disaggregated-fleet role (null on a monolith; "prefill"/"decode"
+    # on split engines, "router" on front-end records) — the fleet
+    # doctor attributes steps per role on it
+    "role",
 )
+
+# the closed vocabulary a non-null serving `role` must come from
+SERVING_ROLES = ("monolith", "prefill", "decode", "router")
 
 # Unified per-segment/offload stats schema (ISSUE 13): the ONE shape
 # both offload paths' StepRecord ``offload`` sub-dict uses — the
@@ -174,7 +181,7 @@ def make_serving_record(*, step, slot_occupancy, queue_depth, active_slots,
                         prefill_tokens, prefill_tokens_per_sec,
                         decode_tokens, decode_steps, decode_tokens_per_sec,
                         ttft=None, tpot=None, page_pool=None, prefix=None,
-                        speculative=None, wall=None):
+                        speculative=None, role=None, wall=None):
     return {
         "kind": KIND_SERVING,
         "step": int(step),
@@ -192,6 +199,7 @@ def make_serving_record(*, step, slot_occupancy, queue_depth, active_slots,
         "page_pool": page_pool,
         "prefix": prefix,
         "speculative": speculative,
+        "role": None if role is None else str(role),
     }
 
 
@@ -261,6 +269,11 @@ def validate_step_record(rec):
                     "decode_tokens", "decode_steps",
                     "decode_tokens_per_sec"):
             num(key)
+        role = rec["role"]
+        if role is not None and role not in SERVING_ROLES:
+            problems.append(
+                "role is neither null nor one of {}: {!r}".format(
+                    list(SERVING_ROLES), role))
         for key, want_sub in SERVING_SUBDICT_KEYS.items():
             sub = rec[key]
             if sub is None:

@@ -35,6 +35,10 @@ Three artifact families, dispatched by shape:
 
 BENCH ``extra.metrics`` (the embedded final /metrics scrape of the
 fleet export plane) is validated for series count + exposition text.
+``extra.longctx`` (tests/perf/bench_longctx.py, the long-context
+sparse-attention rung) is validated for its rows and for the INTERNAL
+CONSISTENCY of its analytic dense-OOM accounting — the published
+fits booleans must match their own published operands.
 
 Usage: check_bench_schema.py [FILE...]; with no args, validates every
 BENCH_*.json in the repo root and tests/perf/. Exit 1 on any failure.
@@ -284,6 +288,100 @@ def check_scoreboard(payload):
                     break
         if not isinstance(serving.get("regression"), bool):
             problems.append("serving.regression is not a bool")
+    longctx = payload.get("longctx")
+    if longctx is not None:
+        # long-context trajectory (ISSUE 18): tokens/s rungs over
+        # BENCH_LONGCTX*.json with the same >10% gate
+        if not isinstance(longctx, dict):
+            problems.append("longctx is neither null nor a dict")
+            return problems
+        lrows = longctx.get("rows")
+        if not isinstance(lrows, list):
+            problems.append("longctx.rows is not a list")
+        else:
+            for i, row in enumerate(lrows):
+                if not isinstance(row, dict):
+                    problems.append(
+                        "longctx.rows[{}] is not an object".format(i))
+                    break
+                for key in ("rung", "file", "seq", "mode", "device",
+                            "tokens_per_sec"):
+                    if key not in row:
+                        problems.append(
+                            "longctx.rows[{}] missing {!r}".format(
+                                i, key))
+                if problems:
+                    break
+        if not isinstance(longctx.get("regression"), bool):
+            problems.append("longctx.regression is not a bool")
+    return problems
+
+
+def check_longctx(payload):
+    """-> list of problems with one ``extra.longctx`` payload
+    (tests/perf/bench_longctx.py — the ISSUE 18 long-context rung).
+    The dense-OOM claim is ANALYTIC (live-bytes arithmetic at the
+    declared shape), so the checker re-derives the fits booleans from
+    the published operands — a row that says "dense doesn't fit" with
+    numbers that say otherwise is a schema failure, not an opinion."""
+    problems = []
+    if not isinstance(payload, dict):
+        return ["extra.longctx is not a dict"]
+    rows = payload.get("rows")
+    if not isinstance(rows, list) or not rows:
+        return ["longctx.rows is not a non-empty list"]
+    timed = 0
+    for i, row in enumerate(rows):
+        if not isinstance(row, dict):
+            problems.append("longctx.rows[{}] is not an object".format(i))
+            break
+        for key in ("seq", "mode", "fits", "timed"):
+            if key not in row:
+                problems.append(
+                    "longctx.rows[{}] missing {!r}".format(i, key))
+        if row.get("mode") not in ("dense", "sparse"):
+            problems.append("longctx.rows[{}] has unknown mode "
+                            "{!r}".format(i, row.get("mode")))
+        if row.get("timed"):
+            timed += 1
+            if row.get("fits") and \
+                    not _is_num(row.get("tokens_per_sec")):
+                problems.append(
+                    "longctx.rows[{}] is timed but tokens_per_sec is "
+                    "not a number".format(i))
+        if problems:
+            break
+    if not timed:
+        problems.append("longctx has no timed row (accounting alone is "
+                        "not a rung)")
+    oom = payload.get("dense_oom")
+    if not isinstance(oom, dict):
+        problems.append("longctx.dense_oom is not a dict")
+        return problems
+    for key in ("hbm_budget_bytes", "dense_bwd_live_bytes",
+                "sparse_bwd_live_bytes"):
+        if not _is_num(oom.get(key)):
+            problems.append(
+                "longctx.dense_oom.{} is not a number".format(key))
+    if problems:
+        return problems
+    budget = oom["hbm_budget_bytes"]
+    for mode in ("dense", "sparse"):
+        fits = oom.get("{}_fits".format(mode))
+        derived = oom["{}_bwd_live_bytes".format(mode)] <= budget
+        if not isinstance(fits, bool):
+            problems.append(
+                "longctx.dense_oom.{}_fits is not a bool".format(mode))
+        elif fits != derived:
+            problems.append(
+                "longctx.dense_oom.{}_fits={} contradicts its own "
+                "operands ({} bytes vs budget {})".format(
+                    mode, fits,
+                    oom["{}_bwd_live_bytes".format(mode)], budget))
+    if oom.get("dense_fits") is True:
+        problems.append("longctx.dense_oom claims dense FITS — the "
+                        "rung's shape no longer demonstrates the "
+                        "long-context memory wall")
     return problems
 
 
@@ -416,6 +514,8 @@ def check_bench_payload(payload):
                     check_telemetry_snapshot(extra["telemetry"]))
             if "serving_trace" in extra:
                 problems.extend(check_serving_trace(extra["serving_trace"]))
+            if "longctx" in extra:
+                problems.extend(check_longctx(extra["longctx"]))
             if "executor" in extra:
                 problems.extend(check_segment_stats(
                     extra["executor"], "extra.executor"))

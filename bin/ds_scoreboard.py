@@ -21,6 +21,12 @@ rows plus the same >10% same-device gate — goodput falling or p95 TTFT
 rising past the threshold against the best prior rung exits 1 (CPU
 rungs exempt unless ``--gate-cpu``).
 
+Long-context rungs (``BENCH_LONGCTX*.json``, same sweep —
+tests/perf/bench_longctx.py's block-sparse 8-16k rung) get the same
+treatment: per-seq/mode tokens/s rows, headline = the best timed
+sparse row, >10% same-device tokens/s gate; the analytic dense-OOM
+accounting rows ride the table but never gate.
+
 Repo-root ``BENCH_r*.json`` files are driver run records
 (``{"n", "cmd", "rc", "tail"}``) whose bench JSON line is embedded in
 the tail — the same unwrap ``bin/check_bench_schema.py`` applies.
@@ -51,6 +57,13 @@ SCOREBOARD_ROW_KEYS = (
 SERVING_ROW_KEYS = (
     "rung", "file", "config", "device",
     "goodput_tokens_per_sec", "ttft_p95_s",
+)
+
+# every long-context trajectory row (one per BENCH_LONGCTX*.json
+# timed/accounting row) carries exactly these keys —
+# check_bench_schema.check_scoreboard pins them on the artifact
+LONGCTX_ROW_KEYS = (
+    "rung", "file", "seq", "mode", "device", "tokens_per_sec",
 )
 
 
@@ -244,8 +257,107 @@ def build_serving_board(paths, regression_pct=10.0, gate_cpu=False):
     }
 
 
+def _longctx_rung_index(path, payload):
+    m = re.search(r"BENCH_LONGCTX_r(\d+)", os.path.basename(path))
+    if m:
+        return int(m.group(1))
+    if isinstance(payload.get("n"), int):
+        return payload["n"]
+    return -1
+
+
+def load_longctx_rung(path):
+    """-> list of long-context trajectory rows (one per
+    ``extra.longctx`` seq/mode row) for one BENCH_LONGCTX*.json file.
+    Files without a longctx payload yield no rows."""
+    with open(path) as fh:
+        payload = json.load(fh)
+    inner = unwrap_driver_record(payload) if "tail" in payload \
+        else payload
+    if inner is None:
+        return []
+    extra = inner.get("extra") or {}
+    longctx = extra.get("longctx") or {}
+    rung = _longctx_rung_index(path, payload)
+    rows = []
+    for row in longctx.get("rows") or []:
+        if not isinstance(row, dict):
+            continue
+        rows.append({
+            "rung": rung,
+            "file": os.path.basename(path),
+            "seq": row.get("seq"),
+            "mode": row.get("mode"),
+            "device": extra.get("device"),
+            "tokens_per_sec": row.get("tokens_per_sec")
+            if row.get("timed") else None,
+        })
+    return rows
+
+
+def build_longctx_board(paths, regression_pct=10.0, gate_cpu=False):
+    """Long-context regression gate (ISSUE 18): the newest rung's
+    headline tokens/s — its best TIMED sparse row — against the best
+    PRIOR rung of the same device kind, with the same >10% gate the MFU
+    and serving trajectories use. Accounting-only rows (the analytic
+    dense-OOM evidence) ride the table but never enter the gate."""
+    rows = []
+    for path in sorted(paths):
+        rows.extend(load_longctx_rung(path))
+    rows.sort(key=lambda r: (r["rung"], r["file"], r["seq"] or 0,
+                             r["mode"] or ""))
+    per_rung = {}
+    for row in rows:
+        if row["tokens_per_sec"] is None:
+            continue
+        key = (row["rung"], row["file"])
+        slot = per_rung.setdefault(key, {
+            "rung": row["rung"], "file": row["file"],
+            "device": row["device"], "tokens_per_sec": None,
+            "seq": None})
+        if slot["tokens_per_sec"] is None or \
+                row["tokens_per_sec"] > slot["tokens_per_sec"]:
+            slot["tokens_per_sec"] = row["tokens_per_sec"]
+            slot["seq"] = row["seq"]
+    rungs = [per_rung[k] for k in sorted(per_rung)]
+    latest = rungs[-1] if rungs else None
+    regression = False
+    gate = None
+    best_prior = None
+    if latest is not None:
+        same_device = [r for r in rungs[:-1]
+                       if r["device"] == latest["device"]]
+        if latest["device"] == "cpu" and not gate_cpu:
+            gate = "skipped: latest longctx rung is a cpu-fallback " \
+                   "rung (pass --gate-cpu to include)"
+        elif not same_device:
+            gate = "skipped: no prior longctx rung on device " \
+                   "{!r}".format(latest["device"])
+        else:
+            best_prior = max(same_device,
+                             key=lambda r: r["tokens_per_sec"])
+            regression = latest["tokens_per_sec"] < \
+                best_prior["tokens_per_sec"] * \
+                (1.0 - regression_pct / 100.0)
+            gate = "tripped: tokens_per_sec" if regression else "passed"
+    return {
+        "rows": rows,
+        "measured_rungs": len(rungs),
+        "latest_rung": latest["rung"] if latest else None,
+        "latest_tokens_per_sec": latest["tokens_per_sec"]
+        if latest else None,
+        "latest_seq": latest["seq"] if latest else None,
+        "best_prior_rung": best_prior["rung"] if best_prior else None,
+        "best_prior_tokens_per_sec": best_prior["tokens_per_sec"]
+        if best_prior else None,
+        "regression_pct": regression_pct,
+        "regression": regression,
+        "gate": gate,
+    }
+
+
 def build_scoreboard(paths, regression_pct=10.0, gate_cpu=False,
-                     serving_paths=None):
+                     serving_paths=None, longctx_paths=None):
     """MFU regression gate: the newest measured rung against the best
     PRIOR rung **of the same device kind** — MFU is a fraction of that
     chip's peak, so a TPU rung never gates against a CPU one. CPU
@@ -276,10 +388,14 @@ def build_scoreboard(paths, regression_pct=10.0, gate_cpu=False,
     serving = build_serving_board(
         serving_paths, regression_pct=regression_pct,
         gate_cpu=gate_cpu) if serving_paths else None
+    longctx = build_longctx_board(
+        longctx_paths, regression_pct=regression_pct,
+        gate_cpu=gate_cpu) if longctx_paths else None
     return {
         "kind": KIND_SCOREBOARD,
         "rows": rows,
         "serving": serving,
+        "longctx": longctx,
         "measured_rungs": len(measured),
         "best_prior_mfu": best_prior["mfu"] if best_prior else None,
         "best_prior_rung": best_prior["rung"] if best_prior else None,
@@ -374,6 +490,43 @@ def render_markdown(board):
                     _fmt(serving["latest_goodput"], "{:.1f}"),
                     _fmt(serving["latest_ttft_p95_s"], "{:.4f}"),
                     serving["gate"] or "n/a"))
+    longctx = board.get("longctx")
+    if longctx and longctx["rows"]:
+        lines += [
+            "",
+            "## Long-context trajectory",
+            "",
+            "| rung | file | seq | mode | tokens/s | device |",
+            "|---:|---|---:|---|---:|---|",
+        ]
+        for row in longctx["rows"]:
+            lines.append(
+                "| {rung} | {file} | {seq} | {mode} | {tps} | "
+                "{device} |".format(
+                    rung=row["rung"], file=row["file"],
+                    seq=row["seq"] if row["seq"] is not None else "-",
+                    mode=row["mode"] or "-",
+                    tps=_fmt(row["tokens_per_sec"], "{:.1f}"),
+                    device=row["device"] or "-"))
+        lines.append("")
+        if longctx["regression"]:
+            lines.append(
+                "**LONGCTX REGRESSION**: rung {} tokens/s {} is more "
+                "than {}% below the best prior rung {} ({}).".format(
+                    longctx["latest_rung"],
+                    _fmt(longctx["latest_tokens_per_sec"], "{:.1f}"),
+                    longctx["regression_pct"],
+                    longctx["best_prior_rung"],
+                    _fmt(longctx["best_prior_tokens_per_sec"],
+                         "{:.1f}")))
+        else:
+            lines.append(
+                "Long-context trajectory healthy: latest {} tokens/s "
+                "at seq {} (gate {}).".format(
+                    _fmt(longctx["latest_tokens_per_sec"], "{:.1f}"),
+                    longctx["latest_seq"] if longctx["latest_seq"]
+                    is not None else "-",
+                    longctx["gate"] or "n/a"))
     return "\n".join(lines) + "\n"
 
 
@@ -398,20 +551,28 @@ def main(argv=None):
     explicit = args.paths or []
     serving_paths = [p for p in explicit
                      if os.path.basename(p).startswith("BENCH_SERVING")]
-    paths = [p for p in explicit if p not in serving_paths]
+    longctx_paths = [p for p in explicit
+                     if os.path.basename(p).startswith("BENCH_LONGCTX")]
+    paths = [p for p in explicit
+             if p not in serving_paths and p not in longctx_paths]
     if not explicit:
         paths = sorted(glob.glob(os.path.join(_REPO, "BENCH_r*.json")))
         serving_paths = sorted(
             glob.glob(os.path.join(_REPO, "tests", "perf",
                                    "BENCH_SERVING*.json")) +
             glob.glob(os.path.join(_REPO, "BENCH_SERVING*.json")))
+        longctx_paths = sorted(
+            glob.glob(os.path.join(_REPO, "tests", "perf",
+                                   "BENCH_LONGCTX*.json")) +
+            glob.glob(os.path.join(_REPO, "BENCH_LONGCTX*.json")))
     if not paths:
         print("ds_scoreboard: no BENCH_r*.json rungs found",
               file=sys.stderr)
         return 1
     board = build_scoreboard(paths, regression_pct=args.regression_pct,
                              gate_cpu=args.gate_cpu,
-                             serving_paths=serving_paths)
+                             serving_paths=serving_paths,
+                             longctx_paths=longctx_paths)
     md = render_markdown(board)
     if args.json_out:
         with open(args.json_out, "w") as fh:
@@ -428,6 +589,11 @@ def main(argv=None):
         print("ds_scoreboard: SERVING regression gate tripped (>{}% "
               "goodput drop or ttft_p95 rise)"
               .format(args.regression_pct), file=sys.stderr)
+        return 1
+    if board.get("longctx") and board["longctx"]["regression"]:
+        print("ds_scoreboard: LONGCTX regression gate tripped (>{}% "
+              "tokens/s drop)".format(args.regression_pct),
+              file=sys.stderr)
         return 1
     return 0
 

@@ -55,6 +55,12 @@ AFTER the cost is paid:
     constructed without a ``daemon=`` keyword: the thread's lifetime is
     undeclared, and a non-daemon thread with no join/close path holds
     the interpreter open on every crash (docs/concurrency.md).
+  * **DSL011 pallas-call-without-cost-estimate** — a ``pl.pallas_call``
+    under ``deepspeed_tpu/ops/`` with no ``cost_estimate=`` keyword: a
+    custom call XLA prices at zero flops silently corrupts MFU
+    accounting and the bench scoreboard's regression gate the moment
+    the kernel lands on a hot path. Every kernel declares its
+    ``pl.CostEstimate`` (docs/pallas_kernels.md).
   * **DSL010 serving-field-outside-schema** — a dict literal tagged
     ``"kind": "serving_step"`` carrying a string key that is NOT in
     telemetry/record.py's pinned ``SERVING_STEP_KEYS`` /
@@ -85,6 +91,7 @@ LINT_RULES = {
     "DSL008": "guarded-mutation-outside-lock",
     "DSL009": "thread-without-daemon-story",
     "DSL010": "serving-field-outside-schema",
+    "DSL011": "pallas-call-without-cost-estimate",
 }
 
 # DSL008: mutating container methods (the static twin of the dynamic
@@ -377,6 +384,19 @@ class _FunctionLint(ast.NodeVisitor):
                                "pl.pallas_call outside deepspeed_tpu/"
                                "ops/ — kernels live in one place "
                                "(ops/pallas; docs/pallas_kernels.md)")
+        # DSL011: every kernel in ops/ must declare its price — a
+        # custom call without a CostEstimate reads as zero flops to
+        # XLA's cost model, silently corrupting MFU and the scoreboard
+        # regression gate the moment the kernel lands on a hot path.
+        if is_pallas_call and self.linter.in_ops and \
+                not any(kw.arg == "cost_estimate" for kw in node.keywords):
+            self.linter.report(
+                "DSL011", self.qualname, node.lineno,
+                "pl.pallas_call without cost_estimate= — a zero-flop "
+                "custom call corrupts MFU pricing and the scoreboard "
+                "gate (pass pl.CostEstimate(flops=..., "
+                "bytes_accessed=..., transcendentals=...); "
+                "docs/pallas_kernels.md)")
         # DSL008: mutating-method call on a declared-guarded attribute
         if isinstance(fn, ast.Attribute) and fn.attr in _DSL008_MUTATORS:
             self._check_guarded_mutation(

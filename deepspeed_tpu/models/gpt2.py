@@ -44,6 +44,13 @@ class GPT2Config:
     # default: the pipeline path owns its own stacking.
     scan_blocks: bool = False
     use_flash_attention: bool = True
+    # Resolved transformer.flash_attention tri-state
+    # ("pallas"|"interpret"|"xla", ops.transformer.attention.
+    # resolve_flash_backend). None keeps the legacy use_flash_attention
+    # bool dispatch; the engine sets this from ds_config so a forced
+    # "pallas" off-TPU runs the interpreter instead of silently going
+    # dense.
+    flash_attention_backend: object = None
     dtype: object = jnp.float32    # param dtype at init (engine recasts)
     # Sequence/context parallelism: "ring" | "ulysses" | None. When set,
     # attention runs via shard_map over sp_mesh's ``sequence`` axis
@@ -232,13 +239,16 @@ def _attn_ctx(x, block, config, train):
         # the ring impl uses its own online-softmax accumulation, so pass
         # None there to keep _make_sharded's jit cache key stable across
         # use_flash_attention values.
-        attn_fn = (causal_attention_fn(config.use_flash_attention)
+        attn_fn = (causal_attention_fn(config.use_flash_attention,
+                                       config.flash_attention_backend)
                    if config.sequence_parallel == "ulysses" else None)
         ctx = sequence_parallel_attention(
             q, k, v, config.sp_mesh, impl=config.sequence_parallel,
             attn_fn=attn_fn)
     else:
-        ctx = causal_attention(q, k, v, use_flash=config.use_flash_attention)
+        ctx = causal_attention(q, k, v,
+                               use_flash=config.use_flash_attention,
+                               backend=config.flash_attention_backend)
     return ctx.reshape(b, s, d)
 
 
@@ -296,11 +306,16 @@ def _sparse_attn_fn(config, seq):
 
 
 def _use_fused_attn(config):
-    """The fused LN+QKV+flash op applies on the plain TPU flash path (the
+    """The fused LN+QKV+flash op applies on the plain flash path (the
     sequence-parallel and block-sparse impls own their attention; the
-    reference jnp path keeps gradients for CPU tests)."""
-    return (config.use_flash_attention and not config.sequence_parallel
-            and not config.sparse_attention
+    reference jnp path keeps gradients for CPU tests). Runs compiled on
+    TPU; a forced "interpret" backend (flash_attention: pallas off-TPU)
+    takes it too, under the Pallas interpreter."""
+    if config.sequence_parallel or config.sparse_attention:
+        return False
+    if config.flash_attention_backend is not None:
+        return config.flash_attention_backend in ("pallas", "interpret")
+    return (config.use_flash_attention
             and jax.default_backend() == "tpu")
 
 
@@ -330,7 +345,8 @@ def _fused_attn_ctx(x, block_params, config):
     return fused_ln_qkv_attention(
         x, block_params["ln1"]["scale"], block_params["ln1"]["bias"],
         block_params["attn"]["qkv_kernel"],
-        block_params["attn"]["qkv_bias"], config.n_heads)
+        block_params["attn"]["qkv_bias"], config.n_heads,
+        interpret=(config.flash_attention_backend == "interpret"))
 
 
 def _qkv_for_cache(x, block, config):

@@ -28,7 +28,8 @@ def adam_init(params, moments_dtype=jnp.float32):
 
 
 def adam_update(grads, state, params, lr, beta1, beta2, eps, weight_decay,
-                bias_correction=True, adam_w_mode=True, use_pallas=False):
+                bias_correction=True, adam_w_mode=True, use_pallas=False,
+                interpret=False):
     """One Adam step over a pytree. All hyperparams may be traced scalars.
 
     Returns (new_params, new_state). With ``adam_w_mode`` weight decay is
@@ -50,7 +51,7 @@ def adam_update(grads, state, params, lr, beta1, beta2, eps, weight_decay,
                     f"got {m.dtype} (set use_pallas=False)")
             return fused_adam_shard(p, g.astype(jnp.float32), m, v, lr, beta1,
                                     beta2, eps, weight_decay, bc1, bc2,
-                                    adam_w_mode)
+                                    adam_w_mode, interpret=interpret)
     else:
         def leaf(p, g, m, v):
             g = g.astype(jnp.float32)
@@ -135,10 +136,13 @@ class FusedAdam:
             use_pallas = default_use_pallas()
         else:
             use_pallas = self.use_pallas
+        # forced-pallas on a non-TPU backend runs the interpreter (the
+        # loud warning fires once at config resolution, engine side)
+        interpret = bool(use_pallas) and jax.default_backend() != "tpu"
         return adam_update(grads, state, params, lr, beta1, beta2, eps,
                            weight_decay, bias_correction=self.bias_correction,
                            adam_w_mode=self.adam_w_mode,
-                           use_pallas=use_pallas)
+                           use_pallas=use_pallas, interpret=interpret)
 
     def state_dict_names(self):
         return ["exp_avg", "exp_avg_sq", "step"]

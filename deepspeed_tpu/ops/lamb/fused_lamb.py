@@ -25,7 +25,7 @@ def lamb_init(params, moments_dtype=jnp.float32):
 
 def lamb_update(grads, state, params, lr, beta1, beta2, eps, weight_decay,
                 bias_correction=True, max_coeff=10.0, min_coeff=0.01,
-                eps_inside_sqrt=False, use_pallas=False):
+                eps_inside_sqrt=False, use_pallas=False, interpret=False):
     """One LAMB step over a pytree; returns (new_params, new_state)."""
     step = state["step"] + 1
     if bias_correction:
@@ -43,7 +43,8 @@ def lamb_update(grads, state, params, lr, beta1, beta2, eps, weight_decay,
         return fused_lamb_shard(p, g, m, v, lr, beta1, beta2, eps,
                                 weight_decay, bc1, bc2,
                                 max_coeff=max_coeff, min_coeff=min_coeff,
-                                eps_inside_sqrt=eps_inside_sqrt)
+                                eps_inside_sqrt=eps_inside_sqrt,
+                                interpret=interpret)
 
     def leaf(p, g, m, v):
         g = g.astype(jnp.float32)
@@ -137,8 +138,11 @@ class FusedLamb:
             use_pallas = default_use_pallas()
         else:
             use_pallas = self.use_pallas
+        # forced-pallas on a non-TPU backend runs the interpreter (the
+        # loud warning fires once at config resolution, engine side)
+        interpret = bool(use_pallas) and jax.default_backend() != "tpu"
         return lamb_update(grads, state, params, lr, beta1, beta2, eps,
                            weight_decay, bias_correction=self.bias_correction,
                            max_coeff=self.max_coeff, min_coeff=self.min_coeff,
                            eps_inside_sqrt=self.eps_inside_sqrt,
-                           use_pallas=use_pallas)
+                           use_pallas=use_pallas, interpret=interpret)

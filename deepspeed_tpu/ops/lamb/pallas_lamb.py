@@ -70,6 +70,7 @@ def _lamb_stage1_flat(p, g, m, v, scalars, eps_inside_sqrt, interpret=False):
                         memory_space=pltpu.VMEM)
     norm_spec = pl.BlockSpec((8, LANE), lambda i: (i, 0),
                              memory_space=pltpu.VMEM)
+    n = p.size
     out = pl.pallas_call(
         functools.partial(_lamb_stage1_kernel,
                           eps_inside_sqrt=eps_inside_sqrt, total_rows=rows),
@@ -82,6 +83,12 @@ def _lamb_stage1_flat(p, g, m, v, scalars, eps_inside_sqrt, interpret=False):
                    jax.ShapeDtypeStruct(p.shape, jnp.float32),
                    jax.ShapeDtypeStruct((grid[0] * 8, LANE), jnp.float32)),
         interpret=interpret,
+        # ~16 VPU flops/element (m, v, update, decay) + ~4 for the two
+        # masked partial-norm reductions, one sqrt per element; 4 fp32
+        # streams in, 3 elementwise out (norm tiles are noise) — what MFU
+        # pricing charges for the custom call (DSL011).
+        cost_estimate=pl.CostEstimate(
+            flops=20 * n, transcendentals=n, bytes_accessed=7 * n * 4),
     )(p, g, m, v, scalars)
     new_m, new_v, update, norm_tiles = out
     partials = norm_tiles.reshape(grid[0], 8, LANE)[:, 0, :2]

@@ -638,6 +638,25 @@ def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
     cols_b = cols_b.reshape(cols_b.shape[0], -1)
     valid_b = valid_b.reshape(valid_b.shape[0], -1)
 
+    # Active (row, col) block pairs summed over heads — the work the
+    # group walk actually performs. MFU pricing must see the SPARSE flop
+    # count, not the dense nb^2 (that under/over-pricing is exactly what
+    # DSL011 exists to prevent); the pad slots groups carry are masked
+    # dead weight and are not priced.
+    n_active = int(np.asarray(valid_f).sum()) * (heads if shared else 1)
+
+    def _sparse_cost(mults, batch, d, operands, out_bytes):
+        """``pl.CostEstimate`` for one sparse-attention pallas_call.
+        ``mults``: matmuls per active score tile (2 fwd, 3 dq, 4 dk/dv);
+        ``operands``: unique input arrays, charged one HBM read each
+        (anchor residency / stream re-reads are pipeline detail)."""
+        tile_elems = batch * n_active * block * block
+        read = sum(int(a.size) * a.dtype.itemsize for a in operands)
+        return pl.CostEstimate(
+            flops=int(2 * mults * tile_elems * d),
+            transcendentals=int(tile_elems),
+            bytes_accessed=int(read + out_bytes))
+
     # PACKED-HEADS path (shared layouts, the default for fixed/window/
     # bigbird): operands packed (b, s, H*d) and all heads processed per
     # grid step — H x pack score tiles of MXU work per step instead of
@@ -741,6 +760,9 @@ def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
                        jax.ShapeDtypeStruct((batch, s, heads),
                                             jnp.float32)),
             interpret=interpret,
+            cost_estimate=_sparse_cost(
+                2, batch, d, [qp, kp, vp] + _mask_ops(kpm, bias),
+                batch * s * hd * q.dtype.itemsize + batch * s * heads * 4),
         )(jnp.asarray(rows_fp), jnp.asarray(cols_fp),
           jnp.asarray(valid_fp), *ops)
         return _from_packed(out, h), lse
@@ -779,6 +801,10 @@ def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
                 scratch_shapes=[pltpu.VMEM((block, hd), jnp.float32)]),
             out_shape=jax.ShapeDtypeStruct((batch, s, hd), q.dtype),
             interpret=interpret,
+            cost_estimate=_sparse_cost(
+                3, batch, d,
+                [qp, kp, vp, dop, lse, delta] + _mask_ops(kpm, bias),
+                batch * s * hd * q.dtype.itemsize),
         )(jnp.asarray(rows_fp), jnp.asarray(cols_fp),
           jnp.asarray(valid_fp), qp, *([kp] * pack_pk), *([vp] * pack_pk),
           *mask_ops, dop, lse, delta)
@@ -809,6 +835,10 @@ def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
             out_shape=(jax.ShapeDtypeStruct((batch, s, hd), k.dtype),
                        jax.ShapeDtypeStruct((batch, s, hd), v.dtype)),
             interpret=interpret,
+            cost_estimate=_sparse_cost(
+                4, batch, d,
+                [qp, kp, vp, dop, lse, delta] + _mask_ops(kpm, bias),
+                2 * batch * s * hd * k.dtype.itemsize),
         )(jnp.asarray(rows_bp), jnp.asarray(cols_bp),
           jnp.asarray(valid_bp), *([qp] * pack_pk), kp, vp, *mask_ops_t,
           *([dop] * pack_pk), *([lse] * pack_pk), *([delta] * pack_pk))
@@ -903,6 +933,9 @@ def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
             out_shape=(jax.ShapeDtypeStruct(q.shape, q.dtype),
                        jax.ShapeDtypeStruct((batch, h, s, 1), jnp.float32)),
             interpret=interpret,
+            cost_estimate=_sparse_cost(
+                2, batch, d, [q, k, v] + _mask_ops(kpm, bias),
+                q.size * q.dtype.itemsize + batch * h * s * 4),
         )(jnp.asarray(rows_f), jnp.asarray(cols_f), jnp.asarray(valid_f),
           *ops)
         return out, lse
@@ -935,6 +968,10 @@ def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
                 scratch_shapes=[pltpu.VMEM((block, d), jnp.float32)]),
             out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
             interpret=interpret,
+            cost_estimate=_sparse_cost(
+                3, batch, d,
+                [q, k, v, do, lse, delta] + _mask_ops(kpm, bias),
+                q.size * q.dtype.itemsize),
         )(jnp.asarray(rows_f), jnp.asarray(cols_f), jnp.asarray(valid_f),
           q, *([k] * pack), *([v] * pack), *mask_ops, do, lse, delta)
 
@@ -963,6 +1000,10 @@ def make_block_sparse_attention(layout, block, causal=False, sm_scale=None,
             out_shape=(jax.ShapeDtypeStruct(k.shape, k.dtype),
                        jax.ShapeDtypeStruct(v.shape, v.dtype)),
             interpret=interpret,
+            cost_estimate=_sparse_cost(
+                4, batch, d,
+                [q, k, v, do, lse, delta] + _mask_ops(kpm, bias),
+                2 * k.size * k.dtype.itemsize),
         )(jnp.asarray(rows_b), jnp.asarray(cols_b), jnp.asarray(valid_b),
           *([q] * pack), k, v, *mask_ops_t, *([do] * pack),
           *([lse] * pack), *([delta] * pack))

@@ -20,25 +20,77 @@ def reference_causal_attention(q, k, v, sm_scale=None):
     return ctx.astype(q.dtype)
 
 
-def causal_attention(q, k, v, use_flash=True, sm_scale=None, interpret=None):
-    """(b, s, h, d) in, (b, s, h, d) out."""
-    if interpret is None:
-        interpret = False
-    backend_ok = jax.default_backend() == "tpu" or interpret
-    if use_flash and backend_ok:
-        # (b,s,h,d)-native kernel: no head fold/unfold relayout (that
-        # transpose costs more than the attention math at d_head 64);
-        # block sizes resolve by width inside the op (auto_blocks), so
-        # wide models (gpt2-xl's h*d=1600) stay inside scoped vmem.
-        from .flash_attention import flash_attention_bshd
-        return flash_attention_bshd(q, k, v, sm_scale, True,
-                                    interpret=interpret)
-    return reference_causal_attention(q, k, v, sm_scale)
+# ds_config spellings of transformer.flash_attention (bools are the
+# legacy form: true -> "auto", false -> "xla").
+FLASH_BACKEND_MODES = ("auto", "pallas", "xla")
+
+_warned_forced_pallas = set()
+
+
+def resolve_flash_backend(requested):
+    """Resolve the ``transformer.flash_attention`` tri-state to what this
+    process will actually run: ``"pallas"`` (compiled kernel, TPU),
+    ``"interpret"`` (kernel under the Pallas interpreter — forced
+    ``"pallas"`` on a non-TPU backend, parity/debug speed), or ``"xla"``
+    (the reference oracle). ``"auto"`` picks the kernel exactly on TPU and
+    falls back to XLA elsewhere; forcing ``"pallas"`` off-TPU warns LOUDLY
+    once instead of silently flipping the dense flag."""
+    if isinstance(requested, bool):
+        requested = "auto" if requested else "xla"
+    if requested not in FLASH_BACKEND_MODES:
+        raise ValueError(
+            f"flash_attention backend {requested!r}: want a bool or one of "
+            f"{FLASH_BACKEND_MODES}")
+    if requested == "xla":
+        return "xla"
+    backend = jax.default_backend()
+    if backend == "tpu":
+        return "pallas"
+    if requested == "auto":
+        return "xla"
+    if backend not in _warned_forced_pallas:
+        _warned_forced_pallas.add(backend)
+        from ...utils.logging import logger
+        logger.warning(
+            "transformer.flash_attention: 'pallas' forced on the %s "
+            "backend — running the flash kernel under the Pallas "
+            "INTERPRETER (orders of magnitude slower; parity/debug only). "
+            "Use 'auto' to take the XLA oracle off-TPU.", backend)
+    return "interpret"
+
+
+def causal_attention(q, k, v, use_flash=True, sm_scale=None, interpret=None,
+                     backend=None):
+    """(b, s, h, d) in, (b, s, h, d) out.
+
+    ``backend``: a RESOLVED tri-state ("pallas"|"interpret"|"xla", see
+    :func:`resolve_flash_backend`) — wins over the legacy ``use_flash``
+    bool when given."""
+    if backend is None:
+        if not use_flash:
+            backend = "xla"
+        elif jax.default_backend() == "tpu":
+            backend = "pallas"
+        else:
+            # explicit interpret=True is a direct (test) request for the
+            # kernel — no config involved, so no loud warning here
+            backend = "interpret" if interpret else "xla"
+    if backend == "xla":
+        return reference_causal_attention(q, k, v, sm_scale)
+    # (b,s,h,d)-native kernel: no head fold/unfold relayout (that
+    # transpose costs more than the attention math at d_head 64);
+    # block sizes resolve by width inside the op (auto_blocks), so
+    # wide models (gpt2-xl's h*d=1600) stay inside scoped vmem.
+    from .flash_attention import flash_attention_bshd
+    return flash_attention_bshd(q, k, v, sm_scale, True,
+                                interpret=(backend == "interpret")
+                                or bool(interpret))
 
 
 @_functools.lru_cache(maxsize=None)
-def causal_attention_fn(use_flash=True):
+def causal_attention_fn(use_flash=True, backend=None):
     """Hashable, cached (q, k, v) -> ctx callable — the form
     sequence_parallel_attention's jit cache needs (a fresh partial per call
     would miss that cache every time)."""
-    return _functools.partial(causal_attention, use_flash=use_flash)
+    return _functools.partial(causal_attention, use_flash=use_flash,
+                              backend=backend)

@@ -2,8 +2,10 @@ from .topology import (ProcessTopology, PipeDataParallelTopology,
                        PipeModelDataParallelTopology, MeshGrid, build_mesh,
                        DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQUENCE_AXIS)
 from .ring import ring_perm, ring_context, ring_rotate
-from .ring_attention import (ring_attention, ulysses_attention,
-                             sequence_parallel_attention)
+from .ring_attention import (ring_attention, ring_sparse_attention,
+                             ulysses_attention,
+                             sequence_parallel_attention,
+                             sequence_parallel_sparse_attention)
 from .collective_matmul import (CollectiveMatmulBinding, allgather_matmul,
                                 matmul_reducescatter, tp_column_matmul,
                                 tp_row_matmul, zero3_ring_gather)

@@ -118,6 +118,103 @@ def ring_attention(q, k, v, axis_name=SEQUENCE_AXIS, causal=True,
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
+def ring_sparse_attention(q, k, v, layout, block, axis_name=SEQUENCE_AXIS,
+                          causal=True, sm_scale=None):
+    """Ring attention composed with a block-sparse layout: the long-context
+    configuration where the sequence is sharded over the ring AND each
+    device only scores the active blocks of the global sparsity pattern.
+
+    ``layout``: ``[heads, nb, nb]`` (or ``[1, nb, nb]`` shared) boolean
+    block mask over the GLOBAL sequence (``nb = S_global // block``), the
+    same array ``make_block_sparse_attention`` takes. Each ring step holds
+    the K/V shard of rank ``(idx - step) % n``, so the mask for that step
+    is the ``[nb_local, nb_local]`` window of the global layout addressed
+    by (resident q rows, rotated k cols) — a ``lax.dynamic_slice`` with
+    trace-time starts, because ``axis_index`` is traced under shard_map
+    (SPMD traces ONE program for all ranks; a python-level slice would
+    bake rank 0's window into every device).
+
+    Exact: inactive blocks contribute nothing (the online-softmax ``where``
+    guard zeroes them), so the result matches masked-dense attention over
+    the expanded element mask bit-for-bit in structure, to float tolerance
+    in value. Rows with no active blocks anywhere return 0 (the oracle in
+    tests uses the same convention).
+    """
+    n, idx, perm = ring_context(axis_name)
+    b, s_local, h, d = q.shape
+    if s_local % block:
+        raise ValueError(
+            "ring_sparse_attention needs the local sequence shard ({}) "
+            "divisible by the sparsity block ({})".format(s_local, block))
+    scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
+    layout_j = jnp.asarray(layout, bool)
+    if layout_j.ndim != 3 or layout_j.shape[0] not in (1, h):
+        raise ValueError(
+            "layout must be [heads|1, nb, nb]; got {} for {} heads".format(
+                layout_j.shape, h))
+    nb_local = s_local // block
+
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)  # [B,H,S,D]
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+
+    q_pos = idx * s_local + jnp.arange(s_local)
+    row0 = idx * nb_local
+
+    m0 = jnp.full((b, h, s_local, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_local, 1), jnp.float32)
+    o0 = jnp.zeros((b, h, s_local, d), jnp.float32)
+
+    def attend(step, m, l, o, k_cur, v_cur):
+        kv_idx = (idx - step) % n
+        col0 = kv_idx * nb_local
+        blk = lax.dynamic_slice(
+            layout_j, (0, row0, col0),
+            (layout_j.shape[0], nb_local, nb_local))
+        emask = jnp.repeat(jnp.repeat(blk, block, axis=1), block, axis=2)
+        if causal:
+            k_pos = kv_idx * s_local + jnp.arange(s_local)
+            emask = emask & (q_pos[:, None] >= k_pos[None, :])[None]
+        return _chunk_attention(qt, k_cur, v_cur, emask[None], scale,
+                                m, l, o)
+
+    def body(carry, step):
+        m, l, o, k_cur, v_cur = carry
+        m, l, o = attend(step, m, l, o, k_cur, v_cur)
+        k_nxt = ring_rotate(k_cur, axis_name, perm)
+        v_nxt = ring_rotate(v_cur, axis_name, perm)
+        return (m, l, o, k_nxt, v_nxt), None
+
+    if n > 1:
+        body = jax.checkpoint(body, prevent_cse=False)
+        (m, l, o, k_last, v_last), _ = lax.scan(
+            body, (m0, l0, o0, kt, vt), jnp.arange(n - 1))
+    else:
+        m, l, o, k_last, v_last = m0, l0, o0, kt, vt
+    m, l, o = attend(n - 1, m, l, o, k_last, v_last)
+    out = o / jnp.maximum(l, 1e-30)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def sequence_parallel_sparse_attention(q, k, v, mesh, layout, block,
+                                       axis_name=SEQUENCE_AXIS, causal=True,
+                                       sm_scale=None):
+    """Global-array entry for :func:`ring_sparse_attention`: shards the
+    sequence dim of [B, S, H, D] over ``axis_name`` of ``mesh`` and runs
+    the ring with the block-sparse layout. Not lru-cached (the layout is
+    an array, unhashable) — wrap the call in your own ``jax.jit`` for the
+    steady state; tracing is cheap next to the attention itself."""
+    from .topology import shard_map_compat
+    fn = functools.partial(ring_sparse_attention, layout=jnp.asarray(layout),
+                           block=block, axis_name=axis_name, causal=causal,
+                           sm_scale=sm_scale)
+    batch_axis = DATA_AXIS if mesh.shape.get(DATA_AXIS, 1) > 1 else None
+    spec = P(batch_axis, axis_name, None, None)
+    sharded = shard_map_compat(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                               out_specs=spec)
+    return jax.jit(sharded)(q, k, v)
+
+
 def ulysses_attention(q, k, v, axis_name=SEQUENCE_AXIS, causal=True,
                       sm_scale=None, attn_fn=None):
     """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism.

@@ -466,13 +466,21 @@ def get_runtime_executor(param_dict):
     return val.lower()
 
 
+TRANSFORMER_FLASH_ATTENTION_MODES = ("auto", "pallas", "xla")
+
+
 def get_transformer_flash_attention(param_dict):
     """``transformer.flash_attention``: tri-state gate for the Pallas
-    flash-attention kernel on the dense training path. ``None`` (key or
-    section absent) leaves the model config's own default; true/false
-    override it at engine init. The kernel itself falls back to the XLA
-    reference automatically off-TPU (ops/transformer/attention.py), so
-    enabling it in a config that also runs on CPU rigs is safe."""
+    flash-attention kernel on the dense training path, mirroring
+    ``inference.paged_attention_kernel``. ``None`` (key or section
+    absent) leaves the model config's own default. ``"auto"`` takes the
+    kernel exactly on TPU and the XLA reference elsewhere; ``"pallas"``
+    forces the kernel — off-TPU it runs under the Pallas interpreter
+    with a LOUD one-time warning (parity/debug) instead of silently
+    going dense; ``"xla"`` pins the reference oracle. The legacy bools
+    still parse: true -> "auto", false -> "xla". Strict-validated like
+    runtime.executor — an enum typo raises instead of silently changing
+    the kernel under a benchmark."""
     sub = param_dict.get(TRANSFORMER) or {}
     if not isinstance(sub, dict):
         raise DeepSpeedConfigError(
@@ -480,11 +488,15 @@ def get_transformer_flash_attention(param_dict):
     val = sub.get(TRANSFORMER_FLASH_ATTENTION)
     if val is None:
         return None
-    if not isinstance(val, bool):
+    if isinstance(val, bool):
+        return "auto" if val else "xla"
+    if not isinstance(val, str) or \
+            val.lower() not in TRANSFORMER_FLASH_ATTENTION_MODES:
         raise DeepSpeedConfigError(
-            "transformer.{} must be a bool or null, got {!r}".format(
-                TRANSFORMER_FLASH_ATTENTION, val))
-    return val
+            "transformer.{} must be a bool, null or one of {}, got {!r}"
+            .format(TRANSFORMER_FLASH_ATTENTION,
+                    "|".join(TRANSFORMER_FLASH_ATTENTION_MODES), val))
+    return val.lower()
 
 
 def get_pld_enabled(param_dict):

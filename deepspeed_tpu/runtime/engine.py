@@ -121,6 +121,11 @@ class DeepSpeedEngine:
                     stack_depth=self._config.analysis_config
                     .concurrency_stack_depth))
         self.model = as_model(model, model_parameters)
+        # resolved kernel tri-states (observable via telemetry_snapshot,
+        # like the serving engine's paged_attention_kernel); None = the
+        # ds_config key was absent
+        self.flash_attention_backend = None
+        self.fused_optimizer_kernel = None
         self._configure_precision()
         self._configure_zero()
         self._configure_comm()
@@ -594,19 +599,28 @@ class DeepSpeedEngine:
                 dict(self.mesh.shape)), ranks=[0])
 
     def _apply_transformer_overrides(self):
-        """``transformer.flash_attention``: flip the model config's
-        dense-path flash-attention gate from ds_config (previously only
-        reachable by constructing the model with use_flash_attention
-        set). The kernel auto-falls-back to the XLA reference off-TPU
-        (ops/transformer/attention.py), so true is safe on CPU rigs."""
+        """``transformer.flash_attention``: resolve the tri-state
+        ("auto"|"pallas"|"xla", bools legacy) against the live backend
+        (ops.transformer.attention.resolve_flash_backend — a forced
+        "pallas" off-TPU runs the interpreter with a loud one-time
+        warning instead of silently flipping the dense flag) and pin the
+        result on the model config. The resolved value is observable as
+        ``self.flash_attention_backend`` and in ``telemetry_snapshot()``,
+        mirroring the serving engine's ``paged_attention_kernel``."""
         flash = self._config.transformer_flash_attention
         if flash is None:
             return
+        from ..ops.transformer.attention import resolve_flash_backend
+        resolved = resolve_flash_backend(flash)
+        self.flash_attention_backend = resolved
         model_cfg = getattr(self.model, "config", None)
         if hasattr(model_cfg, "use_flash_attention"):
-            model_cfg.use_flash_attention = bool(flash)
-            log_dist("transformer.flash_attention={} applied to model "
-                     "{!r}".format(bool(flash), self.model.name),
+            model_cfg.use_flash_attention = resolved != "xla"
+            if hasattr(model_cfg, "flash_attention_backend"):
+                model_cfg.flash_attention_backend = resolved
+            log_dist("transformer.flash_attention={} resolved to {!r} "
+                     "for model {!r}".format(flash, resolved,
+                                             self.model.name),
                      ranks=[0])
         else:
             logger.warning(
@@ -688,6 +702,36 @@ class DeepSpeedEngine:
         max_grad_norm = params.pop("max_grad_norm", None)
         if max_grad_norm and not self._config.gradient_clipping:
             self._config.gradient_clipping = float(max_grad_norm)
+        # optimizer.params.fused_kernel: tri-state for the Pallas apply
+        # kernels (ops/adam/pallas_adam.py, ops/lamb/pallas_lamb.py),
+        # same spelling as transformer.flash_attention. "auto" (default)
+        # leaves the optimizer's own backend pick (default_use_pallas);
+        # "pallas" forces the kernel — off-TPU it runs the interpreter
+        # (the optimizer's update() resolves that) with a loud warning
+        # here; "xla" pins the jnp oracle.
+        fused_kernel = params.pop("fused_kernel", None)
+        if fused_kernel is not None:
+            if not isinstance(fused_kernel, str) or \
+                    fused_kernel.lower() not in ("auto", "pallas", "xla"):
+                raise ValueError(
+                    "optimizer.params.fused_kernel must be one of "
+                    "auto|pallas|xla, got {!r}".format(fused_kernel))
+            fused_kernel = fused_kernel.lower()
+            if name not in (ADAM_OPTIMIZER, "adamw", LAMB_OPTIMIZER):
+                logger.warning(
+                    "optimizer.params.fused_kernel has NO effect: "
+                    "optimizer %r has no Pallas apply kernel", name)
+            elif fused_kernel != "auto":
+                params.setdefault("use_pallas", fused_kernel == "pallas")
+                if fused_kernel == "pallas" and \
+                        jax.default_backend() != "tpu":
+                    logger.warning(
+                        "optimizer.params.fused_kernel: 'pallas' forced "
+                        "on the %s backend — the fused %s apply runs "
+                        "under the Pallas INTERPRETER (orders of "
+                        "magnitude slower; parity/debug only)",
+                        jax.default_backend(), name)
+        self.fused_optimizer_kernel = fused_kernel
         if name in (ADAM_OPTIMIZER, "adamw"):
             if self.zero_cpu_offload():
                 self.optimizer = DeepSpeedCPUAdam(**params)
@@ -1525,9 +1569,19 @@ class DeepSpeedEngine:
         """Rolling-window aggregate of the emitted StepRecords (p50/p95
         step time, MFU, tokens/s/chip, phase means, wire bytes) — ``{}``
         when telemetry is disabled. Benches embed this under
-        ``extra.telemetry``."""
-        return self.telemetry.snapshot() if self.telemetry is not None \
+        ``extra.telemetry``. Resolved kernel tri-states ride along under
+        ``kernels`` (observable like the serving engine's
+        paged_attention_kernel) whenever either ds_config key was set."""
+        out = self.telemetry.snapshot() if self.telemetry is not None \
             else {}
+        if out and (self.flash_attention_backend is not None or
+                    self.fused_optimizer_kernel is not None):
+            out = dict(out)
+            out["kernels"] = {
+                "flash_attention": self.flash_attention_backend,
+                "fused_optimizer": self.fused_optimizer_kernel,
+            }
+        return out
 
     def _tele_flops(self, key, fn, *args):
         """Executed flops of the jitted program behind ``key`` via XLA

@@ -35,12 +35,58 @@ CHROME_TRACE_NAME = "trace_events.json"
 _claimed_dirs = set()
 
 
+def _walk_pallas_costs(jaxpr, acc):
+    """Recurse through a (Closed)Jaxpr accumulating the declared
+    ``pl.CostEstimate`` of every ``pallas_call`` eqn into ``acc``.
+    The pallas_call eqns are nested inside custom_vjp/pjit sub-jaxprs,
+    so a flat scan over the top-level eqns finds nothing."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in getattr(inner, "eqns", ()):
+        if eqn.primitive.name == "pallas_call":
+            ce = eqn.params.get("cost_estimate")
+            if ce is not None:
+                acc["flops"] += float(getattr(ce, "flops", 0) or 0)
+                acc["transcendentals"] += float(
+                    getattr(ce, "transcendentals", 0) or 0)
+                acc["bytes accessed"] += float(
+                    getattr(ce, "bytes_accessed", 0) or 0)
+        for v in eqn.params.values():
+            for item in (v if isinstance(v, (list, tuple)) else (v,)):
+                if hasattr(item, "eqns") or hasattr(item, "jaxpr"):
+                    _walk_pallas_costs(item, acc)
+
+
+def pallas_declared_costs(fn, *args):
+    """Sum of the ``pl.CostEstimate`` declarations carried by every
+    ``pallas_call`` in ``fn``'s jaxpr for ``args``. This is the pricing
+    of record when XLA ``cost_analysis`` cannot see through the custom
+    call (interpret mode inlines real HLO, and TPU cost_analysis
+    already includes the estimate — both of those yield nonzero flops,
+    so this fallback only fires when the opaque call would otherwise
+    price the step at zero and corrupt MFU). Returns ``{}`` when the
+    program declares nothing (or cannot be traced)."""
+    try:
+        import jax
+        closed = jax.make_jaxpr(lambda *a: fn(*a))(*args)
+    except Exception:  # noqa: BLE001 — pricing must never break a step
+        return {}
+    acc = {"flops": 0.0, "transcendentals": 0.0, "bytes accessed": 0.0}
+    _walk_pallas_costs(closed, acc)
+    if not acc["flops"] and not acc["bytes accessed"]:
+        return {}
+    return acc
+
+
 def costs_of_compiled(fn, *args):
     """Full XLA ``cost_analysis`` dict of a jitted callable for ``args``
     (exact for the program about to run). Some jax builds only expose
     costs on the compiled object — the one home for that fallback (the
-    flops profiler and the telemetry collector both read it). Returns
-    ``{}`` when the backend exposes no costs."""
+    flops profiler and the telemetry collector both read it). When the
+    analysis prices the program at zero flops (opaque custom calls the
+    backend refuses to cost), the ``pl.CostEstimate`` declarations of
+    any pallas_call eqns are summed instead so MFU accounting sees
+    through the kernels. Returns ``{}`` when the backend exposes no
+    costs and the program declares none."""
     lowered = fn.lower(*args)
     costs = lowered.cost_analysis()
     if isinstance(costs, list):
@@ -76,6 +122,17 @@ def costs_of_compiled(fn, *args):
                              if k in ("flops", "transcendentals")
                              or k.startswith("bytes accessed") else v)
                          for k, v in costs.items()}
+    if not float((costs or {}).get("flops", 0.0) or 0.0):
+        declared = pallas_declared_costs(fn, *args)
+        if declared:
+            logger.info(
+                "telemetry: cost_analysis priced the program at zero "
+                "flops; using the pl.CostEstimate declarations of its "
+                "pallas_call kernels instead (%.3e flops)",
+                declared["flops"])
+            merged = dict(costs or {})
+            merged.update(declared)
+            costs = merged
     return costs or {}
 
 

@@ -265,10 +265,14 @@ def test_transformer_flash_attention_key():
     from deepspeed_tpu.runtime.config import (
         DeepSpeedConfigError, get_transformer_flash_attention)
     assert get_transformer_flash_attention({}) is None
+    # legacy bools parse onto the tri-state: true -> auto, false -> xla
     assert get_transformer_flash_attention(
-        {"transformer": {"flash_attention": True}}) is True
+        {"transformer": {"flash_attention": True}}) == "auto"
     assert get_transformer_flash_attention(
-        {"transformer": {"flash_attention": False}}) is False
+        {"transformer": {"flash_attention": False}}) == "xla"
+    for mode in ("auto", "pallas", "xla", "PALLAS"):
+        assert get_transformer_flash_attention(
+            {"transformer": {"flash_attention": mode}}) == mode.lower()
     with pytest.raises(DeepSpeedConfigError):
         get_transformer_flash_attention(
             {"transformer": {"flash_attention": "yes"}})
@@ -308,9 +312,19 @@ def test_engine_applies_transformer_and_cm_gates():
     e_on = engine(cm=True, flash=True)
     assert e_on._cm_tp and e_on._cm_zero3
     assert e_on.model.config.collective_matmul is not None
-    # flash flipped ON via ds_config; the dense path falls back to the
-    # XLA kernel off-TPU inside causal_attention
-    assert e_on.model.config.use_flash_attention is True
+    # legacy true parses as "auto": off-TPU that RESOLVES to the XLA
+    # oracle — explicitly, not via a silent in-kernel fallback — and the
+    # resolution is observable on the engine
+    assert e_on.flash_attention_backend == "xla"
+    assert e_on.model.config.flash_attention_backend == "xla"
+    assert e_on.model.config.use_flash_attention is False
+
+    # forced "pallas" off-TPU runs the kernel under the interpreter
+    # (loud warning), never silently dense
+    e_forced = engine(cm=False, flash="pallas")
+    assert e_forced.flash_attention_backend == "interpret"
+    assert e_forced.model.config.flash_attention_backend == "interpret"
+    assert e_forced.model.config.use_flash_attention is True
 
     e_off = engine(cm=False)
     assert not e_off._cm_tp and not e_off._cm_zero3

@@ -107,12 +107,21 @@ def main():
                                   remat=remat, loss_chunk=128)
         n_params = gpt2.num_params(cfg)
         model = gpt2.make_gpt2_model(config=cfg)
+        # the CPU rung runs the classic-offload step so the bench
+        # exercises a MULTI-segment plan — that is where the plan
+        # rewrite passes (hoist/fuse/widen, docs/executor.md) have
+        # segments to move, and extra.executor.rewrites below records
+        # their predicted-vs-measured exposed-wait delta
+        zero = {"stage": 2} if on_tpu else \
+            {"stage": 2, "cpu_offload": True, "sub_group_size": 65536}
         ds_config = {
             "train_micro_batch_size_per_gpu": micro_batch,
             "gradient_accumulation_steps": 1,
             "bf16": {"enabled": True},
-            "zero_optimization": {"stage": 2},
+            "zero_optimization": zero,
             "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+            "runtime": {"executor": "on", "executor_rewrites": {
+                "passes": ["hoist", "fuse", "widen"]}},
             "steps_per_print": 10 ** 9,
             # per-step StepRecords; the final rolling snapshot lands in
             # the JSON line below so BENCH_* files carry MFU/phase/comm

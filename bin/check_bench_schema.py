@@ -92,8 +92,57 @@ SEGMENT_KIND_KEYS = ("segments", "run_s", "wait_s")
 SEGMENT_OPTIONAL_KEYS = (
     "segment_upload_bytes_peak", "groups", "collective_matmul",
     "work_chunks", "mode", "plans_executed", "segments_executed",
-    "last_plan_segments",
+    "last_plan_segments", "rewrites",
 )
+
+# Local copy of telemetry/record.py REWRITE_KEYS / REWRITE_PASS_KEYS
+# (PR 19 plan-rewrite stats; same stdlib-only constraint; pinned equal
+# by tests/unit/test_executor.py).
+REWRITE_KEYS = ("enabled", "passes", "segments_moved",
+                "predicted_exposed_wait_delta_s",
+                "measured_exposed_wait_delta_s")
+REWRITE_PASS_KEYS = ("name", "segments_moved",
+                     "predicted_exposed_wait_delta_s")
+
+
+def check_rewrite_stats(stats, where):
+    """-> list of problems with one REWRITE_KEYS stats dict (a stdlib
+    re-statement of telemetry/record.py validate_rewrite_stats)."""
+    problems = []
+    if not isinstance(stats, dict):
+        return ["{} is not a dict".format(where)]
+    for key in REWRITE_KEYS:
+        if key not in stats:
+            problems.append("{} missing key {!r}".format(where, key))
+    extra = sorted(set(stats) - set(REWRITE_KEYS))
+    if extra:
+        problems.append("{} has unexpected key(s) {}".format(
+            where, extra))
+    if problems:
+        return problems
+    if not isinstance(stats["enabled"], bool):
+        problems.append("{}.enabled is not a bool".format(where))
+    if not _is_num(stats["segments_moved"]) or \
+            stats["segments_moved"] < 0:
+        problems.append("{}.segments_moved is not a nonnegative "
+                        "number".format(where))
+    for key in ("predicted_exposed_wait_delta_s",
+                "measured_exposed_wait_delta_s"):
+        val = stats[key]
+        if val is not None and not _is_num(val):
+            problems.append("{}.{} is neither null nor a number".format(
+                where, key))
+    passes = stats["passes"]
+    if not isinstance(passes, list):
+        return problems + ["{}.passes is not a list".format(where)]
+    for i, entry in enumerate(passes):
+        if not isinstance(entry, dict) or \
+                sorted(entry) != sorted(REWRITE_PASS_KEYS):
+            problems.append(
+                "{}.passes[{}] does not carry exactly {}".format(
+                    where, i, sorted(REWRITE_PASS_KEYS)))
+            break
+    return problems
 
 
 def check_segment_stats(stats, where):
@@ -132,6 +181,9 @@ def check_segment_stats(stats, where):
                     problems.append(
                         "{}.per_kind.{}.{} is not a number".format(
                             where, kind, key))
+    if stats.get("rewrites") is not None:
+        problems.extend(check_rewrite_stats(
+            stats["rewrites"], where + ".rewrites"))
     return problems
 
 
@@ -231,7 +283,7 @@ def check_metrics_payload(payload):
 SCOREBOARD_ROW_KEYS = (
     "rung", "file", "rc", "metric", "value", "unit", "mfu",
     "tokens_per_sec_per_chip", "goodput_tokens_per_sec", "reduction_x",
-    "device", "error",
+    "overlap_efficiency", "device", "error",
 )
 
 

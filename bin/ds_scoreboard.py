@@ -48,7 +48,7 @@ KIND_SCOREBOARD = "bench_scoreboard"
 SCOREBOARD_ROW_KEYS = (
     "rung", "file", "rc", "metric", "value", "unit", "mfu",
     "tokens_per_sec_per_chip", "goodput_tokens_per_sec", "reduction_x",
-    "device", "error",
+    "overlap_efficiency", "device", "error",
 )
 
 # every serving-trajectory row (one per BENCH_SERVING*.json config)
@@ -104,7 +104,8 @@ def load_rung(path):
         "rc": rc,
         "metric": None, "value": None, "unit": None, "mfu": None,
         "tokens_per_sec_per_chip": None, "goodput_tokens_per_sec": None,
-        "reduction_x": None, "device": None, "error": None,
+        "reduction_x": None, "overlap_efficiency": None,
+        "device": None, "error": None,
     }
     if inner is None:
         row["error"] = "no bench JSON line in the run record " \
@@ -129,6 +130,10 @@ def load_rung(path):
             best_goodput = val if best_goodput is None \
                 else max(best_goodput, val)
     row["goodput_tokens_per_sec"] = best_goodput
+    executor = extra.get("executor") or {}
+    eff = executor.get("overlap_efficiency")
+    row["overlap_efficiency"] = eff if isinstance(eff, (int, float)) \
+        and not isinstance(eff, bool) else None
     comm = extra.get("comm") or {}
     red = comm.get("reduction_x")
     row["reduction_x"] = red if isinstance(red, dict) else (
@@ -385,6 +390,32 @@ def build_scoreboard(paths, regression_pct=10.0, gate_cpu=False,
             regression = latest["mfu"] < \
                 best_prior["mfu"] * (1.0 - regression_pct / 100.0)
             gate = "tripped" if regression else "passed"
+    # overlap-efficiency trajectory (PR 19, extra.executor): the same
+    # same-device newest-vs-best-prior gate MFU gets — a plan-rewrite
+    # or scheduler change that quietly re-exposes transfer waits trips
+    # here even when MFU noise hides it
+    overlap = [r for r in rows
+               if r["overlap_efficiency"] is not None and r["rc"] == 0]
+    ov_latest = ov_best_prior = None
+    ov_regression = False
+    ov_gate = None
+    if overlap:
+        ov_latest = overlap[-1]
+        same_device = [r for r in overlap[:-1]
+                       if r["device"] == ov_latest["device"]]
+        if ov_latest["device"] == "cpu" and not gate_cpu:
+            ov_gate = "skipped: latest rung is a cpu-fallback rung " \
+                      "(pass --gate-cpu to include)"
+        elif not same_device:
+            ov_gate = "skipped: no prior overlap-measured rung on " \
+                      "device {!r}".format(ov_latest["device"])
+        else:
+            ov_best_prior = max(same_device,
+                                key=lambda r: r["overlap_efficiency"])
+            ov_regression = ov_latest["overlap_efficiency"] < \
+                ov_best_prior["overlap_efficiency"] * \
+                (1.0 - regression_pct / 100.0)
+            ov_gate = "tripped" if ov_regression else "passed"
     serving = build_serving_board(
         serving_paths, regression_pct=regression_pct,
         gate_cpu=gate_cpu) if serving_paths else None
@@ -401,6 +432,12 @@ def build_scoreboard(paths, regression_pct=10.0, gate_cpu=False,
         "best_prior_rung": best_prior["rung"] if best_prior else None,
         "latest_mfu": latest["mfu"] if latest else None,
         "latest_rung": latest["rung"] if latest else None,
+        "latest_overlap_efficiency":
+        ov_latest["overlap_efficiency"] if ov_latest else None,
+        "best_prior_overlap_efficiency":
+        ov_best_prior["overlap_efficiency"] if ov_best_prior else None,
+        "overlap_regression": ov_regression,
+        "overlap_gate": ov_gate,
         "regression_pct": regression_pct,
         "regression": regression,
         "gate": gate,
@@ -422,18 +459,19 @@ def render_markdown(board):
         "# Bench trajectory",
         "",
         "| rung | file | rc | MFU | tokens/s/chip | goodput tok/s | "
-        "wire reduction_x | device | error |",
-        "|---:|---|---:|---:|---:|---:|---|---|---|",
+        "wire reduction_x | overlap eff | device | error |",
+        "|---:|---|---:|---:|---:|---:|---|---:|---|---|",
     ]
     for row in board["rows"]:
         lines.append(
             "| {rung} | {file} | {rc} | {mfu} | {tps} | {goodput} | "
-            "{red} | {device} | {error} |".format(
+            "{red} | {overlap} | {device} | {error} |".format(
                 rung=row["rung"], file=row["file"], rc=row["rc"],
                 mfu=_fmt(row["mfu"]),
                 tps=_fmt(row["tokens_per_sec_per_chip"], "{:.1f}"),
                 goodput=_fmt(row["goodput_tokens_per_sec"], "{:.1f}"),
                 red=_fmt(row["reduction_x"]),
+                overlap=_fmt(row["overlap_efficiency"]),
                 device=row["device"] or "-",
                 error=(row["error"] or "-").replace("|", "/")[:60]))
     lines.append("")
@@ -450,6 +488,21 @@ def render_markdown(board):
                          _fmt(board["latest_mfu"]),
                          _fmt(board["best_prior_mfu"]),
                          board["gate"] or "n/a"))
+    if board.get("overlap_regression"):
+        lines.append("")
+        lines.append(
+            "**OVERLAP REGRESSION**: latest overlap efficiency {} is "
+            "more than {}% below the best same-device prior {}.".format(
+                _fmt(board["latest_overlap_efficiency"]),
+                board["regression_pct"],
+                _fmt(board["best_prior_overlap_efficiency"])))
+    elif board.get("latest_overlap_efficiency") is not None:
+        lines.append(
+            "Overlap efficiency: latest {} (best same-device prior {}; "
+            "gate {}).".format(
+                _fmt(board["latest_overlap_efficiency"]),
+                _fmt(board["best_prior_overlap_efficiency"]),
+                board["overlap_gate"] or "n/a"))
     serving = board.get("serving")
     if serving and serving["rows"]:
         lines += [
@@ -584,6 +637,11 @@ def main(argv=None):
     if board["regression"]:
         print("ds_scoreboard: REGRESSION gate tripped (>{}% MFU drop)"
               .format(args.regression_pct), file=sys.stderr)
+        return 1
+    if board.get("overlap_regression"):
+        print("ds_scoreboard: OVERLAP regression gate tripped (>{}% "
+              "overlap-efficiency drop)".format(args.regression_pct),
+              file=sys.stderr)
         return 1
     if board.get("serving") and board["serving"]["regression"]:
         print("ds_scoreboard: SERVING regression gate tripped (>{}% "

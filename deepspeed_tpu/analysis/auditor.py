@@ -146,7 +146,7 @@ def audit_programs(specs, config, job="audit", suppressions=None,
         closed, walk_result, findings = audit_program(spec, config)
         report.extend(findings, suppressions)
         meta = {"family": spec.family,
-                "donate_argnums": list(spec.donate_argnums)}
+                "donate_argnums": list(spec.donate)}
         if walk_result is not None:
             meta["segments"] = segment_summary(walk_result)
             report.collective_families[spec.name] = \
@@ -157,8 +157,8 @@ def audit_programs(specs, config, job="audit", suppressions=None,
                 spec.meta.get("wire_multiplier") or
                 spec.meta.get("out_expect")):
             try:
-                fn = jax.jit(spec.build(),
-                             donate_argnums=spec.donate_argnums)
+                from ..runtime.executor.jit import jit_program
+                fn = jit_program(spec.build(), donate=spec.donate)
                 compiled = fn.lower(*spec.args).compile()
             except Exception as err:  # noqa: BLE001 - report, don't die
                 report.add(Finding(
@@ -239,7 +239,9 @@ def audit_plan(engine, report):
     is a bug in the lowering, never an accepted quirk); the plan's
     shape lands in the report's program table as ``plan/<name>``."""
     if getattr(engine, "stream_runner", None) is None and \
-            getattr(engine, "host_state", None) is None:
+            getattr(engine, "host_state", None) is None and \
+            getattr(engine, "pipe_module", None) is None and \
+            not hasattr(engine, "prefill_buckets"):
         return None                 # micro/fused: one-segment plans
     from .ir import plan_of
     try:
@@ -318,11 +320,10 @@ def audit_engine(engine, batch=None, hlo=None, report_path=None,
         suppressions=config.suppressions, sequence=sequence,
         hlo=use_hlo, wire_est=wire_est, mesh=mesh,
         extra_findings=san.report() if san is not None else ())
-    plan = None
-    if job == "train":
-        # lowered-plan verification rides the same report (and lands in
-        # the same artifact) as the program rules
-        plan = audit_plan(engine, report)
+    # lowered-plan verification rides the same report (and lands in
+    # the same artifact) as the program rules — serving included, now
+    # that the scheduler's step is a lowered serving_step plan
+    plan = audit_plan(engine, report)
     # canonical program fingerprint (ISSUE 15): the collective order of
     # every walked program + the lowered plan topology, published into
     # this host's manifest so bin/ds_fleet.py can verify the whole

@@ -208,13 +208,13 @@ def collect_train_programs(engine, batch=None):
         # plus the jitted overflow/norm check; Adam runs on host
         specs.append(ProgramSpec(
             name="micro", family="offload", build=engine._micro_step_fn,
-            args=(state_struct, micro_b, rng, pld), donate_argnums=(0,),
+            args=(state_struct, micro_b, rng, pld), donate=(0,),
             expected_constraints=n_grad, constraint_axes=axes,
             meta={"out_expect": out_expect}, **common))
         specs.append(ProgramSpec(
             name="fused_micros", family="offload",
             build=engine._fused_micros_fn,
-            args=(state_struct, stacked_b, rng, pld), donate_argnums=(0,),
+            args=(state_struct, stacked_b, rng, pld), donate=(0,),
             expected_constraints=n_grad, constraint_axes=axes,
             meta={"out_expect": out_expect}, **common))
         specs.append(ProgramSpec(
@@ -227,20 +227,20 @@ def collect_train_programs(engine, batch=None):
     gas = engine.gradient_accumulation_steps()
     specs.append(ProgramSpec(
         name="micro", family="micro", build=engine._micro_step_fn,
-        args=(state_struct, micro_b, rng, pld), donate_argnums=(0,),
+        args=(state_struct, micro_b, rng, pld), donate=(0,),
         expected_constraints=n_grad, constraint_axes=axes,
         meta={"out_expect": out_expect, "wire_multiplier": gas},
         **common))
     specs.append(ProgramSpec(
         name="apply", family="micro", build=engine._apply_step_fn,
-        args=(state_struct, hyper), donate_argnums=(0,),
+        args=(state_struct, hyper), donate=(0,),
         expected_constraints=max(n_master, n_grad), constraint_axes=axes,
         meta={"out_expect": out_expect, "wire_multiplier": 1},
         **common))
     specs.append(ProgramSpec(
         name="fused_train", family="fused", build=engine._fused_train_fn,
         args=(state_struct, stacked_b, rng, hyper, pld),
-        donate_argnums=(0,),
+        donate=(0,),
         expected_constraints=n_grad + max(n_master, n_grad),
         constraint_axes=axes, meta={"out_expect": out_expect}, **common))
     return specs
@@ -257,13 +257,13 @@ def _collect_pipeline(engine, state_struct, stacked_b, rng, hyper, axes):
         return [ProgramSpec(
             name="pipe_micros", family="pipeline",
             build=engine._pipe_grads_fn,
-            args=(state_struct, stacked_b, rng), donate_argnums=(0,),
+            args=(state_struct, stacked_b, rng), donate=(0,),
             expected_constraints=n_grad, constraint_axes=axes,
             meta={"out_expect": out_expect}, **common)]
     return [ProgramSpec(
         name="pipe_train", family="pipeline",
         build=engine._fused_train_fn,
-        args=(state_struct, stacked_b, rng, hyper), donate_argnums=(0,),
+        args=(state_struct, stacked_b, rng, hyper), donate=(0,),
         expected_constraints=n_grad + max(n_master, n_grad),
         constraint_axes=axes, meta={"out_expect": out_expect}, **common)]
 
@@ -315,12 +315,12 @@ def _collect_streamed(engine, micro_b, rng):
             name="stream/e_fwd",
             build=lambda: runner._embed_fwd_fn(runner._e_def, has_rng),
             args=(e_sds, micro_b, key),
-            donate_argnums=STREAM_DONATE["e_fwd"], **common),
+            donate=STREAM_DONATE["e_fwd"], **common),
         ProgramSpec(
             name="stream/g_fwd",
             build=lambda: runner._group_fwd_fn(b_defs, has_rng),
             args=(g0_split, x_struct, gkeys),
-            donate_argnums=STREAM_DONATE["g_fwd"],
+            donate=STREAM_DONATE["g_fwd"],
             # the boundary activation input is KEPT for the backward
             # recompute — liveness the donation rule cannot see
             keep_args=("1",), **common),
@@ -328,12 +328,12 @@ def _collect_streamed(engine, micro_b, rng):
             name="stream/h_grad",
             build=lambda: runner._head_grad_fn(runner._h_def, has_rng),
             args=(h_sds, x_out, micro_b, key, scale, inv_scale),
-            donate_argnums=STREAM_DONATE["h_grad"], **common),
+            donate=STREAM_DONATE["h_grad"], **common),
         ProgramSpec(
             name="stream/g_bwd",
             build=lambda: runner._group_bwd_fn(b_defs, has_rng),
             args=(g0_split, x_struct, dx_struct, gkeys, inv_scale),
-            donate_argnums=STREAM_DONATE["g_bwd"],
+            donate=STREAM_DONATE["g_bwd"],
             # x_in stays live only because dx claimed the alias; the
             # uploaded weights have no aliasable output (donating them
             # would only buy an XLA warning)
@@ -342,7 +342,7 @@ def _collect_streamed(engine, micro_b, rng):
             name="stream/e_bwd",
             build=lambda: runner._embed_bwd_fn(runner._e_def, has_rng),
             args=(e_sds, micro_b, dx_struct, key, inv_scale),
-            donate_argnums=STREAM_DONATE["e_bwd"],
+            donate=STREAM_DONATE["e_bwd"],
             keep_args=("0",), **common),
     ]
 
@@ -382,7 +382,7 @@ def collect_inference_programs(engine):
             name="prefill/b{}".format(bucket), family="inference",
             build=lambda b=bucket: _unjitted_prefill(engine, b, greedy,
                                                      top_k),
-            args=args, donate_argnums=(1, 2), mesh=engine.mesh,
+            args=args, donate=(1, 2), mesh=engine.mesh,
             # no allow_weak needed: every scalar operand is an explicit
             # np.int32/np.float32 (strong-typed)
             taint_paths=("0",), trace_bound=n_buckets))
@@ -404,7 +404,7 @@ def collect_inference_programs(engine):
             name=name, family="inference",
             build=lambda w=width: _unjitted_decode(engine, greedy, top_k,
                                                    w),
-            args=args, donate_argnums=(1, 2), mesh=engine.mesh,
+            args=args, donate=(1, 2), mesh=engine.mesh,
             taint_paths=("0",), trace_bound=len(widths)))
     return specs
 

@@ -97,9 +97,12 @@ class ProgramSpec:
                          (the engine's ``*_fn`` builder output);
     ``args``             tuple pytree of arrays / ShapeDtypeStructs /
                          scalars — the program's example operands;
-    ``donate_argnums``   the donation set the engine uses on an
-                         accelerator (CPU-gated donations still declare
-                         the accelerator set here);
+    ``donate``           the donation set (argnums) the engine uses on
+                         an accelerator (CPU-gated donations still
+                         declare the accelerator set here) — the same
+                         spelling ``runtime/executor/jit.jit_program``
+                         takes, so the audited declaration IS the
+                         executed one;
     ``taint_paths``      flat-path prefixes ("0/params") whose low-
                          precision leaves seed the dtype-promotion
                          taint;
@@ -120,7 +123,7 @@ class ProgramSpec:
     family: str
     build: object
     args: tuple
-    donate_argnums: tuple = ()
+    donate: tuple = ()
     plan: object = None
     mesh: object = None
     taint_paths: tuple = ()
@@ -130,6 +133,11 @@ class ProgramSpec:
     constraint_axes: tuple = ()
     trace_bound: object = None
     meta: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def donate_argnums(self):
+        """Jax spelling of :attr:`donate` (report/readers compat)."""
+        return self.donate
 
 
 def _kp_str(key_path):
@@ -186,10 +194,10 @@ def _match_prefix(path, prefixes):
 
 
 def donated_flat_indices(spec):
-    """Flat-leaf indices covered by ``donate_argnums``."""
+    """Flat-leaf indices covered by the spec's donation set."""
     donated = set()
     for i, (argnum, _, _) in enumerate(flat_arg_leaves(spec.args)):
-        if argnum in spec.donate_argnums:
+        if argnum in spec.donate:
             donated.add(i)
     return donated
 

@@ -37,6 +37,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..runtime.executor.jit import jit_program
 from ..utils.logging import logger
 from .config import DeepSpeedInferenceConfig
 from .kv_cache import KVCache, PagedKVCache
@@ -48,24 +49,35 @@ _UNSET = object()    # "argument not given" (None means "no EOS token")
 
 def _parse_configs(config, mesh=None):
     """-> (inference_config, telemetry_config-or-None,
-    analysis_config-or-None). One ds_config drives both training and
-    serving; the serving engine reads its own section plus the shared
-    telemetry and analysis sections."""
+    analysis_config-or-None, runtime_cfg). One ds_config drives both
+    training and serving; the serving engine reads its own section
+    plus the shared telemetry/analysis sections and the ``runtime``
+    executor gates (the scheduler step runs as a segment plan on the
+    same PlanExecutor machinery the training engine uses)."""
+    from ..runtime.config import (RUNTIME_EXECUTOR_DEFAULT,
+                                  get_runtime_executor_rewrites)
+    default_runtime = {"executor": RUNTIME_EXECUTOR_DEFAULT,
+                       "executor_rewrites":
+                       get_runtime_executor_rewrites({})}
     if isinstance(config, DeepSpeedInferenceConfig):
-        return config, None, None
+        return config, None, None, default_runtime
     from ..runtime.config import DeepSpeedConfig
     if isinstance(config, DeepSpeedConfig):
         return (config.inference_config, config.telemetry_config,
-                config.analysis_config)
+                config.analysis_config,
+                {"executor": config.runtime_executor,
+                 "executor_rewrites": config.runtime_executor_rewrites})
     if config is None:
-        return DeepSpeedInferenceConfig({}), None, None
+        return DeepSpeedInferenceConfig({}), None, None, default_runtime
     if isinstance(config, dict):
         full = DeepSpeedConfig(None, param_dict=config, mesh=mesh,
                                inference_only=True)
     else:
         full = DeepSpeedConfig(config, mesh=mesh, inference_only=True)
     return (full.inference_config, full.telemetry_config,
-            full.analysis_config)
+            full.analysis_config,
+            {"executor": full.runtime_executor,
+             "executor_rewrites": full.runtime_executor_rewrites})
 
 
 class InferenceEngine:
@@ -83,8 +95,15 @@ class InferenceEngine:
         assert model_config is not None and hasattr(model_config, "n_heads"), \
             "init_inference needs a model with a GPT2Config at .config " \
             "(e.g. models.gpt2.make_gpt2_model)"
-        self.inference_config, telemetry_config, analysis_config = \
-            _parse_configs(config, mesh=mesh)
+        self.inference_config, telemetry_config, analysis_config, \
+            runtime_cfg = _parse_configs(config, mesh=mesh)
+        # segment-plan executor (runtime/executor/, docs/executor.md):
+        # the continuous-batching scheduler step runs as a SegmentPlan;
+        # runtime.executor "off" = serial oracle, else overlap mode
+        self._executor_mode = "serial" \
+            if runtime_cfg["executor"] == "off" else "overlap"
+        self._executor_rewrites = runtime_cfg["executor_rewrites"]
+        self._plan_executor = None
         if analysis_config is None:
             from ..analysis.config import DeepSpeedAnalysisConfig
             analysis_config = DeepSpeedAnalysisConfig({})
@@ -484,7 +503,7 @@ class InferenceEngine:
                 token = sampler(logits, rng, temperature, top_p)[0]
                 return k_cache, v_cache, token, logits[0]
 
-        fn = jax.jit(prefill, donate_argnums=(1, 2))
+        fn = jit_program(prefill, donate=(1, 2))
         self._prefill_fns[key] = fn
         self.compile_stats["prefill_traces"] += 1
         if self.telemetry is not None:
@@ -555,7 +574,7 @@ class InferenceEngine:
                                  top_p).reshape(tokens.shape)
                 return k_cache, v_cache, chosen, logits
 
-        fn = jax.jit(decode, donate_argnums=(1, 2))
+        fn = jit_program(decode, donate=(1, 2))
         self._decode_fns[key] = fn
         self.compile_stats["decode_traces"] += 1
         if self.telemetry is not None:
@@ -570,6 +589,27 @@ class InferenceEngine:
 
     def pages_for(self, n_tokens):
         return -(-n_tokens // self.page_size)
+
+    def plan_executor(self):
+        """The serving engine's PlanExecutor (the training engine's
+        twin seam): the continuous-batching scheduler runs each step
+        as an admit -> prefill -> decode -> retire segment plan
+        (runtime/executor/serving.py)."""
+        if self._plan_executor is None:
+            from ..runtime.executor import PlanExecutor
+            self._plan_executor = PlanExecutor(
+                mode=self._executor_mode,
+                rewrites=self._executor_rewrites
+                if self._executor_rewrites.get("enabled") else None)
+        return self._plan_executor
+
+    def executor_snapshot(self):
+        """Engine-lifetime executor counters (bench extra.executor),
+        mirroring the training engine's seam."""
+        if self._plan_executor is None:
+            return {"mode": self._executor_mode, "plans_executed": 0,
+                    "segments_executed": 0, "last_plan_segments": 0}
+        return self._plan_executor.lifetime_snapshot()
 
     def page_pool_stats(self):
         """``{num_pages, pages_in_use, occupancy}`` — None on the slot
@@ -678,7 +718,7 @@ class InferenceEngine:
         if self._page_copy_fn is None:
             def copy(k, v, src, dst):
                 return (k.at[dst].set(k[src]), v.at[dst].set(v[src]))
-            self._page_copy_fn = jax.jit(copy, donate_argnums=(0, 1))
+            self._page_copy_fn = jit_program(copy, donate=(0, 1))
         k, v = self._page_copy_fn(self.kv.k, self.kv.v, jnp.int32(src),
                                   jnp.int32(dst))
         self.kv.update((k, v))

@@ -477,7 +477,6 @@ class ContinuousBatchingScheduler:
             # telemetry.jsonl without bound and drag the snapshot's
             # occupancy/queue p50/p95 down to the idle value)
             return []
-        retired = []
         tel = getattr(self.engine, "telemetry", None)
         # 0-based like the training engine's records (global_steps at
         # window open) and ENGINE-lifetime (not per-generate-call), so
@@ -488,32 +487,11 @@ class ContinuousBatchingScheduler:
             # BEFORE the step's prefill/decode work so an armed xprof
             # window opens around it, not after it (docs/telemetry.md)
             tel.on_step_begin(record_step)
-
-        self._admit()
-        self._prefill_chunks(retired)
-        # occupancy counts slots that did work THIS step — retire-at-
-        # prefill already freed some, so measure before the decode
-        # retire pass too
-        busy = self.num_active + len(retired)
-        self._decode(retired)
-
-        self.steps += 1
-        self.engine.serving_record_steps = record_step + 1
-        occupancy = min(busy, self.engine.num_slots) / self.engine.num_slots
-        self._account("record_schedule",
-                      occupancy=occupancy,
-                      queue_depth=len(self.queue), step=self.steps)
-        if tel is not None:
-            # one serving_step record per scheduler step through the same
-            # sink layer the training engine writes (docs/telemetry.md)
-            tel.emit_serving_step(
-                step=record_step, metrics=self._record_metrics,
-                active_slots=self.num_active,
-                queue_depth=len(self.queue), occupancy=occupancy,
-                page_pool=self.engine.page_pool_stats(),
-                prefix=self.engine.prefix_stats(),
-                role=getattr(self.engine, "serving_role", None))
-        return retired
+        # the step body is a segment plan on the PlanExecutor
+        # (runtime/executor/serving.py): admit -> prefill -> decode ->
+        # retire, each phase one audited segment
+        from ..runtime.executor.serving import run_serving_step
+        return run_serving_step(self, record_step)
 
     def run(self):
         """Drive step() until every submitted request has retired; returns

@@ -26,6 +26,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..runtime.executor.jit import jit_program
+
 
 class NGramDrafter:
     """Prompt-lookup drafting (host-side, deterministic, model-free).
@@ -129,7 +131,7 @@ class ModelDrafter:
                 v_cache, v_row, slot, axis=0)
             return k_cache, v_cache
 
-        fn = jax.jit(prefill, donate_argnums=(1, 2))
+        fn = jit_program(prefill, donate=(1, 2))
         self._prefill_fns[bucket] = fn
         return fn
 
@@ -162,7 +164,7 @@ class ModelDrafter:
                 length=k + 1)
             return k_cache, v_cache, drafts.T[:, :k]    # (slots, k)
 
-        fn = jax.jit(propose, donate_argnums=(1, 2))
+        fn = jit_program(propose, donate=(1, 2))
         self._propose_fns[k] = fn
         return fn
 

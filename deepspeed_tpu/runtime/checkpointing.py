@@ -171,9 +171,8 @@ def _write_pool():
     of being dropped mid-queue."""
     global _WRITE_POOL
     if _WRITE_POOL is None:
-        from concurrent.futures import ThreadPoolExecutor
-        _WRITE_POOL = ThreadPoolExecutor(max_workers=1,
-                                         thread_name_prefix="ckpt-write")
+        from .executor.pools import write_pool
+        _WRITE_POOL = write_pool()
         atexit.register(_drain_write_pool_at_exit)
     return _WRITE_POOL
 

@@ -466,6 +466,79 @@ def get_runtime_executor(param_dict):
     return val.lower()
 
 
+RUNTIME_EXECUTOR_REWRITES = "executor_rewrites"
+RUNTIME_EXECUTOR_REWRITE_PASSES = ("hoist", "widen", "fuse")
+RUNTIME_EXECUTOR_REWRITES_KEYS = (
+    "enabled", "passes", "max_window", "hoist_max_live_bytes")
+RUNTIME_EXECUTOR_REWRITES_MAX_WINDOW_DEFAULT = 8
+RUNTIME_EXECUTOR_REWRITES_LIVE_BYTES_DEFAULT = 1 << 28
+
+
+def get_runtime_executor_rewrites(param_dict):
+    """``runtime.executor_rewrites``: the plan rewrite passes
+    (``runtime/executor/rewrite.py``, docs/executor.md) applied at
+    plan-build time in overlap mode — collective/transfer hoisting,
+    prefetch-window widening, small-segment fusion. Default OFF (the
+    lowered plans execute exactly as declared). ``true`` enables every
+    pass; a dict selects passes and bounds (``max_window``: widening
+    ceiling per pool; ``hoist_max_live_bytes``: the live-bytes window a
+    hoist may extend a result's lifetime across). Strict-validated like
+    ``runtime.executor``: unknown keys or pass names raise — a typo'd
+    pass silently not running would fake an A/B result."""
+    sub = param_dict.get(RUNTIME) or {}
+    if not isinstance(sub, dict):
+        raise DeepSpeedConfigError(
+            "runtime must be a dict, got {}".format(type(sub).__name__))
+    val = sub.get(RUNTIME_EXECUTOR_REWRITES, False)
+    if isinstance(val, bool):
+        val = {"enabled": val}
+    if not isinstance(val, dict):
+        raise DeepSpeedConfigError(
+            "runtime.{} must be a bool or a dict, got {!r}".format(
+                RUNTIME_EXECUTOR_REWRITES, val))
+    for key in val:
+        if key not in RUNTIME_EXECUTOR_REWRITES_KEYS:
+            raise DeepSpeedConfigError(
+                "unknown key {!r} in runtime.{} (accepted: {})".format(
+                    key, RUNTIME_EXECUTOR_REWRITES,
+                    ", ".join(RUNTIME_EXECUTOR_REWRITES_KEYS)))
+    enabled = val.get("enabled", True)
+    if not isinstance(enabled, bool):
+        raise DeepSpeedConfigError(
+            "runtime.{}.enabled must be a bool, got {!r}".format(
+                RUNTIME_EXECUTOR_REWRITES, enabled))
+    passes = val.get("passes", list(RUNTIME_EXECUTOR_REWRITE_PASSES))
+    if not isinstance(passes, (list, tuple)) or not all(
+            isinstance(p, str) for p in passes):
+        raise DeepSpeedConfigError(
+            "runtime.{}.passes must be a list of pass names, got "
+            "{!r}".format(RUNTIME_EXECUTOR_REWRITES, passes))
+    for p in passes:
+        if p not in RUNTIME_EXECUTOR_REWRITE_PASSES:
+            raise DeepSpeedConfigError(
+                "unknown rewrite pass {!r} in runtime.{}.passes "
+                "(accepted: {})".format(
+                    p, RUNTIME_EXECUTOR_REWRITES,
+                    "|".join(RUNTIME_EXECUTOR_REWRITE_PASSES)))
+    max_window = val.get("max_window",
+                         RUNTIME_EXECUTOR_REWRITES_MAX_WINDOW_DEFAULT)
+    if isinstance(max_window, bool) or not isinstance(max_window, int) \
+            or max_window < 1:
+        raise DeepSpeedConfigError(
+            "runtime.{}.max_window must be an int >= 1, got {!r}".format(
+                RUNTIME_EXECUTOR_REWRITES, max_window))
+    live_bytes = val.get("hoist_max_live_bytes",
+                         RUNTIME_EXECUTOR_REWRITES_LIVE_BYTES_DEFAULT)
+    if isinstance(live_bytes, bool) or not isinstance(live_bytes, int) \
+            or live_bytes < 1:
+        raise DeepSpeedConfigError(
+            "runtime.{}.hoist_max_live_bytes must be an int >= 1, got "
+            "{!r}".format(RUNTIME_EXECUTOR_REWRITES, live_bytes))
+    return {"enabled": enabled, "passes": tuple(passes),
+            "max_window": max_window,
+            "hoist_max_live_bytes": live_bytes}
+
+
 TRANSFORMER_FLASH_ATTENTION_MODES = ("auto", "pallas", "xla")
 
 
@@ -681,6 +754,8 @@ class DeepSpeedConfig(object):
         self.transformer_flash_attention = \
             get_transformer_flash_attention(param_dict)
         self.runtime_executor = get_runtime_executor(param_dict)
+        self.runtime_executor_rewrites = \
+            get_runtime_executor_rewrites(param_dict)
 
         self.gradient_clipping = get_gradient_clipping(param_dict)
         self.grad_accum_dtype = get_grad_accum_dtype(param_dict)
@@ -843,7 +918,7 @@ class DeepSpeedConfig(object):
         # CollectiveMatmulConfig itself (runtime/comm/config.py)
         COMM: KNOWN_COMM_KEYS,
         TRANSFORMER: {TRANSFORMER_FLASH_ATTENTION},
-        RUNTIME: {RUNTIME_EXECUTOR},
+        RUNTIME: {RUNTIME_EXECUTOR, RUNTIME_EXECUTOR_REWRITES},
         "elasticity": {"enabled", "max_train_batch_size",
                        "micro_batch_sizes", "min_gpus", "max_gpus",
                        "min_time", "prefer_larger_batch",

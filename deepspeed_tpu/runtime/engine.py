@@ -182,6 +182,10 @@ class DeepSpeedEngine:
         # overlap-constructing schedule (built lazily on first use)
         self._executor_mode = "serial" \
             if self._config.runtime_executor == "off" else "overlap"
+        # plan rewrite passes (runtime/executor/rewrite.py): the
+        # strict-validated runtime.executor_rewrites dict (enabled,
+        # passes, bounds); applied in overlap mode only
+        self._executor_rewrites = self._config.runtime_executor_rewrites
         self._plan_executor = None
         # elastic rescale trail (runtime/elastic/): an ElasticRunner
         # swaps in its SHARED events list so the crash bundle's topology
@@ -1541,9 +1545,11 @@ class DeepSpeedEngine:
 
         return apply_step
 
-    def _get_jit(self, key, builder, **jit_kwargs):
+    def _get_jit(self, key, builder, donate=(), **jit_kwargs):
         if key not in self._jit_cache:
-            self._jit_cache[key] = jax.jit(builder(), **jit_kwargs)
+            from .executor.jit import jit_program
+            self._jit_cache[key] = jit_program(builder(), donate=donate,
+                                               **jit_kwargs)
         return self._jit_cache[key]
 
     # -------------------------------------------------------------- telemetry
@@ -1622,13 +1628,13 @@ class DeepSpeedEngine:
             self._window_flops += self._tele_flops(key, fn, *args)
             self.telemetry.programs.observe_call(key, fn, args)
 
-    def _jit_priced(self, key, builder, *args, donate_argnums=(0,)):
+    def _jit_priced(self, key, builder, *args, donate=(0,)):
         """``_get_jit`` plus telemetry flops accounting in one place,
         priced with ``args`` BEFORE the returned fn runs (it donates
         them). Every jitted train path must obtain its fn through this
         (zero/stream.py's ``_run`` is the offload twin) or
         ``_window_flops`` silently undercounts and MFU deflates."""
-        fn = self._get_jit(key, builder, donate_argnums=donate_argnums)
+        fn = self._get_jit(key, builder, donate=donate)
         self._tele_add_flops(key, fn, *args)
         return fn
 
@@ -2138,7 +2144,9 @@ class DeepSpeedEngine:
             from .executor import PlanExecutor
             self._plan_executor = PlanExecutor(
                 mode=self._executor_mode,
-                windows={"d2h": self._D2H_WINDOW})
+                windows={"d2h": self._D2H_WINDOW},
+                rewrites=self._executor_rewrites
+                if self._executor_rewrites.get("enabled") else None)
         return self._plan_executor
 
     def executor_snapshot(self):
@@ -2186,9 +2194,9 @@ class DeepSpeedEngine:
                                   hs)
 
     def _upload_pool(self):
-        from .zero.transfer import make_upload_pool
+        from .executor.pools import upload_pool
         if getattr(self, "_h2d_pool", None) is None:
-            self._h2d_pool = make_upload_pool()
+            self._h2d_pool = upload_pool()
         return self._h2d_pool
 
     def _h2d_split_cache(self):

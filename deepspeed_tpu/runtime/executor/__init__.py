@@ -20,12 +20,17 @@ from .scheduler import PlanExecutor, SegmentRecord
 def plan_for_engine(engine, family=None):
     """The abstract segment plan of ``engine``'s step path (topology
     only — run payloads are None). ``family``: ``"offload_apply"`` /
-    ``"streamed_micro"`` / ``"streamed_apply"``; default resolves from
+    ``"streamed_micro"`` / ``"streamed_apply"`` / ``"pipe_step"`` /
+    ``"pipe_eval_step"`` / ``"serving_step"``; default resolves from
     the engine's live path. Raises ValueError for paths that have no
     multi-segment lowering (micro/fused run as one-segment plans built
     inline at step time)."""
     if family is None:
-        if getattr(engine, "stream_runner", None) is not None:
+        if hasattr(engine, "prefill_buckets"):       # inference engine
+            family = "serving_step"
+        elif getattr(engine, "pipe_module", None) is not None:
+            family = "pipe_step"
+        elif getattr(engine, "stream_runner", None) is not None:
             family = "streamed_micro"
         elif getattr(engine, "host_state", None) is not None:
             family = "offload_apply"
@@ -33,9 +38,16 @@ def plan_for_engine(engine, family=None):
             raise ValueError(
                 "plan_for_engine: engine runs the {} path, which lowers "
                 "to one-segment plans built at step time — only the "
-                "offload/streamed paths expose a multi-segment plan "
-                "ahead of time".format(
+                "pipe/offload/streamed paths expose a multi-segment "
+                "plan ahead of time".format(
                     getattr(engine, "_step_path", "micro")))
+    if family in ("pipe_step", "pipe_eval_step"):
+        from .pipe import build_pipe_plan
+        return build_pipe_plan(engine,
+                               eval_mode=(family == "pipe_eval_step"))
+    if family == "serving_step":
+        from .serving import build_serving_plan
+        return build_serving_plan(engine)
     if family == "offload_apply":
         from .offload import build_update_plan
         return build_update_plan(engine)

@@ -1,0 +1,81 @@
+"""Segment-plan pricing: every lowered plan is a priced DAG.
+
+The rewrite passes (``rewrite.py``) reason over declared prices — a
+hoist trades live bytes for exposed-wait reduction, a widened window
+pins more in-flight transfer bytes — so the prices must come from
+seams the repo already trusts rather than fresh guesswork:
+
+  * ``nbytes`` on transfer segments: the actual payload sizes the
+    lowering knows (host-buffer shapes, batch leaves), or — for
+    collective segments — ``runtime/comm/wire.py``'s census-ground-
+    truthed per-step byte estimate split across the plan's collective
+    nodes;
+  * ``flops`` on compute segments: the XLA ``cost_analysis`` prices
+    the engine's ``_tele_flops`` telemetry seam caches per jit key
+    (Pallas kernels surface theirs through the same seam via
+    ``pl.CostEstimate``-backed cost_analysis).
+
+Pricing mutates the plan in place and is idempotent; abstract plans
+(``ir.plan_of``) price the same way, so the audited DAG carries the
+same numbers the executed one does.
+"""
+import numpy as np
+
+
+def batch_nbytes(batch):
+    """Total bytes of a host batch pytree (the ``h2d/batch`` price)."""
+    total = 0
+    import jax
+    for leaf in jax.tree_util.tree_leaves(batch):
+        nb = getattr(leaf, "nbytes", None)   # no copy for array leaves
+        if nb is None:
+            arr = np.asarray(leaf)
+            nb = int(arr.size) * int(arr.dtype.itemsize)
+        total += int(nb)
+    return total
+
+
+def wire_collective_bytes(engine):
+    """Per-step collective bytes from the wire.py estimator; 0 when the
+    engine cannot be priced (no zero_plan yet, serving engine)."""
+    try:
+        est = engine._telemetry_wire()
+    except Exception:  # noqa: BLE001 - pricing must never break a step
+        est = None
+    if not est:
+        return 0
+    return int(est.get("total_bytes_per_step", 0) or 0)
+
+
+def price_plan(plan, engine=None, nbytes=None, flops=None):
+    """Attach prices to ``plan``'s segments in place and return it.
+
+    ``nbytes``/``flops`` map segment names to explicit prices (the
+    lowering's own knowledge — these win). Without an explicit price,
+    collective segments split the engine's wire.py per-step bytes
+    evenly, and compute segments read the ``_tele_flops_cache`` entry
+    for the jit key named by ``flops`` (so a price appears once the
+    program has been priced by its first ``_jit_priced`` call).
+    """
+    nbytes = nbytes or {}
+    flops = flops or {}
+    collectives = [s for s in plan.segments if s.kind == "collective"
+                   and s.name not in nbytes]
+    share = 0
+    if engine is not None and collectives:
+        share = wire_collective_bytes(engine) // len(collectives)
+    for seg in plan.segments:
+        if seg.name in nbytes:
+            seg.nbytes = int(nbytes[seg.name])
+        elif seg.kind == "collective" and share and not seg.nbytes:
+            seg.nbytes = share
+        price = flops.get(seg.name)
+        if price is None:
+            continue
+        if isinstance(price, str):
+            # a jit-key reference into the telemetry pricing seam
+            cache = getattr(engine, "_tele_flops_cache", None) or {}
+            price = cache.get(price)
+        if price:
+            seg.flops = float(price)
+    return plan

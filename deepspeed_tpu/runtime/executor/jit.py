@@ -1,0 +1,27 @@
+"""The one place a step program declares donation.
+
+Every jitted step program in the repo obtains its wrapper through
+:func:`jit_program` (directly, or via ``DeepSpeedEngine._get_jit`` /
+``StreamedOffloadRunner._jit`` which route here): the executor owns the
+donation policy exactly like it owns async dispatch and phase timing
+(DSL006 — step scheduling lives in ``runtime/executor/`` only; since
+ISSUE 19 the baseline for that rule is EMPTY).
+
+``donate`` is the same declaration :class:`~.plan.Segment.donate`
+mirrors and ``analysis/rules.py``'s donation audit reads — one spelling
+per program, checked end to end: the engine passes it here, the plan
+records it, the auditor verifies the jitted program honors it.
+"""
+import jax
+
+
+def jit_program(fn, donate=(), **jit_kwargs):
+    """``jax.jit`` with the executor-owned donation declaration.
+
+    ``donate``: positional argnums the program consumes (its
+    ``donate_argnums``). Extra ``jit_kwargs`` (``out_shardings``,
+    ``static_argnums``, ...) pass through untouched.
+    """
+    if donate:
+        jit_kwargs["donate_argnums"] = tuple(donate)
+    return jax.jit(fn, **jit_kwargs)

@@ -100,6 +100,7 @@ def build_update_plan(engine, work=None, payloads=None):
     for j, item in enumerate(work):
         by_leaf.setdefault(item[0], []).append(j)
     upload_names = []
+    leaf_bytes = {}
     for j, item in enumerate(work):
         i = item[0]
         rows = item[3]
@@ -108,6 +109,7 @@ def build_update_plan(engine, work=None, payloads=None):
         if rows is not None and shape:
             n = (rows[1] - rows[0]) * \
                 (int(np.prod(shape[1:])) if len(shape) > 1 else 1)
+        leaf_bytes[i] = leaf_bytes.get(i, 0) + n * 4
         run, start = payloads.get("d2h/%d" % j, (None, None))
         plan.add(Segment(
             name="d2h/%d" % j, kind="transfer", async_ok=True,
@@ -122,7 +124,8 @@ def build_update_plan(engine, work=None, payloads=None):
             plan.add(Segment(
                 name="upload/%d" % i, kind="transfer",
                 deps=tuple("adam/%d" % jj for jj in by_leaf[i]),
-                phase="h2d_dispatch_s", run=run))
+                phase="h2d_dispatch_s", run=run,
+                nbytes=leaf_bytes[i]))
             upload_names.append("upload/%d" % i)
     run, _ = payloads.get("upload_finish", (None, None))
     plan.add(Segment(
@@ -132,6 +135,12 @@ def build_update_plan(engine, work=None, payloads=None):
     plan.add(Segment(
         name="reshard", kind="compute", deps=("upload_finish",),
         phase="h2d_reshard_s", run=run))
+    # reshard re-places the uploaded masters across the mesh — its
+    # traffic price is the wire.py census-ground-truthed per-step bytes
+    from .costs import price_plan, wire_collective_bytes
+    wire = wire_collective_bytes(engine)
+    price_plan(plan, engine=engine,
+               nbytes={"reshard": wire} if wire else None)
     return plan
 
 

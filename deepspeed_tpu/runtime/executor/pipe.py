@@ -1,0 +1,141 @@
+"""Pipeline 1F1B train/eval step, lowered onto the segment executor.
+
+Replaces the bespoke step body that lived in
+``PipelineEngine._pipe_train_batch_impl``: one optimizer step is now a
+:class:`~.plan.SegmentPlan` —
+
+  ``h2d/batch -> cycles [-> apply] -> loss``
+
+where ``h2d/batch`` stages the stacked microbatches onto the mesh (an
+async ``h2d``-pool transfer the overlap mode launches ahead of the
+main thread), ``cycles`` invokes the ONE jitted 1F1B shard_map program
+(warmup/steady/drain fori_loops — the loop itself stays a single XLA
+program; the plan schedules AROUND it, never inside it), ``apply`` is
+the ZeRO-Offload host optimizer step when the engine runs host_state
+(itself a nested ``offload_apply`` plan), and ``loss`` closes the step
+with the (mean_loss, metrics) pair the engine consumes.
+
+``_pipe_step_topology`` is the ONE place the plan shape is written
+down: ``build_pipe_plan(engine)`` with no payloads is the ABSTRACT
+twin for ``analysis.ir.plan_of`` / the auditor, so the audited
+topology can never drift from what executes.
+"""
+from .plan import Segment, SegmentPlan
+
+
+def _pipe_step_topology(offload, eval_mode=False):
+    """Ordered (name, kind, deps, pool, phase) descriptors of one
+    pipeline step. ``offload``: the ZeRO-Offload split (grads jit +
+    host apply); ``eval_mode``: the forward-only InferenceSchedule
+    twin."""
+    nodes = []
+
+    def add(name, kind, deps=(), pool=None, phase=None):
+        nodes.append((name, kind, tuple(deps), pool, phase))
+
+    add("h2d/batch", "transfer", (), "h2d", "h2d_dispatch_s")
+    if eval_mode:
+        add("cycles_eval", "compute", ("h2d/batch",))
+        add("loss", "host", ("cycles_eval",))
+        return nodes
+    add("cycles", "compute", ("h2d/batch",))
+    if offload:
+        # the host optimizer step (itself a nested offload_apply plan
+        # billing its own phase clocks) gates the step's metrics
+        add("apply", "host", ("cycles",))
+        add("loss", "host", ("cycles", "apply"))
+    else:
+        add("loss", "host", ("cycles",))
+    return nodes
+
+
+def build_pipe_plan(engine, payloads=None, eval_mode=False, batch=None):
+    """Segment plan of one pipeline step. ``payloads`` maps names to
+    run callables; absent -> abstract plan (``ir.plan_of``). ``batch``
+    (the host microbatch stack, when the caller has one) prices the
+    ``h2d/batch`` transfer; the cycles segment is priced from the
+    telemetry flops cache once ``_jit_priced`` has seen the program."""
+    offload = getattr(engine, "host_state", None) is not None
+    nodes = _pipe_step_topology(offload, eval_mode=eval_mode)
+    payloads = payloads or {}
+    plan = SegmentPlan("pipe_eval_step" if eval_mode else "pipe_step")
+    for name, kind, deps, pool, phase in nodes:
+        plan.add(Segment(
+            name=name, kind=kind, deps=deps,
+            run=payloads.get(name),
+            async_ok=pool is not None, pool=pool or "d2h", phase=phase,
+            wait_phase="h2d_wait_s" if kind == "compute" else None,
+            # the fused/micro pipe programs donate their state arg —
+            # the same declaration analysis/programs.py publishes
+            donate=(0,) if name == "cycles" else (),
+            keep_result=(name == "loss")))
+    from .costs import batch_nbytes, price_plan
+    nbytes = {"h2d/batch": batch_nbytes(batch)} if batch is not None \
+        else None
+    price_plan(plan, engine=engine, nbytes=nbytes, flops={
+        "cycles_eval": "pipe_eval",
+        "cycles": "pipe_micros" if offload else "pipe_train"})
+    return plan
+
+
+def run_pipe_step(engine, batch, step_rng):
+    """One pipeline optimizer step on the executor. Returns
+    ``(mean_loss, metrics)`` — bit-exact with the bespoke body (same
+    programs, same values, same order; the executor changes wall-clock
+    placement only)."""
+    offload = engine.host_state is not None
+
+    payloads = {
+        "h2d/batch": lambda env: engine._to_device_stacked(batch),
+    }
+
+    if offload:
+        # ZeRO-Offload under pipelines: jit only the pipe loop's grad
+        # accumulation; the optimizer step runs on host
+        def cycles(env):
+            dev_batch = env["h2d/batch"]
+            micros = engine._jit_priced(
+                "pipe_micros", engine._pipe_grads_fn,
+                engine.state, dev_batch, step_rng)
+            engine.state, mean_loss = micros(engine.state, dev_batch,
+                                             step_rng)
+            return mean_loss
+
+        payloads["cycles"] = cycles
+        payloads["apply"] = lambda env: engine._host_apply_step()
+        payloads["loss"] = lambda env: (env["cycles"], env["apply"])
+    else:
+        def cycles(env):
+            dev_batch = env["h2d/batch"]
+            fused = engine._jit_priced(
+                "pipe_train", engine._fused_train_fn,
+                engine.state, dev_batch, step_rng, engine._hyper())
+            engine.state, out = fused(engine.state, dev_batch,
+                                      step_rng, engine._hyper())
+            return out
+
+        payloads["cycles"] = cycles
+        payloads["loss"] = lambda env: env["cycles"]
+
+    plan = build_pipe_plan(engine, payloads=payloads, batch=batch)
+    env = engine.plan_executor().execute(plan)
+    return env["loss"]
+
+
+def run_pipe_eval(engine, batch):
+    """Forward-only evaluation through the pipe loop on the executor
+    (the InferenceSchedule twin). Returns the loss value."""
+    def cycles_eval(env):
+        inputs_stack, labels_stack = env["h2d/batch"]
+        fn = engine._get_jit("pipe_eval", engine._pipeline_eval_fn)
+        return fn(engine.state["params"], inputs_stack, labels_stack)
+
+    payloads = {
+        "h2d/batch": lambda env: engine._to_device_stacked(batch),
+        "cycles_eval": cycles_eval,
+        "loss": lambda env: env["cycles_eval"],
+    }
+    plan = build_pipe_plan(engine, payloads=payloads, eval_mode=True,
+                           batch=batch)
+    env = engine.plan_executor().execute(plan)
+    return env["loss"]

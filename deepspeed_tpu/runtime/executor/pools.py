@@ -1,0 +1,30 @@
+"""Serial worker pools: the one place background step workers spin up.
+
+Every ordered background worker in the runtime — the executor's own
+per-class pools (``scheduler.py``), the coalesced H2D upload worker
+(``runtime/zero/transfer.py``), the checkpoint shard writer
+(``runtime/checkpointing.py``) — is a single-thread pool so submission
+order IS execution order. Constructing them here (DSL006: worker pools
+live in ``runtime/executor/`` only) keeps that invariant reviewable in
+one file instead of once per subsystem.
+"""
+from concurrent.futures import ThreadPoolExecutor
+
+
+def serial_pool(name):
+    """One ordered background worker (``max_workers=1``): submissions
+    execute in submission order, so a caller can sequence work by
+    submit order alone."""
+    return ThreadPoolExecutor(max_workers=1, thread_name_prefix=name)
+
+
+def upload_pool(name="offload-upload"):
+    """The serial pack+device_put worker of the coalesced H2D batcher
+    (jax dispatch is thread-safe; one worker keeps uploads ordered)."""
+    return serial_pool(name)
+
+
+def write_pool(name="ckpt-write"):
+    """The serial checkpoint shard writer: an async ``save_latest``
+    queued after the shard writes cannot run until they all landed."""
+    return serial_pool(name)

@@ -1,0 +1,215 @@
+"""Plan rewrite passes: optimize a lowered step plan as a graph.
+
+Once every step path is a :class:`~.plan.SegmentPlan` (pipe, serving,
+offload, streamed), step-scheduling optimizations become graph
+rewrites applied in ONE place instead of per-engine hand surgery.
+Three passes, gated by the strict-validated ``runtime.executor_rewrites``
+ds_config section (docs/executor.md):
+
+  * ``hoist`` — move an async-eligible segment to the earliest
+    position its deps allow, bounded by a live-bytes window (hoisting
+    extends the result's lifetime, pinning its buffer longer) and
+    never reordering collective segments against each other (their
+    rendezvous order must match on every rank). A hoisted transfer
+    enters the scheduler's bounded launch-ahead scan sooner, so its
+    wall rides behind more main-thread compute.
+  * ``widen`` — raise a pool's in-flight window when the executor's
+    MEASURED exposed wait dominates: window-blocked async segments run
+    inline and bill their wall as exposed wait, so a too-narrow window
+    shows up directly in the accounting this pass reads.
+  * ``fuse`` — merge a small transfer/collective segment into its
+    adjacent sole consumer (the PR 12 quantized-collective pattern:
+    a tiny packed-collective node feeding exactly one compute node).
+    Adjacency means no main-thread work could have overlapped the
+    producer anyway, so fusion removes a scheduling hop for free.
+
+Every pass preserves the execution contract: identical payloads,
+identical values, identical per-segment consumption order — a rewrite
+changes WHEN work launches, never WHAT it computes, so rewritten plans
+stay bitwise equal to the unrewritten serial oracle (pinned by
+tests/unit/test_executor.py). Rewrites run at plan-build time inside
+``PlanExecutor.execute`` in overlap mode only; the ABSTRACT plans the
+auditor fingerprints (``analysis.ir.plan_of``) are never rewritten,
+so plan fingerprints are stable by construction.
+"""
+from .plan import Segment, SegmentPlan
+
+# rewritten-plan stats schema (telemetry/record.py pins the canonical
+# copy; bin/check_bench_schema.py carries the stdlib twin)
+REWRITE_KEYS = ("enabled", "passes", "segments_moved",
+                "predicted_exposed_wait_delta_s",
+                "measured_exposed_wait_delta_s")
+REWRITE_PASS_KEYS = ("name", "segments_moved",
+                     "predicted_exposed_wait_delta_s")
+
+# nominal host-link bandwidth for the hoist pass's predicted-delta
+# price (bytes/s); deliberately conservative — predictions are
+# compared against the measured delta in extra.executor.rewrites, so a
+# bad nominal shows up as a visible predicted-vs-measured gap
+NOMINAL_XFER_BYTES_PER_S = 10e9
+
+
+def _clone(plan, segments=None):
+    out = SegmentPlan(plan.name)
+    out.windows = dict(plan.windows)
+    for seg in (plan.segments if segments is None else segments):
+        out.add(seg)
+    return out
+
+
+def hoist_pass(plan, max_live_bytes):
+    """Move async segments to the earliest position their deps allow.
+    Returns ``(plan, moved, predicted_s)``. A hoist is REFUSED when it
+    would cross a dependency (earliest position is derived from the
+    deps, so this holds by construction), reorder two collectives, or
+    push the hoisted results' extra live bytes past the budget."""
+    order = list(plan.segments)
+    # extra live bytes pinned at each schedule position by prior hoists
+    extra = [0] * (len(order) + 1)
+    moved = 0
+    hoisted_bytes = 0
+    for seg in [s for s in plan.segments if s.async_ok]:
+        old = order.index(seg)
+        earliest = 0
+        for dep in seg.deps:
+            earliest = max(earliest, order.index(plan[dep]) + 1)
+        if seg.kind == "collective":
+            for j in range(earliest, old):
+                if order[j].kind == "collective":
+                    earliest = j + 1
+        new = earliest
+        nbytes = int(seg.nbytes or 0)
+        while new < old and any(
+                extra[j] + nbytes > max_live_bytes
+                for j in range(new, old)):
+            new += 1
+        if new >= old:
+            continue
+        for j in range(new, old):
+            extra[j] += nbytes
+        order.pop(old)
+        order.insert(new, seg)
+        moved += 1
+        hoisted_bytes += nbytes
+    if not moved:
+        return plan, 0, 0.0
+    predicted = hoisted_bytes / NOMINAL_XFER_BYTES_PER_S
+    return _clone(plan, order), moved, predicted
+
+
+def fuse_pass(plan):
+    """Merge adjacent producer -> sole-consumer pairs where the
+    producer is a transfer/collective node. Returns
+    ``(plan, fused_count)``. A fused node keeps the consumer's name
+    and identity (deps union minus the producer), bridging the
+    producer's value through a private env so the consumer payload
+    still reads ``env[producer.name]``. Producers with other
+    consumers, ``keep_result`` producers, and non-adjacent pairs are
+    refused — fusing those would change lifetimes or lose overlap."""
+    counts = plan.consumer_counts()
+    out = []
+    fused = 0
+    for seg in plan.segments:
+        prev = out[-1] if out else None
+        if prev is not None and \
+                prev.kind in ("transfer", "collective") and \
+                not prev.keep_result and \
+                counts.get(prev.name, 0) == 1 and \
+                prev.name in seg.deps:
+            out[-1] = _fused_segment(prev, seg)
+            fused += 1
+            continue
+        out.append(seg)
+    if not fused:
+        return plan, 0
+    return _clone(plan, out), fused
+
+
+def _fused_segment(producer, consumer):
+    deps = tuple(dict.fromkeys(
+        tuple(producer.deps) +
+        tuple(d for d in consumer.deps if d != producer.name)))
+    run = None
+    if producer.run is not None or consumer.run is not None:
+        def run(env, _p=producer, _c=consumer):
+            penv = {d: env[d] for d in _p.deps if d in env}
+            if _p.start is not None:
+                _p.start(penv)
+            value = _p.run(penv) if _p.run is not None else None
+            cenv = dict(env)
+            cenv[_p.name] = value
+            return _c.run(cenv) if _c.run is not None else None
+    return Segment(
+        name=consumer.name, kind=consumer.kind, deps=deps, run=run,
+        start=None, async_ok=consumer.async_ok, pool=consumer.pool,
+        phase=consumer.phase, wait_phase=consumer.wait_phase,
+        donate=consumer.donate,
+        flops=(producer.flops or 0.0) + (consumer.flops or 0.0),
+        nbytes=int(producer.nbytes or 0) + int(consumer.nbytes or 0),
+        keep_result=consumer.keep_result)
+
+
+def widen_pass(plan, executor, max_window):
+    """Raise per-pool in-flight windows on ``plan`` when the
+    executor's measured exposed wait dominates (> 10% of main-thread
+    busy). Returns ``(plan, widened_pools, predicted_s)``. Until the
+    executor has measurements (first plan of a run) nothing widens —
+    calibrate-then-rewrite."""
+    per_kind, busy, waits = executor.measured_totals()
+    if waits <= 0.10 * max(busy, 1e-12):
+        return plan, 0, 0.0
+    pools = {}
+    for seg in plan.segments:
+        if seg.async_ok:
+            pools[seg.pool] = pools.get(seg.pool, 0) + 1
+    widened = 0
+    predicted = 0.0
+    new_windows = dict(plan.windows)
+    for pool, count in pools.items():
+        cur = new_windows.get(pool, executor.windows.get(pool, 1))
+        target = min(max_window, count)
+        if target > cur:
+            new_windows[pool] = target
+            widened += 1
+            # the waits a wider window could hide, pro-rated by how
+            # much deeper the in-flight pipeline gets
+            predicted += waits * (1.0 - cur / float(target)) \
+                / max(executor.plans_total, 1)
+    if not widened:
+        return plan, 0, 0.0
+    out = _clone(plan)
+    out.windows = new_windows
+    return out, widened, predicted
+
+
+def apply_rewrites(plan, rewrites, executor=None):
+    """Run the configured passes over ``plan``; returns
+    ``(plan, pass_stats)`` where ``pass_stats`` is a list of
+    ``{name, segments_moved, predicted_exposed_wait_delta_s}`` entries
+    for the passes that FIRED (empty when nothing changed). The input
+    plan is never mutated — callers keep the canonical plan for
+    auditing/fingerprinting."""
+    if not rewrites or not rewrites.get("enabled"):
+        return plan, []
+    passes = rewrites.get("passes", ())
+    stats = []
+    if "hoist" in passes:
+        plan, moved, predicted = hoist_pass(
+            plan, int(rewrites.get("hoist_max_live_bytes", 1 << 28)))
+        if moved:
+            stats.append({"name": "hoist", "segments_moved": moved,
+                          "predicted_exposed_wait_delta_s":
+                          round(predicted, 9)})
+    if "fuse" in passes:
+        plan, fused = fuse_pass(plan)
+        if fused:
+            stats.append({"name": "fuse", "segments_moved": fused,
+                          "predicted_exposed_wait_delta_s": 0.0})
+    if "widen" in passes and executor is not None:
+        plan, widened, predicted = widen_pass(
+            plan, executor, int(rewrites.get("max_window", 8)))
+        if widened:
+            stats.append({"name": "widen", "segments_moved": widened,
+                          "predicted_exposed_wait_delta_s":
+                          round(predicted, 9)})
+    return plan, stats

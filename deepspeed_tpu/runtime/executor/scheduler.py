@@ -95,7 +95,7 @@ class PlanExecutor(AtexitCloseMixin):
     """Executes segment plans; owns the worker pools and the per-step
     accounting. One instance per engine (``engine.plan_executor()``)."""
 
-    def __init__(self, mode="overlap", windows=None):
+    def __init__(self, mode="overlap", windows=None, rewrites=None):
         if mode not in ("overlap", "serial"):
             raise ValueError(
                 "executor mode must be 'overlap' or 'serial', got "
@@ -104,6 +104,10 @@ class PlanExecutor(AtexitCloseMixin):
         self.windows = dict(DEFAULT_WINDOWS)
         if windows:
             self.windows.update({k: int(v) for k, v in windows.items()})
+        # plan rewrite passes (runtime/executor/rewrite.py), applied at
+        # execute time in overlap mode only — the strict-validated
+        # ``runtime.executor_rewrites`` dict, or None/disabled
+        self.rewrites = rewrites
         self._pools = {}
         # per-step accounting (drained by the telemetry emit path)
         self._step_records = []
@@ -116,6 +120,14 @@ class PlanExecutor(AtexitCloseMixin):
         self._life_per_kind = {}
         self._life_busy = 0.0
         self._life_waits = 0.0
+        # rewrite accounting: calibrate-then-rewrite — the FIRST
+        # execution of each plan name runs unrewritten and records its
+        # exposed wait as the baseline the rewritten executions are
+        # measured against (values are mode-invariant, so the
+        # calibration step costs nothing but its un-overlapped wall)
+        self._rewrite_base = {}       # plan name -> baseline waits
+        self._rewrite_meas = {}       # plan name -> [rewritten waits]
+        self._rewrite_pass_totals = {}   # pass name -> aggregated entry
 
     # ------------------------------------------------------------- pools
     def _pool(self, key):
@@ -152,6 +164,30 @@ class PlanExecutor(AtexitCloseMixin):
         env = {} if env is None else env
         phases = {} if phases is None else phases
         overlap = self.mode == "overlap"
+        rewritten = False
+        if overlap and self.rewrites and self.rewrites.get("enabled"):
+            if plan.name not in self._rewrite_base:
+                # calibration execution: run the canonical plan and
+                # record its exposed wait as this plan name's baseline
+                self._rewrite_base[plan.name] = None
+            else:
+                from .rewrite import apply_rewrites
+                plan, pass_stats = apply_rewrites(plan, self.rewrites,
+                                                  executor=self)
+                rewritten = bool(pass_stats)
+                for entry in pass_stats:
+                    slot = self._rewrite_pass_totals.setdefault(
+                        entry["name"],
+                        {"name": entry["name"], "segments_moved": 0,
+                         "predicted_exposed_wait_delta_s": 0.0})
+                    slot["segments_moved"] += entry["segments_moved"]
+                    slot["predicted_exposed_wait_delta_s"] += \
+                        entry["predicted_exposed_wait_delta_s"]
+                problems = plan.validate()
+                if problems:
+                    raise PlanError(
+                        "rewritten plan {!r} invalid: {}".format(
+                            plan.name, "; ".join(problems)))
         windows = dict(self.windows)
         windows.update(plan.windows)
         segs = plan.segments
@@ -287,6 +323,15 @@ class PlanExecutor(AtexitCloseMixin):
             self.plans_total += 1
             self.segments_total += len(segs)
             self.last_plan_segments = len(segs)
+            if self.rewrites and self.rewrites.get("enabled") and \
+                    self.mode == "overlap":
+                _, _, plan_waits = self._aggregate(records)
+                if self._rewrite_base.get(plan.name) is None and \
+                        not rewritten:
+                    self._rewrite_base[plan.name] = plan_waits
+                elif rewritten:
+                    self._rewrite_meas.setdefault(
+                        plan.name, []).append(plan_waits)
         return env
 
     def run_program(self, name, kind, fn, phase=None):
@@ -334,6 +379,51 @@ class PlanExecutor(AtexitCloseMixin):
                 busy += rec.run_s
         return per_kind, busy, waits
 
+    def measured_totals(self):
+        """Lifetime (busy, waits) including the live step window — the
+        measured accounting the widen rewrite pass reads."""
+        per_kind, busy, waits = self._aggregate(self._step_records)
+        for kind, life in self._life_per_kind.items():
+            slot = per_kind.setdefault(
+                kind, {"segments": 0, "run_s": 0.0, "wait_s": 0.0})
+            for key in ("segments", "run_s", "wait_s"):
+                slot[key] += life[key]
+        return per_kind, busy + self._life_busy, \
+            waits + self._life_waits
+
+    def rewrite_snapshot(self):
+        """The ``extra.executor.rewrites`` section (REWRITE_KEYS
+        schema, telemetry/record.py): which passes fired, how many
+        segments they moved, and the predicted vs MEASURED exposed-
+        wait delta (calibration baseline minus the rewritten
+        executions' mean, summed over plan names with both). None when
+        rewrites are not configured."""
+        if not self.rewrites:
+            return None
+        passes = [dict(self._rewrite_pass_totals[name],
+                       predicted_exposed_wait_delta_s=round(
+                           self._rewrite_pass_totals[name]
+                           ["predicted_exposed_wait_delta_s"], 9))
+                  for name in sorted(self._rewrite_pass_totals)]
+        predicted = round(sum(p["predicted_exposed_wait_delta_s"]
+                              for p in passes), 9)
+        measured = None
+        deltas = []
+        for name, meas in self._rewrite_meas.items():
+            base = self._rewrite_base.get(name)
+            if base is None or not meas:
+                continue
+            deltas.append(base - sum(meas) / len(meas))
+        if deltas:
+            measured = round(sum(deltas), 9)
+        return {
+            "enabled": bool(self.rewrites.get("enabled")),
+            "passes": passes,
+            "segments_moved": sum(p["segments_moved"] for p in passes),
+            "predicted_exposed_wait_delta_s": predicted,
+            "measured_exposed_wait_delta_s": measured,
+        }
+
     @staticmethod
     def _rounded(per_kind):
         return {kind: {"segments": slot["segments"],
@@ -363,18 +453,11 @@ class PlanExecutor(AtexitCloseMixin):
         """Engine-lifetime counters (bench ``extra.executor``):
         cumulative per-kind walls over every executed plan (drained
         steps included) + the live window."""
-        per_kind, busy, waits = self._aggregate(self._step_records)
-        for kind, life in self._life_per_kind.items():
-            slot = per_kind.setdefault(
-                kind, {"segments": 0, "run_s": 0.0, "wait_s": 0.0})
-            for key in ("segments", "run_s", "wait_s"):
-                slot[key] += life[key]
-        busy += self._life_busy
-        waits += self._life_waits
+        per_kind, busy, waits = self.measured_totals()
         eff = None
         if busy + waits > 0:
             eff = round(busy / (busy + waits), 4)
-        return {
+        out = {
             "plan_segments": len(self._step_records),
             "per_kind": self._rounded(per_kind),
             "overlap_efficiency": eff,
@@ -383,3 +466,7 @@ class PlanExecutor(AtexitCloseMixin):
             "segments_executed": self.segments_total,
             "last_plan_segments": self.last_plan_segments,
         }
+        rewrites = self.rewrite_snapshot()
+        if rewrites is not None:
+            out["rewrites"] = rewrites
+        return out

@@ -88,6 +88,23 @@ def build_micro_plan(runner, payloads=None):
     # h2d window of 2 IS the "current + prefetched group" HBM budget
     plan.windows = {"d2h": len(fetches), "h2d": 2}
     from ..zero.stream import STREAM_DONATE
+
+    def _leaves_nbytes(leaves):
+        return sum(int(getattr(p, "nbytes", 0)) for p in leaves)
+
+    # transfer prices from the host master leaves the segments move
+    # (uploads stream the params up; d2h fetches bring the grads back,
+    # same shapes) — the rewrite passes budget live bytes against these
+    nbytes = {"up/e_f": _leaves_nbytes(runner._e_leaves),
+              "up/e_b": _leaves_nbytes(runner._e_leaves),
+              "d2h/e": _leaves_nbytes(runner._e_leaves),
+              "up/h_f": _leaves_nbytes(runner._h_leaves),
+              "d2h/h": _leaves_nbytes(runner._h_leaves)}
+    for g in range(G):
+        group = _leaves_nbytes(runner._group_leaves(g))
+        nbytes["up/g_f%d" % g] = group
+        nbytes["up/g_b%d" % g] = group
+        nbytes["d2h/g%d" % g] = group
     for name, kind, deps, pool, phase in nodes:
         run, start = payloads.get(name, (None, None))
         plan.add(Segment(
@@ -96,6 +113,7 @@ def build_micro_plan(runner, payloads=None):
             wait_phase="h2d_wait_s" if kind == "compute"
             else ("d2h_grads_s" if name == "resolve" else None),
             keep_result=(name == "loss"),
+            nbytes=nbytes.get(name, 0),
             # the plan mirrors the ONE donation declaration the jit
             # path and the shard-lint auditor read (stream.py)
             donate=STREAM_DONATE.get(name.split("/")[0], ())))

@@ -725,24 +725,15 @@ class PipelineEngine(DeepSpeedEngine):
             assert data_iter is not None
             batch = self._stack_microbatches(data_iter)
         self._telemetry_window_begin()
-        batch = self._to_device_stacked(batch)
         self._telemetry_add_tokens(batch)
 
         self._rng, step_rng = jax.random.split(self._rng)
-        if self.host_state is not None:
-            # ZeRO-Offload under pipelines: jit only the pipe loop's
-            # grad accumulation; the optimizer step runs on host
-            # (shard-wise D2H/H2D, same as the base engine's offload path)
-            micros = self._jit_priced("pipe_micros", self._pipe_grads_fn,
-                                      self.state, batch, step_rng)
-            self.state, mean_loss = micros(self.state, batch, step_rng)
-            metrics = self._host_apply_step()
-        else:
-            fused = self._jit_priced("pipe_train", self._fused_train_fn,
-                                     self.state, batch, step_rng,
-                                     self._hyper())
-            self.state, (mean_loss, metrics) = fused(self.state, batch,
-                                                     step_rng, self._hyper())
+        # the step body is a segment plan on the PlanExecutor
+        # (runtime/executor/pipe.py): h2d/batch -> cycles [-> apply]
+        # -> loss — serial mode is the bit-exact oracle of the old
+        # bespoke body, overlap mode launches the batch staging ahead
+        from ..executor.pipe import run_pipe_step
+        mean_loss, metrics = run_pipe_step(self, batch, step_rng)
         overflow = bool(metrics["overflow"])
         if overflow:
             self.skipped_steps += 1
@@ -771,10 +762,8 @@ class PipelineEngine(DeepSpeedEngine):
         if batch is None:
             assert data_iter is not None
             batch = self._stack_microbatches(data_iter)
-        batch = self._to_device_stacked(batch)
-        inputs_stack, labels_stack = batch
-        fn = self._get_jit("pipe_eval", self._pipeline_eval_fn)
-        return fn(self.state["params"], inputs_stack, labels_stack)
+        from ..executor.pipe import run_pipe_eval
+        return run_pipe_eval(self, batch)
 
     def is_gradient_accumulation_boundary(self):
         return True

@@ -232,10 +232,10 @@ class StreamedOffloadRunner:
             # split program: CPU cannot alias the buffers and warns on
             # every call; the declared (accelerator) set is what the
             # shard-lint auditor verifies
+            from ..executor.jit import jit_program
             donate = STREAM_DONATE.get(key[0], ()) \
                 if jax.default_backend() != "cpu" else ()
-            self._jit_cache[key] = jax.jit(builder(),
-                                           donate_argnums=donate)
+            self._jit_cache[key] = jit_program(builder(), donate=donate)
         return self._jit_cache[key]
 
     def _run(self, key, builder, *args):

@@ -17,8 +17,6 @@ jitted (donated, on-device) reshape program per bucket layout. Packing +
 upload run on a single background worker so the serialization cost rides
 behind the caller's host Adam.
 """
-from concurrent.futures import ThreadPoolExecutor
-
 import numpy as np
 
 import jax
@@ -152,8 +150,11 @@ class H2DBatcher:
 
 def make_upload_pool(name="offload-upload"):
     """One serial background worker for pack+device_put (jax dispatch is
-    thread-safe; a single worker keeps uploads ordered)."""
-    return ThreadPoolExecutor(max_workers=1, thread_name_prefix=name)
+    thread-safe; a single worker keeps uploads ordered). Pool
+    construction lives with the executor (DSL006) — this is the
+    batcher-local spelling of ``runtime/executor/pools.upload_pool``."""
+    from ..executor.pools import upload_pool
+    return upload_pool(name)
 
 
 def host_adam_chunk(lib, p, g, m, v, hyper, bc1, bc2, adam_w):

@@ -74,8 +74,65 @@ SEGMENT_KIND_KEYS = ("segments", "run_s", "wait_s")
 SEGMENT_OPTIONAL_KEYS = (
     "segment_upload_bytes_peak", "groups", "collective_matmul",
     "work_chunks", "mode", "plans_executed", "segments_executed",
-    "last_plan_segments",
+    "last_plan_segments", "rewrites",
 )
+
+# plan-rewrite stats sub-dict (PR 19): the executor's
+# ``rewrite_snapshot()`` shape — the canonical copy lives with the
+# passes in runtime/executor/rewrite.py; this module and
+# bin/check_bench_schema.py's stdlib twin are pinned equal to it by
+# tests/unit/test_executor.py
+REWRITE_KEYS = ("enabled", "passes", "segments_moved",
+                "predicted_exposed_wait_delta_s",
+                "measured_exposed_wait_delta_s")
+REWRITE_PASS_KEYS = ("name", "segments_moved",
+                     "predicted_exposed_wait_delta_s")
+
+
+def validate_rewrite_stats(stats):
+    """Schema check for one REWRITE_KEYS stats dict (the ``rewrites``
+    sub-dict of a bench's ``extra.executor``). Returns a list of
+    problem strings."""
+    problems = []
+    if not isinstance(stats, dict):
+        return ["rewrite stats is not a dict: {!r}".format(
+            type(stats).__name__)]
+    missing = [k for k in REWRITE_KEYS if k not in stats]
+    for key in missing:
+        problems.append("rewrites missing key {!r}".format(key))
+    extra = sorted(set(stats) - set(REWRITE_KEYS))
+    if extra:
+        problems.append("rewrites unexpected key(s) {}".format(extra))
+    if problems:
+        return problems
+    if not isinstance(stats["enabled"], bool):
+        problems.append("rewrites.enabled is not a bool: {!r}".format(
+            stats["enabled"]))
+    moved = stats["segments_moved"]
+    if isinstance(moved, bool) or not isinstance(moved, _NUMERIC) or \
+            moved < 0:
+        problems.append("rewrites.segments_moved is not a nonnegative "
+                        "number: {!r}".format(moved))
+    for key in ("predicted_exposed_wait_delta_s",
+                "measured_exposed_wait_delta_s"):
+        val = stats[key]
+        if val is not None and (isinstance(val, bool) or
+                                not isinstance(val, _NUMERIC)):
+            problems.append(
+                "rewrites.{} is neither null nor a number: {!r}".format(
+                    key, val))
+    passes = stats["passes"]
+    if not isinstance(passes, (list, tuple)):
+        return problems + ["rewrites.passes is not a list"]
+    for i, entry in enumerate(passes):
+        if not isinstance(entry, dict):
+            problems.append("rewrites.passes[{}] is not a dict".format(i))
+            continue
+        if sorted(entry) != sorted(REWRITE_PASS_KEYS):
+            problems.append(
+                "rewrites.passes[{}] keys {} != {}".format(
+                    i, sorted(entry), sorted(REWRITE_PASS_KEYS)))
+    return problems
 
 
 def validate_segment_stats(stats):
@@ -123,6 +180,8 @@ def validate_segment_stats(stats):
                 problems.append(
                     "per_kind.{}.{} is not a nonnegative number: "
                     "{!r}".format(kind, key, val))
+    if "rewrites" in stats and stats["rewrites"] is not None:
+        problems.extend(validate_rewrite_stats(stats["rewrites"]))
     return problems
 
 

@@ -153,8 +153,10 @@ def test_clean_inference_family():
         audit=False)
     report = engine.audit()
     assert report.findings == [], [f.key for f in report.findings]
+    # plan/serving_step: the lowered scheduler-step plan is audited
+    # alongside the jit programs (docs/executor.md)
     assert set(report.programs) == {"prefill/b8", "prefill/b16",
-                                    "decode"}
+                                    "decode", "plan/serving_step"}
 
 
 def test_inference_spec_verify_program_audited():
@@ -201,7 +203,9 @@ def test_clean_pipeline_family():
     # (micro_batches, ...) stack the pipe loop consumes
     ids = rng.randint(0, 256, size=(8, 64)).astype(np.int32)
     report = engine.audit(batch=(ids, ids.copy()))
-    assert set(report.programs) == {"pipe_train"}
+    # plan/pipe_step: the lowered 1F1B step plan is audited alongside
+    # the jit program (docs/executor.md)
+    assert set(report.programs) == {"pipe_train", "plan/pipe_step"}
     assert report.programs["pipe_train"]["family"] == "pipeline"
     assert report.findings == [], [f.key for f in report.findings]
 
@@ -223,7 +227,7 @@ def test_defect_dropped_donation_fires():
     engine = _make_engine({"analysis": {"donation_min_bytes": 1024}})
     specs = collectors.collect_train_programs(engine, batch=_batch())
     micro = next(s for s in specs if s.name == "micro")
-    bad = dataclasses.replace(micro, donate_argnums=())
+    bad = dataclasses.replace(micro, donate=())
     _, _, findings = audit_program(bad, engine._config.analysis_config)
     assert any(f.check == "donation_miss" for f in findings), \
         [f.key for f in findings]
@@ -236,7 +240,7 @@ def test_defect_unhonorable_donation_fires():
     engine = _make_engine({"analysis": {"donation_min_bytes": 1024}})
     specs = collectors.collect_train_programs(engine, batch=_batch())
     micro = next(s for s in specs if s.name == "micro")
-    bad = dataclasses.replace(micro, donate_argnums=(0, 1))
+    bad = dataclasses.replace(micro, donate=(0, 1))
     _, _, findings = audit_program(bad, engine._config.analysis_config)
     assert any(f.check == "donation_unhonored" for f in findings), \
         [f.key for f in findings]
@@ -763,10 +767,10 @@ def test_h2d_split_program_donation_audit():
     flat = jax.ShapeDtypeStruct((2 * 512 * 512,), np.float32)
     clean = ProgramSpec(name="h2d_split", family="streamed",
                         build=lambda: fn.__wrapped__, args=(flat,),
-                        donate_argnums=())
+                        donate=())
     _, _, findings = audit_program(clean, None)
     assert findings == [], [f.key for f in findings]
-    donated = dataclasses.replace(clean, donate_argnums=(0,))
+    donated = dataclasses.replace(clean, donate=(0,))
     _, _, findings = audit_program(donated, None)
     assert [f.check for f in findings] == ["donation_unhonored"]
 
@@ -788,7 +792,7 @@ def test_decode_step_donation_audit():
     _, _, clean = audit_program(decode, engine.analysis_config)
     assert not any(f.rule == "donation" for f in clean), \
         [f.key for f in clean]
-    bad = dataclasses.replace(decode, donate_argnums=())
+    bad = dataclasses.replace(decode, donate=())
     _, _, findings = audit_program(bad, engine.analysis_config)
     missed = [f for f in findings if f.check == "donation_miss"]
     assert len(missed) >= 2, [f.key for f in findings]

@@ -545,3 +545,512 @@ def test_repo_lint_green_with_dsl006_baseline():
     # place scheduling is allowed — nothing there needs baselining)
     assert not any("runtime/executor" in k for k in findings
                    if k.startswith("DSL006"))
+
+
+def test_dsl006_zero_sites_outside_executor():
+    """PR 19 endpoint: the whole package carries ZERO step-scheduling
+    sites outside runtime/executor/ — the DSL006 baseline is empty and
+    must stay empty (a new occurrence fails the baseline diff above,
+    this pins that the accepted set itself is zero)."""
+    from deepspeed_tpu.analysis import astlint
+    repo = os.path.join(os.path.dirname(__file__), "..", "..")
+    findings = astlint.lint_paths(
+        [os.path.join(repo, "deepspeed_tpu")], base=repo)
+    dsl6 = sorted(k for k in findings if k.startswith("DSL006"))
+    assert dsl6 == [], dsl6
+    baseline = astlint.load_baseline(
+        os.path.join(repo, "bin", "ds_lint_baseline.json"))
+    assert not any(k.startswith("DSL006") for k in baseline), \
+        "DSL006 baseline entries must stay deleted"
+
+
+# ----------------------------------------------- pipe lowering, bit-exact
+class _TanhLayer:
+    def __init__(self, dim):
+        self.dim = dim
+
+    def init(self, rng):
+        import jax
+        w = jax.random.normal(rng, (self.dim, self.dim)) * 0.3
+        return {"w": w, "b": jnp.zeros((self.dim,))}
+
+    def apply(self, params, x):
+        return jnp.tanh(x @ params["w"].astype(x.dtype) +
+                        params["b"].astype(x.dtype))
+
+
+def _pipe_engine(mode, gas=4, rewrites=None):
+    from deepspeed_tpu.pipe import PipelineModule, LayerSpec
+
+    def mse(out, labels):
+        return jnp.mean((out.astype(jnp.float32) -
+                         labels.astype(jnp.float32)) ** 2)
+
+    net = PipelineModule(
+        layers=[LayerSpec(_TanhLayer, 16) for _ in range(4)],
+        num_stages=2, num_dp=4, loss_fn=mse)
+    runtime = {"executor": mode}
+    if rewrites is not None:
+        runtime["executor_rewrites"] = rewrites
+    engine, _, _, _ = deepspeed.initialize(
+        model=net, config_params={
+            "train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": gas,
+            "bf16": {"enabled": True},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "runtime": runtime,
+            "steps_per_print": 10 ** 9,
+        })
+    return engine
+
+
+def _pipe_batches(gas=4, steps=3, seed=0):
+    # micro batch 16 = 4 per gpu * 4 dp, matching test_pipe.py
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(steps):
+        x = rng.randn(gas, 16, 16).astype(np.float32)
+        y = np.tanh(x @ (rng.randn(16, 16) * 0.3).astype(np.float32))
+        out.append((x, y))
+    return out
+
+
+def test_pipe_serial_vs_overlap_bitexact():
+    engines = {m: _pipe_engine(m) for m in ("off", "on")}
+    batches = _pipe_batches()
+    for step, (x, y) in enumerate(batches):
+        losses = {m: float(e.train_batch(batch=(x, y)))
+                  for m, e in engines.items()}
+        assert losses["off"] == losses["on"], (step, losses)
+    import jax
+    for a, b in zip(
+            jax.tree_util.tree_leaves(engines["off"].get_params()),
+            jax.tree_util.tree_leaves(engines["on"].get_params())):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # eval rides the executor too
+    x, y = batches[0]
+    evals = {m: float(e.eval_batch(batch=(x, y)))
+             for m, e in engines.items()}
+    assert evals["off"] == evals["on"]
+    snaps = {m: e.executor_snapshot() for m, e in engines.items()}
+    assert snaps["on"]["mode"] == "overlap"
+    assert snaps["off"]["mode"] == "serial"
+    assert snaps["on"]["plans_executed"] >= len(batches) + 1
+
+
+def test_plan_of_pipe_topology_matches_execution():
+    eng = _pipe_engine("on")
+    plan = plan_for_engine(eng)
+    assert plan.name == "pipe_step" and plan.validate() == []
+    names = {s.name for s in plan.segments}
+    assert names == {"h2d/batch", "cycles", "loss"}
+    x, y = _pipe_batches(steps=1)[0]
+    eng.train_batch(batch=(x, y))
+    executed = {r.name for r in eng.plan_executor().drain_step_records()}
+    assert names <= executed
+    # the priced plan carries the staged batch's real bytes
+    from deepspeed_tpu.runtime.executor.pipe import build_pipe_plan
+    priced = build_pipe_plan(eng, batch=(x, y))
+    assert priced["h2d/batch"].nbytes == x.nbytes + y.nbytes
+    # eval plan is the forward-only twin
+    eval_plan = plan_for_engine(eng, family="pipe_eval_step")
+    assert {s.name for s in eval_plan.segments} == \
+        {"h2d/batch", "cycles_eval", "loss"}
+
+
+def test_pipe_audit_plan_covered():
+    from deepspeed_tpu.analysis import AnalysisReport
+    from deepspeed_tpu.analysis.auditor import audit_plan
+    eng = _pipe_engine("on")
+    report = AnalysisReport(job="t")
+    plan = audit_plan(eng, report)
+    assert plan is not None and plan.name == "pipe_step"
+    assert not report.findings
+    assert any(name.startswith("plan/pipe_step")
+               for name in report.programs)
+
+
+# -------------------------------------------- serving lowering, bit-exact
+def _serving_engine(mode, rewrites=None):
+    model = gpt2.make_gpt2_model(config=GPT_CFG)
+    runtime = {"executor": mode}
+    if rewrites is not None:
+        runtime["executor_rewrites"] = rewrites
+    return deepspeed.init_inference(model=model, config={
+        "inference": {"max_batch_size": 3, "prefill_buckets": [8, 16],
+                      "dtype": "fp32", "greedy": True},
+        "runtime": runtime,
+    })
+
+
+def _drain_scheduler(eng, prompts, max_new=6):
+    from deepspeed_tpu.inference.scheduler import (
+        ContinuousBatchingScheduler)
+    sched = ContinuousBatchingScheduler(eng)
+    uids = [sched.submit(list(p), max_new_tokens=max_new)
+            for p in prompts]
+    steps = 0
+    while sched.has_work:
+        sched.step()
+        steps += 1
+        assert steps < 200
+    return [sched.results[uid] for uid in uids], sched
+
+
+def test_serving_step_serial_vs_overlap_bitexact():
+    prompts = [[1, 2, 3], [5, 6, 7, 8], [9, 10]]
+    streams = {}
+    scheds = {}
+    for mode in ("off", "on"):
+        streams[mode], scheds[mode] = _drain_scheduler(
+            _serving_engine(mode), prompts)
+    assert streams["off"] == streams["on"]
+    # every step executed as a serving_step plan on the engine executor
+    snap = scheds["on"].engine.executor_snapshot()
+    assert snap["mode"] == "overlap"
+    assert snap["plans_executed"] == scheds["on"].steps
+    assert snap["last_plan_segments"] == 4
+    assert scheds["off"].engine.executor_snapshot()["mode"] == "serial"
+
+
+def test_plan_of_serving_topology():
+    eng = _serving_engine("on")
+    plan = plan_for_engine(eng)
+    assert plan.name == "serving_step" and plan.validate() == []
+    assert [s.name for s in plan.segments] == \
+        ["admit", "prefill", "decode", "retire"]
+    # the auditor covers the serving plan through the same entry point
+    from deepspeed_tpu.analysis import AnalysisReport
+    from deepspeed_tpu.analysis.auditor import audit_plan
+    report = AnalysisReport(job="s")
+    assert audit_plan(eng, report) is not None
+    assert not report.findings
+    assert any(name.startswith("plan/serving_step")
+               for name in report.programs)
+
+
+# ------------------------------------------------- rewrite pass matrix
+def _seg(name, kind="compute", deps=(), **kw):
+    return Segment(name=name, kind=kind, deps=deps, **kw)
+
+
+def _hoist_fixture():
+    """compute a -> compute b -> async transfer t(deps a) -> compute c
+    (deps b, t): t can hoist to right after a."""
+    plan = SegmentPlan("fix")
+    plan.add(_seg("a"))
+    plan.add(_seg("b", deps=("a",)))
+    plan.add(_seg("t", kind="transfer", deps=("a",), async_ok=True,
+                  nbytes=1024))
+    plan.add(_seg("c", deps=("b", "t")))
+    return plan
+
+
+def test_hoist_moves_async_segment_earliest():
+    from deepspeed_tpu.runtime.executor.rewrite import hoist_pass
+    plan = _hoist_fixture()
+    out, moved, predicted = hoist_pass(plan, max_live_bytes=1 << 20)
+    assert moved == 1 and predicted > 0
+    assert [s.name for s in out.segments] == ["a", "t", "b", "c"]
+    assert out.validate() == []
+    # the canonical plan is untouched
+    assert [s.name for s in plan.segments] == ["a", "b", "t", "c"]
+
+
+def test_hoist_refuses_to_cross_dependency():
+    from deepspeed_tpu.runtime.executor.rewrite import hoist_pass
+    plan = SegmentPlan("fix")
+    plan.add(_seg("a"))
+    plan.add(_seg("b", deps=("a",)))
+    # t depends on b: earliest legal slot is where it already is
+    plan.add(_seg("t", kind="transfer", deps=("b",), async_ok=True))
+    out, moved, _ = hoist_pass(plan, max_live_bytes=1 << 30)
+    assert moved == 0 and out is plan
+
+
+def test_hoist_never_reorders_collectives():
+    from deepspeed_tpu.runtime.executor.rewrite import hoist_pass
+    plan = SegmentPlan("fix")
+    plan.add(_seg("a"))
+    plan.add(_seg("ar1", kind="collective", deps=("a",)))
+    plan.add(_seg("b", deps=("a",)))
+    # ar2 could hoist past ar1 by deps alone — rendezvous order forbids
+    plan.add(_seg("ar2", kind="collective", deps=("a",), async_ok=True))
+    plan.add(_seg("c", deps=("ar1", "ar2", "b")))
+    out, moved, _ = hoist_pass(plan, max_live_bytes=1 << 30)
+    names = [s.name for s in out.segments]
+    assert names.index("ar1") < names.index("ar2")
+    if moved:                      # may still hoist past plain compute b
+        assert names == ["a", "ar1", "ar2", "b", "c"]
+
+
+def test_hoist_respects_live_bytes_budget():
+    from deepspeed_tpu.runtime.executor.rewrite import hoist_pass
+    plan = _hoist_fixture()
+    # budget below the transfer's 1024B pins it in place
+    out, moved, _ = hoist_pass(plan, max_live_bytes=512)
+    assert moved == 0 and out is plan
+
+
+def test_fuse_merges_sole_consumer_transfer():
+    from deepspeed_tpu.runtime.executor.rewrite import fuse_pass
+    plan = SegmentPlan("fix")
+    plan.add(_seg("t", kind="transfer", run=lambda env: 21, nbytes=8))
+    plan.add(_seg("c", deps=("t",), run=lambda env: env["t"] * 2))
+    out, fused = fuse_pass(plan)
+    assert fused == 1
+    assert [s.name for s in out.segments] == ["c"]
+    assert out["c"].nbytes == 8
+    env = {}
+    assert out["c"].run(env) == 42
+    # canonical plan unmutated; fused plan still validates
+    assert len(plan) == 2 and out.validate() == []
+
+
+def test_fuse_refuses_keep_result_and_multi_consumer():
+    from deepspeed_tpu.runtime.executor.rewrite import fuse_pass
+    keep = SegmentPlan("fix")
+    keep.add(_seg("t", kind="transfer", keep_result=True))
+    keep.add(_seg("c", deps=("t",)))
+    assert fuse_pass(keep)[1] == 0
+    multi = SegmentPlan("fix")
+    multi.add(_seg("t", kind="transfer"))
+    multi.add(_seg("c1", deps=("t",)))
+    multi.add(_seg("c2", deps=("t",)))
+    assert fuse_pass(multi)[1] == 0
+    gap = SegmentPlan("fix")     # non-adjacent producer/consumer
+    gap.add(_seg("t", kind="transfer"))
+    gap.add(_seg("x"))
+    gap.add(_seg("c", deps=("t",)))
+    assert fuse_pass(gap)[1] == 0
+
+
+def test_widen_fires_only_on_measured_waits():
+    from deepspeed_tpu.runtime.executor.rewrite import widen_pass
+
+    class _Exec:
+        windows = {"d2h": 1}
+        plans_total = 1
+
+        def __init__(self, waits):
+            self._w = waits
+
+        def measured_totals(self):
+            return {}, 1.0, self._w
+
+    plan = SegmentPlan("fix")
+    for i in range(4):
+        plan.add(_seg("t%d" % i, kind="transfer", async_ok=True))
+    # calibration phase: no measured waits -> nothing widens
+    out, widened, _ = widen_pass(plan, _Exec(0.0), max_window=8)
+    assert widened == 0 and out is plan
+    # dominated by exposed wait -> pool window rises to segment count
+    out, widened, predicted = widen_pass(plan, _Exec(0.5), max_window=8)
+    assert widened == 1 and predicted > 0
+    assert out.windows["d2h"] == 4
+    assert plan.windows == {}    # canonical untouched
+
+
+def test_apply_rewrites_respects_pass_gating():
+    from deepspeed_tpu.runtime.executor.rewrite import apply_rewrites
+    plan = _hoist_fixture()
+    out, stats = apply_rewrites(plan, {"enabled": False})
+    assert out is plan and stats == []
+    # fuse alone: t is adjacent to its sole consumer c -> merges
+    out, stats = apply_rewrites(
+        plan, {"enabled": True, "passes": ("fuse",)})
+    assert [s["name"] for s in stats] == ["fuse"]
+    assert [s.name for s in out.segments] == ["a", "b", "c"]
+    # hoist runs BEFORE fuse, so t moves away from c and keeps overlap
+    out, stats = apply_rewrites(
+        plan, {"enabled": True, "passes": ("hoist", "fuse"),
+               "hoist_max_live_bytes": 1 << 20})
+    assert [s["name"] for s in stats] == ["hoist"]
+    assert [s.name for s in out.segments] == ["a", "t", "b", "c"]
+    out, stats = apply_rewrites(
+        plan, {"enabled": True, "passes": ("hoist",),
+               "hoist_max_live_bytes": 1 << 20})
+    assert [s["name"] for s in stats] == ["hoist"]
+    assert stats[0]["segments_moved"] == 1
+    assert sorted(stats[0]) == sorted(
+        ["name", "segments_moved", "predicted_exposed_wait_delta_s"])
+    assert out.validate() == []
+
+
+def test_executor_calibrates_then_rewrites_bitexact():
+    """First execution of a plan name runs UNREWRITTEN (the measured
+    baseline); later executions run the rewritten plan and must produce
+    the same values."""
+    calls = []
+
+    def build():
+        # t sits AFTER b but only deps a: hoist moves it up one slot
+        plan = SegmentPlan("p")
+        plan.add(_seg("a", run=lambda env: calls.append("a") or 3.0))
+        plan.add(_seg("b", run=lambda env: calls.append("b") or 5.0))
+        plan.add(_seg("t", kind="transfer", deps=("a",), async_ok=True,
+                      nbytes=64, run=lambda env: env["a"] * 2))
+        plan.add(_seg("out", deps=("t", "b"), keep_result=True,
+                      run=lambda env: env["t"] + env["b"]))
+        return plan
+
+    rewrites = {"enabled": True, "passes": ("hoist", "fuse", "widen"),
+                "max_window": 8, "hoist_max_live_bytes": 1 << 28}
+    ex = PlanExecutor(mode="overlap", rewrites=rewrites)
+    vals = [ex.execute(build())["out"] for _ in range(3)]
+    assert vals == [11.0, 11.0, 11.0]
+    snap = ex.rewrite_snapshot()
+    assert snap is not None and snap["enabled"] is True
+    assert snap["segments_moved"] >= 1
+    assert [p["name"] for p in snap["passes"]] == \
+        sorted(p["name"] for p in snap["passes"])
+    assert rec_mod.validate_rewrite_stats(snap) == []
+    # rewrites land in the lifetime snapshot the bench records publish
+    life = ex.lifetime_snapshot()
+    assert life["rewrites"] == snap
+    # a rewrites-off executor reports no section at all
+    off = PlanExecutor(mode="overlap")
+    off.execute(build())
+    assert off.rewrite_snapshot() is None
+    assert "rewrites" not in off.lifetime_snapshot()
+
+
+def test_rewritten_plan_must_still_validate():
+    from deepspeed_tpu.runtime.executor import rewrite as rw
+
+    def bad_pass(plan, *a, **kw):
+        broken = SegmentPlan(plan.name)
+        broken.add(_seg("z", deps=("missing",)))
+        return broken, 1, 0.0
+
+    ex = PlanExecutor(mode="overlap",
+                      rewrites={"enabled": True, "passes": ("hoist",),
+                                "hoist_max_live_bytes": 1 << 28})
+    plan = SegmentPlan("p")
+    plan.add(_seg("a", run=lambda env: 1, keep_result=True))
+    ex.execute(plan)             # calibration run
+    orig = rw.hoist_pass
+    rw.hoist_pass = bad_pass
+    try:
+        with pytest.raises(PlanError):
+            ex.execute(plan)
+    finally:
+        rw.hoist_pass = orig
+
+
+def test_rewrites_never_touch_abstract_plans():
+    """plan_for_engine output (what the auditor fingerprints) is built
+    fresh from topology — rewrite config on the engine must not change
+    it."""
+    for rewrites in (None, {"enabled": True,
+                            "passes": ["hoist", "fuse", "widen"]}):
+        eng = _pipe_engine("on", rewrites=rewrites)
+        plan = plan_for_engine(eng)
+        assert [s.name for s in plan.segments] == \
+            ["h2d/batch", "cycles", "loss"]
+        assert plan.windows == {}
+
+
+def test_engine_rewrites_bitexact_vs_serial():
+    """The whole point: rewrites change WHEN, never WHAT. A rewritten
+    overlap engine matches the plain serial engine bit for bit."""
+    engine, _, _, _ = deepspeed.initialize(
+        model=Model(lambda p, x, y: jnp.mean((x @ p["w"] - y) ** 2),
+                    {"w": jnp.zeros((8, 4))}),
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 5e-2}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 2, "cpu_offload": True,
+                                  "sub_group_size": 16},
+            "runtime": {"executor": "on", "executor_rewrites": {
+                "passes": ["hoist", "fuse", "widen"]}},
+            "steps_per_print": 10 ** 9,
+        })
+    eng_rw = engine
+    eng_off = _linear_engine("off")
+    rng = np.random.RandomState(7)
+    for _ in range(4):
+        x = rng.randn(8, 8).astype(np.float32)
+        y = rng.randn(8, 4).astype(np.float32)
+        l1 = float(eng_rw(x, y)); eng_rw.backward(l1); eng_rw.step()
+        l2 = float(eng_off(x, y)); eng_off.backward(l2); eng_off.step()
+        assert l1 == l2
+    for a, b in zip(_host_masters(eng_rw), _host_masters(eng_off)):
+        np.testing.assert_array_equal(a, b)
+    snap = eng_rw.plan_executor().rewrite_snapshot()
+    assert snap is not None and snap["segments_moved"] >= 1
+    assert rec_mod.validate_rewrite_stats(snap) == []
+
+
+# -------------------------------------------- config + schema validation
+def _rewrites_cfg(val):
+    from deepspeed_tpu.runtime.config import get_runtime_executor_rewrites
+    return get_runtime_executor_rewrites({"runtime":
+                                          {"executor_rewrites": val}})
+
+
+def test_executor_rewrites_config_matrix():
+    assert _rewrites_cfg(False)["enabled"] is False
+    on = _rewrites_cfg(True)
+    assert on["enabled"] is True
+    assert set(on["passes"]) == {"hoist", "widen", "fuse"}
+    assert on["max_window"] == 8
+    assert on["hoist_max_live_bytes"] == 1 << 28
+    picked = _rewrites_cfg({"passes": ["hoist"], "max_window": 2,
+                            "hoist_max_live_bytes": 4096})
+    assert picked == {"enabled": True, "passes": ("hoist",),
+                      "max_window": 2, "hoist_max_live_bytes": 4096}
+    for bad in ({"passes": ["hoisted"]}, {"window": 3},
+                {"max_window": 0}, {"max_window": True},
+                {"hoist_max_live_bytes": 0}, {"enabled": "yes"},
+                {"passes": "hoist"}, "on", 3):
+        with pytest.raises(DeepSpeedConfigError):
+            _rewrites_cfg(bad)
+    # default when the section is absent: disabled
+    from deepspeed_tpu.runtime.config import get_runtime_executor_rewrites
+    assert get_runtime_executor_rewrites({})["enabled"] is False
+
+
+def test_rewrite_keys_pinned_across_copies():
+    """rewrite.py is canonical; telemetry/record.py re-exports it and
+    bin/check_bench_schema.py carries a stdlib-only twin."""
+    from deepspeed_tpu.runtime.executor import rewrite as rw
+    import importlib.util
+    path = os.path.join(os.path.dirname(__file__), "..", "..", "bin",
+                        "check_bench_schema.py")
+    spec = importlib.util.spec_from_file_location("_cbs", path)
+    cbs = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cbs)
+    assert rw.REWRITE_KEYS == rec_mod.REWRITE_KEYS == cbs.REWRITE_KEYS
+    assert rw.REWRITE_PASS_KEYS == rec_mod.REWRITE_PASS_KEYS == \
+        cbs.REWRITE_PASS_KEYS
+
+
+def test_validate_rewrite_stats_rejects_malformed():
+    good = {"enabled": True,
+            "passes": [{"name": "hoist", "segments_moved": 2,
+                        "predicted_exposed_wait_delta_s": 0.001}],
+            "segments_moved": 2,
+            "predicted_exposed_wait_delta_s": 0.001,
+            "measured_exposed_wait_delta_s": None}
+    assert rec_mod.validate_rewrite_stats(good) == []
+    bad_keys = dict(good); bad_keys.pop("segments_moved")
+    assert rec_mod.validate_rewrite_stats(bad_keys)
+    bad_pass = dict(good, passes=[{"name": "hoist"}])
+    assert rec_mod.validate_rewrite_stats(bad_pass)
+    bad_moved = dict(good, segments_moved=-1)
+    assert rec_mod.validate_rewrite_stats(bad_moved)
+    bad_delta = dict(good, measured_exposed_wait_delta_s="fast")
+    assert rec_mod.validate_rewrite_stats(bad_delta)
+    # and the stats flow through validate_segment_stats via "rewrites"
+    seg = {"plan_segments": 3,
+           "per_kind": {"transfer": {"segments": 2, "run_s": 0.1,
+                                     "wait_s": 0.0}},
+           "overlap_efficiency": 0.8, "upload_batches": 1,
+           "upload_elems": 10, "upload_bytes": 40, "bucket_elems": 8,
+           "bucket_occupancy": None, "work_chunks": 4}
+    seg["rewrites"] = bad_moved
+    assert rec_mod.validate_segment_stats(seg)
+    seg["rewrites"] = good
+    assert rec_mod.validate_segment_stats(seg) == []

@@ -37,7 +37,7 @@ def _compiled_temp_bytes(gas, num_virtual=1):
     ids = np.zeros((gas, 8, 64), np.int32)
     batch = engine._to_device_stacked((ids, ids.copy()))
     fused = engine._get_jit("pipe_train", engine._fused_train_fn,
-                            donate_argnums=(0,))
+                            donate=(0,))
     compiled = fused.lower(engine.state, batch, jrandom.PRNGKey(0),
                            engine._hyper()).compile()
     stats = compiled.memory_analysis()

@@ -53,16 +53,19 @@ class PageSlice:
     """One request's exported KV state (host-side numpy)."""
 
     __slots__ = ("k_pages", "v_pages", "page_size", "length",
-                 "pending_token", "context")
+                 "pending_token", "context", "trace_id")
 
     def __init__(self, k_pages, v_pages, page_size, length,
-                 pending_token, context):
+                 pending_token, context, trace_id=None):
         self.k_pages = k_pages        # (n_pages, layers, heads, ps, dh)
         self.v_pages = v_pages
         self.page_size = int(page_size)
         self.length = int(length)     # tokens resident in the pages
         self.pending_token = int(pending_token)
         self.context = [int(t) for t in context]
+        # the request's span trace_id, carried across the handoff so
+        # prefill + decode read as ONE trace (None when spans are off)
+        self.trace_id = None if trace_id is None else str(trace_id)
 
     @property
     def n_pages(self):
@@ -73,7 +76,7 @@ class PageSlice:
         return self.k_pages.nbytes + self.v_pages.nbytes
 
 
-def export_slice(engine, slot, context, pending_token):
+def export_slice(engine, slot, context, pending_token, trace_id=None):
     """Lift ``slot``'s live pages out of a paged engine's pool into a
     host :class:`PageSlice`. The slot keeps its pages (the caller
     frees it after a successful handoff — export never mutates)."""
@@ -88,7 +91,7 @@ def export_slice(engine, slot, context, pending_token):
     k = np.asarray(engine.kv.k[page_ids])
     v = np.asarray(engine.kv.v[page_ids])
     return PageSlice(k, v, engine.page_size, length, pending_token,
-                     context)
+                     context, trace_id=trace_id)
 
 
 def serialize_slice(sl, quantize=False, block_size=DEFAULT_HANDOFF_BLOCK):
@@ -113,6 +116,7 @@ def serialize_slice(sl, quantize=False, block_size=DEFAULT_HANDOFF_BLOCK):
         "length": sl.length,
         "pending_token": sl.pending_token,
         "context": sl.context,
+        "trace_id": sl.trace_id,
         "shape": list(sl.k_pages.shape),
         "dtype": np.dtype(sl.k_pages.dtype).name,
         "quantized": bool(quantize),
@@ -187,8 +191,11 @@ def deserialize_slice(data):
     else:
         k = arrays["k"].astype(dtype, copy=False).reshape(shape)
         v = arrays["v"].astype(dtype, copy=False).reshape(shape)
+    # tolerant get: version-1 slices written before trace propagation
+    # simply carry no trace_id
     return PageSlice(k, v, header["page_size"], header["length"],
-                     header["pending_token"], header["context"])
+                     header["pending_token"], header["context"],
+                     trace_id=header.get("trace_id"))
 
 
 def import_slice(engine, slot, sl):

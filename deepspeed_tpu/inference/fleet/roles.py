@@ -56,6 +56,15 @@ class PrefillRole:
             return None
         self._free.pop()
         ic = engine.inference_config
+        # the request's trace STARTS here: the root's trace_id rides the
+        # page-slice header so the decode host's spans continue the SAME
+        # trace (one request = one trace across role processes)
+        tel = engine.telemetry
+        spans = tel.spans if tel is not None else None
+        span = None
+        if spans is not None:
+            span = spans.begin("prefill_request", role="prefill",
+                               prompt_tokens=len(prompt))
         t0 = time.perf_counter()
         start = engine.match_prefix(slot, prompt)
         if start:
@@ -66,10 +75,14 @@ class PrefillRole:
             max_chunk=engine.prefill_buckets[-1])
         token = None
         for c_start, c_len in chunks:
+            c_t0 = time.time()
             token = engine.prefill_chunk(
                 slot, prompt[c_start:c_start + c_len], c_start,
                 sampling=self.sampling)
             engine.register_prefix(slot, prompt[:c_start + c_len])
+            if span is not None:
+                span.timed_child("prefill_chunk", c_t0, time.time(),
+                                 start=c_start, tokens=c_len)
         dt = time.perf_counter() - t0
         if metrics is not None:
             metrics.record_prefill(len(prompt) - start, dt)
@@ -87,13 +100,19 @@ class PrefillRole:
                     prefix=engine.prefix_stats(), role="prefill")
                 engine.serving_record_steps += 1
         sl = export_slice(engine, slot, context=prompt,
-                          pending_token=token)
+                          pending_token=token,
+                          trace_id=span.trace_id
+                          if span is not None else None)
         payload = serialize_slice(sl, quantize=self.quantize,
                                   block_size=self.block_size)
         engine.free_slot(slot)
         self._free.append(slot)
         self.handoffs += 1
         self.handoff_bytes += len(payload)
+        if span is not None:
+            span.event("handoff_export", bytes=len(payload),
+                       pages=sl.n_pages)
+            span.end()
         return payload, int(token), dt, engine.bucket_for(len(prompt))
 
 
@@ -155,6 +174,15 @@ class DecodeRole:
         self.sched._admitted += 1
         req.first_token_t = time.perf_counter()
         self.sched.slots[slot] = req
+        if self.sched._spans is not None:
+            # continue the prefill host's trace (sl.trace_id from the
+            # slice header; None mints a fresh one) — ds_fleet's merged
+            # view shows the request as ONE lane across both roles
+            req.span = self.sched._spans.begin(
+                "serving_request", trace_id=sl.trace_id, uid=req.uid,
+                prompt_tokens=len(sl.context), role="decode")
+            req.span.event("handoff_accept", slot=slot,
+                           pages=sl.n_pages)
         if engine.drafter is not None:
             engine.drafter.prefill(slot, req.context)
         self.accepted += 1

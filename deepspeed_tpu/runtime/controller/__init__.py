@@ -1,0 +1,23 @@
+"""Closed-loop runtime controller: retunes the running system from its
+own telemetry, with every decision a schema-pinned, replayable ledger
+event (docs/controller.md)."""
+from .core import RuntimeController
+from .ledger import (CONTROLLER_EVENT_TYPES, CONTROLLER_EVENTS_JSONL,
+                     CONTROLLER_KNOBS, DECISION_KEYS,
+                     KIND_CONTROLLER_EVENT, DecisionLedger,
+                     make_controller_event, unreverted_regressions,
+                     validate_controller_event)
+from .policies import (CONTROLLER_POLICIES, POLICY_REGISTRY,
+                       LaunchAheadPolicy, PrefillBucketsPolicy,
+                       QuantizedCollectivesPolicy, SpeculationPolicy,
+                       make_move)
+
+__all__ = [
+    "RuntimeController", "DecisionLedger", "DECISION_KEYS",
+    "CONTROLLER_EVENT_TYPES", "CONTROLLER_EVENTS_JSONL",
+    "CONTROLLER_KNOBS", "KIND_CONTROLLER_EVENT",
+    "make_controller_event", "validate_controller_event",
+    "unreverted_regressions", "CONTROLLER_POLICIES", "POLICY_REGISTRY",
+    "LaunchAheadPolicy", "SpeculationPolicy",
+    "QuantizedCollectivesPolicy", "PrefillBucketsPolicy", "make_move",
+]

@@ -1,0 +1,207 @@
+"""Engine adapters: bind the jax-free controller core to the live
+engines. Every knob getter/setter the controller can actuate is
+DEFINED here — textually inside ``runtime/controller/`` — so the
+DSL012 lint (knob-write-outside-controller) keeps meaning: a knob
+mutation anywhere else in the tree is a bypass of the audited
+``apply_override`` seam, not an idiom.
+
+``attach_train_controller`` / ``attach_serving_controller`` construct
+the :class:`RuntimeController` (call them ONLY when the strict-
+validated ``controller`` config section enables it — off must stay
+structurally absent), register the engine's eligible knobs, and hook
+the snapshot into the collector's ``/healthz`` /
+``telemetry_snapshot()`` view. ``train_signals`` / ``serving_signals``
+assemble the per-tick signals dict from the existing observability
+seams: the plan executor's measured totals, the serving metrics'
+speculative acceptance, the watchdog's TTFT burn rate, the fleet
+state's ingested ICI health, the compile observatory's storm flags,
+and the wire estimator as the quantized-collectives pricer.
+"""
+from ...utils.logging import logger
+from .core import RuntimeController
+
+
+def _set_window(engine, target, value):
+    engine.plan_executor().windows[str(target)] = int(value)
+
+
+def _set_h2d_bucket(engine, value):
+    engine._h2d_bucket_elems = int(value)
+
+
+def _set_quantized(engine, target, value):
+    value = bool(value)
+    if target == "weights":
+        engine._qwz_enabled = value
+    else:
+        engine._qgz_enabled = value
+        if value and "qg_error" not in engine.state:
+            acc = engine.state.get("acc_grads")
+            if acc is not None:
+                engine._init_qg_error(acc)
+    # the jitted step builders close over these bools — drop the cache
+    # so the next step re-traces with the new collective decomposition
+    engine._jit_cache.clear()
+
+
+def _set_spec_k(engine, value):
+    engine.spec_k = int(value)
+
+
+def _set_prefill_chunk(engine, value):
+    engine.inference_config.prefill_chunk_tokens = int(value)
+
+
+def _set_prefill_buckets(engine, value):
+    engine.prefill_buckets = [int(b) for b in value]
+
+
+def _storm_flags(telemetry):
+    try:
+        return [f["key"] for f in telemetry.programs.flags
+                if str(f["key"]).startswith("recompile_storm:")]
+    except Exception:  # noqa: BLE001 - a malformed flag must not
+        return []     # poison the tick
+
+
+def attach_train_controller(engine, cfg):
+    """Build the training engine's controller: launch-ahead windows,
+    H2D transfer chunk, and (where the ZeRO config makes them
+    eligible) quantized collectives per class."""
+    ctrl = RuntimeController(cfg, telemetry=engine.telemetry,
+                             role="train")
+    ctrl.register_knob(
+        "launch_ahead_window",
+        lambda target: int(engine.plan_executor().windows.get(
+            str(target), 1)),
+        lambda target, value: _set_window(engine, target, value))
+    if getattr(engine, "_h2d_bucket_elems", None):
+        ctrl.register_knob(
+            "h2d_bucket_elems",
+            lambda target: int(engine._h2d_bucket_elems),
+            lambda target, value: _set_h2d_bucket(engine, value))
+    if _quantized_classes(engine):
+        ctrl.register_knob(
+            "quantized_collectives",
+            lambda target: bool(engine._qwz_enabled
+                                if target == "weights"
+                                else engine._qgz_enabled),
+            lambda target, value: _set_quantized(engine, target, value))
+    if engine.telemetry is not None:
+        engine.telemetry.set_controller_view(ctrl.snapshot)
+    logger.info("controller[train]: attached (policies: %s; knobs: %s)",
+                ", ".join(cfg["policies"]), ", ".join(ctrl.knobs))
+    return ctrl
+
+
+def _quantized_classes(engine):
+    """The collective classes THIS config's machinery can actually
+    quantize (toggling an ineligible class would silently no-op or
+    break the step builders — observe_fleet never proposes it)."""
+    stage = engine.zero_optimization_stage()
+    classes = {}
+    if stage >= 3 and getattr(engine.zero_plan, "param_data_axes",
+                              ()) != ():
+        classes["weights"] = bool(engine._qwz_enabled)
+    if engine._config.zero_enabled and stage >= 2:
+        classes["gradients"] = bool(engine._qgz_enabled)
+    return classes
+
+
+def _wire_win_s(engine):
+    """The quantized-collectives pricer: the wire estimator's per-class
+    bytes-on-wire over measured ICI nominal bandwidth, scaled by the
+    int8 payload shrink (~3/4 of the full-precision bytes stay home).
+    ``{}`` when the estimate is unavailable."""
+    est = engine._telemetry_wire()
+    if not est or engine.telemetry is None:
+        return {}
+    try:
+        from ..comm.wire import ici_bytes_per_s_for
+        bw = ici_bytes_per_s_for(engine.telemetry._device)
+    except Exception:  # noqa: BLE001 - pricing must not kill the tick
+        return {}
+    if not bw:
+        return {}
+    out = {}
+    for cls, key in (("weights", "allgather_bytes_per_step"),
+                     ("gradients", "reduce_bytes_per_step")):
+        nbytes = est.get(key) or 0
+        if nbytes > 0:
+            out[cls] = 0.75 * float(nbytes) / float(bw)
+    return out
+
+
+def train_signals(engine):
+    """Signals dict (see policies.py vocabulary) for one training
+    tick, assembled from the existing telemetry seams only."""
+    tel = engine.telemetry
+    sig = {"step": engine.global_steps}
+    ex = engine._plan_executor
+    if ex is not None:
+        per_kind, busy, waits = ex.measured_totals()
+        sig["exec_per_kind"] = per_kind
+        sig["exec_busy_s"] = busy
+        sig["exec_waits_s"] = waits
+        sig["windows"] = dict(ex.windows)
+    if getattr(engine, "_h2d_bucket_elems", None):
+        sig["h2d_bucket_elems"] = int(engine._h2d_bucket_elems)
+    quantized = _quantized_classes(engine)
+    if quantized:
+        sig["quantized"] = quantized
+        sig["wire_win_s"] = _wire_win_s(engine)
+    if tel is not None:
+        if tel.fleet is not None and tel.fleet.ici_health:
+            sig["ici_health"] = dict(tel.fleet.ici_health)
+        sig["storm_flags"] = _storm_flags(tel)
+    return sig
+
+
+def attach_serving_controller(engine, cfg):
+    """Build the serving engine's controller: speculative k (drafter
+    configured), chunked-prefill size (chunking configured), and the
+    prefill bucket list."""
+    ctrl = RuntimeController(cfg, telemetry=engine.telemetry,
+                             role="serve")
+    if engine.drafter is not None:
+        ctrl.register_knob(
+            "spec_k",
+            lambda target: int(engine.spec_k),
+            lambda target, value: _set_spec_k(engine, value))
+    if engine.inference_config.prefill_chunk_tokens:
+        ctrl.register_knob(
+            "prefill_chunk_tokens",
+            lambda target: int(
+                engine.inference_config.prefill_chunk_tokens),
+            lambda target, value: _set_prefill_chunk(engine, value))
+    ctrl.register_knob(
+        "prefill_buckets",
+        lambda target: list(engine.prefill_buckets),
+        lambda target, value: _set_prefill_buckets(engine, value))
+    if engine.telemetry is not None:
+        engine.telemetry.set_controller_view(ctrl.snapshot)
+    logger.info("controller[serve]: attached (policies: %s; knobs: %s)",
+                ", ".join(cfg["policies"]), ", ".join(ctrl.knobs))
+    return ctrl
+
+
+def serving_signals(sched):
+    """Signals dict for one serving-scheduler tick."""
+    engine = sched.engine
+    tel = engine.telemetry
+    sig = {"step": engine.serving_record_steps,
+           "spec_k": int(engine.spec_k),
+           "prefill_buckets": list(engine.prefill_buckets)}
+    chunk = engine.inference_config.prefill_chunk_tokens
+    if chunk:
+        sig["prefill_chunk_tokens"] = int(chunk)
+    metrics = getattr(sched, "_record_metrics", None)
+    if metrics is not None:
+        dist = metrics.spec_dist()
+        if dist is not None:
+            sig["acceptance_rate"] = dist["acceptance_rate"]
+    if tel is not None:
+        if tel.watchdog is not None:
+            sig["ttft_burn_rate"] = tel.watchdog.ttft_burn_rate()
+        sig["storm_flags"] = _storm_flags(tel)
+    return sig

@@ -1,0 +1,304 @@
+"""The closed-loop runtime controller (docs/controller.md).
+
+Observe -> decide -> act -> evaluate -> (revert): every tick the
+controller folds the engine's objective sample (step wall) into its
+rolling window, finalizes any override whose evaluation window
+elapsed (the *measured* win lands in the ledger next to the pricer's
+prediction — their ratio is the ``controller_drift`` gauge), and every
+``interval_steps`` asks its policies for moves.
+
+Observability is the contract, enforced structurally:
+
+* actuation happens ONLY through :meth:`RuntimeController.apply_override`
+  — the single audited seam (DSL012 flags knob writes anywhere else),
+  and that seam cannot act without emitting a ledger ``decision``;
+* the full ledger rides every crash bundle (``state.controller`` via
+  the flight-recorder context registered at construction);
+* a measured regression past ``guardrail_pct`` trips the ``controller``
+  watchdog (dump by default — the bundle carries the ledger) and
+  auto-reverts through the same seam, so the revert is a ledger event
+  too.
+
+The core is jax-free: engines adapt themselves by registering knob
+bindings (getter/setter pairs) and assembling the signals dict from
+``telemetry_snapshot()`` / ``ingest_fleet`` state (see the policy
+module's signals vocabulary).
+"""
+from ...utils.logging import logger
+from .ledger import DecisionLedger
+from .policies import POLICY_REGISTRY
+
+
+class _KnobBinding:
+    __slots__ = ("knob", "getter", "setter")
+
+    def __init__(self, knob, getter, setter):
+        self.knob = knob
+        self.getter = getter      # (target) -> current value
+        self.setter = setter      # (target, value) -> None
+
+
+class _Override:
+    """One applied move awaiting its evaluation window."""
+
+    __slots__ = ("decision_id", "policy", "knob", "target", "old",
+                 "new", "applied_step", "eval_at_step", "baseline_s",
+                 "predicted_win_s", "samples")
+
+    def __init__(self, *, decision_id, policy, knob, target, old, new,
+                 applied_step, eval_at_step, baseline_s,
+                 predicted_win_s):
+        self.decision_id = decision_id
+        self.policy = policy
+        self.knob = knob
+        self.target = target
+        self.old = old
+        self.new = new
+        self.applied_step = applied_step
+        self.eval_at_step = eval_at_step
+        self.baseline_s = baseline_s
+        self.predicted_win_s = predicted_win_s
+        self.samples = []         # objective samples after the move
+
+
+class RuntimeController:
+    """One per engine (train or serving). Construct only when the
+    strict-validated ``controller`` config section enables it — a
+    disabled controller is structurally absent (``engine.controller is
+    None``): no ledger file, no policies, no per-step branch beyond
+    one ``is not None``."""
+
+    def __init__(self, cfg, telemetry=None, role="train",
+                 output_dir=None):
+        self.cfg = dict(cfg)
+        self.role = role
+        self.telemetry = telemetry
+        if output_dir is None and telemetry is not None:
+            output_dir = getattr(telemetry, "output_dir", None)
+        self.ledger = DecisionLedger(output_dir)
+        self.policies = [POLICY_REGISTRY[name]()
+                         for name in self.cfg["policies"]]
+        self._knobs = {}
+        self._pending = []        # _Override awaiting evaluation
+        self._cooldown = {}       # (knob, target) -> step it expires
+        self._objective = []      # recent (step, objective_s)
+        self._next_id = 0
+        self._last_decide_step = None
+        self.decisions = 0
+        self.outcomes = 0
+        self.reverts = 0
+        self.drift = None         # last predicted/measured ratio
+        recorder = getattr(telemetry, "recorder", None) \
+            if telemetry is not None else None
+        if recorder is not None:
+            # the whole ledger in every crash bundle, resolved at dump
+            # time — a dump alone replays every decision
+            recorder.set_context("controller", self._bundle_context)
+
+    # ------------------------------------------------------------ knobs
+    def register_knob(self, knob, getter, setter):
+        """Bind a controller-managed tunable. ``getter(target)`` reads
+        the live value, ``setter(target, value)`` writes it — the
+        setter is invoked ONLY from apply_override."""
+        self._knobs[knob] = _KnobBinding(knob, getter, setter)
+
+    @property
+    def knobs(self):
+        return sorted(self._knobs)
+
+    # ---------------------------------------------------------- the seam
+    def apply_override(self, *, policy, knob, target=None, new=None,
+                       signal=None, predicted_win_s=None, reason="",
+                       step=None):
+        """THE single audited actuation seam: every knob write the
+        controller ever performs goes through here, and none happens
+        without its ledger ``decision`` event. Returns the event, or
+        None when the knob has no binding / is cooling down."""
+        binding = self._knobs.get(knob)
+        if binding is None:
+            return None
+        step = self._last_step() if step is None else int(step)
+        if self._cooldown.get((knob, target), -1) >= step:
+            return None
+        old = binding.getter(target)
+        if old == new:
+            return None
+        decision_id = "{}-{:04d}".format(self.role, self._next_id)
+        self._next_id += 1
+        binding.setter(target, new)
+        ev = self.ledger.emit(
+            event="decision", decision_id=decision_id, policy=policy,
+            knob=knob, target=target, old=old, new=new,
+            signal=dict(signal or {}, step=step),
+            predicted_win_s=predicted_win_s, reason=reason)
+        self.decisions += 1
+        self._metric("controller_decision", knob)
+        self._pending.append(_Override(
+            decision_id=decision_id, policy=policy, knob=knob,
+            target=target, old=old, new=new, applied_step=step,
+            eval_at_step=step + self.cfg["eval_steps"],
+            baseline_s=self._objective_mean(self.cfg["interval_steps"]),
+            predicted_win_s=predicted_win_s))
+        self._cooldown[(knob, target)] = \
+            step + self.cfg["cooldown_steps"]
+        logger.info("controller[%s]: %s %s%s %r -> %r (%s)", self.role,
+                    policy, knob, "" if target is None else
+                    ":" + str(target), old, new, reason)
+        return ev
+
+    # ------------------------------------------------------------- tick
+    def on_step(self, step, objective_s, signals=None):
+        """The per-step tick, called from the engine's telemetry emit
+        path: fold the objective sample, finalize due evaluations,
+        and every ``interval_steps`` ask the policies for moves."""
+        step = int(step)
+        if objective_s is not None:
+            self._objective.append((step, float(objective_s)))
+            del self._objective[:-256]
+            for ov in self._pending:
+                if step > ov.applied_step:
+                    ov.samples.append(float(objective_s))
+        self._evaluate(step)
+        if signals is None:
+            return
+        last = self._last_decide_step
+        if last is not None and \
+                step - last < self.cfg["interval_steps"]:
+            return
+        self._last_decide_step = step
+        signals.setdefault(
+            "step_time_s",
+            self._objective_mean(self.cfg["interval_steps"]))
+        budget = self.cfg["max_moves_per_tick"]
+        for pol in self.policies:
+            if budget <= 0:
+                break
+            try:
+                moves = pol.propose(signals)
+            except Exception:  # noqa: BLE001 - a policy bug must not
+                logger.warning("controller policy %s failed on its "
+                               "signals", pol.name, exc_info=True)
+                continue      # kill the training step
+            for move in moves:
+                if budget <= 0:
+                    break
+                if self.apply_override(step=step, **move) is not None:
+                    budget -= 1
+
+    # ------------------------------------------------------- evaluation
+    def _evaluate(self, step):
+        due = [ov for ov in self._pending
+               if step >= ov.eval_at_step and ov.samples]
+        for ov in due:
+            self._pending.remove(ov)
+            measured = sum(ov.samples) / len(ov.samples)
+            win = None if ov.baseline_s is None \
+                else ov.baseline_s - measured
+            drift = None
+            if win and ov.predicted_win_s is not None:
+                drift = ov.predicted_win_s / win if win != 0 else None
+            cite = {"baseline_s": ov.baseline_s,
+                    "measured_s": measured,
+                    "n_samples": len(ov.samples),
+                    "drift": drift}
+            self.outcomes += 1
+            self.ledger.emit(
+                event="outcome", decision_id=ov.decision_id,
+                policy=ov.policy, knob=ov.knob, target=ov.target,
+                old=ov.old, new=ov.new, signal=cite,
+                predicted_win_s=ov.predicted_win_s,
+                measured_win_s=0.0 if win is None else win,
+                reason="evaluation window closed")
+            if drift is not None:
+                self.drift = drift
+                self._metric("controller_drift", drift)
+            if win is not None and ov.baseline_s and win < 0 and \
+                    -win > abs(ov.baseline_s) * \
+                    self.cfg["guardrail_pct"]:
+                self._regressed(ov, win, measured)
+
+    def _regressed(self, ov, win, measured):
+        """Guardrail trip: dump (the bundle carries the ledger), then
+        auto-revert — the revert is a first-class ledger event."""
+        detail = ("{}: {}{} {!r} -> {!r} regressed {:.1%} past the "
+                  "{:.0%} guardrail (baseline {:.4f}s, measured "
+                  "{:.4f}s)").format(
+                      ov.decision_id, ov.knob,
+                      "" if ov.target is None else ":" + str(ov.target),
+                      ov.old, ov.new, -win / abs(ov.baseline_s),
+                      self.cfg["guardrail_pct"], ov.baseline_s,
+                      measured)
+        watchdog = getattr(self.telemetry, "watchdog", None) \
+            if self.telemetry is not None else None
+        if watchdog is not None:
+            watchdog.observe_controller(detail)
+        binding = self._knobs.get(ov.knob)
+        if binding is not None:
+            binding.setter(ov.target, ov.old)
+        self.reverts += 1
+        self._metric("controller_revert", ov.knob)
+        # cooldown so the reverted knob is not immediately re-proposed
+        self._cooldown[(ov.knob, ov.target)] = \
+            ov.eval_at_step + 2 * self.cfg["cooldown_steps"]
+        self.ledger.emit(
+            event="revert", decision_id=ov.decision_id,
+            policy=ov.policy, knob=ov.knob, target=ov.target,
+            old=ov.new, new=ov.old,
+            signal={"baseline_s": ov.baseline_s,
+                    "measured_s": measured},
+            predicted_win_s=ov.predicted_win_s, measured_win_s=win,
+            reason=detail)
+        logger.warning("controller[%s]: reverted %s", self.role,
+                       detail)
+
+    # ---------------------------------------------------------- helpers
+    def _last_step(self):
+        return self._objective[-1][0] if self._objective else 0
+
+    def _objective_mean(self, last_n):
+        vals = [v for _, v in self._objective[-int(last_n):]]
+        return sum(vals) / len(vals) if vals else None
+
+    def _metric(self, what, arg):
+        metrics = getattr(self.telemetry, "metrics", None) \
+            if self.telemetry is not None else None
+        if metrics is None:
+            return
+        try:
+            if what == "controller_decision":
+                metrics.controller_decision(arg)
+            elif what == "controller_revert":
+                metrics.controller_revert(arg)
+            else:
+                metrics.controller_drift(arg)
+        except Exception:  # noqa: BLE001 - metrics must not kill steps
+            logger.warning("controller metrics update failed",
+                           exc_info=True)
+
+    def overrides(self):
+        """Currently-live overrides (awaiting evaluation) — surfaced
+        in /healthz so an operator sees what the controller holds."""
+        return [{"decision_id": ov.decision_id, "policy": ov.policy,
+                 "knob": ov.knob, "target": ov.target, "old": ov.old,
+                 "new": ov.new, "applied_step": ov.applied_step}
+                for ov in self._pending]
+
+    def snapshot(self):
+        """CONTROLLER_SNAPSHOT_KEYS shape (telemetry/record.py):
+        rides ``telemetry_snapshot()['controller']``, ``/healthz`` and
+        the bench ``extra.controller`` block."""
+        return {
+            "enabled": True,
+            "role": self.role,
+            "policies": [pol.name for pol in self.policies],
+            "decisions": self.decisions,
+            "outcomes": self.outcomes,
+            "reverts": self.reverts,
+            "pending": len(self._pending),
+            "overrides": self.overrides(),
+            "drift": self.drift,
+            "ledger_path": self.ledger.path,
+        }
+
+    def _bundle_context(self):
+        return dict(self.snapshot(), events=self.ledger.snapshot())

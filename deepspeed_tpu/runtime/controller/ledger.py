@@ -1,0 +1,190 @@
+"""Decision-ledger schema for the closed-loop runtime controller.
+
+Every move the controller takes lands as ONE schema-pinned JSON event
+appended to ``controller_events.jsonl`` inside the telemetry job
+directory: the ``decision`` (signal citation with the measured values
+that triggered it, knob, old -> new, the pricer's predicted win), the
+``outcome`` appended after the evaluation window (measured win,
+predicted-vs-measured drift), and — when a guardrail trips — the
+``revert`` (also a first-class event, so a doctored run reconstructs
+the whole episode from the ledger alone). The fleet merger
+(telemetry/fleet/aggregate.py) reads the per-host files the same way
+it reads rescale/router events and surfaces them in the fleet report's
+``controller`` section (bin/ds_fleet.py prints the DECISIONS table).
+
+Stdlib-only by contract: ``aggregate.py`` and ``check_bench_schema.py``
+carry local copies of :data:`DECISION_KEYS` /
+:data:`CONTROLLER_EVENT_TYPES` / :data:`CONTROLLER_KNOBS` (pinned
+equal by tests/unit/test_controller.py) so doctoring a crashed run
+never needs jax importable.
+"""
+import json
+import os
+import time
+
+KIND_CONTROLLER_EVENT = "controller_event"
+
+# per-host file name inside a telemetry job directory (the rescale/
+# router events discipline: one JSONL per host, merged wall-ordered)
+CONTROLLER_EVENTS_JSONL = "controller_events.jsonl"
+
+# the event vocabulary — one decision episode is decision -> outcome
+# [-> revert]; the controller emits nothing outside this set
+CONTROLLER_EVENT_TYPES = ("decision", "outcome", "revert")
+
+# the knob vocabulary — every controller-managed tunable (DSL012 flags
+# writes to these outside runtime/controller/ and the config parsers)
+CONTROLLER_KNOBS = ("launch_ahead_window", "h2d_bucket_elems", "spec_k",
+                    "prefill_chunk_tokens", "quantized_collectives",
+                    "prefill_buckets")
+
+# every controller_event carries exactly these top-level keys
+DECISION_KEYS = ("kind", "wall", "seq", "event", "decision_id", "policy",
+                 "knob", "target", "old", "new", "signal",
+                 "predicted_win_s", "measured_win_s", "reason")
+
+
+def make_controller_event(*, event, decision_id, policy, knob,
+                          target=None, old=None, new=None, signal=None,
+                          predicted_win_s=None, measured_win_s=None,
+                          reason="", seq=0, wall=None):
+    return {
+        "kind": KIND_CONTROLLER_EVENT,
+        "wall": float(wall if wall is not None else time.time()),
+        "seq": int(seq),
+        "event": str(event),
+        "decision_id": str(decision_id),
+        "policy": str(policy),
+        "knob": str(knob),
+        "target": None if target is None else str(target),
+        "old": old,
+        "new": new,
+        "signal": signal,
+        "predicted_win_s": (None if predicted_win_s is None
+                            else float(predicted_win_s)),
+        "measured_win_s": (None if measured_win_s is None
+                           else float(measured_win_s)),
+        "reason": str(reason),
+    }
+
+
+def validate_controller_event(ev):
+    """Schema check for one controller_event dict. Returns a list of
+    problem strings; empty list = valid."""
+    problems = []
+    if not isinstance(ev, dict):
+        return ["controller event is not a dict: {!r}".format(
+            type(ev).__name__)]
+    for key in DECISION_KEYS:
+        if key not in ev:
+            problems.append("missing key {!r}".format(key))
+    extra = sorted(set(ev) - set(DECISION_KEYS))
+    if extra:
+        problems.append("unexpected key(s) {}".format(extra))
+    if problems:
+        return problems
+    if ev["kind"] != KIND_CONTROLLER_EVENT:
+        problems.append("kind is {!r}, want {!r}".format(
+            ev["kind"], KIND_CONTROLLER_EVENT))
+    if ev["event"] not in CONTROLLER_EVENT_TYPES:
+        problems.append("event {!r} not in {}".format(
+            ev["event"], CONTROLLER_EVENT_TYPES))
+    if ev["knob"] not in CONTROLLER_KNOBS:
+        problems.append("knob {!r} not in {}".format(
+            ev["knob"], CONTROLLER_KNOBS))
+    for key in ("wall", "seq"):
+        if isinstance(ev[key], bool) or \
+                not isinstance(ev[key], (int, float)):
+            problems.append("{} is not a number: {!r}".format(
+                key, ev[key]))
+    for key in ("decision_id", "policy", "reason"):
+        if not isinstance(ev[key], str):
+            problems.append("{} is not a string: {!r}".format(
+                key, ev[key]))
+    if ev["target"] is not None and not isinstance(ev["target"], str):
+        problems.append("target is neither null nor a string: "
+                        "{!r}".format(ev["target"]))
+    if ev["signal"] is not None and not isinstance(ev["signal"], dict):
+        problems.append("signal is neither null nor a dict: "
+                        "{!r}".format(ev["signal"]))
+    for key in ("predicted_win_s", "measured_win_s"):
+        if ev[key] is not None and (
+                isinstance(ev[key], bool) or
+                not isinstance(ev[key], (int, float))):
+            problems.append("{} is neither null nor a number: "
+                            "{!r}".format(key, ev[key]))
+    # a decision cites its trigger; an outcome/revert cites its measure
+    if ev["event"] == "decision" and ev["signal"] is None:
+        problems.append("decision event carries no signal citation")
+    if ev["event"] in ("outcome", "revert") and \
+            ev["measured_win_s"] is None:
+        problems.append("{} event carries no measured_win_s".format(
+            ev["event"]))
+    return problems
+
+
+def unreverted_regressions(events, guardrail_pct=0.0):
+    """Decision ids whose ``outcome`` measured a regression past the
+    guardrail with no later ``revert`` — reconstructable from the
+    ledger alone (bin/ds_fleet.py --strict counts these)."""
+    regressed, reverted = {}, set()
+    for ev in events:
+        if not isinstance(ev, dict) or \
+                ev.get("kind") != KIND_CONTROLLER_EVENT:
+            continue
+        if ev.get("event") == "revert":
+            reverted.add(ev.get("decision_id"))
+        elif ev.get("event") == "outcome":
+            win = ev.get("measured_win_s")
+            base = (ev.get("signal") or {}).get("baseline_s")
+            if isinstance(win, (int, float)) and not \
+                    isinstance(win, bool) and win < 0:
+                floor = abs(base) * float(guardrail_pct) \
+                    if isinstance(base, (int, float)) and not \
+                    isinstance(base, bool) else 0.0
+                if -win >= floor:
+                    regressed[ev.get("decision_id")] = win
+    return sorted(d for d in regressed if d not in reverted and
+                  d is not None)
+
+
+class DecisionLedger:
+    """In-memory event list + optional JSONL append (one line per
+    event, flushed per event so a crashed controller leaves every
+    decision it took on disk — the torn-tail tolerance lives in the
+    merger's ``read_jsonl_tolerant``)."""
+
+    def __init__(self, output_dir=None):
+        self.events = []
+        self.path = None
+        self._seq = 0
+        if output_dir is not None:
+            os.makedirs(output_dir, exist_ok=True)
+            self.path = os.path.join(output_dir, CONTROLLER_EVENTS_JSONL)
+
+    def emit(self, **kwargs):
+        kwargs.setdefault("seq", self._seq)
+        ev = make_controller_event(**kwargs)
+        problems = validate_controller_event(ev)
+        assert not problems, "controller event failed its own schema: " \
+            "{}".format(problems)
+        self._seq = max(self._seq, ev["seq"]) + 1
+        self.events.append(ev)
+        if self.path is not None:
+            with open(self.path, "a") as fh:
+                fh.write(json.dumps(ev) + "\n")
+                fh.flush()
+        return ev
+
+    def tally(self):
+        """{event type: count} over everything emitted so far."""
+        counts = {}
+        for ev in self.events:
+            counts[ev["event"]] = counts.get(ev["event"], 0) + 1
+        return counts
+
+    def snapshot(self):
+        """The full ledger (crash bundles embed this under
+        ``state.controller.events`` via the flight-recorder context,
+        so a dump alone replays every decision)."""
+        return list(self.events)

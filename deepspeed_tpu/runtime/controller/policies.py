@@ -1,0 +1,305 @@
+"""The controller's policy catalog (docs/controller.md).
+
+Each policy is a small stateful object: ``propose(signals)`` reads the
+signals dict the engine adapter assembled from ``telemetry_snapshot()``
+/ ``ingest_fleet`` state and returns a list of *proposed moves* — plain
+dicts citing the measured values that triggered them and the pricer's
+predicted win. Policies never actuate anything themselves: the
+:class:`~deepspeed_tpu.runtime.controller.core.RuntimeController`
+applies at most ``max_moves_per_tick`` of them through its single
+audited ``apply_override()`` seam, which is also the only place the
+ledger's ``decision`` events are born.
+
+Signals dict vocabulary (absent keys = signal not available; policies
+must tolerate every absence):
+
+* ``step`` — current engine step
+* ``step_time_s`` — rolling mean of the objective (step wall)
+* ``exec_per_kind`` — ``{kind: {segments, run_s, wait_s}}`` lifetime
+  executor totals (``PlanExecutor.measured_totals``)
+* ``exec_busy_s`` / ``exec_waits_s`` — lifetime busy / exposed-wait
+* ``windows`` — the executor's live launch-ahead windows dict
+* ``h2d_bucket_elems`` — the H2D batcher's transfer chunk size
+* ``acceptance_rate`` — speculative-decode acceptance (0..1)
+* ``ttft_burn_rate`` — TTFT SLO burn rate (>1 = burning too fast)
+* ``spec_k`` / ``prefill_chunk_tokens`` / ``prefill_buckets`` —
+  current serving knob values
+* ``ici_health`` — ``{"host:class": achieved/nominal}`` from
+  ``ingest_fleet`` (1.0 = nominal, lower = degraded link)
+* ``quantized`` — ``{"weights": bool, "gradients": bool}``
+* ``wire_win_s`` — ``{class: predicted seconds saved per step}`` from
+  the wire estimator's quantized-vs-full byte model
+* ``storm_flags`` — recompile-storm program keys from the compile
+  observatory (``telemetry.programs.flags``)
+"""
+
+# shared proposal shape (the controller turns one of these into a
+# ledger ``decision`` event via apply_override)
+
+
+def make_move(*, policy, knob, target=None, new=None, signal=None,
+              predicted_win_s=None, reason=""):
+    return {"policy": policy, "knob": knob, "target": target,
+            "new": new, "signal": signal or {},
+            "predicted_win_s": predicted_win_s, "reason": reason}
+
+
+class LaunchAheadPolicy:
+    """Executor launch-ahead windows and H2D transfer chunk size from
+    measured exposed waits — the continuous version of the act-once
+    ``widen`` rewrite pass. When the exposed-wait fraction of a step
+    rises past ``wait_frac_hi`` the window of the waitiest segment kind
+    widens by one (the pricer: the wait it would hide); when a widened
+    window's kind shows ~no wait any more the window decays back toward
+    its base so the schedule never ratchets. With the h2d window
+    already at ``max_window`` and h2d still the waitiest kind, the
+    transfer chunk size doubles instead (fewer, larger copies)."""
+
+    name = "launch_ahead"
+
+    def __init__(self, wait_frac_hi=0.10, wait_frac_lo=0.02,
+                 max_window=16, max_bucket_growth=4):
+        self.wait_frac_hi = float(wait_frac_hi)
+        self.wait_frac_lo = float(wait_frac_lo)
+        self.max_window = int(max_window)
+        self.max_bucket_growth = int(max_bucket_growth)
+        self._prev = None          # (per_kind wait_s, busy, waits)
+        self._base_bucket = None
+
+    def propose(self, signals):
+        per_kind = signals.get("exec_per_kind")
+        busy = signals.get("exec_busy_s")
+        waits = signals.get("exec_waits_s")
+        windows = signals.get("windows")
+        if per_kind is None or busy is None or waits is None or \
+                not windows:
+            return []
+        kind_waits = {k: float(v.get("wait_s", 0.0))
+                      for k, v in per_kind.items()}
+        prev = self._prev or ({}, 0.0, 0.0)
+        self._prev = (kind_waits, float(busy), float(waits))
+        d_busy = float(busy) - prev[1]
+        d_waits = float(waits) - prev[2]
+        if d_busy + d_waits <= 0:
+            return []
+        frac = d_waits / (d_busy + d_waits)
+        d_kind = {k: w - prev[0].get(k, 0.0)
+                  for k, w in kind_waits.items() if k in windows}
+        moves = []
+        if frac > self.wait_frac_hi and d_kind:
+            kind = max(d_kind, key=d_kind.get)
+            if d_kind[kind] <= 0:
+                return []
+            cur = int(windows.get(kind, 1))
+            cite = {"wait_frac": round(frac, 4),
+                    "kind_wait_delta_s": round(d_kind[kind], 6),
+                    "busy_delta_s": round(d_busy, 6)}
+            if cur < self.max_window:
+                moves.append(make_move(
+                    policy=self.name, knob="launch_ahead_window",
+                    target=kind, new=cur + 1, signal=cite,
+                    # the widen pricer: half the kind's exposed wait is
+                    # hideable by one more in-flight slot
+                    predicted_win_s=d_kind[kind] * 0.5,
+                    reason="exposed-wait fraction {:.0%} past {:.0%}; "
+                           "{} waitiest".format(frac, self.wait_frac_hi,
+                                                kind)))
+            elif kind == "h2d" and \
+                    signals.get("h2d_bucket_elems") is not None:
+                elems = int(signals["h2d_bucket_elems"])
+                if self._base_bucket is None:
+                    self._base_bucket = elems
+                if elems < self._base_bucket * self.max_bucket_growth:
+                    cite["h2d_window"] = cur
+                    moves.append(make_move(
+                        policy=self.name, knob="h2d_bucket_elems",
+                        new=elems * 2, signal=cite,
+                        predicted_win_s=d_kind[kind] * 0.25,
+                        reason="h2d window at max {}; growing transfer "
+                               "chunk".format(cur)))
+        elif frac < self.wait_frac_lo:
+            # decay: narrow the widest window whose kind shows no wait
+            idle = [(k, int(w)) for k, w in windows.items()
+                    if int(w) > 1 and d_kind.get(k, 0.0) <= 0.0]
+            if idle:
+                kind, cur = max(idle, key=lambda kv: kv[1])
+                moves.append(make_move(
+                    policy=self.name, knob="launch_ahead_window",
+                    target=kind, new=cur - 1,
+                    signal={"wait_frac": round(frac, 4)},
+                    predicted_win_s=0.0,
+                    reason="exposed-wait fraction {:.1%} below "
+                           "{:.0%}; decaying".format(
+                               frac, self.wait_frac_lo)))
+        return moves
+
+
+class SpeculationPolicy:
+    """Speculative k and chunked-prefill size from acceptance rate and
+    TTFT SLO burn. High acceptance means the drafter is cheap tokens on
+    the table (raise k); low acceptance means wasted verify flops
+    (lower k). A burning TTFT SLO shrinks the prefill chunk so decode
+    interleaves sooner; a comfortably green SLO grows it back toward
+    the configured base."""
+
+    name = "speculation"
+
+    def __init__(self, accept_hi=0.8, accept_lo=0.4, max_k=8,
+                 burn_hi=1.0, burn_lo=0.5, min_chunk=64):
+        self.accept_hi = float(accept_hi)
+        self.accept_lo = float(accept_lo)
+        self.max_k = int(max_k)
+        self.burn_hi = float(burn_hi)
+        self.burn_lo = float(burn_lo)
+        self.min_chunk = int(min_chunk)
+        self._base_chunk = None
+
+    def propose(self, signals):
+        moves = []
+        accept = signals.get("acceptance_rate")
+        k = signals.get("spec_k")
+        step_s = signals.get("step_time_s") or 0.0
+        if accept is not None and k:
+            cite = {"acceptance_rate": round(float(accept), 4),
+                    "spec_k": int(k)}
+            if accept > self.accept_hi and k < self.max_k:
+                moves.append(make_move(
+                    policy=self.name, knob="spec_k", new=int(k) + 1,
+                    signal=cite,
+                    # one more draft token at this acceptance ~ its
+                    # share of the verify step's wall back
+                    predicted_win_s=step_s * float(accept) / (k + 1),
+                    reason="acceptance {:.0%} past {:.0%}".format(
+                        accept, self.accept_hi)))
+            elif accept < self.accept_lo and k > 1:
+                moves.append(make_move(
+                    policy=self.name, knob="spec_k", new=int(k) - 1,
+                    signal=cite,
+                    predicted_win_s=step_s * (1.0 - float(accept)) / k,
+                    reason="acceptance {:.0%} below {:.0%}".format(
+                        accept, self.accept_lo)))
+        burn = signals.get("ttft_burn_rate")
+        chunk = signals.get("prefill_chunk_tokens")
+        if burn is not None and chunk:
+            chunk = int(chunk)
+            if self._base_chunk is None:
+                self._base_chunk = chunk
+            cite = {"ttft_burn_rate": round(float(burn), 4),
+                    "prefill_chunk_tokens": chunk}
+            if burn > self.burn_hi and chunk // 2 >= self.min_chunk:
+                moves.append(make_move(
+                    policy=self.name, knob="prefill_chunk_tokens",
+                    new=chunk // 2, signal=cite,
+                    predicted_win_s=step_s * 0.5,
+                    reason="TTFT SLO burn {:.2f} past {:.2f}; halving "
+                           "prefill chunk".format(burn, self.burn_hi)))
+            elif burn < self.burn_lo and chunk * 2 <= self._base_chunk:
+                moves.append(make_move(
+                    policy=self.name, knob="prefill_chunk_tokens",
+                    new=chunk * 2, signal=cite, predicted_win_s=0.0,
+                    reason="TTFT SLO burn {:.2f} below {:.2f}; growing "
+                           "prefill chunk back".format(
+                               burn, self.burn_lo)))
+        return moves
+
+
+class QuantizedCollectivesPolicy:
+    """Quantized collectives on/off per class from ingested ICI health
+    vs the wire estimator's predicted win (the EQuARX argument: the
+    quantization win is link-health-dependent, so it must be decided
+    from live measurement). A class quantizes when any link's
+    achieved/nominal ratio sinks past ``health_lo`` AND the wire model
+    predicts a positive win; it un-quantizes when every link is back
+    above ``health_hi``."""
+
+    name = "quantized_collectives"
+
+    def __init__(self, health_lo=0.6, health_hi=0.9):
+        self.health_lo = float(health_lo)
+        self.health_hi = float(health_hi)
+
+    def propose(self, signals):
+        health = signals.get("ici_health") or {}
+        quantized = signals.get("quantized") or {}
+        wire_win = signals.get("wire_win_s") or {}
+        vals = [v for v in health.values()
+                if isinstance(v, (int, float))]
+        if not vals or not quantized:
+            return []
+        worst_key = min(health, key=lambda k: health[k]
+                        if isinstance(health[k], (int, float))
+                        else float("inf"))
+        worst = float(health[worst_key])
+        moves = []
+        for cls, on in sorted(quantized.items()):
+            win = wire_win.get(cls)
+            cite = {"worst_link": worst_key,
+                    "worst_health": round(worst, 4),
+                    "predicted_wire_win_s": win}
+            if not on and worst < self.health_lo and win and win > 0:
+                moves.append(make_move(
+                    policy=self.name, knob="quantized_collectives",
+                    target=cls, new=True, signal=cite,
+                    predicted_win_s=win,
+                    reason="link {} at {:.0%} of nominal (< {:.0%}); "
+                           "wire model predicts {:.3f}s/step".format(
+                               worst_key, worst, self.health_lo,
+                               win)))
+            elif on and worst > self.health_hi:
+                moves.append(make_move(
+                    policy=self.name, knob="quantized_collectives",
+                    target=cls, new=False, signal=cite,
+                    predicted_win_s=0.0,
+                    reason="links recovered to {:.0%} of nominal "
+                           "(> {:.0%})".format(worst, self.health_hi)))
+        return moves
+
+
+class PrefillBucketsPolicy:
+    """Prefill buckets from compile-observatory storm flags: a
+    recompile storm on the prefill program family means the bucket
+    list admits too many distinct shapes, so coarsen it (drop every
+    other bucket, always keeping the largest — admission correctness
+    depends on the top bucket covering max_seq_len). Acts at most once
+    per distinct storm flag set."""
+
+    name = "prefill_buckets"
+
+    def __init__(self, min_buckets=2):
+        self.min_buckets = int(min_buckets)
+        self._seen_flags = set()
+
+    def propose(self, signals):
+        flags = tuple(sorted(signals.get("storm_flags") or ()))
+        buckets = signals.get("prefill_buckets")
+        if not flags or not buckets or flags in self._seen_flags:
+            return []
+        self._seen_flags.add(flags)
+        buckets = list(buckets)
+        if len(buckets) <= self.min_buckets:
+            return []
+        coarse = buckets[::2]
+        if coarse[-1] != buckets[-1]:
+            coarse.append(buckets[-1])
+        step_s = signals.get("step_time_s") or 0.0
+        return [make_move(
+            policy=self.name, knob="prefill_buckets", new=coarse,
+            signal={"storm_flags": list(flags),
+                    "n_buckets": len(buckets)},
+            # the pricer: each avoided executable is roughly one step
+            # wall of compile amortization saved
+            predicted_win_s=step_s * (len(buckets) - len(coarse)),
+            reason="recompile storm on {}; coarsening {} -> {} "
+                   "buckets".format(", ".join(flags), len(buckets),
+                                    len(coarse)))]
+
+
+# registry: config "policies" list entries -> classes
+POLICY_REGISTRY = {
+    LaunchAheadPolicy.name: LaunchAheadPolicy,
+    SpeculationPolicy.name: SpeculationPolicy,
+    QuantizedCollectivesPolicy.name: QuantizedCollectivesPolicy,
+    PrefillBucketsPolicy.name: PrefillBucketsPolicy,
+}
+
+CONTROLLER_POLICIES = tuple(sorted(POLICY_REGISTRY))

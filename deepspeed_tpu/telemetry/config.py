@@ -68,10 +68,10 @@ from .programs import (RECOMPILE_STORM_THRESHOLD_DEFAULT,
 from .recorder import (RECORDER_CAPACITY_DEFAULT,
                        RECORDER_MAX_BUNDLES_DEFAULT)
 from .spans import SPANS_MAX_EVENTS_DEFAULT
-from .watchdog import (LOSS_SPIKE_DEFAULTS, NAN_STREAK_DEFAULTS,
-                       POOL_EXHAUSTION_DEFAULTS, STEP_DEADLINE_DEFAULTS,
-                       STRAGGLER_DEFAULTS, TTFT_SLO_DEFAULTS,
-                       WATCHDOG_ACTIONS)
+from .watchdog import (CONTROLLER_DEFAULTS, LOSS_SPIKE_DEFAULTS,
+                       NAN_STREAK_DEFAULTS, POOL_EXHAUSTION_DEFAULTS,
+                       STEP_DEADLINE_DEFAULTS, STRAGGLER_DEFAULTS,
+                       TTFT_SLO_DEFAULTS, WATCHDOG_ACTIONS)
 
 
 def warn_or_raise_noop(msg, strict, flag="telemetry.strict"):
@@ -125,7 +125,7 @@ KNOWN_FLIGHT_RECORDER_KEYS = {"enabled", "capacity", "max_bundles",
                               "output_path", "on_sigterm"}
 KNOWN_WATCHDOG_KEYS = {"enabled", "step_deadline", "nan_streak",
                        "loss_spike", "ttft_slo", "pool_exhaustion",
-                       "straggler"}
+                       "straggler", "controller"}
 KNOWN_PROGRAMS_KEYS = {"recompile_storm_threshold",
                        "replicated_leaf_bytes"}
 KNOWN_METRICS_KEYS = {"enabled", "port", "namespace"}
@@ -286,6 +286,7 @@ class DeepSpeedTelemetryConfig(object):
             "ttft_slo": TTFT_SLO_DEFAULTS,
             "pool_exhaustion": POOL_EXHAUSTION_DEFAULTS,
             "straggler": STRAGGLER_DEFAULTS,
+            "controller": CONTROLLER_DEFAULTS,
         }
         parsed = {}
         for name, base in defaults.items():

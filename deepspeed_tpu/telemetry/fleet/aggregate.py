@@ -67,7 +67,7 @@ FINGERPRINT_KEYS = ("version", "digest", "families")
 FLEET_REPORT_KEYS = (
     "kind", "run_dir", "n_hosts", "hosts", "offsets", "records", "gaps",
     "straggler", "ici_health", "trace", "divergence", "rescale",
-    "router",
+    "router", "controller",
 )
 
 # elastic rescale events (ISSUE 16): file name + kind + schema
@@ -95,6 +95,18 @@ ROUTER_DECISIONS = ("admit", "deny", "route_away", "preempt_migrate",
 # serving-role vocabulary duplicated from telemetry/record.py
 # (SERVING_ROLES), same pin
 SERVING_ROLES = ("monolith", "prefill", "decode", "router")
+
+# runtime-controller decision ledger (ISSUE 20): file name + kind +
+# schema duplicated from runtime/controller/ledger.py (stdlib-import
+# contract); pinned equal by tests/unit/test_controller.py
+CONTROLLER_EVENTS_JSONL = "controller_events.jsonl"
+KIND_CONTROLLER_EVENT = "controller_event"
+DECISION_KEYS = (
+    "kind", "wall", "seq", "event", "decision_id", "policy", "knob",
+    "target", "old", "new", "signal", "predicted_win_s",
+    "measured_win_s", "reason",
+)
+CONTROLLER_EVENT_TYPES = ("decision", "outcome", "revert")
 
 # every merged fleet-step record carries exactly these keys
 FLEET_STEP_KEYS = (
@@ -621,6 +633,48 @@ def merge_run(run_dir, factor=None, k=None, min_hosts=None,
         "decisions": decisions,
         "events": router_events,
     }
+    # runtime-controller decision ledger (ISSUE 20): per-host
+    # controller_events.jsonl files, wall-ordered union + per-event-type
+    # tally + the unreverted-regression list (`ds_fleet --strict` exits
+    # 2 on those: the controller measured itself making things worse
+    # and did NOT undo it)
+    controller_events = []
+    for host in hosts:
+        path = os.path.join(host.path, CONTROLLER_EVENTS_JSONL)
+        if not os.path.exists(path):
+            continue
+        events, problems = read_jsonl_tolerant(path)
+        host.gaps.extend(problems)
+        gaps.extend("{}: {}".format(host.name, p) for p in problems)
+        for ev in events:
+            if isinstance(ev, dict) and \
+                    ev.get("kind") == KIND_CONTROLLER_EVENT:
+                controller_events.append(dict(ev, source=host.name))
+    controller_events.sort(
+        key=lambda ev: ev["wall"]
+        if isinstance(ev.get("wall"), _NUMERIC)
+        and not isinstance(ev.get("wall"), bool) else 0.0)
+    ctrl_tally = {}
+    regressed, reverted_ids = set(), set()
+    for ev in controller_events:
+        etype = ev.get("event")
+        if isinstance(etype, str):
+            ctrl_tally[etype] = ctrl_tally.get(etype, 0) + 1
+        if etype == "revert":
+            reverted_ids.add(ev.get("decision_id"))
+        elif etype == "outcome":
+            win = ev.get("measured_win_s")
+            if isinstance(win, _NUMERIC) and \
+                    not isinstance(win, bool) and win < 0:
+                regressed.add(ev.get("decision_id"))
+    controller = {
+        "count": len(controller_events),
+        "tally": ctrl_tally,
+        "unreverted": sorted(d for d in regressed
+                             if d not in reverted_ids and
+                             d is not None),
+        "events": controller_events,
+    }
     return {
         "kind": KIND_FLEET_REPORT,
         "run_dir": os.path.abspath(run_dir),
@@ -635,6 +689,7 @@ def merge_run(run_dir, factor=None, k=None, min_hosts=None,
         "divergence": divergence,
         "rescale": rescale,
         "router": router,
+        "controller": controller,
     }
 
 
@@ -685,6 +740,46 @@ def merge_chrome_traces(hosts, offsets, out_path):
             if isinstance(ev.get("ts"), _NUMERIC):
                 ev["ts"] = ev["ts"] - offset_us
             merged.append(ev)
+    _rehome_cross_host_requests(merged, len(hosts))
     with open(out_path, "w") as fh:
         json.dump(merged, fh)       # strict JSON: always loadable
     return out_path, len(merged), hosts_merged
+
+
+def _rehome_cross_host_requests(merged, req_pid):
+    """A disaggregated request is ONE trace: spans that carry the same
+    ``args.trace_id`` from two or more host processes (the prefill
+    role's work and the decode role's continuation) are re-homed into
+    a shared ``requests`` process lane, one thread row per trace_id,
+    so the handoff reads as a single per-request timeline instead of
+    two unrelated fragments."""
+    seen = {}                       # trace_id -> set of host pids
+    for ev in merged:
+        tid = _event_trace_id(ev)
+        if tid is not None:
+            seen.setdefault(tid, set()).add(ev.get("pid"))
+    cross = sorted(t for t, pids in seen.items() if len(pids) >= 2)
+    if not cross:
+        return
+    rows = {t: i for i, t in enumerate(cross)}
+    for ev in merged:
+        tid = _event_trace_id(ev)
+        if tid in rows:
+            ev["pid"] = req_pid
+            ev["tid"] = rows[tid]
+    merged.append({"name": "process_name", "ph": "M", "pid": req_pid,
+                   "tid": 0, "ts": 0, "args": {"name": "requests"}})
+    for tid, row in rows.items():
+        merged.append({"name": "thread_name", "ph": "M", "pid": req_pid,
+                       "tid": row, "ts": 0, "args": {"name": tid}})
+
+
+def _event_trace_id(ev):
+    if ev.get("ph") == "M":
+        return None
+    args = ev.get("args")
+    if isinstance(args, dict):
+        tid = args.get("trace_id")
+        if isinstance(tid, str) and tid:
+            return tid
+    return None

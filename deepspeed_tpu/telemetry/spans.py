@@ -198,11 +198,18 @@ class SpanTracer:
         return "s{}".format(next(self._span_counter))
 
     # ------------------------------------------------------------- build
-    def begin(self, name, start_s=None, **attrs):
+    def begin(self, name, start_s=None, trace_id=None, **attrs):
         """Open a new root span (one trace). ``end()`` on it exports the
-        whole tree."""
-        trace_id = "{}-{}".format(self._trace_prefix,
-                                  next(_trace_counter))
+        whole tree. Passing ``trace_id`` CONTINUES an existing trace
+        instead of minting one — the disaggregated prefill -> decode
+        handoff carries the prefill host's trace_id in the page-slice
+        header, so one request stays ONE trace across role processes
+        (ds_fleet merges the fragments into a single request lane)."""
+        if trace_id is None:
+            trace_id = "{}-{}".format(self._trace_prefix,
+                                      next(_trace_counter))
+        else:
+            trace_id = str(trace_id)
         root = Span(self, name, trace_id, self._next_span_id(),
                     parent_id=None, attrs=attrs, start_s=start_s)
         self._open_roots[trace_id] = root

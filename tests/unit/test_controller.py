@@ -1,0 +1,691 @@
+"""Closed-loop runtime controller tests (docs/controller.md): the
+decision-ledger schema and its stdlib pins, the policy decision matrix
+over synthetic signals, the audited apply_override seam, the guardrail
+trip -> crash-bundle dump -> auto-revert round trip, off-is-
+structurally-absent, config validation, the fleet merger's controller
+section + ds_fleet DECISIONS table on a jax-less box, and the DSL012
+knob-write lint."""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.analysis import astlint
+from deepspeed_tpu.runtime.config import DeepSpeedConfigError, \
+    get_controller
+from deepspeed_tpu.runtime.controller import (
+    CONTROLLER_EVENT_TYPES, CONTROLLER_EVENTS_JSONL, CONTROLLER_KNOBS,
+    CONTROLLER_POLICIES, DECISION_KEYS, DecisionLedger,
+    KIND_CONTROLLER_EVENT, POLICY_REGISTRY, RuntimeController,
+    make_controller_event, unreverted_regressions,
+    validate_controller_event)
+from deepspeed_tpu.runtime.controller.policies import (
+    LaunchAheadPolicy, PrefillBucketsPolicy, QuantizedCollectivesPolicy,
+    SpeculationPolicy)
+from deepspeed_tpu.telemetry import record as record_mod
+from deepspeed_tpu.telemetry.config import DeepSpeedTelemetryConfig
+from deepspeed_tpu.telemetry.fleet import aggregate
+from deepspeed_tpu.telemetry.fleet.aggregate import write_host_manifest
+from deepspeed_tpu.telemetry.recorder import FlightRecorder
+from deepspeed_tpu.telemetry.watchdog import Watchdog
+
+pytestmark = pytest.mark.controller
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _load_bin(name):
+    path = os.path.join(_REPO, "bin", name + ".py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------------------ pins
+def test_ledger_schema_pinned_across_stdlib_copies():
+    """One schema, three stdlib copies: the ledger module (source of
+    truth), the jax-free fleet merger, and the bin/ checker."""
+    checker = _load_bin("check_bench_schema")
+    assert tuple(DECISION_KEYS) == tuple(aggregate.DECISION_KEYS)
+    assert tuple(DECISION_KEYS) == tuple(checker.DECISION_KEYS)
+    assert tuple(CONTROLLER_EVENT_TYPES) == \
+        tuple(aggregate.CONTROLLER_EVENT_TYPES)
+    assert tuple(CONTROLLER_EVENT_TYPES) == \
+        tuple(checker.CONTROLLER_EVENT_TYPES)
+    assert CONTROLLER_EVENTS_JSONL == aggregate.CONTROLLER_EVENTS_JSONL
+    assert CONTROLLER_EVENTS_JSONL == checker.CONTROLLER_EVENTS_JSONL
+    assert KIND_CONTROLLER_EVENT == aggregate.KIND_CONTROLLER_EVENT
+    assert KIND_CONTROLLER_EVENT == checker.KIND_CONTROLLER_EVENT
+    assert tuple(CONTROLLER_KNOBS) == tuple(checker.CONTROLLER_KNOBS)
+    assert tuple(record_mod.CONTROLLER_SNAPSHOT_KEYS) == \
+        tuple(checker.CONTROLLER_SNAPSHOT_KEYS)
+    # every configurable policy is registered, and the registry names
+    # ARE the config vocabulary
+    assert tuple(sorted(POLICY_REGISTRY)) == tuple(CONTROLLER_POLICIES)
+
+
+def test_dsl012_attr_set_covers_every_knob():
+    """The lint's attribute vocabulary is the static twin of the knob
+    table: each CONTROLLER_KNOBS entry actuates through at least one
+    attribute DSL012 watches (adapters.py is the mapping)."""
+    attrs = astlint._DSL012_KNOB_ATTRS
+    assert attrs == frozenset({
+        "spec_k", "prefill_chunk_tokens", "prefill_buckets", "windows",
+        "_h2d_bucket_elems", "_qwz_enabled", "_qgz_enabled"})
+    covered = {
+        "launch_ahead_window": "windows",
+        "h2d_bucket_elems": "_h2d_bucket_elems",
+        "spec_k": "spec_k",
+        "prefill_chunk_tokens": "prefill_chunk_tokens",
+        "quantized_collectives": "_qwz_enabled",
+        "prefill_buckets": "prefill_buckets",
+    }
+    assert set(covered) == set(CONTROLLER_KNOBS)
+    assert set(covered.values()) <= attrs
+
+
+# ------------------------------------------------------- event schema
+def test_controller_event_schema_matrix():
+    ev = make_controller_event(
+        event="decision", decision_id="train-0000", policy="speculation",
+        knob="spec_k", old=3, new=4, signal={"acceptance_rate": 0.9},
+        predicted_win_s=0.01, reason="acceptance high")
+    assert validate_controller_event(ev) == []
+    assert sorted(ev) == sorted(DECISION_KEYS)
+    # missing key
+    bad = dict(ev)
+    del bad["signal"]
+    assert any("missing" in p for p in validate_controller_event(bad))
+    # extra key
+    bad = dict(ev, freelance=1)
+    assert any("unexpected" in p for p in validate_controller_event(bad))
+    # unknown event / knob vocabulary
+    assert validate_controller_event(dict(ev, event="ponder")) != []
+    assert validate_controller_event(dict(ev, knob="warp_drive")) != []
+    # a decision must cite its signal
+    assert any("signal" in p for p in validate_controller_event(
+        dict(ev, signal=None)))
+    # an outcome/revert must carry the measurement
+    out = make_controller_event(
+        event="outcome", decision_id="train-0000", policy="speculation",
+        knob="spec_k", measured_win_s=0.004)
+    assert validate_controller_event(out) == []
+    assert any("measured_win_s" in p for p in validate_controller_event(
+        dict(out, measured_win_s=None)))
+
+
+def test_ledger_appends_schema_valid_jsonl(tmp_path):
+    led = DecisionLedger(str(tmp_path))
+    led.emit(event="decision", decision_id="t-0", policy="speculation",
+             knob="spec_k", old=3, new=4, signal={"step": 1})
+    led.emit(event="outcome", decision_id="t-0", policy="speculation",
+             knob="spec_k", measured_win_s=0.002)
+    assert led.path == os.path.join(str(tmp_path),
+                                    CONTROLLER_EVENTS_JSONL)
+    lines = [json.loads(ln) for ln in open(led.path)]
+    assert len(lines) == 2
+    for ev in lines:
+        assert validate_controller_event(ev) == []
+    assert [ev["seq"] for ev in lines] == [0, 1]   # monotone
+    assert led.tally() == {"decision": 1, "outcome": 1}
+    # the bin/ checker accepts the file as-is
+    checker = _load_bin("check_bench_schema")
+    assert checker.check_file(led.path) == []
+    # ...and names the first bad line when one is torn in
+    with open(led.path, "a") as fh:
+        fh.write(json.dumps({"kind": KIND_CONTROLLER_EVENT}) + "\n")
+    assert checker.check_file(led.path) != []
+
+
+def test_unreverted_regressions_from_ledger_alone():
+    def outcome(did, win, base=0.1):
+        return make_controller_event(
+            event="outcome", decision_id=did, policy="p", knob="spec_k",
+            measured_win_s=win, signal={"baseline_s": base})
+
+    revert = make_controller_event(
+        event="revert", decision_id="t-1", policy="p", knob="spec_k",
+        measured_win_s=-0.05)
+    events = [outcome("t-0", 0.01), outcome("t-1", -0.05),
+              outcome("t-2", -0.04), revert]
+    # t-1 regressed but was reverted; t-2 regressed and was NOT
+    assert unreverted_regressions(events) == ["t-2"]
+    # the guardrail floor filters sub-threshold regressions
+    assert unreverted_regressions(events, guardrail_pct=0.45) == []
+
+
+# ------------------------------------------------------ policy matrix
+def test_launch_ahead_policy_widens_waitiest_kind():
+    pol = LaunchAheadPolicy()
+    sig0 = {"exec_per_kind": {"h2d": {"wait_s": 0.0},
+                              "compute": {"wait_s": 0.0}},
+            "exec_busy_s": 0.0, "exec_waits_s": 0.0,
+            "windows": {"h2d": 2, "compute": 1}}
+    assert pol.propose(sig0) == []          # first tick only baselines
+    sig1 = {"exec_per_kind": {"h2d": {"wait_s": 0.30},
+                              "compute": {"wait_s": 0.01}},
+            "exec_busy_s": 1.0, "exec_waits_s": 0.31,
+            "windows": {"h2d": 2, "compute": 1}}
+    moves = pol.propose(sig1)
+    assert len(moves) == 1
+    mv = moves[0]
+    assert mv["knob"] == "launch_ahead_window" and mv["target"] == "h2d"
+    assert mv["new"] == 3
+    assert mv["predicted_win_s"] == pytest.approx(0.15)
+    assert mv["signal"]["wait_frac"] > 0.2   # the citation is measured
+
+
+def test_launch_ahead_policy_grows_h2d_bucket_at_max_window():
+    pol = LaunchAheadPolicy(max_window=2)
+    pol.propose({"exec_per_kind": {"h2d": {"wait_s": 0.0}},
+                 "exec_busy_s": 0.0, "exec_waits_s": 0.0,
+                 "windows": {"h2d": 2}})
+    moves = pol.propose(
+        {"exec_per_kind": {"h2d": {"wait_s": 0.4}},
+         "exec_busy_s": 1.0, "exec_waits_s": 0.4,
+         "windows": {"h2d": 2}, "h2d_bucket_elems": 1 << 20})
+    assert [m["knob"] for m in moves] == ["h2d_bucket_elems"]
+    assert moves[0]["new"] == 2 << 20
+
+
+def test_launch_ahead_policy_decays_idle_windows():
+    pol = LaunchAheadPolicy()
+    pol.propose({"exec_per_kind": {"h2d": {"wait_s": 0.0}},
+                 "exec_busy_s": 0.0, "exec_waits_s": 0.0,
+                 "windows": {"h2d": 4}})
+    moves = pol.propose({"exec_per_kind": {"h2d": {"wait_s": 0.0}},
+                         "exec_busy_s": 1.0, "exec_waits_s": 0.0,
+                         "windows": {"h2d": 4}})
+    assert [(m["knob"], m["target"], m["new"]) for m in moves] == \
+        [("launch_ahead_window", "h2d", 3)]
+
+
+def test_speculation_policy_matrix():
+    pol = SpeculationPolicy()
+    up = pol.propose({"acceptance_rate": 0.9, "spec_k": 3,
+                      "step_time_s": 0.1})
+    assert [(m["knob"], m["new"]) for m in up] == [("spec_k", 4)]
+    down = pol.propose({"acceptance_rate": 0.2, "spec_k": 3})
+    assert [(m["knob"], m["new"]) for m in down] == [("spec_k", 2)]
+    # k floor / ceiling
+    assert pol.propose({"acceptance_rate": 0.2, "spec_k": 1}) == []
+    assert pol.propose({"acceptance_rate": 0.95, "spec_k": 8}) == []
+    # burning TTFT SLO halves the prefill chunk; a green one grows it
+    # back toward (never past) the base
+    burn = pol.propose({"ttft_burn_rate": 1.5,
+                        "prefill_chunk_tokens": 256})
+    assert [(m["knob"], m["new"]) for m in burn] == \
+        [("prefill_chunk_tokens", 128)]
+    back = pol.propose({"ttft_burn_rate": 0.1,
+                        "prefill_chunk_tokens": 128})
+    assert [(m["knob"], m["new"]) for m in back] == \
+        [("prefill_chunk_tokens", 256)]
+    assert pol.propose({"ttft_burn_rate": 0.1,
+                        "prefill_chunk_tokens": 256}) == []
+    # absent signals = no moves (policies tolerate every absence)
+    assert pol.propose({}) == []
+
+
+def test_quantized_collectives_policy_needs_health_and_positive_win():
+    pol = QuantizedCollectivesPolicy()
+    base = {"ici_health": {"h0:reduce_scatter": 0.4},
+            "quantized": {"gradients": False},
+            "wire_win_s": {"gradients": 0.02}}
+    moves = pol.propose(base)
+    assert [(m["knob"], m["target"], m["new"]) for m in moves] == \
+        [("quantized_collectives", "gradients", True)]
+    assert moves[0]["predicted_win_s"] == pytest.approx(0.02)
+    assert moves[0]["signal"]["worst_health"] == pytest.approx(0.4)
+    # degraded link but no predicted win: no move
+    assert pol.propose(dict(base, wire_win_s={})) == []
+    # healthy links un-quantize
+    off = pol.propose({"ici_health": {"h0:reduce_scatter": 0.98},
+                       "quantized": {"gradients": True}})
+    assert [(m["target"], m["new"]) for m in off] == [("gradients",
+                                                       False)]
+    # mid-band: hysteresis, no move either way
+    assert pol.propose({"ici_health": {"h0:reduce_scatter": 0.75},
+                        "quantized": {"gradients": False},
+                        "wire_win_s": {"gradients": 0.02}}) == []
+
+
+def test_prefill_buckets_policy_coarsens_once_per_storm():
+    pol = PrefillBucketsPolicy()
+    sig = {"storm_flags": ["recompile_storm:prefill"],
+           "prefill_buckets": [8, 16, 32, 64, 128], "step_time_s": 0.2}
+    moves = pol.propose(sig)
+    assert len(moves) == 1
+    # every other bucket, largest always kept (admission correctness)
+    assert moves[0]["new"] == [8, 32, 128]
+    assert moves[0]["knob"] == "prefill_buckets"
+    # the same storm flag set never re-fires (act once)
+    assert pol.propose(sig) == []
+    # no storm, no move
+    assert pol.propose({"prefill_buckets": [8, 16]}) == []
+
+
+# ----------------------------------------------------- the seam + loop
+def _cfg(**over):
+    base = {"enabled": True, "interval_steps": 2, "eval_steps": 2,
+            "cooldown_steps": 4, "guardrail_pct": 0.2,
+            "max_moves_per_tick": 1, "policies": ["speculation"]}
+    base.update(over)
+    return base
+
+
+class _Box:
+    """A registered-knob target: one mutable value."""
+
+    def __init__(self, value):
+        self.value = value
+
+
+def _bind(ctrl, knob, box):
+    ctrl.register_knob(knob, lambda target: box.value,
+                       lambda target, value: setattr(box, "value",
+                                                     value))
+
+
+def test_apply_override_is_the_only_actuation_and_always_ledgers(
+        tmp_path):
+    ctrl = RuntimeController(_cfg(), output_dir=str(tmp_path))
+    box = _Box(3)
+    _bind(ctrl, "spec_k", box)
+    # unbound knob: refused, no ledger event, no mutation
+    assert ctrl.apply_override(policy="manual", knob="prefill_buckets",
+                               new=[8], signal={}) is None
+    assert ctrl.ledger.events == []
+    ev = ctrl.apply_override(policy="manual", knob="spec_k", new=5,
+                             signal={"why": "test"}, step=10,
+                             predicted_win_s=0.01, reason="manual move")
+    assert box.value == 5
+    assert ev["event"] == "decision" and ev["old"] == 3 and \
+        ev["new"] == 5
+    assert ev["signal"]["step"] == 10       # the citation carries step
+    assert validate_controller_event(ev) == []
+    # cooldown: the same knob refuses a second move inside the window
+    assert ctrl.apply_override(policy="manual", knob="spec_k", new=6,
+                               signal={}, step=12) is None
+    assert box.value == 5
+    # ...and accepts one after it expires
+    assert ctrl.apply_override(policy="manual", knob="spec_k", new=6,
+                               signal={}, step=15) is not None
+    # no-op moves (old == new) never ledger
+    n = len(ctrl.ledger.events)
+    assert ctrl.apply_override(policy="manual", knob="spec_k", new=6,
+                               signal={}, step=40) is None
+    assert len(ctrl.ledger.events) == n
+    snap = ctrl.snapshot()
+    assert record_mod.validate_controller_snapshot(snap) == []
+    assert snap["decisions"] == 2 and snap["pending"] == 2
+
+
+def test_outcome_measures_win_and_drift(tmp_path):
+    ctrl = RuntimeController(_cfg(), output_dir=str(tmp_path))
+    box = _Box(3)
+    _bind(ctrl, "spec_k", box)
+    for step in range(4):                    # baseline: 0.1 s steps
+        ctrl.on_step(step, 0.1)
+    ctrl.apply_override(policy="manual", knob="spec_k", new=5,
+                        signal={}, step=3, predicted_win_s=0.02)
+    for step in range(4, 8):                 # after: 0.06 s steps
+        ctrl.on_step(step, 0.06)
+    outs = [e for e in ctrl.ledger.events if e["event"] == "outcome"]
+    assert len(outs) == 1
+    out = outs[0]
+    assert out["measured_win_s"] == pytest.approx(0.04)
+    assert out["signal"]["baseline_s"] == pytest.approx(0.1)
+    assert ctrl.drift == pytest.approx(0.5)  # predicted 0.02 / won 0.04
+    assert box.value == 5                    # an improvement stays
+    assert unreverted_regressions(ctrl.ledger.events,
+                                  guardrail_pct=0.2) == []
+
+
+def test_guardrail_trip_dumps_ledger_and_reverts(tmp_path):
+    """The whole episode: a bad move regresses past the guardrail, the
+    controller watchdog trips, the crash bundle carries the full
+    ledger (every decision replayable from the dump alone), the knob
+    reverts through the same seam, and the revert is a ledger event."""
+
+    class _Tel:
+        output_dir = str(tmp_path)
+        recorder = FlightRecorder(str(tmp_path), job_name="t")
+        watchdog = None
+        metrics = None
+
+    tel = _Tel()
+    tel.watchdog = Watchdog({"controller": {"action": "dump"}},
+                            recorder=tel.recorder, job_name="t")
+    ctrl = RuntimeController(_cfg(), telemetry=tel, role="serve")
+    box = _Box(3)
+    _bind(ctrl, "spec_k", box)
+    for step in range(4):
+        ctrl.on_step(step, 0.1)
+    ctrl.apply_override(policy="manual", knob="spec_k", new=8,
+                        signal={"why": "deliberately bad"}, step=3,
+                        predicted_win_s=0.01)
+    for step in range(4, 8):                 # 2x regression: 0.2 s
+        ctrl.on_step(step, 0.2)
+    # reverted through the seam, counted, cooled down
+    assert box.value == 3
+    assert ctrl.reverts == 1
+    events = ctrl.ledger.snapshot()
+    assert [e["event"] for e in events] == ["decision", "outcome",
+                                            "revert"]
+    revert = events[-1]
+    assert revert["decision_id"] == events[0]["decision_id"]
+    assert revert["old"] == 8 and revert["new"] == 3   # the undo
+    assert revert["measured_win_s"] == pytest.approx(-0.1)
+    # the ledger itself proves the regression was handled
+    assert unreverted_regressions(events, guardrail_pct=0.2) == []
+    # the watchdog tripped and dumped
+    trips = list(tel.watchdog.trips)
+    assert [t["watchdog"] for t in trips] == ["controller"]
+    bundles = [os.path.join(str(tmp_path), n)
+               for n in sorted(os.listdir(str(tmp_path)))
+               if n.startswith("bundle_") and n.endswith(".json")]
+    assert len(bundles) == 1
+    bundle = json.load(open(bundles[0]))
+    assert bundle["reason"] == "watchdog:controller"
+    state = bundle["state"]["controller"]
+    # the bundle snapshot is from BEFORE the revert (the trip fires
+    # first, so the dump shows the regressing override still applied)
+    assert state["enabled"] is True and state["role"] == "serve"
+    assert [e["event"] for e in state["events"]] == ["decision",
+                                                     "outcome"]
+    checker = _load_bin("check_bench_schema")
+    for i, ev in enumerate(state["events"]):
+        assert checker.check_controller_event(ev, "ev[{}]".format(i)) \
+            == []
+    # the on-disk ledger has all three events and validates
+    assert checker.check_file(ctrl.ledger.path) == []
+    assert len([json.loads(ln) for ln in open(ctrl.ledger.path)]) == 3
+
+
+def test_policy_exception_never_kills_the_tick(tmp_path):
+    class _Bomb:
+        name = "bomb"
+
+        def propose(self, signals):
+            raise RuntimeError("boom")
+
+    ctrl = RuntimeController(_cfg(policies=["speculation"]),
+                             output_dir=str(tmp_path))
+    ctrl.policies.insert(0, _Bomb())
+    box = _Box(3)
+    _bind(ctrl, "spec_k", box)
+    # the bomb fires first, the speculation policy still runs
+    ctrl.on_step(0, 0.1, {"acceptance_rate": 0.95, "spec_k": 3})
+    assert box.value == 4
+    assert ctrl.decisions == 1
+
+
+# --------------------------------------------------- config validation
+def test_controller_config_matrix():
+    assert get_controller({}) is None
+    assert get_controller({"controller": False}) is None
+    assert get_controller({"controller": {"enabled": False}}) is None
+    cfg = get_controller({"controller": True})
+    assert cfg == {"enabled": True, "interval_steps": 20,
+                   "eval_steps": 20, "cooldown_steps": 40,
+                   "guardrail_pct": 0.2, "max_moves_per_tick": 1,
+                   "policies": list(CONTROLLER_POLICIES)}
+    cfg = get_controller({"controller": {
+        "interval_steps": 5, "policies": ["speculation"]}})
+    assert cfg["interval_steps"] == 5 and \
+        cfg["policies"] == ["speculation"]
+    with pytest.raises(DeepSpeedConfigError, match="unknown key"):
+        get_controller({"controller": {"intervall_steps": 5}})
+    with pytest.raises(DeepSpeedConfigError, match="interval_steps"):
+        get_controller({"controller": {"interval_steps": 0}})
+    with pytest.raises(DeepSpeedConfigError, match="guardrail_pct"):
+        get_controller({"controller": {"guardrail_pct": -0.5}})
+    with pytest.raises(DeepSpeedConfigError, match="unknown policy"):
+        get_controller({"controller": {"policies": ["warp_drive"]}})
+    with pytest.raises(DeepSpeedConfigError, match="policies"):
+        get_controller({"controller": {"policies": []}})
+
+
+# ------------------------------------------- serving engine integration
+def _serve_engine(tmp_path, controller=None, drafter=False):
+    from deepspeed_tpu.models import gpt2
+    cfg = gpt2.GPT2Config(vocab_size=64, max_seq_len=32, n_layers=1,
+                          n_heads=2, d_model=16,
+                          use_flash_attention=False, remat=False)
+    inf = {"max_batch_size": 2, "prefill_buckets": [8, 16],
+           "dtype": "fp32", "greedy": True, "max_new_tokens": 3,
+           "kv_layout": "paged", "kv_block_size": 4}
+    if drafter:
+        inf["speculative"] = {"enabled": True, "method": "ngram",
+                              "num_draft_tokens": 3}
+    config = {"inference": inf,
+              "telemetry": {"enabled": True,
+                            "output_path": str(tmp_path)}}
+    if controller is not None:
+        config["controller"] = controller
+    return deepspeed_tpu.init_inference(
+        model=gpt2.make_gpt2_model(config=cfg), config=config)
+
+
+def test_controller_off_is_structurally_absent(tmp_path):
+    engine = _serve_engine(tmp_path)
+    try:
+        assert engine.controller is None
+        snap = engine.telemetry.snapshot()
+        assert "controller" not in snap
+        assert "controller" not in engine.telemetry.healthz()
+        assert not os.path.exists(os.path.join(
+            engine.telemetry.output_dir, CONTROLLER_EVENTS_JSONL))
+    finally:
+        engine.telemetry.close()
+
+
+def test_serving_controller_attaches_and_surfaces_snapshot(tmp_path):
+    engine = _serve_engine(tmp_path, controller=True, drafter=True)
+    try:
+        ctrl = engine.controller
+        assert ctrl is not None and ctrl.role == "serve"
+        assert ctrl.knobs == ["prefill_buckets", "spec_k"]
+        from deepspeed_tpu.inference.scheduler import \
+            ContinuousBatchingScheduler
+        sched = ContinuousBatchingScheduler(engine)
+        sched.submit([2, 3, 5, 7])
+        while sched.has_work:
+            sched.step()
+        assert sched.results                 # the request retired
+        # the controller ticked from the scheduler step path
+        assert ctrl._objective
+        snap = engine.telemetry.snapshot()
+        assert record_mod.validate_controller_snapshot(
+            snap["controller"]) == []
+        assert snap["controller"]["role"] == "serve"
+        assert engine.telemetry.healthz()["controller"]["enabled"]
+        # a forced move through the seam actuates the live engine knob
+        old_k = engine.spec_k
+        ctrl.apply_override(policy="manual", knob="spec_k",
+                            new=old_k + 1, signal={}, step=999)
+        assert engine.spec_k == old_k + 1
+    finally:
+        engine.telemetry.close()
+
+
+# ------------------------------------------------- fleet merge + CLI
+def _host_with_controller_events(root, name, events):
+    d = os.path.join(str(root), name)
+    os.makedirs(d, exist_ok=True)
+    write_host_manifest(d, job_name=name)
+    with open(os.path.join(d, aggregate.JSONL_NAME), "w") as fh:
+        fh.write(json.dumps({"kind": "train_step", "step": 0,
+                             "wall": 1000.0}) + "\n")
+    with open(os.path.join(d, CONTROLLER_EVENTS_JSONL), "w") as fh:
+        for ev in events:
+            fh.write(json.dumps(ev) + "\n")
+    return d
+
+
+def _episode(role, *, revert):
+    dec = make_controller_event(
+        event="decision", decision_id=role + "-0000", policy="manual",
+        knob="spec_k", old=3, new=8, signal={"step": 3}, wall=1001.0)
+    out = make_controller_event(
+        event="outcome", decision_id=role + "-0000", policy="manual",
+        knob="spec_k", old=3, new=8, measured_win_s=-0.1,
+        signal={"baseline_s": 0.1}, wall=1002.0, seq=1)
+    events = [dec, out]
+    if revert:
+        events.append(make_controller_event(
+            event="revert", decision_id=role + "-0000", policy="manual",
+            knob="spec_k", old=8, new=3, measured_win_s=-0.1,
+            wall=1003.0, seq=2))
+    return events
+
+
+def test_merge_run_controller_section_and_checker(tmp_path):
+    _host_with_controller_events(tmp_path, "h0",
+                                 _episode("serve", revert=True))
+    _host_with_controller_events(tmp_path, "h1",
+                                 _episode("train", revert=False))
+    report = aggregate.merge_run(str(tmp_path))
+    ctrl = report["controller"]
+    assert ctrl["count"] == 5
+    assert ctrl["tally"] == {"decision": 2, "outcome": 2, "revert": 1}
+    # h1's regression was never undone; h0's was
+    assert ctrl["unreverted"] == ["train-0000"]
+    # wall-ordered union with host attribution
+    assert [ev["source"] for ev in ctrl["events"]].count("h0") == 3
+    assert ctrl["events"][0]["wall"] <= ctrl["events"][-1]["wall"]
+    # the checker accepts the merged report artifact
+    checker = _load_bin("check_bench_schema")
+    rpath = os.path.join(str(tmp_path), "fleet_report.json")
+    with open(rpath, "w") as fh:
+        json.dump(report, fh)
+    assert checker.check_file(rpath) == []
+    # ...and rejects one missing the section
+    del report["controller"]
+    with open(rpath, "w") as fh:
+        json.dump(report, fh)
+    assert checker.check_file(rpath) != []
+
+
+def test_ds_fleet_decisions_table_and_strict_without_jax(tmp_path):
+    """The DECISIONS table + --strict unreverted-regression exit must
+    run on a jax-less box (the stdlib doctoring contract)."""
+    _host_with_controller_events(tmp_path, "h0",
+                                 _episode("serve", revert=False))
+    poison = tmp_path / "poison"
+    poison.mkdir()
+    (poison / "jax.py").write_text(
+        "raise ImportError('no jax on this box (test_controller)')\n")
+    env = dict(os.environ, PYTHONPATH=str(poison))
+    cmd = [sys.executable, os.path.join(_REPO, "bin", "ds_fleet.py"),
+           str(tmp_path), "--strict"]
+    out = subprocess.run(cmd, capture_output=True, text=True, env=env)
+    assert out.returncode == 2, (out.stdout, out.stderr)
+    assert "CONTROLLER DECISIONS" in out.stdout
+    assert "UNREVERTED REGRESSIONS: serve-0000" in out.stdout
+    assert "manual/spec_k" in out.stdout
+    # with the revert in the ledger, strict passes
+    _host_with_controller_events(tmp_path, "h0",
+                                 _episode("serve", revert=True))
+    out = subprocess.run(cmd, capture_output=True, text=True, env=env)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "1 revert" in out.stdout
+
+
+# ----------------------------------------------------------- DSL012
+_KNOB_WRITE_SRC = """
+class Engine:
+    def retune(self):
+        self.spec_k = 5
+        self.plan_executor().windows["h2d"] = 4
+
+    def grow(self):
+        self.prefill_chunk_tokens += 64
+"""
+
+
+def test_dsl012_fires_outside_controller_dir(tmp_path):
+    src = tmp_path / "rogue.py"
+    src.write_text(_KNOB_WRITE_SRC)
+    found = astlint.lint_file(str(src),
+                              relpath="deepspeed_tpu/inference/rogue.py")
+    rules = [(rule, line) for rule, _, line, _ in found
+             if rule == "DSL012"]
+    assert len(rules) == 3                  # attr, subscript, augassign
+    # unrelated attribute names stay silent
+    quiet = tmp_path / "quiet.py"
+    quiet.write_text("class A:\n    def f(self):\n"
+                     "        self.windows_completed = 1\n")
+    assert astlint.lint_file(
+        str(quiet), relpath="deepspeed_tpu/inference/quiet.py") == []
+
+
+def test_dsl012_inert_in_controller_and_config_parsers(tmp_path):
+    src = tmp_path / "adapters.py"
+    src.write_text(_KNOB_WRITE_SRC)
+    for rel in ("deepspeed_tpu/runtime/controller/adapters.py",
+                "deepspeed_tpu/runtime/config.py",
+                "deepspeed_tpu/inference/config.py"):
+        found = astlint.lint_file(str(src), relpath=rel)
+        assert [f for f in found if f[0] == "DSL012"] == [], rel
+
+
+def test_repo_self_lint_is_baseline_clean():
+    """Every knob write in the tree is either inside the controller
+    seam or a reviewed construction-time baseline entry."""
+    findings = astlint.lint_paths(
+        [os.path.join(_REPO, "deepspeed_tpu")], base=_REPO)
+    baseline = astlint.load_baseline(
+        os.path.join(_REPO, "bin", "ds_lint_baseline.json"))
+    new, _stale = astlint.diff_baseline(findings, baseline)
+    dsl012 = [f for f in new if f.rule == "DSL012"]
+    assert dsl012 == [], [f.message for f in dsl012]
+
+
+# ------------------------------------------------- trace_id satellite
+def test_page_slice_carries_trace_id_across_the_wire():
+    np = pytest.importorskip("numpy")
+    from deepspeed_tpu.inference.fleet.handoff import (PageSlice,
+                                                       deserialize_slice,
+                                                       serialize_slice)
+    k = np.arange(2 * 1 * 2 * 4 * 3, dtype=np.float32).reshape(
+        2, 1, 2, 4, 3)
+    sl = PageSlice(k, k + 1, page_size=4, length=5, pending_token=7,
+                   context=[1, 2, 3, 4, 5], trace_id="serve-9-12")
+    back = deserialize_slice(serialize_slice(sl))
+    assert back.trace_id == "serve-9-12"
+    # absence stays None (older slices, spans off)
+    sl2 = PageSlice(k, k, page_size=4, length=5, pending_token=7,
+                    context=[1])
+    assert deserialize_slice(serialize_slice(sl2)).trace_id is None
+
+
+def test_span_tracer_continues_a_carried_trace_id():
+    from deepspeed_tpu.telemetry.spans import SpanTracer
+    tracer = SpanTracer([])
+    cont = tracer.begin("serving_request", trace_id="prefill-1-0")
+    assert cont.trace_id == "prefill-1-0"
+    minted = tracer.begin("serving_request")
+    assert minted.trace_id != "prefill-1-0"
+
+
+def test_merged_trace_rehomes_cross_host_requests():
+    ev = lambda pid, tid_arg: {"name": "s", "ph": "X", "ts": 1.0,
+                               "dur": 1.0, "pid": pid, "tid": 0,
+                               "args": {"trace_id": tid_arg}}
+    merged = [ev(0, "req-a"), ev(1, "req-a"),    # crosses hosts
+              ev(0, "req-b"),                    # single-host: stays
+              {"name": "x", "ph": "X", "ts": 0.0, "dur": 1.0,
+               "pid": 1, "tid": 3}]              # no trace_id: stays
+    aggregate._rehome_cross_host_requests(merged, req_pid=2)
+    assert [e["pid"] for e in merged[:4]] == [2, 2, 0, 1]
+    assert merged[0]["tid"] == merged[1]["tid"]
+    names = [e for e in merged if e.get("ph") == "M"]
+    assert {(m["name"], m["args"]["name"]) for m in names} == \
+        {("process_name", "requests"), ("thread_name", "req-a")}
